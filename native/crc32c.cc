@@ -1,0 +1,175 @@
+// CRC-32C (Castagnoli) — hardware-accelerated host implementation.
+//
+// TPU-native rebuild of the reference's hashing layer
+// (reference: src/v/hashing/crc32c.h:15-29, which wraps google/crc32c).
+// Semantics match `crc::crc32c` there: reflected CRC-32C, polynomial
+// 0x1EDC6F41, init/final-xor 0xFFFFFFFF, with an `extend` API so the
+// checksum of a fragmented buffer can be computed incrementally
+// (reference: src/v/hashing/crc32c.h:46 crc_extend_iobuf).
+//
+// Two engines:
+//  * SSE4.2 `crc32` instruction, 8 bytes per issue (x86-64 hosts).
+//  * slice-by-8 table fallback (also used to cross-check the HW path
+//    in tests, and as the portable build).
+//
+// Also exposes rp_crc32c_combine(crcA, crcB, lenB) — GF(2) matrix
+// shift trick (same math zlib uses for crc32_combine) — which is what
+// lets the device-side batched CRC kernel chunk a payload, checksum the
+// chunks in parallel lanes, and stitch the results back together.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#if defined(__x86_64__) || defined(_M_X64)
+#include <nmmintrin.h>
+#define RP_HAVE_SSE42 1
+#endif
+
+namespace {
+
+constexpr uint32_t kPoly = 0x82F63B78u;  // reflected 0x1EDC6F41
+
+struct Tables {
+    uint32_t t[8][256];
+    Tables() {
+        for (uint32_t n = 0; n < 256; n++) {
+            uint32_t c = n;
+            for (int k = 0; k < 8; k++) c = (c & 1) ? (kPoly ^ (c >> 1)) : (c >> 1);
+            t[0][n] = c;
+        }
+        for (uint32_t n = 0; n < 256; n++) {
+            uint32_t c = t[0][n];
+            for (int k = 1; k < 8; k++) {
+                c = t[0][c & 0xff] ^ (c >> 8);
+                t[k][n] = c;
+            }
+        }
+    }
+};
+
+uint32_t crc32c_sw_raw(uint32_t crc, const uint8_t* buf, size_t len) {
+    // Thread-safe lazy init: C++ magic statics (ctypes calls drop the
+    // GIL, so concurrent first calls are possible).
+    static const Tables tables;
+    const auto& g_table = tables.t;
+    while (len && ((uintptr_t)buf & 7)) {
+        crc = g_table[0][(crc ^ *buf++) & 0xff] ^ (crc >> 8);
+        len--;
+    }
+    while (len >= 8) {
+        uint64_t word;
+        memcpy(&word, buf, 8);
+        word ^= crc;
+        crc = g_table[7][word & 0xff] ^ g_table[6][(word >> 8) & 0xff]
+            ^ g_table[5][(word >> 16) & 0xff] ^ g_table[4][(word >> 24) & 0xff]
+            ^ g_table[3][(word >> 32) & 0xff] ^ g_table[2][(word >> 40) & 0xff]
+            ^ g_table[1][(word >> 48) & 0xff] ^ g_table[0][(word >> 56) & 0xff];
+        buf += 8;
+        len -= 8;
+    }
+    while (len--) crc = g_table[0][(crc ^ *buf++) & 0xff] ^ (crc >> 8);
+    return crc;
+}
+
+#ifdef RP_HAVE_SSE42
+uint32_t crc32c_hw_raw(uint32_t crc, const uint8_t* buf, size_t len) {
+    uint64_t c = crc;
+    while (len && ((uintptr_t)buf & 7)) {
+        c = _mm_crc32_u8((uint32_t)c, *buf++);
+        len--;
+    }
+    while (len >= 8) {
+        uint64_t word;
+        memcpy(&word, buf, 8);
+        c = _mm_crc32_u64(c, word);
+        buf += 8;
+        len -= 8;
+    }
+    while (len >= 4) {
+        uint32_t word;
+        memcpy(&word, buf, 4);
+        c = _mm_crc32_u32((uint32_t)c, word);
+        buf += 4;
+        len -= 4;
+    }
+    while (len--) c = _mm_crc32_u8((uint32_t)c, *buf++);
+    return (uint32_t)c;
+}
+#endif
+
+// --- GF(2) matrix ops for crc combine (zlib crc32_combine scheme) ---
+
+uint32_t gf2_matrix_times(const uint32_t* mat, uint32_t vec) {
+    uint32_t sum = 0;
+    while (vec) {
+        if (vec & 1) sum ^= *mat;
+        vec >>= 1;
+        mat++;
+    }
+    return sum;
+}
+
+void gf2_matrix_square(uint32_t* square, const uint32_t* mat) {
+    for (int n = 0; n < 32; n++) square[n] = gf2_matrix_times(mat, mat[n]);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Extend `crc` (a finalized CRC-32C value, or 0 for a fresh start) over
+// `len` bytes. Matches crc32c::Extend / crc::crc32c::extend semantics.
+uint32_t rp_crc32c(uint32_t crc, const uint8_t* buf, size_t len) {
+    uint32_t c = crc ^ 0xffffffffu;
+#ifdef RP_HAVE_SSE42
+    c = crc32c_hw_raw(c, buf, len);
+#else
+    c = crc32c_sw_raw(c, buf, len);
+#endif
+    return c ^ 0xffffffffu;
+}
+
+uint32_t rp_crc32c_sw(uint32_t crc, const uint8_t* buf, size_t len) {
+    uint32_t c = crc ^ 0xffffffffu;
+    c = crc32c_sw_raw(c, buf, len);
+    return c ^ 0xffffffffu;
+}
+
+// crc(A ++ B) given crc(A), crc(B), len(B).
+uint32_t rp_crc32c_combine(uint32_t crc1, uint32_t crc2, uint64_t len2) {
+    if (len2 == 0) return crc1;
+    uint32_t even[32];
+    uint32_t odd[32];
+    odd[0] = kPoly;
+    uint32_t row = 1;
+    for (int n = 1; n < 32; n++) {
+        odd[n] = row;
+        row <<= 1;
+    }
+    gf2_matrix_square(even, odd);  // x^2
+    gf2_matrix_square(odd, even);  // x^4
+    do {
+        gf2_matrix_square(even, odd);
+        if (len2 & 1) crc1 = gf2_matrix_times(even, crc1);
+        len2 >>= 1;
+        if (!len2) break;
+        gf2_matrix_square(odd, even);
+        if (len2 & 1) crc1 = gf2_matrix_times(odd, crc1);
+        len2 >>= 1;
+    } while (len2);
+    return crc1 ^ crc2;
+}
+
+// Batched extend: n buffers laid out contiguously, each `stride` bytes
+// apart, `lens[i]` meaningful bytes. Feeds the host-side record-batch
+// validator (reference: src/v/model/record.h:763 record_batch_crc_checker)
+// and serves as the CPU baseline for the Pallas batched-CRC kernel.
+void rp_crc32c_batch(const uint8_t* bufs, size_t stride, const uint64_t* lens,
+                     uint32_t* out, size_t n) {
+    for (size_t i = 0; i < n; i++) {
+        out[i] = rp_crc32c(0, bufs + i * stride, lens[i]);
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,23 @@
+"""redpanda_tpu — a TPU-native streaming data platform.
+
+A brand-new framework with the capabilities of the reference
+(sarvex/redpanda, a Kafka-API-compatible, Raft-replicated streaming
+broker): host data plane in Python-async + native C++ hot paths, with
+all per-partition consensus state laid out as struct-of-arrays and
+stepped by batched JAX/XLA/Pallas kernels — quorum/commit decisions for
+tens of thousands of partitions in one device call.
+
+Layer map (mirrors SURVEY.md §1):
+  utils/        foundation: iobuf, crc32c, vint, named types
+  compression/  codec registry (gzip/snappy/lz4/zstd + device backend slot)
+  models/       record/record_batch data model + consensus state tensors
+  ops/          device kernels: batched quorum, batched crc32c, codecs
+  parallel/     device mesh, shardings, collective cluster step
+  storage/      kvstore + segment log engine
+  rpc/          framed async RPC with correlation multiplexing
+  raft/         per-partition consensus; scalar + TPU batched backends
+  cluster/      controller, topic table, partition/shard management
+  kafka/        Kafka wire protocol, server handlers, internal client
+"""
+
+__version__ = "0.1.0"

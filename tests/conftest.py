@@ -1,0 +1,20 @@
+"""Test harness configuration.
+
+Force JAX onto a virtual 8-device CPU platform so multi-chip sharding
+(mesh/pjit/shard_map paths) is exercised without TPU hardware — the
+strategy SURVEY.md §4.2 calls for (multi-"node" testing in one
+process). Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
