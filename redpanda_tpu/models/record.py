@@ -1,0 +1,504 @@
+"""Record / record-batch data model with dual CRC.
+
+Reference: src/v/model/record.h — `record`, `record_batch`,
+`record_batch_header` carrying two checksums:
+
+* `crc` — the Kafka-compatible CRC-32C over the batch body exactly as
+  it appears on the Kafka wire from the `attributes` field onward
+  (reference: model/record.h:398-400, model/record_utils.h:23-31).
+* `header_crc` — CRC-32C over the *internal* batch header fields
+  (little-endian), protecting the broker-side metadata the Kafka CRC
+  does not cover (reference: model/record.h:392, recompute at
+  model/record.h:659-660).
+
+The on-disk / internal representation here is: a fixed 69-byte
+little-endian internal header followed by the body (the Kafka v2
+records section, possibly compressed). Conversion to/from the Kafka
+wire batch framing (base_offset/batch_length/leader_epoch/magic + the
+CRC-covered section) is loss-free; the CRC-covered section is stored
+verbatim so produce → store → fetch never recomputes payload bytes.
+
+Batched validation: `batch_crcs` stages many bodies into one padded
+uint8 matrix for the host native batch CRC (and, through the same
+layout, the device kernel in ops.crc32c) — the
+`record_batch_crc_checker` (reference: model/record.h:763-781) turned
+into one vectorized call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+import time
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from .. import compression as compression_mod
+from ..compression import CompressionType
+from ..utils import crc as crc_mod
+from ..utils import vint
+from ..utils.iobuf import IOBufParser
+
+
+class RecordBatchType(enum.IntEnum):
+    """Reference: src/v/model/record_batch_types.h:21-41."""
+
+    raft_data = 1
+    raft_configuration = 2
+    controller = 3
+    kvstore = 4
+    checkpoint = 5
+    topic_management_cmd = 6
+    ghost_batch = 7
+    id_allocator = 8
+    tx_prepare = 9
+    tx_fence = 10
+    tm_update = 11
+    user_management_cmd = 12
+    acl_management_cmd = 13
+    group_prepare_tx = 14
+    group_commit_tx = 15
+    group_abort_tx = 16
+    node_management_cmd = 17
+    data_policy_management_cmd = 18
+    archival_metadata = 19
+    cluster_config_cmd = 20
+    feature_update = 21
+    cluster_bootstrap_cmd = 22
+
+
+# attribute bit layout (Kafka batch attributes, i16)
+_COMPRESSION_MASK = 0x07
+_TIMESTAMP_TYPE_BIT = 1 << 3
+_TRANSACTIONAL_BIT = 1 << 4
+_CONTROL_BIT = 1 << 5
+
+# internal header: header_crc | size_bytes | base_offset | type | crc |
+# attrs | last_offset_delta | first_timestamp | max_timestamp |
+# producer_id | producer_epoch | base_sequence | record_count | term
+_HDR = struct.Struct("<IiqbIhiqqqhiiq")
+HEADER_SIZE = _HDR.size  # 69 bytes
+
+# Kafka wire: fixed section after batch_length field
+_KAFKA_WIRE = struct.Struct(">qiibIhiqqqhii")
+KAFKA_BATCH_OVERHEAD = _KAFKA_WIRE.size  # 61: base_offset..record_count
+# bytes after the batch_length field, excluding records
+_KAFKA_AFTER_LEN = KAFKA_BATCH_OVERHEAD - 12  # minus base_offset+batch_length
+# the crc-covered prefix rebuilt from header fields (attributes onward)
+_CRC_PREFIX = struct.Struct(">hiqqqhii")
+
+
+@dataclasses.dataclass(slots=True)
+class RecordHeader:
+    key: bytes
+    value: bytes
+
+
+@dataclasses.dataclass(slots=True)
+class Record:
+    """One record inside a batch (reference: model/record.h record)."""
+
+    attributes: int = 0
+    timestamp_delta: int = 0
+    offset_delta: int = 0
+    key: bytes | None = None
+    value: bytes | None = None
+    headers: list[RecordHeader] = dataclasses.field(default_factory=list)
+
+    def encode(self) -> bytes:
+        body = bytearray()
+        body += bytes([self.attributes & 0xFF])
+        body += vint.encode(self.timestamp_delta)
+        body += vint.encode(self.offset_delta)
+        if self.key is None:
+            body += vint.encode(-1)
+        else:
+            body += vint.encode(len(self.key))
+            body += self.key
+        if self.value is None:
+            body += vint.encode(-1)
+        else:
+            body += vint.encode(len(self.value))
+            body += self.value
+        body += vint.encode(len(self.headers))
+        for h in self.headers:
+            body += vint.encode(len(h.key))
+            body += h.key
+            body += vint.encode(len(h.value))
+            body += h.value
+        return bytes(vint.encode(len(body))) + bytes(body)
+
+    @staticmethod
+    def decode(parser: IOBufParser) -> "Record":
+        length = parser.read_vint()
+        end = parser.pos() + length
+        attrs = parser.read(1)[0]
+        ts_delta = parser.read_vint()
+        off_delta = parser.read_vint()
+        klen = parser.read_vint()
+        key = parser.read(klen) if klen >= 0 else None
+        vlen = parser.read_vint()
+        value = parser.read(vlen) if vlen >= 0 else None
+        hcount = parser.read_vint()
+        headers = []
+        for _ in range(hcount):
+            hklen = parser.read_vint()
+            hk = parser.read(hklen) if hklen >= 0 else b""
+            hvlen = parser.read_vint()
+            hv = parser.read(hvlen) if hvlen >= 0 else b""
+            headers.append(RecordHeader(hk, hv))
+        if parser.pos() != end:
+            raise ValueError(
+                f"record length mismatch: declared {length}, consumed {parser.pos() - (end - length)}"
+            )
+        return Record(attrs, ts_delta, off_delta, key, value, headers)
+
+
+@dataclasses.dataclass(slots=True)
+class RecordBatchHeader:
+    """Internal batch header (reference: model/record.h:370-420)."""
+
+    header_crc: int = 0
+    size_bytes: int = 0
+    base_offset: int = 0
+    type: RecordBatchType = RecordBatchType.raft_data
+    crc: int = 0
+    attrs: int = 0
+    last_offset_delta: int = 0
+    first_timestamp: int = 0
+    max_timestamp: int = 0
+    producer_id: int = -1
+    producer_epoch: int = -1
+    base_sequence: int = -1
+    record_count: int = 0
+    term: int = -1  # raft term (reference: ctx.term), maps to leader_epoch
+
+    @property
+    def last_offset(self) -> int:
+        return self.base_offset + self.last_offset_delta
+
+    @property
+    def compression(self) -> CompressionType:
+        return CompressionType(self.attrs & _COMPRESSION_MASK)
+
+    @property
+    def is_transactional(self) -> bool:
+        return bool(self.attrs & _TRANSACTIONAL_BIT)
+
+    @property
+    def is_control(self) -> bool:
+        return bool(self.attrs & _CONTROL_BIT)
+
+    def pack(self) -> bytes:
+        return _HDR.pack(
+            self.header_crc,
+            self.size_bytes,
+            self.base_offset,
+            int(self.type),
+            self.crc & 0xFFFFFFFF,
+            self.attrs,
+            self.last_offset_delta,
+            self.first_timestamp,
+            self.max_timestamp,
+            self.producer_id,
+            self.producer_epoch,
+            self.base_sequence,
+            self.record_count,
+            self.term,
+        )
+
+    @staticmethod
+    def unpack(data: bytes) -> "RecordBatchHeader":
+        f = _HDR.unpack(data[:HEADER_SIZE])
+        return RecordBatchHeader(
+            header_crc=f[0],
+            size_bytes=f[1],
+            base_offset=f[2],
+            type=RecordBatchType(f[3]),
+            crc=f[4],
+            attrs=f[5],
+            last_offset_delta=f[6],
+            first_timestamp=f[7],
+            max_timestamp=f[8],
+            producer_id=f[9],
+            producer_epoch=f[10],
+            base_sequence=f[11],
+            record_count=f[12],
+            term=f[13],
+        )
+
+    def compute_header_crc(self) -> int:
+        """CRC-32C over the internal header minus the header_crc field
+        itself (reference: model/record_utils.cc crc_record_batch_header)."""
+        return crc_mod.crc32c(self.pack()[4:])
+
+    def crc_prefix(self) -> bytes:
+        """The Kafka-wire bytes between the crc field and the records
+        section — what the Kafka `crc` covers together with the body."""
+        return _CRC_PREFIX.pack(
+            self.attrs,
+            self.last_offset_delta,
+            self.first_timestamp,
+            self.max_timestamp,
+            self.producer_id,
+            self.producer_epoch,
+            self.base_sequence,
+            self.record_count,
+        )
+
+
+class RecordBatch:
+    """Header + body (records section bytes, possibly compressed)."""
+
+    __slots__ = ("header", "body")
+
+    def __init__(self, header: RecordBatchHeader, body: bytes):
+        self.header = header
+        self.body = body
+
+    # -- integrity ---------------------------------------------------
+    def compute_crc(self) -> int:
+        """Kafka-compatible batch CRC (reference: model/record.h:398)."""
+        return crc_mod.crc32c(self.body, crc_mod.crc32c(self.header.crc_prefix()))
+
+    def verify_crc(self) -> bool:
+        return (
+            self.header.header_crc == self.header.compute_header_crc()
+            and self.header.crc == self.compute_crc()
+        )
+
+    def finalize_crcs(self) -> "RecordBatch":
+        self.header.crc = self.compute_crc()
+        self.header.header_crc = self.header.compute_header_crc()
+        return self
+
+    # -- sizes / offsets --------------------------------------------
+    @property
+    def base_offset(self) -> int:
+        return self.header.base_offset
+
+    @property
+    def last_offset(self) -> int:
+        return self.header.last_offset
+
+    @property
+    def record_count(self) -> int:
+        return self.header.record_count
+
+    def size_bytes(self) -> int:
+        return HEADER_SIZE + len(self.body)
+
+    # -- internal (on-disk) serialization ---------------------------
+    def serialize(self) -> bytes:
+        self.header.size_bytes = self.size_bytes()
+        return self.header.pack() + self.body
+
+    @staticmethod
+    def deserialize(data: bytes | IOBufParser) -> "RecordBatch":
+        parser = data if isinstance(data, IOBufParser) else IOBufParser(data)
+        header = RecordBatchHeader.unpack(parser.read(HEADER_SIZE))
+        if header.size_bytes < HEADER_SIZE:
+            raise ValueError(f"corrupt size_bytes {header.size_bytes}")
+        body = parser.read(header.size_bytes - HEADER_SIZE)
+        return RecordBatch(header, body)
+
+    # -- Kafka wire framing (reference: kafka/protocol/kafka_batch_adapter) --
+    def to_kafka_wire(self) -> bytes:
+        h = self.header
+        batch_length = _KAFKA_AFTER_LEN + len(self.body)
+        fixed = _KAFKA_WIRE.pack(
+            h.base_offset,
+            batch_length,
+            max(-1, min(h.term, 2**31 - 1)),  # partition_leader_epoch
+            2,  # magic v2
+            h.crc & 0xFFFFFFFF,
+            h.attrs,
+            h.last_offset_delta,
+            h.first_timestamp,
+            h.max_timestamp,
+            h.producer_id,
+            h.producer_epoch,
+            h.base_sequence,
+            h.record_count,
+        )
+        return fixed + self.body
+
+    @staticmethod
+    def from_kafka_wire(parser: IOBufParser | bytes, verify: bool = True) -> "RecordBatch":
+        """Adapt one Kafka wire batch to the internal form, verifying the
+        Kafka CRC (reference: kafka/protocol/kafka_batch_adapter.cc:99-123)."""
+        if not isinstance(parser, IOBufParser):
+            parser = IOBufParser(parser)
+        fixed = parser.read(KAFKA_BATCH_OVERHEAD)
+        f = _KAFKA_WIRE.unpack(fixed)
+        (
+            base_offset,
+            batch_length,
+            leader_epoch,
+            magic,
+            wire_crc,
+            attrs,
+            last_offset_delta,
+            first_timestamp,
+            max_timestamp,
+            producer_id,
+            producer_epoch,
+            base_sequence,
+            record_count,
+        ) = f
+        if magic != 2:
+            raise ValueError(f"unsupported batch magic {magic}")
+        if batch_length < _KAFKA_AFTER_LEN:
+            raise ValueError(f"batch_length {batch_length} shorter than fixed section")
+        body = parser.read(batch_length - _KAFKA_AFTER_LEN)
+        header = RecordBatchHeader(
+            base_offset=base_offset,
+            type=RecordBatchType.raft_data,
+            crc=wire_crc,
+            attrs=attrs,
+            last_offset_delta=last_offset_delta,
+            first_timestamp=first_timestamp,
+            max_timestamp=max_timestamp,
+            producer_id=producer_id,
+            producer_epoch=producer_epoch,
+            base_sequence=base_sequence,
+            record_count=record_count,
+            term=leader_epoch,
+        )
+        batch = RecordBatch(header, body)
+        if verify and batch.compute_crc() != wire_crc:
+            raise CrcMismatch(
+                f"kafka batch crc mismatch: wire={wire_crc:#x} computed={batch.compute_crc():#x}"
+            )
+        header.size_bytes = batch.size_bytes()
+        header.header_crc = header.compute_header_crc()
+        return batch
+
+    # -- records access ---------------------------------------------
+    def records(self) -> list[Record]:
+        """Decode records (decompressing the body if needed)."""
+        data = self.body
+        ctype = self.header.compression
+        if ctype != CompressionType.none:
+            data = compression_mod.uncompress(data, ctype)
+        parser = IOBufParser(data)
+        return [Record.decode(parser) for _ in range(self.header.record_count)]
+
+    def __repr__(self) -> str:  # pragma: no cover
+        h = self.header
+        return (
+            f"RecordBatch(type={h.type.name}, base={h.base_offset}, "
+            f"n={h.record_count}, bytes={self.size_bytes()})"
+        )
+
+
+class CrcMismatch(ValueError):
+    pass
+
+
+class RecordBatchBuilder:
+    """Builds a batch with correct offsets/timestamps/CRCs
+    (reference: storage/record_batch_builder.{h,cc})."""
+
+    def __init__(
+        self,
+        batch_type: RecordBatchType = RecordBatchType.raft_data,
+        base_offset: int = 0,
+        compression: CompressionType = CompressionType.none,
+        producer_id: int = -1,
+        producer_epoch: int = -1,
+        base_sequence: int = -1,
+        transactional: bool = False,
+        timestamp_ms: int | None = None,
+    ):
+        self._type = batch_type
+        self._base_offset = base_offset
+        self._compression = compression
+        self._producer_id = producer_id
+        self._producer_epoch = producer_epoch
+        self._base_sequence = base_sequence
+        self._transactional = transactional
+        self._base_ts = (
+            timestamp_ms if timestamp_ms is not None else int(time.time() * 1000)
+        )
+        self._max_ts = self._base_ts
+        self._records: list[bytes] = []
+
+    def add(
+        self,
+        value: bytes | None,
+        key: bytes | None = None,
+        headers: Sequence[tuple[bytes, bytes]] = (),
+        timestamp_ms: int | None = None,
+    ) -> "RecordBatchBuilder":
+        ts = timestamp_ms if timestamp_ms is not None else self._base_ts
+        self._max_ts = max(self._max_ts, ts)
+        rec = Record(
+            attributes=0,
+            timestamp_delta=ts - self._base_ts,
+            offset_delta=len(self._records),
+            key=key,
+            value=value,
+            headers=[RecordHeader(k, v) for k, v in headers],
+        )
+        self._records.append(rec.encode())
+        return self
+
+    def empty(self) -> bool:
+        return not self._records
+
+    def build(self) -> RecordBatch:
+        if not self._records:
+            raise ValueError("empty batch")
+        raw = b"".join(self._records)
+        attrs = int(self._compression) & _COMPRESSION_MASK
+        if self._transactional:
+            attrs |= _TRANSACTIONAL_BIT
+        body = (
+            compression_mod.compress(raw, self._compression)
+            if self._compression != CompressionType.none
+            else raw
+        )
+        header = RecordBatchHeader(
+            base_offset=self._base_offset,
+            type=self._type,
+            attrs=attrs,
+            last_offset_delta=len(self._records) - 1,
+            first_timestamp=self._base_ts,
+            max_timestamp=self._max_ts,
+            producer_id=self._producer_id,
+            producer_epoch=self._producer_epoch,
+            base_sequence=self._base_sequence,
+            record_count=len(self._records),
+        )
+        batch = RecordBatch(header, body)
+        batch.header.size_bytes = batch.size_bytes()
+        return batch.finalize_crcs()
+
+
+def batch_crcs(batches: Iterable[RecordBatch]) -> np.ndarray:
+    """Compute Kafka CRCs for many batches in one call — the batched
+    `record_batch_crc_checker` (reference: model/record.h:763-781).
+
+    Stages (crc_prefix + body) rows into a padded uint8 matrix: the
+    layout consumed both by the host native path and the device kernel
+    (ops.crc32c.crc32c_device)."""
+    payloads = [b.header.crc_prefix() + b.body for b in batches]
+    if not payloads:
+        return np.zeros(0, dtype=np.uint32)
+    stride = max(len(p) for p in payloads)
+    mat = np.zeros((len(payloads), stride), dtype=np.uint8)
+    lens = np.zeros(len(payloads), dtype=np.uint64)
+    for i, p in enumerate(payloads):
+        mat[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+        lens[i] = len(p)
+    return crc_mod.crc32c_batch(mat, lens)
+
+
+def verify_batch_crcs(batches: Sequence[RecordBatch]) -> bool:
+    got = batch_crcs(batches)
+    return all(
+        int(got[i]) == (b.header.crc & 0xFFFFFFFF) for i, b in enumerate(batches)
+    )

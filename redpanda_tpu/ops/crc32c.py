@@ -1,0 +1,197 @@
+"""Batched CRC-32C on device — validate many record batches per call.
+
+The device-side record-batch validator (north star: BASELINE.md —
+record-batch CRC as a batched kernel; host analog
+model/record_utils.h:23-31 + the native rp_crc32c_batch).
+
+CRC is bit-serial per byte stream, so a single checksum doesn't
+vectorize — but the broker's unit of work is *many* batches (one per
+produce request partition / per fetched segment chunk), which maps to
+the TPU as one lane per batch:
+
+  1. Rows are padded to a uniform stride. The hot loop is a
+     slice-by-8 column scan: `stride/8` iterations, each folding 8
+     byte-columns of every row through 8 lookup tables — pure gathers
+     + xors over [B] lanes, no masking, no data-dependent control
+     flow (XLA-friendly by construction).
+  2. Per-row lengths are then fixed up *after* the scan: padding zeros
+     are algebraically removed by multiplying the raw CRC register by
+     Z^-k over GF(2), where Z is the one-zero-byte extension operator
+     and k = stride - len. Z^-(2^j) matrices are precomputed host-side;
+     the fixup is ~32 xor/select ops per set bit of k. This turns
+     "variable-length rows" — the thing that usually kills batched CRC
+     — into a constant-depth epilogue.
+
+Differentially tested against the native host implementation
+(tests/test_ops.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.consensus_state import GroupState  # noqa: F401  (x64 side effect)
+
+_POLY = np.uint32(0x82F63B78)
+_MAX_LOG_PAD = 30  # supports strides up to 2^30
+
+
+def _make_tables() -> np.ndarray:
+    """Slice-by-8 tables, identical to native/crc32c.cc."""
+    t = np.zeros((8, 256), dtype=np.uint32)
+    for n in range(256):
+        c = np.uint32(n)
+        for _ in range(8):
+            c = (_POLY ^ (c >> np.uint32(1))) if (c & np.uint32(1)) else (c >> np.uint32(1))
+        t[0, n] = c
+    for n in range(256):
+        c = t[0, n]
+        for k in range(1, 8):
+            c = t[0, c & 0xFF] ^ (c >> np.uint32(8))
+            t[k, n] = c
+    return t
+
+
+_TABLES = _make_tables()
+
+
+def _gf2_matvec_np(cols: np.ndarray, v: np.ndarray) -> np.ndarray:
+    out = np.zeros_like(v)
+    for k in range(32):
+        bit = (v >> np.uint32(k)) & np.uint32(1)
+        out ^= np.where(bit.astype(bool), cols[k], np.uint32(0))
+    return out
+
+
+@functools.cache
+def _zero_unextend_matrices() -> np.ndarray:
+    """Columns of Z^-(2^j) for j in [0, _MAX_LOG_PAD): [J, 32] uint32.
+
+    Z is the linear map one zero byte applies to the raw CRC register:
+    s' = T0[s & 0xff] ^ (s >> 8). CRC tables are GF(2)-linear, so Z is
+    a 32x32 bit-matrix; its inverse un-extends padding zeros."""
+    t0 = _TABLES[0]
+    # columns of Z: image of each basis bit
+    z_cols = np.array(
+        [t0[(1 << k) & 0xFF] ^ (np.uint32(1 << k) >> np.uint32(8)) for k in range(32)],
+        dtype=np.uint32,
+    )
+
+    def mat_to_bits(cols: np.ndarray) -> np.ndarray:
+        m = np.zeros((32, 32), dtype=np.uint8)
+        for c in range(32):
+            for r in range(32):
+                m[r, c] = (int(cols[c]) >> r) & 1
+        return m
+
+    def bits_to_cols(m: np.ndarray) -> np.ndarray:
+        cols = np.zeros(32, dtype=np.uint32)
+        for c in range(32):
+            v = 0
+            for r in range(32):
+                if m[r, c]:
+                    v |= 1 << r
+            cols[c] = v
+        return cols
+
+    def gf2_inv(m: np.ndarray) -> np.ndarray:
+        n = m.shape[0]
+        aug = np.concatenate([m.copy(), np.eye(n, dtype=np.uint8)], axis=1)
+        for col in range(n):
+            pivot = next(r for r in range(col, n) if aug[r, col])
+            if pivot != col:
+                aug[[col, pivot]] = aug[[pivot, col]]
+            for r in range(n):
+                if r != col and aug[r, col]:
+                    aug[r] ^= aug[col]
+        return aug[:, n:]
+
+    def gf2_matmul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        return (a.astype(np.int32) @ b.astype(np.int32) % 2).astype(np.uint8)
+
+    z_bits = mat_to_bits(z_cols)
+    zinv = gf2_inv(z_bits)
+    pows = []
+    cur = zinv
+    for _ in range(_MAX_LOG_PAD):
+        pows.append(bits_to_cols(cur))
+        cur = gf2_matmul(cur, cur)
+    return np.stack(pows)  # [J, 32]
+
+
+def _crc32c_padded_scan(data: jax.Array) -> jax.Array:
+    """Raw (non-finalized) CRC register after scanning every full row.
+
+    data: [B, S] uint8 with S % 8 == 0. Returns [B] uint32."""
+    b, s = data.shape
+    words = data.reshape(b, s // 8, 8).astype(jnp.uint32)
+    tables = [jnp.asarray(_TABLES[k]) for k in range(8)]
+
+    def step(i, crc):
+        w = words[:, i, :]  # [B, 8]
+        low = w[:, 0] | (w[:, 1] << 8) | (w[:, 2] << 16) | (w[:, 3] << 24)
+        x = crc ^ low
+        out = (
+            jnp.take(tables[7], x & 0xFF)
+            ^ jnp.take(tables[6], (x >> 8) & 0xFF)
+            ^ jnp.take(tables[5], (x >> 16) & 0xFF)
+            ^ jnp.take(tables[4], (x >> 24) & 0xFF)
+            ^ jnp.take(tables[3], w[:, 4])
+            ^ jnp.take(tables[2], w[:, 5])
+            ^ jnp.take(tables[1], w[:, 6])
+            ^ jnp.take(tables[0], w[:, 7])
+        )
+        return out
+
+    init = jnp.full((b,), 0xFFFFFFFF, jnp.uint32)
+    return jax.lax.fori_loop(0, s // 8, step, init)
+
+
+def _gf2_matvec(cols: jax.Array, v: jax.Array) -> jax.Array:
+    """cols: [32] uint32 (matrix columns); v: [B] uint32."""
+    out = jnp.zeros_like(v)
+    for k in range(32):
+        bit = ((v >> k) & 1).astype(bool)
+        out = out ^ jnp.where(bit, cols[k], jnp.uint32(0))
+    return out
+
+
+def _unextend_zeros(raw: jax.Array, pad: jax.Array) -> jax.Array:
+    """Remove `pad` trailing zero bytes from each row's raw register."""
+    mats = jnp.asarray(_zero_unextend_matrices())  # [J, 32]
+    out = raw
+    for j in range(_MAX_LOG_PAD):
+        apply = ((pad >> j) & 1).astype(bool)
+        out = jnp.where(apply, _gf2_matvec(mats[j], out), out)
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=())
+def crc32c_device(data: jax.Array, lens: jax.Array) -> jax.Array:
+    """CRC-32C of each row: data [B, S] uint8 (S % 8 == 0), lens [B].
+
+    Returns [B] uint32 finalized checksums. Rows must be zero-padded
+    beyond their length (the scan assumes padding bytes are 0)."""
+    raw = _crc32c_padded_scan(data)
+    pad = (data.shape[1] - lens).astype(jnp.uint32)
+    fixed = _unextend_zeros(raw, pad)
+    return fixed ^ jnp.uint32(0xFFFFFFFF)
+
+
+def crc32c_batch_device(bufs: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Drop-in device counterpart of utils.crc.crc32c_batch (same padded
+    [n, stride] layout produced by models.record.batch_crcs)."""
+    bufs = np.ascontiguousarray(bufs, dtype=np.uint8)
+    lens = np.asarray(lens, dtype=np.int64)
+    if lens.size and int(lens.max()) > bufs.shape[1]:
+        raise ValueError(
+            f"lens.max()={int(lens.max())} exceeds stride={bufs.shape[1]}"
+        )
+    if bufs.shape[1] % 8:
+        pad = 8 - bufs.shape[1] % 8
+        bufs = np.pad(bufs, ((0, 0), (0, pad)))
+    return np.asarray(crc32c_device(jnp.asarray(bufs), jnp.asarray(lens)))

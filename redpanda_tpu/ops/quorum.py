@@ -1,0 +1,202 @@
+"""Batched quorum / commit-index kernels — the north-star sweep.
+
+One device call advances the consensus decision math for *all* raft
+groups on a shard, replacing the reference's per-group scalar loops:
+
+* `quorum_commit_step` — the leader commit rule
+  (reference: consensus.cc:2704-2759 do_maybe_update_leader_commit_idx
+  + group_configuration.h:407-428 quorum_match): per-replica value is
+  min(flushed, match) (types.h:97-99 match_committed_index); the
+  majority value is the ascending (n-1)/2-th order statistic over
+  voters; joint configs take min over both voter sets
+  (group_configuration.h:487-490); result is clamped to the leader's
+  own flushed offset and gated on the current-term check
+  (commit only entries of the leader's term — Raft §5.4.2).
+  Also computes the majority-replicated dirty offset used for
+  relaxed-consistency visibility (consensus.cc:3262-3276).
+
+* `follower_commit_step` — the follower-side rule
+  (consensus.cc:2760-2777): commit = min(leader_commit, flushed),
+  monotone.
+
+* `fold_replies` — scatter a node-batch of append_entries/heartbeat
+  replies back into the [G, R] match/flushed tensors with the
+  monotone-seq reordering guard (types.h:107-117), replacing the
+  per-reply scalar path (consensus.cc:274 update_follower_index).
+
+* `build_heartbeats` — gather per-target-node (group, term,
+  commit_index, last_dirty) vectors from state, replacing the
+  per-group iteration in heartbeat_manager.cc:203.
+
+All kernels are pure jnp on `[G]`/`[G, R]` int64/bool tensors — XLA
+fuses the sort + arithmetic into a handful of HBM passes; no Python
+per-group work anywhere.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..models.consensus_state import SELF_SLOT, GroupState
+
+_I64_MIN = jnp.iinfo(jnp.int64).min
+_I64_MAX = jnp.iinfo(jnp.int64).max
+
+
+def _masked_quorum_value(values: jax.Array, mask: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-row majority order statistic over masked entries.
+
+    values: [G, R] i64; mask: [G, R] bool. Returns ([G] value, [G] n).
+    Matches details::quorum_match (group_configuration.h:407-428):
+    ascending order statistic at index (n-1)/2. Masked-out slots are
+    filled with i64 min so they sort to the front; the real values
+    occupy positions [R-n, R), making the target index
+    R - n + (n-1)//2. Rows with n == 0 return i64 min.
+    """
+    g, r = values.shape
+    filled = jnp.where(mask, values, _I64_MIN)
+    ordered = jnp.sort(filled, axis=-1)
+    n = jnp.sum(mask, axis=-1, dtype=jnp.int64)
+    idx = jnp.clip(r - n + (n - 1) // 2, 0, r - 1)
+    val = jnp.take_along_axis(ordered, idx[:, None], axis=-1)[:, 0]
+    return jnp.where(n > 0, val, _I64_MIN), n
+
+
+def quorum_commit_step(state: GroupState) -> GroupState:
+    """Advance commit_index and last_visible for every leader group."""
+    # Quorum input per replica: min(flushed, match). For SELF_SLOT the
+    # tensors mirror the local log, so this equals the leader's flushed
+    # offset — the same value consensus.cc:2712 feeds for `_self`.
+    committed = jnp.minimum(state.flushed_index, state.match_index)
+
+    m_cur, n_cur = _masked_quorum_value(committed, state.is_voter)
+    m_old, n_old = _masked_quorum_value(committed, state.is_voter_old)
+    # joint consensus: min over both quorums when the old set is active
+    majority = jnp.where(n_old > 0, jnp.minimum(m_cur, m_old), m_cur)
+
+    # clamp to leader's own flushed offset (consensus.cc:2737-2739)
+    leader_flushed = state.flushed_index[:, SELF_SLOT]
+    majority = jnp.minimum(majority, leader_flushed)
+
+    # current-term gate: log.get_term(majority) == term  ⇔  majority >=
+    # term_start (consensus.cc:2741), plus monotonicity.
+    advance = (
+        state.is_leader
+        & (n_cur > 0)
+        & (majority > state.commit_index)
+        & (majority >= state.term_start)
+    )
+    new_commit = jnp.where(advance, majority, state.commit_index)
+
+    # relaxed-consistency visibility: majority over dirty offsets,
+    # joint min, no flush clamp (consensus.cc:3262-3276); visible index
+    # never exceeds commit-gated rules — mirror
+    # maybe_update_last_visible_index by taking max of commit and the
+    # majority-dirty value capped at the leader's dirty offset.
+    d_cur, dn_cur = _masked_quorum_value(state.match_index, state.is_voter)
+    d_old, dn_old = _masked_quorum_value(state.match_index, state.is_voter_old)
+    majority_dirty = jnp.where(dn_old > 0, jnp.minimum(d_cur, d_old), d_cur)
+    leader_dirty = state.match_index[:, SELF_SLOT]
+    majority_dirty = jnp.minimum(majority_dirty, leader_dirty)
+    new_visible = jnp.where(
+        state.is_leader & (dn_cur > 0),
+        jnp.maximum(state.last_visible, jnp.maximum(new_commit, majority_dirty)),
+        state.last_visible,
+    )
+    return state._replace(commit_index=new_commit, last_visible=new_visible)
+
+
+def follower_commit_step(
+    state: GroupState, leader_commit: jax.Array
+) -> GroupState:
+    """Follower commit rule over all groups at once
+    (consensus.cc:2760-2777): if leaderCommit > commit, commit =
+    min(leaderCommit, flushed). leader_commit: [G] i64 (i64 min for
+    groups with no update this tick)."""
+    flushed = state.flushed_index[:, SELF_SLOT]
+    proposed = jnp.minimum(leader_commit, flushed)
+    new_commit = jnp.where(
+        (leader_commit > state.commit_index) & (proposed > state.commit_index),
+        proposed,
+        state.commit_index,
+    )
+    visible = jnp.maximum(state.last_visible, new_commit)
+    return state._replace(commit_index=new_commit, last_visible=visible)
+
+
+def fold_replies(
+    state: GroupState,
+    group_idx: jax.Array,     # [M] i32/i64 group row per reply
+    replica_slot: jax.Array,  # [M] slot of the responding peer
+    last_dirty: jax.Array,    # [M] i64 follower's last dirty offset
+    last_flushed: jax.Array,  # [M] i64 follower's last flushed offset
+    seq: jax.Array,           # [M] i64 request sequence number
+) -> GroupState:
+    """Fold a node-batch of successful append/heartbeat replies into
+    match/flushed. Replies with seq <= last_seq[g, r] are dropped
+    (reordered responses, types.h:107-117). Duplicate (g, r) pairs in
+    one batch resolve via per-target max — safe because updates are
+    monotone on the fast path."""
+    fresh = seq > state.last_seq[group_idx, replica_slot]
+    eff_dirty = jnp.where(fresh, last_dirty, _I64_MIN)
+    eff_flushed = jnp.where(fresh, last_flushed, _I64_MIN)
+    eff_seq = jnp.where(fresh, seq, _I64_MIN)
+    return state._replace(
+        match_index=state.match_index.at[group_idx, replica_slot].max(eff_dirty),
+        flushed_index=state.flushed_index.at[group_idx, replica_slot].max(eff_flushed),
+        last_seq=state.last_seq.at[group_idx, replica_slot].max(eff_seq),
+    )
+
+
+def build_heartbeats(state: GroupState, group_idx: jax.Array) -> dict[str, jax.Array]:
+    """Gather heartbeat payload vectors for a set of groups (typically
+    all leader groups targeting one peer node) in one device gather —
+    the batched analog of heartbeat_manager.cc:203's per-group loop.
+    Returns arrays the RPC layer serializes into one node-level
+    heartbeat request (heartbeat_manager.h:54-83)."""
+    return {
+        "group": group_idx,
+        "term": state.term[group_idx],
+        "commit_index": state.commit_index[group_idx],
+        "last_dirty": state.match_index[group_idx, SELF_SLOT],
+        "last_visible": state.last_visible[group_idx],
+    }
+
+
+def local_append_update(
+    state: GroupState, group_idx: jax.Array, dirty: jax.Array, flushed: jax.Array
+) -> GroupState:
+    """Reflect local log appends/flushes into SELF_SLOT for a batch of
+    groups (the disk_append → leader state hand-off)."""
+    self_slot = jnp.full_like(group_idx, SELF_SLOT)
+    return state._replace(
+        match_index=state.match_index.at[group_idx, self_slot].max(dirty),
+        flushed_index=state.flushed_index.at[group_idx, self_slot].max(flushed),
+    )
+
+
+# jitted entry points (donate state buffers: the sweep updates in place)
+quorum_commit_step_jit = jax.jit(quorum_commit_step, donate_argnums=0)
+follower_commit_step_jit = jax.jit(follower_commit_step, donate_argnums=0)
+fold_replies_jit = jax.jit(fold_replies, donate_argnums=0)
+local_append_update_jit = jax.jit(local_append_update, donate_argnums=0)
+build_heartbeats_jit = jax.jit(build_heartbeats)
+
+
+def heartbeat_tick(
+    state: GroupState,
+    group_idx: jax.Array,
+    replica_slot: jax.Array,
+    last_dirty: jax.Array,
+    last_flushed: jax.Array,
+    seq: jax.Array,
+) -> GroupState:
+    """One fused leader tick: fold a reply batch, then advance commit
+    indices for all groups — the complete 50k-partition sweep as a
+    single compiled program."""
+    state = fold_replies(state, group_idx, replica_slot, last_dirty, last_flushed, seq)
+    return quorum_commit_step(state)
+
+
+heartbeat_tick_jit = jax.jit(heartbeat_tick, donate_argnums=0)

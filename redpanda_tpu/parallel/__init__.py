@@ -1,0 +1,23 @@
+"""Device-mesh parallelism (SURVEY.md §2.11, §5.8).
+
+The reference scales by sharding partitions over cores and nodes and
+exchanging per-group offset/term scalars over its TCP RPC. Here the
+same axes map onto the TPU:
+
+* partition axis → groups sharded across devices (`shard` mesh axis),
+* replication → per-group state exchanged between the devices hosting
+  leader/follower roles via ICI collectives (ppermute ring) inside
+  `shard_map`, with DCN/host RPC as the cross-host fallback.
+"""
+
+from .mesh import group_sharding, make_mesh, shard_group_state
+from .cluster_step import make_cluster_state, cluster_tick, cluster_tick_sharded
+
+__all__ = [
+    "group_sharding",
+    "make_mesh",
+    "shard_group_state",
+    "make_cluster_state",
+    "cluster_tick",
+    "cluster_tick_sharded",
+]

@@ -1,0 +1,156 @@
+"""Multi-device replicated cluster step — heartbeats over ICI.
+
+Models an N-node cluster as an N-device mesh: device d leads the
+groups in its shard block and follows the groups of devices d-1, d-2
+(ring placement, replication factor 3). One `cluster_tick` is the
+complete heartbeat round the reference runs over TCP
+(heartbeat_manager.cc:373 → service.h:66 → consensus append → reply →
+commit-index fold), executed as a single shard_map program:
+
+  1. leaders reflect their local appends (SELF_SLOT),
+  2. heartbeat payloads (term/commit/last_dirty) ride ICI to the
+     follower devices via lax.ppermute (ring hops +1, +2),
+  3. followers advance their follower-side log mirrors and commit
+     indices (follower_commit_step rule), reply with
+     (last_dirty, last_flushed) over the reverse hops,
+  4. leaders fold replies into [G, R] slots positionally (slot r ↔
+     ring hop r — no scatter needed) and run the batched quorum sweep.
+
+A final psum over per-device committed counts stands in for the
+cluster-level health/metrics aggregation (health_monitor analog).
+
+On one host this exercises the virtual CPU mesh; on a real slice the
+same program rides ICI. Cross-host (DCN) replication uses the host RPC
+path instead (redpanda_tpu.rpc), mirroring the reference's
+TCP backend; see SURVEY.md §5.8.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.consensus_state import GroupState, make_group_state
+from ..ops.quorum import quorum_commit_step
+from .mesh import SHARD_AXIS
+
+RF = 3  # replication factor modeled by the ring placement
+
+
+class ClusterState(NamedTuple):
+    """Per-device leader state + follower-side mirrors.
+
+    Every array's axis 0 is the global group axis, sharded over the
+    mesh. fol_* hold this device's *follower* role for the groups led
+    by ring neighbors: fol_dirty[g, j] is the mirrored dirty offset for
+    hop j+1's groups aligned to the neighbor's block positions."""
+
+    leader: GroupState
+    fol_dirty: jax.Array    # [G, RF-1] i64
+    fol_flushed: jax.Array  # [G, RF-1] i64
+    fol_commit: jax.Array   # [G, RF-1] i64
+    fol_term: jax.Array     # [G, RF-1] i64 highest leader term seen
+
+
+def make_cluster_state(num_groups: int, replica_slots: int = 8) -> ClusterState:
+    leader = make_group_state(num_groups, replica_slots)
+    # every group: 3 voters in slots 0..2 (self + 2 ring followers)
+    voters = jnp.zeros((num_groups, replica_slots), bool).at[:, :RF].set(True)
+    leader = leader._replace(is_leader=jnp.ones(num_groups, bool), is_voter=voters)
+    shape = (num_groups, RF - 1)
+    neg = jnp.full(shape, -1, jnp.int64)
+    return ClusterState(leader, neg, neg, neg, jnp.zeros(shape, jnp.int64))
+
+
+def cluster_tick(state: ClusterState, new_dirty: jax.Array) -> tuple[ClusterState, jax.Array]:
+    """One heartbeat round. new_dirty: [G] i64 — offsets appended to
+    each leader's local log this tick. Returns (state, total_committed)
+    where total_committed is the cluster-wide count of groups whose
+    commit index advanced (psum'd)."""
+    axis = SHARD_AXIS
+    n = jax.lax.axis_size(axis)
+    leader = state.leader
+
+    # 1. local append: self slot tracks the leader log (flush immediate
+    # in this modeled step; the host runtime splits dirty/flushed).
+    match = leader.match_index.at[:, 0].max(new_dirty)
+    flushed = leader.flushed_index.at[:, 0].max(new_dirty)
+    leader = leader._replace(match_index=match, flushed_index=flushed)
+    old_commit = leader.commit_index
+
+    payload = jnp.stack(
+        [leader.term, leader.commit_index, leader.match_index[:, 0]], axis=-1
+    )  # [G, 3]
+
+    fol_dirty, fol_flushed, fol_commit, fol_term = (
+        state.fol_dirty,
+        state.fol_flushed,
+        state.fol_commit,
+        state.fol_term,
+    )
+    replies = []
+    for hop in range(1, RF):
+        # 2. heartbeat rides ICI to the follower device
+        fwd = [(i, (i + hop) % n) for i in range(n)]
+        recv = jax.lax.ppermute(payload, axis, fwd)  # groups of device d-hop
+        j = hop - 1
+        r_term, r_commit, r_dirty = recv[:, 0], recv[:, 1], recv[:, 2]
+        # 3. term gate (do_append_entries term check, consensus.cc:1752):
+        # heartbeats from a stale term are rejected wholesale
+        accept = r_term >= fol_term[:, j]
+        fol_term = fol_term.at[:, j].max(r_term)
+        # follower accepts the append (mirror advances to leader dirty)
+        # and applies the follower commit rule
+        new_f_dirty = jnp.where(
+            accept, jnp.maximum(fol_dirty[:, j], r_dirty), fol_dirty[:, j]
+        )
+        new_f_flushed = jnp.maximum(fol_flushed[:, j], new_f_dirty)
+        proposed = jnp.minimum(r_commit, new_f_flushed)
+        new_f_commit = jnp.where(
+            accept & (proposed > fol_commit[:, j]), proposed, fol_commit[:, j]
+        )
+        fol_dirty = fol_dirty.at[:, j].set(new_f_dirty)
+        fol_flushed = fol_flushed.at[:, j].set(new_f_flushed)
+        fol_commit = fol_commit.at[:, j].set(new_f_commit)
+        # reply returns over the reverse hop
+        back = [(i, (i - hop) % n) for i in range(n)]
+        reply = jnp.stack([new_f_dirty, new_f_flushed], axis=-1)
+        replies.append(jax.lax.ppermute(reply, axis, back))
+
+    # 4. fold replies: ring hop r maps positionally onto replica slot r
+    for hop in range(1, RF):
+        rep = replies[hop - 1]
+        leader = leader._replace(
+            match_index=leader.match_index.at[:, hop].max(rep[:, 0]),
+            flushed_index=leader.flushed_index.at[:, hop].max(rep[:, 1]),
+        )
+    leader = quorum_commit_step(leader)
+
+    advanced = jnp.sum(leader.commit_index > old_commit)
+    total = jax.lax.psum(advanced, axis)
+    return (
+        ClusterState(leader, fol_dirty, fol_flushed, fol_commit, fol_term),
+        total,
+    )
+
+
+def cluster_tick_sharded(mesh: Mesh):
+    """Build the jitted shard_map'd cluster step for `mesh`."""
+    spec = P(SHARD_AXIS)
+    state_specs = ClusterState(
+        leader=jax.tree.map(lambda _: spec, make_group_state(1)),
+        fol_dirty=spec,
+        fol_flushed=spec,
+        fol_commit=spec,
+        fol_term=spec,
+    )
+    fn = jax.shard_map(
+        cluster_tick,
+        mesh=mesh,
+        in_specs=(state_specs, spec),
+        out_specs=(state_specs, P()),
+    )
+    return jax.jit(fn)

@@ -1,0 +1,33 @@
+"""Mesh construction and consensus-state shardings."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.consensus_state import GroupState
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = SHARD_AXIS) -> Mesh:
+    devices = jax.devices()
+    n = n_devices if n_devices is not None else len(devices)
+    if n > len(devices):
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n]), (axis,))
+
+
+def group_sharding(mesh: Mesh, axis: str = SHARD_AXIS) -> NamedSharding:
+    """Groups sharded along axis 0; per-replica axis replicated."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_group_state(state: GroupState, mesh: Mesh, axis: str = SHARD_AXIS) -> GroupState:
+    """Place every [G, ...] tensor with the group axis split across the
+    mesh — each device owns an equal contiguous block of raft groups,
+    the device-level analog of the reference's shard_table
+    (cluster/shard_table.h:26)."""
+    sharding = group_sharding(mesh, axis)
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), state)

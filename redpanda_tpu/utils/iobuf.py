@@ -155,6 +155,8 @@ class IOBufParser:
         return self._size - self._pos
 
     def read(self, n: int) -> bytes:
+        if n < 0:
+            raise ValueError(f"negative read length {n}")
         if self.bytes_left() < n:
             raise EOFError(f"need {n} bytes, have {self.bytes_left()}")
         frag = self._frags[self._frag_idx] if n else b""

@@ -8,7 +8,7 @@ process). Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env pins the TPU platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,3 +18,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon site customization re-pins JAX_PLATFORMS at interpreter start,
+# so the env var alone is not enough — override via config too (must run
+# before any backend is initialized).
+import jax
+
+jax.config.update("jax_platforms", "cpu")

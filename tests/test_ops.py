@@ -1,0 +1,296 @@
+"""Device-kernel tests: differential vs the scalar reference backend.
+
+SURVEY.md §8b: device-batched quorum math must stay bit-identical to
+scalar semantics — these tests randomize cluster states and compare
+every group's decision against redpanda_tpu.raft.quorum_scalar.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from redpanda_tpu.models.consensus_state import (
+    SELF_SLOT,
+    GroupState,
+    make_group_state,
+)
+from redpanda_tpu.ops import crc32c as dev_crc
+from redpanda_tpu.ops import quorum as q
+from redpanda_tpu.raft import quorum_scalar as ref
+from redpanda_tpu.utils import crc as host_crc
+
+I64_MIN = -(2**63)
+
+
+def random_state(rng, g=64, r=8, joint_prob=0.2):
+    state = make_group_state(g, r)
+    n_voters = rng.integers(1, r + 1, g)
+    voter = np.zeros((g, r), bool)
+    for i in range(g):
+        voter[i, : n_voters[i]] = True
+    old = np.zeros((g, r), bool)
+    for i in range(g):
+        if rng.random() < joint_prob:
+            k = rng.integers(1, r + 1)
+            slots = rng.permutation(r)[:k]
+            old[i, slots] = True
+    match = rng.integers(-1, 1000, (g, r)).astype(np.int64)
+    flushed = match - rng.integers(0, 50, (g, r)).astype(np.int64)
+    commit = rng.integers(-1, 500, g).astype(np.int64)
+    term_start = rng.integers(0, 600, g).astype(np.int64)
+    return state._replace(
+        is_leader=jnp.asarray(rng.random(g) < 0.8),
+        is_voter=jnp.asarray(voter),
+        is_voter_old=jnp.asarray(old),
+        match_index=jnp.asarray(match),
+        flushed_index=jnp.asarray(flushed),
+        commit_index=jnp.asarray(commit),
+        term_start=jnp.asarray(term_start),
+    )
+
+
+def scalar_expected_commit(state: GroupState):
+    # pull tensors host-side once; per-element jnp reads are device ops
+    match = np.asarray(state.match_index)
+    flushed = np.asarray(state.flushed_index)
+    voter = np.asarray(state.is_voter)
+    voter_old = np.asarray(state.is_voter_old)
+    is_leader = np.asarray(state.is_leader)
+    commit = np.asarray(state.commit_index)
+    term_start = np.asarray(state.term_start)
+    g, r = match.shape
+    out = []
+    for i in range(g):
+        if not is_leader[i]:
+            out.append(int(commit[i]))
+            continue
+        replicas = [
+            ref.ReplicaState(
+                match_index=int(match[i, j]),
+                flushed_index=int(flushed[i, j]),
+                is_voter=bool(voter[i, j]),
+                is_voter_old=bool(voter_old[i, j]),
+            )
+            for j in range(r)
+        ]
+        out.append(
+            ref.leader_commit_index(
+                replicas,
+                leader_flushed=int(flushed[i, SELF_SLOT]),
+                commit_index=int(commit[i]),
+                term_start=int(term_start[i]),
+            )
+        )
+    return np.array(out, dtype=np.int64)
+
+
+class TestQuorumCommit:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_differential_vs_scalar(self, seed):
+        rng = np.random.default_rng(seed)
+        state = random_state(rng)
+        new = q.quorum_commit_step(state)
+        expected = scalar_expected_commit(state)
+        np.testing.assert_array_equal(np.asarray(new.commit_index), expected)
+
+    def test_simple_majority(self):
+        # 3 voters: self flushed 10, followers at 8 and 5 → commit 8
+        state = make_group_state(1, 4)
+        state = state._replace(
+            is_leader=jnp.array([True]),
+            is_voter=jnp.array([[True, True, True, False]]),
+            match_index=jnp.array([[10, 8, 5, I64_MIN]], jnp.int64),
+            flushed_index=jnp.array([[10, 8, 5, I64_MIN]], jnp.int64),
+            term_start=jnp.array([0], jnp.int64),
+        )
+        new = q.quorum_commit_step(state)
+        assert int(new.commit_index[0]) == 8
+
+    def test_flush_clamp(self):
+        # followers ahead of leader's own flush → clamp to leader flushed
+        state = make_group_state(1, 4)
+        state = state._replace(
+            is_leader=jnp.array([True]),
+            is_voter=jnp.array([[True, True, True, False]]),
+            match_index=jnp.array([[20, 20, 20, I64_MIN]], jnp.int64),
+            flushed_index=jnp.array([[7, 20, 20, I64_MIN]], jnp.int64),
+            term_start=jnp.array([0], jnp.int64),
+        )
+        new = q.quorum_commit_step(state)
+        assert int(new.commit_index[0]) == 7
+
+    def test_term_gate_blocks_old_term_entries(self):
+        # majority at 8 but current term starts at 9 → no commit
+        state = make_group_state(1, 4)
+        state = state._replace(
+            is_leader=jnp.array([True]),
+            is_voter=jnp.array([[True, True, True, False]]),
+            match_index=jnp.array([[10, 8, 8, I64_MIN]], jnp.int64),
+            flushed_index=jnp.array([[10, 8, 8, I64_MIN]], jnp.int64),
+            term_start=jnp.array([9], jnp.int64),
+            commit_index=jnp.array([3], jnp.int64),
+        )
+        new = q.quorum_commit_step(state)
+        assert int(new.commit_index[0]) == 3
+
+    def test_joint_config_takes_min(self):
+        state = make_group_state(1, 6)
+        state = state._replace(
+            is_leader=jnp.array([True]),
+            is_voter=jnp.array([[True, True, True, False, False, False]]),
+            is_voter_old=jnp.array([[False, False, False, True, True, True]]),
+            match_index=jnp.array([[10, 10, 10, 4, 4, 4]], jnp.int64),
+            flushed_index=jnp.array([[10, 10, 10, 4, 4, 4]], jnp.int64),
+            term_start=jnp.array([0], jnp.int64),
+        )
+        new = q.quorum_commit_step(state)
+        assert int(new.commit_index[0]) == 4
+
+    def test_non_leader_untouched(self):
+        state = make_group_state(4, 4)
+        state = state._replace(
+            is_voter=jnp.ones((4, 4), bool),
+            match_index=jnp.full((4, 4), 100, jnp.int64),
+            flushed_index=jnp.full((4, 4), 100, jnp.int64),
+        )
+        new = q.quorum_commit_step(state)
+        assert np.all(np.asarray(new.commit_index) == -1)
+
+
+class TestFollowerCommit:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_differential(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        g = 128
+        state = make_group_state(g, 4)
+        flushed = rng.integers(-1, 100, g).astype(np.int64)
+        commit = rng.integers(-1, 80, g).astype(np.int64)
+        leader_commit = rng.integers(-1, 150, g).astype(np.int64)
+        state = state._replace(
+            flushed_index=state.flushed_index.at[:, SELF_SLOT].set(jnp.asarray(flushed)),
+            commit_index=jnp.asarray(commit),
+        )
+        new = q.follower_commit_step(state, jnp.asarray(leader_commit))
+        got = np.asarray(new.commit_index)
+        for i in range(g):
+            exp = ref.follower_commit_index(int(commit[i]), int(flushed[i]), int(leader_commit[i]))
+            assert int(got[i]) == exp
+
+
+class TestFoldReplies:
+    def test_seq_guard_drops_stale(self):
+        state = make_group_state(2, 4)
+        state = state._replace(last_seq=state.last_seq.at[0, 1].set(10))
+        new = q.fold_replies(
+            state,
+            group_idx=jnp.array([0, 0]),
+            replica_slot=jnp.array([1, 2]),
+            last_dirty=jnp.array([50, 60], jnp.int64),
+            last_flushed=jnp.array([50, 60], jnp.int64),
+            seq=jnp.array([5, 1], jnp.int64),  # seq 5 <= 10 → stale for slot 1
+        )
+        assert int(new.match_index[0, 1]) == -1  # dropped
+        assert int(new.match_index[0, 2]) == 60  # applied
+
+    def test_monotone_and_duplicates(self):
+        state = make_group_state(1, 4)
+        new = q.fold_replies(
+            state,
+            group_idx=jnp.array([0, 0]),
+            replica_slot=jnp.array([1, 1]),
+            last_dirty=jnp.array([30, 20], jnp.int64),
+            last_flushed=jnp.array([25, 22], jnp.int64),
+            seq=jnp.array([2, 3], jnp.int64),
+        )
+        # duplicates resolve via max
+        assert int(new.match_index[0, 1]) == 30
+        assert int(new.flushed_index[0, 1]) == 25
+        assert int(new.last_seq[0, 1]) == 3
+
+    def test_heartbeat_tick_end_to_end(self):
+        state = make_group_state(3, 4)
+        state = state._replace(
+            is_leader=jnp.ones(3, bool),
+            is_voter=jnp.zeros((3, 4), bool).at[:, :3].set(True),
+            match_index=state.match_index.at[:, 0].set(100),
+            flushed_index=state.flushed_index.at[:, 0].set(100),
+            term_start=jnp.zeros(3, jnp.int64),
+        )
+        # replies from both followers of each group at offset 100
+        gi = jnp.array([0, 0, 1, 1, 2, 2])
+        slot = jnp.array([1, 2, 1, 2, 1, 2])
+        off = jnp.full(6, 100, jnp.int64)
+        seq = jnp.ones(6, jnp.int64)
+        new = q.heartbeat_tick(state, gi, slot, off, off, seq)
+        assert np.all(np.asarray(new.commit_index) == 100)
+
+
+class TestBuildHeartbeats:
+    def test_gather(self):
+        state = make_group_state(8, 4)
+        state = state._replace(
+            term=jnp.arange(8, dtype=jnp.int64),
+            commit_index=jnp.arange(8, dtype=jnp.int64) * 10,
+            match_index=state.match_index.at[:, 0].set(jnp.arange(8, dtype=jnp.int64) * 100),
+        )
+        hb = q.build_heartbeats(state, jnp.array([2, 5]))
+        assert hb["term"].tolist() == [2, 5]
+        assert hb["commit_index"].tolist() == [20, 50]
+        assert hb["last_dirty"].tolist() == [200, 500]
+
+
+class TestDeviceCrc32c:
+    @pytest.mark.parametrize("seed,stride", [(0, 64), (1, 256), (2, 1024)])
+    def test_differential_vs_host(self, seed, stride):
+        rng = np.random.default_rng(seed)
+        n = 32
+        lens = rng.integers(0, stride + 1, n).astype(np.int64)
+        mat = np.zeros((n, stride), dtype=np.uint8)
+        for i in range(n):
+            mat[i, : lens[i]] = rng.integers(0, 256, lens[i], dtype=np.uint8)
+        dev = dev_crc.crc32c_batch_device(mat, lens)
+        host = host_crc.crc32c_batch(mat, lens.astype(np.uint64))
+        np.testing.assert_array_equal(dev, host)
+
+    def test_known_vector(self):
+        data = np.zeros((1, 16), dtype=np.uint8)
+        payload = b"123456789"
+        data[0, :9] = np.frombuffer(payload, np.uint8)
+        out = dev_crc.crc32c_batch_device(data, np.array([9]))
+        assert int(out[0]) == 0xE3069283
+
+
+class TestClusterStep:
+    def test_multi_device_tick(self):
+        from redpanda_tpu.parallel import (
+            cluster_tick_sharded,
+            make_cluster_state,
+            make_mesh,
+            shard_group_state,
+        )
+        from redpanda_tpu.parallel.mesh import group_sharding
+
+        n_dev = len(jax.devices())
+        assert n_dev == 8, "conftest must provide 8 virtual devices"
+        mesh = make_mesh(8)
+        g = 64  # 8 groups per device
+        state = make_cluster_state(g)
+        sharding = group_sharding(mesh)
+        state = jax.tree.map(lambda a: jax.device_put(a, sharding), state)
+        tick = cluster_tick_sharded(mesh)
+        new_dirty = jax.device_put(jnp.full(g, 5, jnp.int64), sharding)
+        state, total = tick(state, new_dirty)
+        # after one round every leader has both follower acks at 5 and
+        # its own flush at 5 → all 64 groups commit
+        assert int(total) == g
+        assert np.all(np.asarray(state.leader.commit_index) == 5)
+        # commit index reaches followers on the NEXT heartbeat (real
+        # raft propagation): after tick 1 mirrors still hold -1
+        assert np.all(np.asarray(state.fol_commit) == -1)
+        # second tick with no new appends: no further leader advancement,
+        # but followers learn the commit index
+        zero = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
+        state, total2 = tick(state, zero)
+        assert int(total2) == 0
+        assert np.all(np.asarray(state.fol_commit) == 5)
