@@ -21,3 +21,9 @@ Layer map (mirrors SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+# Offsets/terms are int64 end-to-end across the device tensors; enable
+# x64 at package init so no module depends on import order for it.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)

@@ -46,12 +46,13 @@ import numpy as np
 # Offsets are int64 end-to-end; enable x64 before any array is created.
 jax.config.update("jax_enable_x64", True)
 
+from .fundamental import NO_OFFSET as _NO_OFFSET
+
 DEFAULT_REPLICA_SLOTS = 8
 SELF_SLOT = 0
 
-# Sentinel for "no offset" — matches model::offset{} semantics of being
-# smaller than any real offset.
-NO_OFFSET = np.int64(-1)
+# the one shared "no offset" sentinel (-1), as an int64 for tensor fills
+NO_OFFSET = np.int64(_NO_OFFSET)
 
 
 class GroupState(NamedTuple):

@@ -21,7 +21,10 @@ PartitionId = named_int("PartitionId")
 RevisionId = named_int("RevisionId")
 ProducerId = named_int("ProducerId")
 
-# Sentinel: "no offset yet" (reference uses model::offset{} / -9223372036854775808)
+# Sentinel: "no offset yet". The framework uses -1 uniformly (one less
+# than the first real offset 0) across Python objects, device tensors
+# and the scalar backend; I64_MIN appears only as the masked-slot fill
+# inside quorum order-statistic kernels.
 NO_OFFSET = Offset(-1)
 NO_TERM = Term(-1)
 NO_NODE = NodeId(-1)

@@ -34,20 +34,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.consensus_state import GroupState  # noqa: F401  (x64 side effect)
+from ..utils.crc import _TABLE as _BYTE_TABLE
 
-_POLY = np.uint32(0x82F63B78)
 _MAX_LOG_PAD = 30  # supports strides up to 2^30
 
 
 def _make_tables() -> np.ndarray:
-    """Slice-by-8 tables, identical to native/crc32c.cc."""
+    """Slice-by-8 tables: row 0 is the shared byte table from utils.crc
+    (same polynomial by construction); rows 1..7 are derived."""
     t = np.zeros((8, 256), dtype=np.uint32)
-    for n in range(256):
-        c = np.uint32(n)
-        for _ in range(8):
-            c = (_POLY ^ (c >> np.uint32(1))) if (c & np.uint32(1)) else (c >> np.uint32(1))
-        t[0, n] = c
+    t[0] = _BYTE_TABLE
     for n in range(256):
         c = t[0, n]
         for k in range(1, 8):
@@ -57,14 +53,6 @@ def _make_tables() -> np.ndarray:
 
 
 _TABLES = _make_tables()
-
-
-def _gf2_matvec_np(cols: np.ndarray, v: np.ndarray) -> np.ndarray:
-    out = np.zeros_like(v)
-    for k in range(32):
-        bit = (v >> np.uint32(k)) & np.uint32(1)
-        out ^= np.where(bit.astype(bool), cols[k], np.uint32(0))
-    return out
 
 
 @functools.cache

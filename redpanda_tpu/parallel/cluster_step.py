@@ -139,6 +139,12 @@ def cluster_tick(state: ClusterState, new_dirty: jax.Array) -> tuple[ClusterStat
 
 def cluster_tick_sharded(mesh: Mesh):
     """Build the jitted shard_map'd cluster step for `mesh`."""
+    n = mesh.devices.size
+    if n < RF:
+        # with fewer devices than the replication factor the ring hops
+        # wrap onto the sender: a leader would count its own payload as
+        # a follower ack and commit unreplicated data
+        raise ValueError(f"mesh has {n} devices; ring replication needs >= RF={RF}")
     spec = P(SHARD_AXIS)
     state_specs = ClusterState(
         leader=jax.tree.map(lambda _: spec, make_group_state(1)),

@@ -35,12 +35,15 @@ def joint_quorum_match(cur_values: list[int], old_values: list[int]) -> int:
     return min(cur, quorum_match(old_values))
 
 
+NO_OFFSET = -1  # shared sentinel (models.fundamental.NO_OFFSET)
+
+
 @dataclasses.dataclass
 class ReplicaState:
     """Per-replica tracking (follower_index_metadata, types.h:78-117)."""
 
-    match_index: int = I64_MIN  # last_dirty_log_index acked
-    flushed_index: int = I64_MIN  # last_flushed_log_index acked
+    match_index: int = NO_OFFSET  # last_dirty_log_index acked
+    flushed_index: int = NO_OFFSET  # last_flushed_log_index acked
     is_voter: bool = True
     is_voter_old: bool = False
     last_seq: int = 0

@@ -1,0 +1,258 @@
+"""The per-partition log (reference: src/v/storage/disk_log_impl.{h,cc}).
+
+Segment list + active appender with: offset assignment, size-based
+rolling (disk_log_impl.cc:1112), flush tracking (the acks=all fsync
+boundary), suffix truncation (raft log-matching conflicts), prefix
+truncation (retention / snapshots), offset/term/timestamp queries, and
+batch-cache-served reads with CRC-verified disk fallback
+(log_reader + parser analog).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..models.record import RecordBatch
+from .batch_cache import BatchCache, BatchCacheIndex
+from .segment import Segment
+
+
+class LogConfig:
+    def __init__(
+        self,
+        segment_max_bytes: int = 128 * 1024 * 1024,
+        retention_bytes: int | None = None,
+        retention_ms: int | None = None,
+    ):
+        self.segment_max_bytes = segment_max_bytes
+        self.retention_bytes = retention_bytes
+        self.retention_ms = retention_ms
+
+
+class LogOffsets:
+    """Reference: storage/types.h offset_stats."""
+
+    __slots__ = ("start_offset", "dirty_offset", "committed_offset")
+
+    def __init__(self, start: int, dirty: int, committed: int):
+        self.start_offset = start
+        self.dirty_offset = dirty
+        self.committed_offset = committed  # flushed
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"LogOffsets(start={self.start_offset}, dirty={self.dirty_offset}, "
+            f"committed={self.committed_offset})"
+        )
+
+
+class Log:
+    def __init__(
+        self,
+        directory: str,
+        config: LogConfig | None = None,
+        cache: BatchCache | None = None,
+    ):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.config = config or LogConfig()
+        self._segments: list[Segment] = []
+        self._cache_index: BatchCacheIndex | None = (
+            cache.make_index() if cache is not None else None
+        )
+        self._recover()
+
+    # -- recovery ----------------------------------------------------
+    def _recover(self) -> None:
+        found = []
+        for name in os.listdir(self._dir):
+            if name.endswith(".log"):
+                base, term = name[:-4].split("-")
+                found.append((int(base), int(term)))
+        for base, term in sorted(found):
+            seg = Segment(self._dir, base, term)
+            if seg.dirty_offset < seg.base_offset and self._segments:
+                # empty tail segment: keep only if it is the active head
+                pass
+            self._segments.append(seg)
+
+    # -- offsets -----------------------------------------------------
+    def offsets(self) -> LogOffsets:
+        if not self._segments:
+            return LogOffsets(0, -1, -1)
+        start = self._segments[0].base_offset
+        dirty = self._segments[-1].dirty_offset
+        # rolled segments are flushed at roll time, so the tail's stable
+        # offset is the log's flushed offset
+        committed = self._segments[-1].stable_offset
+        return LogOffsets(start, dirty, committed)
+
+    def term_of_last_batch(self) -> int:
+        if not self._segments:
+            return -1
+        return self._segments[-1].term
+
+    def get_term(self, offset: int) -> int | None:
+        """Term of the segment containing offset (segments roll on term
+        change, so per-segment term is exact)."""
+        for seg in reversed(self._segments):
+            if offset >= seg.base_offset:
+                if offset > seg.dirty_offset:
+                    return None
+                return seg.term
+        return None
+
+    # -- append ------------------------------------------------------
+    def append(self, batch: RecordBatch, term: int | None = None) -> tuple[int, int]:
+        """Assign offsets and append; returns (base, last) offsets.
+        The batch's base_offset/term are rewritten to the log's view
+        (storage assigns offsets, reference disk_log_impl appender)."""
+        offs = self.offsets()
+        base = offs.dirty_offset + 1
+        if term is None:
+            term = batch.header.term if batch.header.term >= 0 else 0
+        batch.header.base_offset = base
+        batch.header.term = term
+        batch.finalize_crcs()
+
+        seg = self._active_segment(term)
+        seg.append(batch)
+        if self._cache_index is not None:
+            self._cache_index.put(batch)
+        return base, batch.header.last_offset
+
+    def append_exactly(self, batch: RecordBatch) -> tuple[int, int]:
+        """Append preserving the batch's own base_offset/term (follower
+        path: the leader already assigned offsets)."""
+        seg = self._active_segment(batch.header.term)
+        seg.append(batch)
+        if self._cache_index is not None:
+            self._cache_index.put(batch)
+        return batch.header.base_offset, batch.header.last_offset
+
+    def _active_segment(self, term: int) -> Segment:
+        if self._segments:
+            seg = self._segments[-1]
+            if (
+                seg.term == term
+                and seg.size_bytes() < self.config.segment_max_bytes
+            ):
+                return seg
+            if seg.dirty_offset < seg.base_offset and seg.term == term:
+                return seg  # empty segment, reuse
+            seg.flush()
+            seg.persist_index()
+        base = self.offsets().dirty_offset + 1
+        seg = Segment(self._dir, base, term)
+        self._segments.append(seg)
+        return seg
+
+    def flush(self) -> int:
+        """fsync the active segment; returns the flushed offset — the
+        value raft reports as _flushed_offset for acks=all."""
+        if not self._segments:
+            return -1
+        return self._segments[-1].flush()
+
+    # -- read --------------------------------------------------------
+    def read(
+        self, start_offset: int, max_bytes: int = 1 << 20, upto: int | None = None
+    ) -> list[RecordBatch]:
+        """Batches intersecting [start_offset, upto]. Serves from the
+        batch cache when possible, else CRC-trusted segment scan."""
+        offs = self.offsets()
+        end = offs.dirty_offset if upto is None else min(upto, offs.dirty_offset)
+        if start_offset > end:
+            return []
+        out: list[RecordBatch] = []
+        consumed = 0
+        pos = start_offset
+        while pos <= end and consumed < max_bytes:
+            batch = None
+            if self._cache_index is not None:
+                batch = self._cache_index.get(pos)
+            if batch is None:
+                batch = self._read_from_disk(pos)
+            if batch is None:
+                break
+            out.append(batch)
+            consumed += batch.size_bytes()
+            pos = batch.header.last_offset + 1
+        return out
+
+    def _read_from_disk(self, offset: int) -> RecordBatch | None:
+        for seg in reversed(self._segments):
+            if offset >= seg.base_offset:
+                if offset > seg.dirty_offset:
+                    return None
+                batches = seg.read_batches(offset, max_bytes=1 << 20)
+                for b in batches:
+                    if b.header.last_offset >= offset:
+                        if self._cache_index is not None:
+                            self._cache_index.put(b)
+                        return b
+                return None
+        return None
+
+    def timequery(self, ts: int) -> int | None:
+        for seg in self._segments:
+            if seg.max_timestamp >= ts:
+                hint = seg.timequery(ts)
+                start = hint if hint is not None else seg.base_offset
+                for b in seg.read_batches(start):
+                    if b.header.max_timestamp >= ts:
+                        return b.header.base_offset
+        return None
+
+    # -- truncation --------------------------------------------------
+    def truncate(self, offset: int) -> None:
+        """Remove everything at-or-after offset (suffix truncation)."""
+        while self._segments and self._segments[-1].base_offset >= offset:
+            seg = self._segments.pop()
+            seg.close()
+            seg.remove_files()
+        if self._segments:
+            self._segments[-1].truncate(offset)
+        if self._cache_index is not None:
+            self._cache_index.truncate(offset)
+
+    def prefix_truncate(self, offset: int) -> None:
+        """Drop whole segments entirely below offset (retention,
+        raft snapshots; disk_log_impl prefix truncation)."""
+        while (
+            len(self._segments) > 1 and self._segments[1].base_offset <= offset
+        ):
+            seg = self._segments.pop(0)
+            seg.close()
+            seg.remove_files()
+
+    # -- housekeeping -------------------------------------------------
+    def apply_retention(self, now_ms: int | None = None) -> int:
+        """Size/time retention (log_manager housekeeping analog).
+        Returns first retained offset."""
+        cfg = self.config
+        if cfg.retention_bytes is not None:
+            total = sum(s.size_bytes() for s in self._segments)
+            while len(self._segments) > 1 and total > cfg.retention_bytes:
+                seg = self._segments[0]
+                total -= seg.size_bytes()
+                self._segments.pop(0)
+                seg.close()
+                seg.remove_files()
+        if cfg.retention_ms is not None and now_ms is not None:
+            while (
+                len(self._segments) > 1
+                and self._segments[0].max_timestamp >= 0
+                and self._segments[0].max_timestamp < now_ms - cfg.retention_ms
+            ):
+                seg = self._segments.pop(0)
+                seg.close()
+                seg.remove_files()
+        return self.offsets().start_offset
+
+    def segment_count(self) -> int:
+        return len(self._segments)
+
+    def close(self) -> None:
+        for seg in self._segments:
+            seg.close()
