@@ -1,0 +1,107 @@
+"""In-memory loopback transport — the fixture backbone (SURVEY.md §4.2).
+
+The reference tests distribution by booting several full application
+instances in one process over localhost sockets
+(cluster/tests/cluster_test_fixture.h, raft/tests/raft_group_fixture.h:83).
+We go one step lighter: a `LoopbackNetwork` maps node-id → Dispatcher,
+and `LoopbackTransport` awaits handlers directly — zero sockets, fully
+deterministic, and supports partition/heal for failure tests
+(the ducktape failure_injector's iptables isolation, in-process).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .server import Dispatcher, Service
+from .types import RpcError, Status
+
+
+class LoopbackNetwork:
+    def __init__(self):
+        self._nodes: dict[int, Dispatcher] = {}
+        self._isolated: set[int] = set()
+        self._links_down: set[tuple[int, int]] = set()
+        self.delay_s: float = 0.0
+
+    def register_node(self, node_id: int) -> Dispatcher:
+        d = Dispatcher()
+        self._nodes[node_id] = d
+        return d
+
+    def register(self, node_id: int, service: Service) -> None:
+        if node_id not in self._nodes:
+            self.register_node(node_id)
+        self._nodes[node_id].register(service)
+
+    # -- failure injection (iptables isolation analog) ---------------
+    def isolate(self, node_id: int) -> None:
+        self._isolated.add(node_id)
+
+    def heal(self, node_id: int | None = None) -> None:
+        if node_id is None:
+            self._isolated.clear()
+            self._links_down.clear()
+        else:
+            self._isolated.discard(node_id)
+            self._links_down = {
+                l for l in self._links_down if node_id not in l
+            }
+
+    def cut_link(self, a: int, b: int) -> None:
+        self._links_down.add((a, b))
+        self._links_down.add((b, a))
+
+    def reachable(self, src: int, dst: int) -> bool:
+        return (
+            dst in self._nodes
+            and src not in self._isolated
+            and dst not in self._isolated
+            and (src, dst) not in self._links_down
+        )
+
+    async def deliver(
+        self, src: int, dst: int, method_id: int, payload: bytes
+    ) -> bytes:
+        if not self.reachable(src, dst):
+            raise ConnectionError(f"node {dst} unreachable from {src}")
+        if self.delay_s:
+            await asyncio.sleep(self.delay_s)
+        try:
+            return await self._nodes[dst].dispatch(method_id, payload)
+        except (RpcError, ConnectionError, asyncio.CancelledError):
+            raise
+        except Exception as e:
+            # match the TCP server's contract: handler failures surface
+            # as RpcError(SERVICE_ERROR), never as the raw exception
+            raise RpcError(Status.SERVICE_ERROR, str(e))
+
+
+class LoopbackTransport:
+    """Transport-protocol adapter for one (src → dst) edge."""
+
+    def __init__(self, network: LoopbackNetwork, src: int, dst: int):
+        self._net = network
+        self.src = src
+        self.dst = dst
+
+    async def connect(self) -> None:
+        if not self._net.reachable(self.src, self.dst):
+            raise ConnectionRefusedError(f"node {self.dst} unreachable")
+
+    def is_connected(self) -> bool:
+        return self._net.reachable(self.src, self.dst)
+
+    async def call(
+        self, method_id: int, payload: bytes, timeout: float | None = None
+    ) -> bytes:
+        try:
+            coro = self._net.deliver(self.src, self.dst, method_id, payload)
+            if timeout is not None:
+                return await asyncio.wait_for(coro, timeout)
+            return await coro
+        except asyncio.TimeoutError:
+            raise RpcError(Status.TIMEOUT, f"method {method_id} timed out")
+
+    async def close(self) -> None:
+        pass

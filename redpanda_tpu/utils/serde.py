@@ -1,0 +1,209 @@
+"""Versioned envelope serialization (reference: src/v/serde/envelope.h:26-64).
+
+The wire format for all internal RPC types. An `Envelope` subclass
+declares `SERDE_VERSION`, `SERDE_COMPAT_VERSION` and a `SERDE_FIELDS`
+list of (attribute_name, serde_type) pairs. Encoding writes:
+
+    [version u8][compat_version u8][payload_size u32 le][fields...]
+
+Decoding reads exactly `payload_size` bytes: unknown trailing fields
+written by a newer peer are skipped (forward compatibility), and a peer
+whose `compat_version` exceeds our known version is rejected — the same
+evolution contract as the reference's envelope
+(serde/envelope_for_each_field.h drives field iteration there; here the
+field list is explicit, which doubles as the wire documentation).
+
+Primitive serde types mirror serde's fundamental encodings: fixed-width
+little-endian ints, bool, length-prefixed bytes/string (u32 length),
+optional (u8 presence tag), vector (u32 count), and nested envelopes.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Callable, NamedTuple
+
+from .iobuf import IOBufParser
+
+
+class SerdeType(NamedTuple):
+    encode: Callable[[bytearray, Any], None]
+    decode: Callable[[IOBufParser], Any]
+
+
+def _fixed(fmt: str) -> SerdeType:
+    s = struct.Struct(fmt)
+
+    def enc(out: bytearray, v: Any) -> None:
+        out += s.pack(v)
+
+    def dec(p: IOBufParser) -> Any:
+        return s.unpack(p.read(s.size))[0]
+
+    return SerdeType(enc, dec)
+
+
+i8 = _fixed("<b")
+u8 = _fixed("<B")
+i16 = _fixed("<h")
+u16 = _fixed("<H")
+i32 = _fixed("<i")
+u32 = _fixed("<I")
+i64 = _fixed("<q")
+u64 = _fixed("<Q")
+f64 = _fixed("<d")
+
+
+def _enc_bool(out: bytearray, v: bool) -> None:
+    out.append(1 if v else 0)
+
+
+def _dec_bool(p: IOBufParser) -> bool:
+    return p.read(1)[0] != 0
+
+
+boolean = SerdeType(_enc_bool, _dec_bool)
+
+
+def _enc_bytes(out: bytearray, v: bytes) -> None:
+    out += struct.pack("<I", len(v))
+    out += v
+
+
+def _dec_bytes(p: IOBufParser) -> bytes:
+    (n,) = struct.unpack("<I", p.read(4))
+    return p.read(n)
+
+
+bytes_t = SerdeType(_enc_bytes, _dec_bytes)
+
+string = SerdeType(
+    lambda out, v: _enc_bytes(out, v.encode("utf-8")),
+    lambda p: _dec_bytes(p).decode("utf-8"),
+)
+
+
+def optional(t: SerdeType) -> SerdeType:
+    def enc(out: bytearray, v: Any) -> None:
+        if v is None:
+            out.append(0)
+        else:
+            out.append(1)
+            t.encode(out, v)
+
+    def dec(p: IOBufParser) -> Any:
+        return t.decode(p) if p.read(1)[0] else None
+
+    return SerdeType(enc, dec)
+
+
+def vector(t: SerdeType) -> SerdeType:
+    def enc(out: bytearray, v: Any) -> None:
+        out += struct.pack("<I", len(v))
+        for item in v:
+            t.encode(out, item)
+
+    def dec(p: IOBufParser) -> list:
+        (n,) = struct.unpack("<I", p.read(4))
+        return [t.decode(p) for _ in range(n)]
+
+    return SerdeType(enc, dec)
+
+
+def mapping(kt: SerdeType, vt: SerdeType) -> SerdeType:
+    def enc(out: bytearray, v: dict) -> None:
+        out += struct.pack("<I", len(v))
+        for k, val in v.items():
+            kt.encode(out, k)
+            vt.encode(out, val)
+
+    def dec(p: IOBufParser) -> dict:
+        (n,) = struct.unpack("<I", p.read(4))
+        return {kt.decode(p): vt.decode(p) for _ in range(n)}
+
+    return SerdeType(enc, dec)
+
+
+class SerdeError(ValueError):
+    pass
+
+
+class Envelope:
+    """Base for versioned wire types. Subclasses set SERDE_FIELDS (and
+    optionally SERDE_VERSION / SERDE_COMPAT_VERSION) and get __init__,
+    encode/decode, repr and equality for free."""
+
+    SERDE_VERSION: int = 1
+    SERDE_COMPAT_VERSION: int = 1
+    SERDE_FIELDS: list[tuple[str, SerdeType]] = []
+
+    def __init__(self, **kwargs: Any):
+        names = [n for n, _ in self.SERDE_FIELDS]
+        for name in names:
+            setattr(self, name, kwargs.pop(name))
+        if kwargs:
+            raise TypeError(f"unknown fields: {sorted(kwargs)}")
+
+    def encode(self) -> bytes:
+        body = bytearray()
+        for name, t in self.SERDE_FIELDS:
+            t.encode(body, getattr(self, name))
+        head = struct.pack(
+            "<BBI", self.SERDE_VERSION, self.SERDE_COMPAT_VERSION, len(body)
+        )
+        return head + bytes(body)
+
+    @classmethod
+    def decode(cls, data: "bytes | IOBufParser") -> "Envelope":
+        p = data if isinstance(data, IOBufParser) else IOBufParser(data)
+        version, compat, size = struct.unpack("<BBI", p.read(6))
+        if compat > cls.SERDE_VERSION:
+            raise SerdeError(
+                f"{cls.__name__}: peer compat_version {compat} > known "
+                f"version {cls.SERDE_VERSION}"
+            )
+        end = p.pos() + size
+        obj = cls.__new__(cls)
+        for name, t in cls.SERDE_FIELDS:
+            if p.pos() >= end:
+                # older peer: fields added after its version are absent
+                raise SerdeError(
+                    f"{cls.__name__}: truncated envelope (missing {name})"
+                )
+            setattr(obj, name, t.decode(p))
+            if p.pos() > end:
+                # field decode ran past the declared envelope size: a
+                # truncated/corrupt envelope must fail HERE, not desync
+                # the surrounding stream
+                raise SerdeError(
+                    f"{cls.__name__}: field {name} overran envelope "
+                    f"bounds ({p.pos() - end} bytes)"
+                )
+        if p.pos() < end:  # newer peer wrote extra fields: skip
+            p.skip(end - p.pos())
+        return obj
+
+    # `envelope(Cls)` serde type for nesting
+    @classmethod
+    def serde(cls) -> SerdeType:
+        return SerdeType(
+            lambda out, v: out.extend(v.encode()),
+            lambda p: cls.decode(p),
+        )
+
+    def __eq__(self, other: object) -> bool:
+        if type(other) is not type(self):
+            return NotImplemented
+        return all(
+            getattr(self, n) == getattr(other, n) for n, _ in self.SERDE_FIELDS
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover
+        fields = ", ".join(
+            f"{n}={getattr(self, n)!r}" for n, _ in self.SERDE_FIELDS
+        )
+        return f"{type(self).__name__}({fields})"
+
+
+def envelope(cls: type[Envelope]) -> SerdeType:
+    return cls.serde()
