@@ -1,0 +1,732 @@
+"""Per-group Raft consensus (reference: src/v/raft/consensus.{h,cc}).
+
+One instance per partition. Handles what MUST stay per-group — log I/O,
+elections, membership, truncation — while all hot decision math (match/
+flushed tracking, quorum commit) lives in the shard-wide SoA
+(shard_state.ShardGroupArrays) so the heartbeat manager can step every
+group in one batched device call (SURVEY.md §3.3).
+
+Protocol fidelity notes (all cited into the reference):
+* commit rule: median-of-voters over min(flushed, match), clamped to the
+  leader's flushed offset, gated on current-term (consensus.cc:2704-2759,
+  group_configuration.h:407-428) — via shard arrays scalar/device path.
+* follower commit: min(leader_commit, flushed), monotone
+  (consensus.cc:2760-2777).
+* append_entries follower path: term checks → gap check → prev-term
+  match → truncate-on-conflict → append → flush → commit update
+  (consensus.cc:1734-1928).
+* election: randomized timeout, vote persistence, log-up-to-date check
+  (vote_stm.cc; voted_for durable in kvstore as in the reference).
+* new leader appends a configuration batch in its own term so the
+  commit gate `term_start` can advance (consensus.cc leadership path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from enum import Enum
+from typing import Awaitable, Callable, Optional
+
+from ..models.record import RecordBatch, RecordBatchBuilder, RecordBatchType
+from ..models.consensus_state import SELF_SLOT
+from ..storage.kvstore import KeySpace, KvStore
+from ..storage.log import Log
+from ..utils import serde
+from . import quorum_scalar as qs
+from . import types as rt
+from .configuration import GroupConfiguration
+from .shard_state import ShardGroupArrays
+
+logger = logging.getLogger("raft")
+
+NO_OFFSET = -1
+
+
+class Role(Enum):
+    FOLLOWER = 0
+    CANDIDATE = 1
+    LEADER = 2
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader_id: Optional[int]):
+        super().__init__(f"not leader (leader={leader_id})")
+        self.leader_id = leader_id
+
+
+class ReplicateTimeout(Exception):
+    pass
+
+
+class _VoteState(serde.Envelope):
+    SERDE_FIELDS = [("term", serde.i64), ("voted_for", serde.i32)]
+
+
+# send(target_node_id, method_id, payload, timeout) -> reply payload
+SendFn = Callable[[int, int, bytes, float], Awaitable[bytes]]
+
+
+class Consensus:
+    def __init__(
+        self,
+        group_id: int,
+        node_id: int,
+        config: GroupConfiguration,
+        log: Log,
+        kvstore: KvStore,
+        arrays: ShardGroupArrays,
+        send: SendFn,
+        election_timeout_s: float = 0.3,
+    ):
+        self.group_id = group_id
+        self.node_id = node_id
+        self.config = config
+        self.log = log
+        self._kvstore = kvstore
+        self.arrays = arrays
+        self._send = send
+        self._election_timeout = election_timeout_s
+
+        self.row = arrays.alloc_row()
+        self.role = Role.FOLLOWER
+        self.leader_id: Optional[int] = None
+        self._voted_for: Optional[int] = None
+        self._slot_map: dict[int, int] = {}
+        self._next_index: dict[int, int] = {}
+        self._peer_locks: dict[int, asyncio.Lock] = {}
+        self._last_heartbeat = 0.0
+        self._commit_event = asyncio.Event()
+        self._leadership_waiters: list[asyncio.Event] = []
+        self._timer_task: Optional[asyncio.Task] = None
+        self._bg_tasks: set[asyncio.Task] = set()
+        self._append_lock = asyncio.Lock()  # append_entries_buffer analog
+        self._vote_lock = asyncio.Lock()
+        self._closed = False
+
+    # ---------------------------------------------------------- setup
+    def _vote_key(self) -> bytes:
+        return f"vote/{self.group_id}".encode()
+
+    def _load_vote_state(self) -> None:
+        raw = self._kvstore.get(KeySpace.consensus, self._vote_key())
+        if raw is not None:
+            st = _VoteState.decode(raw)
+            self.arrays.term[self.row] = max(int(st.term), 0)
+            self._voted_for = st.voted_for if st.voted_for >= 0 else None
+
+    def _persist_vote_state(self) -> None:
+        st = _VoteState(
+            term=int(self.term),
+            voted_for=self._voted_for if self._voted_for is not None else -1,
+        )
+        self._kvstore.put(KeySpace.consensus, self._vote_key(), st.encode())
+
+    def _rebuild_slots(self) -> None:
+        """slot 0 = self; peers in sorted order. Rewrites voter masks
+        (host slow path — membership is a control-plane event)."""
+        row = self.row
+        self._slot_map = {self.node_id: SELF_SLOT}
+        peers = sorted(n for n in self.config.all_nodes() if n != self.node_id)
+        if len(peers) + 1 > self.arrays.replica_slots:
+            raise ValueError("replication factor exceeds replica slots")
+        self.arrays.is_voter[row] = False
+        self.arrays.is_voter_old[row] = False
+        self.arrays.is_voter[row, SELF_SLOT] = self.config.is_voter(self.node_id)
+        self.arrays.is_voter_old[row, SELF_SLOT] = self.node_id in self.config.old_voters
+        for i, peer in enumerate(peers):
+            slot = i + 1
+            self._slot_map[peer] = slot
+            self.arrays.is_voter[row, slot] = self.config.is_voter(peer)
+            self.arrays.is_voter_old[row, slot] = peer in self.config.old_voters
+            self._peer_locks.setdefault(peer, asyncio.Lock())
+
+    async def start(self) -> None:
+        self._load_vote_state()
+        self._rebuild_slots()
+        offs = self.log.offsets()
+        row = self.row
+        self.arrays.match_index[row, SELF_SLOT] = offs.dirty_offset
+        self.arrays.flushed_index[row, SELF_SLOT] = offs.committed_offset
+        last_term = self.log.term_of_last_batch()
+        if last_term > self.term:
+            self.arrays.term[row] = last_term
+        self._last_heartbeat = asyncio.get_event_loop().time()
+        self._timer_task = asyncio.ensure_future(self._election_loop())
+
+    async def stop(self) -> None:
+        self._closed = True
+        for t in [self._timer_task, *self._bg_tasks]:
+            if t is not None:
+                t.cancel()
+        tasks = [t for t in [self._timer_task, *self._bg_tasks] if t is not None]
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        self._notify_commit()  # release waiters
+
+    # ------------------------------------------------------ properties
+    @property
+    def term(self) -> int:
+        return int(self.arrays.term[self.row])
+
+    @property
+    def commit_index(self) -> int:
+        return int(self.arrays.commit_index[self.row])
+
+    @property
+    def last_visible_index(self) -> int:
+        return int(self.arrays.last_visible[self.row])
+
+    def is_leader(self) -> bool:
+        return self.role == Role.LEADER
+
+    def peers(self) -> list[int]:
+        return [n for n in self.config.all_nodes() if n != self.node_id]
+
+    def dirty_offset(self) -> int:
+        return int(self.arrays.match_index[self.row, SELF_SLOT])
+
+    def flushed_offset(self) -> int:
+        return int(self.arrays.flushed_index[self.row, SELF_SLOT])
+
+    # ------------------------------------------------------- elections
+    async def _election_loop(self) -> None:
+        while not self._closed:
+            timeout = self._election_timeout * (1.0 + random.random())
+            await asyncio.sleep(timeout)
+            if self._closed or self.role == Role.LEADER:
+                continue
+            now = asyncio.get_event_loop().time()
+            if now - self._last_heartbeat < self._election_timeout:
+                continue
+            if not self.config.is_voter(self.node_id):
+                continue
+            try:
+                await self.dispatch_vote()
+            except Exception:
+                logger.exception("g%d: election round failed", self.group_id)
+
+    async def dispatch_vote(self, leadership_transfer: bool = False) -> bool:
+        """One election round (vote_stm.cc). Returns True on win.
+
+        The vote lock is held only for the local state mutations, NOT
+        across the remote gather — two simultaneous candidates holding
+        their locks across RPCs would block each other's handle_vote
+        until timeout and systematically fail contested rounds."""
+        async with self._vote_lock:
+            row = self.row
+            self.role = Role.CANDIDATE
+            self.leader_id = None
+            self.arrays.term[row] = self.term + 1
+            term = self.term
+            self._voted_for = self.node_id
+            self._persist_vote_state()
+            offs = self.log.offsets()
+            req = rt.VoteRequest(
+                group=self.group_id,
+                node_id=self.node_id,
+                term=term,
+                prev_log_index=offs.dirty_offset,
+                prev_log_term=self.log.term_of_last_batch(),
+                leadership_transfer=leadership_transfer,
+                prevote=False,
+            ).encode()
+
+        async def ask(peer: int) -> Optional[rt.VoteReply]:
+            try:
+                raw = await self._send(peer, rt.VOTE, req, self._election_timeout)
+                return rt.VoteReply.decode(raw)
+            except Exception:
+                return None
+
+        peers = self.peers()
+        replies = await asyncio.gather(*(ask(p) for p in peers))
+
+        async with self._vote_lock:
+            granted = {self.node_id}
+            for peer, rep in zip(peers, replies):
+                if rep is None:
+                    continue
+                if rep.term > term:
+                    self._step_down(int(rep.term))
+                    return False
+                if rep.granted:
+                    granted.add(peer)
+            # state may have moved while gathering: only claim
+            # leadership if still the same term's candidate
+            if self.term != term or self.role != Role.CANDIDATE:
+                return False
+            if self._has_majority(granted):
+                self._become_leader()
+                return True
+            self.role = Role.FOLLOWER
+            return False
+
+    def _has_majority(self, granted: set[int]) -> bool:
+        cur = [v for v in self.config.voters if v in granted]
+        ok = len(cur) >= self.config.majority_size()
+        if self.config.is_joint():
+            old = [v for v in self.config.old_voters if v in granted]
+            ok = ok and len(old) >= (len(self.config.old_voters) // 2 + 1)
+        return ok
+
+    def _become_leader(self) -> None:
+        row = self.row
+        self.role = Role.LEADER
+        self.leader_id = self.node_id
+        offs = self.log.offsets()
+        self.arrays.is_leader[row] = True
+        # reset follower tracking for the new term
+        for peer, slot in self._slot_map.items():
+            if peer == self.node_id:
+                continue
+            self.arrays.match_index[row, slot] = NO_OFFSET
+            self.arrays.flushed_index[row, slot] = NO_OFFSET
+            self._next_index[peer] = offs.dirty_offset + 1
+        # commit gate: only entries of our own term count
+        # (consensus.cc:2741 / Raft §5.4.2) — established by replicating
+        # the configuration in the new term
+        self.arrays.term_start[row] = offs.dirty_offset + 1
+        builder = RecordBatchBuilder(batch_type=RecordBatchType.raft_configuration)
+        builder.add(value=self.config.encode(), key=b"raft_configuration")
+        batch = builder.build()
+        base, last = self.log.append(batch, term=self.term)
+        flushed = self.log.flush()
+        self.arrays.match_index[row, SELF_SLOT] = last
+        self.arrays.flushed_index[row, SELF_SLOT] = flushed
+        if self.arrays.scalar_commit_update(row):
+            self._notify_commit()
+        logger.info(
+            "g%d: node %d elected leader term %d", self.group_id, self.node_id, self.term
+        )
+        for ev in self._leadership_waiters:
+            ev.set()
+        # establish leadership immediately
+        for peer in self.peers():
+            self._spawn(self._catch_up(peer))
+
+    def _step_down(self, term: int) -> None:
+        row = self.row
+        if term > self.term:
+            self.arrays.term[row] = term
+            self._voted_for = None
+            self._persist_vote_state()
+        if self.role == Role.LEADER:
+            logger.info("g%d: node %d stepping down term %d", self.group_id, self.node_id, term)
+        self.role = Role.FOLLOWER
+        self.arrays.is_leader[row] = False
+        self._notify_commit()  # wake replicate waiters → they fail fast
+
+    async def wait_for_leadership(self, timeout: float = 5.0) -> None:
+        if self.is_leader():
+            return
+        ev = asyncio.Event()
+        self._leadership_waiters.append(ev)
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        finally:
+            self._leadership_waiters.remove(ev)
+
+    # ---------------------------------------------------------- voting
+    async def handle_vote(self, req: rt.VoteRequest) -> rt.VoteReply:
+        async with self._vote_lock:
+            if req.term < self.term:
+                return rt.VoteReply(
+                    group=self.group_id, term=self.term, granted=False, log_ok=False
+                )
+            offs = self.log.offsets()
+            last_term = self.log.term_of_last_batch()
+            log_ok = (req.prev_log_term > last_term) or (
+                req.prev_log_term == last_term
+                and req.prev_log_index >= offs.dirty_offset
+            )
+            if req.term > self.term:
+                self._step_down(int(req.term))
+            granted = log_ok and (
+                self._voted_for is None or self._voted_for == req.node_id
+            )
+            if granted:
+                self._voted_for = int(req.node_id)
+                self._persist_vote_state()
+                # grant ⇒ suppress own election for a while
+                self._last_heartbeat = asyncio.get_event_loop().time()
+            return rt.VoteReply(
+                group=self.group_id, term=self.term, granted=granted, log_ok=log_ok
+            )
+
+    # ------------------------------------------------ follower appends
+    async def handle_append_entries(
+        self, req: rt.AppendEntriesRequest
+    ) -> rt.AppendEntriesReply:
+        """Follower-side append path (consensus.cc:1734 do_append_entries),
+        serialized per group (append_entries_buffer analog)."""
+        async with self._append_lock:
+            return await self._do_append_entries(req)
+
+    def _reply(self, status: int, seq: int) -> rt.AppendEntriesReply:
+        return rt.AppendEntriesReply(
+            group=self.group_id,
+            node_id=self.node_id,
+            term=self.term,
+            last_dirty_log_index=self.dirty_offset(),
+            last_flushed_log_index=self.flushed_offset(),
+            seq=seq,
+            status=status,
+        )
+
+    async def _do_append_entries(
+        self, req: rt.AppendEntriesRequest
+    ) -> rt.AppendEntriesReply:
+        row = self.row
+        # 1. term checks (consensus.cc:1752-1774)
+        if req.term < self.term:
+            return self._reply(rt.AppendEntriesReply.FAILURE, int(req.seq))
+        self._last_heartbeat = asyncio.get_event_loop().time()
+        if req.term > self.term or self.role != Role.FOLLOWER:
+            self._step_down(int(req.term))
+        self.leader_id = int(req.node_id)
+
+        offs = self.log.offsets()
+        # 2. gap check (consensus.cc:1789)
+        if req.prev_log_index > offs.dirty_offset:
+            return self._reply(rt.AppendEntriesReply.FAILURE, int(req.seq))
+        # 3. prev-term match (consensus.cc:1800-1828)
+        if req.prev_log_index >= offs.start_offset and req.prev_log_index >= 0:
+            local_term = self.log.get_term(req.prev_log_index)
+            if local_term is None or local_term != req.prev_log_term:
+                return self._reply(rt.AppendEntriesReply.FAILURE, int(req.seq))
+
+        # 4. append, truncating on conflict (consensus.cc:1869-1928).
+        # Entries at-or-below `last_new_entry` are verified identical to
+        # the leader's log; the commit update below must never run past
+        # it (Raft §5.3: min(leaderCommit, index of last new entry)) —
+        # a retained local suffix beyond it may be divergent.
+        appended = False
+        last_new_entry = int(req.prev_log_index)
+        for raw in req.batches:
+            batch = RecordBatch.deserialize(raw)
+            base = batch.header.base_offset
+            cur = self.log.offsets()
+            if base <= cur.dirty_offset:
+                local_term = self.log.get_term(base)
+                if local_term == batch.header.term:
+                    last_new_entry = batch.header.last_offset
+                    continue  # duplicate delivery
+                # safety gate BEFORE any destruction: committed data
+                # must never be truncated
+                if self.commit_index >= base:
+                    raise RuntimeError(
+                        f"g{self.group_id}: attempt to truncate committed "
+                        f"offset {base} <= {self.commit_index}"
+                    )
+                logger.info(
+                    "g%d: truncating at %d (term conflict %s != %d)",
+                    self.group_id, base, local_term, batch.header.term,
+                )
+                self.log.truncate(base)
+                self.arrays.match_index[row, SELF_SLOT] = base - 1
+                self.arrays.flushed_index[row, SELF_SLOT] = min(
+                    int(self.arrays.flushed_index[row, SELF_SLOT]), base - 1
+                )
+            self.log.append_exactly(batch)
+            appended = True
+            last_new_entry = batch.header.last_offset
+        if appended or req.flush:
+            flushed = self.log.flush()
+            new_offs = self.log.offsets()
+            self.arrays.match_index[row, SELF_SLOT] = new_offs.dirty_offset
+            self.arrays.flushed_index[row, SELF_SLOT] = flushed
+
+        # 5. follower commit index (consensus.cc:2760-2777), capped at
+        # the last entry confirmed to match the leader's log
+        new_commit = qs.follower_commit_index(
+            self.commit_index,
+            self.flushed_offset(),
+            min(int(req.commit_index), last_new_entry),
+        )
+        if new_commit != self.commit_index:
+            self.arrays.commit_index[row] = new_commit
+            self.arrays.last_visible[row] = max(
+                int(self.arrays.last_visible[row]), new_commit
+            )
+            self._notify_commit()
+        return self._reply(rt.AppendEntriesReply.SUCCESS, int(req.seq))
+
+    def handle_heartbeat(
+        self,
+        leader_id: int,
+        term: int,
+        prev_log_index: int,
+        prev_log_term: int,
+        commit_index: int,
+        seq: int,
+    ) -> tuple[int, int, int, int, int]:
+        """Empty-append fast path (consensus.cc:1833-1846). Runs the
+        SAME term/gap/prev-term checks as the full append path — a
+        heartbeat is an empty append_entries in the reference, and
+        skipping the checks would let a rejoining divergent follower
+        commit its own never-replicated suffix. Returns
+        (term, dirty, flushed, seq, status) for the batched reply.
+        Synchronous: no log I/O on this path."""
+        row = self.row
+        if term < self.term:
+            return (self.term, self.dirty_offset(), self.flushed_offset(), seq,
+                    rt.AppendEntriesReply.FAILURE)
+        self._last_heartbeat = asyncio.get_event_loop().time()
+        if term > self.term or self.role != Role.FOLLOWER:
+            self._step_down(term)
+        self.leader_id = leader_id
+        # gap / prev-term consistency (consensus.cc:1789-1828): reject
+        # without committing anything if our log does not match the
+        # leader's view at prev
+        if prev_log_index > self.dirty_offset():
+            return (self.term, self.dirty_offset(), self.flushed_offset(), seq,
+                    rt.AppendEntriesReply.FAILURE)
+        if prev_log_index >= 0 and prev_log_index >= self.log.offsets().start_offset:
+            local_term = self.log.get_term(prev_log_index)
+            if local_term is None or local_term != prev_log_term:
+                return (self.term, self.dirty_offset(), self.flushed_offset(), seq,
+                        rt.AppendEntriesReply.FAILURE)
+        # only entries ≤ prev are confirmed identical to the leader's
+        # log; never commit a (possibly divergent) local suffix beyond
+        # it (Raft §5.3: min(leaderCommit, index of last new entry))
+        capped = min(commit_index, prev_log_index) if prev_log_index >= 0 else -1
+        new_commit = qs.follower_commit_index(
+            self.commit_index, self.flushed_offset(), capped
+        )
+        if new_commit != self.commit_index:
+            self.arrays.commit_index[row] = new_commit
+            self.arrays.last_visible[row] = max(
+                int(self.arrays.last_visible[row]), new_commit
+            )
+            self._notify_commit()
+        return (self.term, self.dirty_offset(), self.flushed_offset(), seq,
+                rt.AppendEntriesReply.SUCCESS)
+
+    # ------------------------------------------------- leader replicate
+    async def replicate(
+        self,
+        builder_or_batch: "RecordBatchBuilder | RecordBatch",
+        acks: int = -1,
+        timeout: float = 10.0,
+    ) -> tuple[int, int]:
+        """Leader write path (consensus.cc:717 replicate). acks: -1 =
+        quorum (wait for commit), 1 = leader ack (local flush only),
+        0 = fire and forget. Returns (base, last) assigned offsets."""
+        if self.role != Role.LEADER:
+            raise NotLeaderError(self.leader_id)
+        row = self.row
+        term = self.term
+        batch = (
+            builder_or_batch.build()
+            if isinstance(builder_or_batch, RecordBatchBuilder)
+            else builder_or_batch
+        )
+        base, last = self.log.append(batch, term=term)
+        flushed = self.log.flush()
+        self.arrays.match_index[row, SELF_SLOT] = last
+        self.arrays.flushed_index[row, SELF_SLOT] = flushed
+        # the local flush itself can complete a quorum (RF=1, or
+        # followers already ahead): consensus.cc:2704 runs after every
+        # flush, not only on replies
+        if self.arrays.scalar_commit_update(row):
+            self._notify_commit()
+        for peer in self.peers():
+            self._spawn(self._catch_up(peer))
+        if acks == 0 or acks == 1:
+            return base, last
+        # acks=all: wait for quorum commit
+        deadline = asyncio.get_event_loop().time() + timeout
+        while self.commit_index < last:
+            if self._closed:
+                raise ReplicateTimeout("node stopped")
+            if self.role != Role.LEADER or self.term != term:
+                raise NotLeaderError(self.leader_id)
+            remaining = deadline - asyncio.get_event_loop().time()
+            if remaining <= 0:
+                raise ReplicateTimeout(
+                    f"g{self.group_id}: offset {last} not committed in {timeout}s"
+                )
+            ev = self._commit_event
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                continue
+        if self.log.get_term(base) != term:
+            # truncated by a newer leader while waiting
+            raise NotLeaderError(self.leader_id)
+        return base, last
+
+    def _notify_commit(self) -> None:
+        ev = self._commit_event
+        self._commit_event = asyncio.Event()
+        ev.set()
+
+    async def wait_committed(self, offset: int, timeout: float = 10.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while self.commit_index < offset:
+            remaining = deadline - asyncio.get_event_loop().time()
+            if remaining <= 0:
+                raise ReplicateTimeout(f"offset {offset} not committed")
+            ev = self._commit_event
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                continue
+
+    def _spawn(self, coro) -> None:
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+
+    async def _catch_up(self, peer: int) -> None:
+        """Per-follower replication/recovery fiber
+        (replicate_entries_stm.cc dispatch_one + recovery_stm). Drives
+        the follower to the leader's dirty offset, backing off
+        next_index on log mismatch."""
+        lock = self._peer_locks.setdefault(peer, asyncio.Lock())
+        if lock.locked():
+            return  # a fiber is already driving this follower
+        async with lock:
+            while (
+                not self._closed
+                and self.role == Role.LEADER
+                and self._follower_needs_data(peer)
+            ):
+                if not await self._dispatch_append(peer):
+                    return
+
+    def _follower_needs_data(self, peer: int) -> bool:
+        slot = self._slot_map[peer]
+        match = int(self.arrays.match_index[self.row, slot])
+        flushed = int(self.arrays.flushed_index[self.row, slot])
+        return match < self.dirty_offset() or flushed < match
+
+    async def _dispatch_append(self, peer: int) -> bool:
+        """One append_entries round to one follower. Returns False to
+        stop the catch-up fiber (rpc error / stepped down)."""
+        row = self.row
+        slot = self._slot_map[peer]
+        term = self.term
+        next_idx = self._next_index.get(peer, self.dirty_offset() + 1)
+        prev = next_idx - 1
+        offs = self.log.offsets()
+        if prev >= 0 and prev < offs.start_offset:
+            # follower needs data below our start: snapshot territory
+            logger.warning("g%d: follower %d below log start", self.group_id, peer)
+            return False
+        prev_term = self.log.get_term(prev) if prev >= 0 else -1
+        if prev_term is None:
+            prev_term = -1
+        batches = self.log.read(next_idx, max_bytes=1 << 20) if next_idx <= offs.dirty_offset else []
+        seq = int(self.arrays.next_seq[row, slot]) + 1
+        self.arrays.next_seq[row, slot] = seq
+        req = rt.AppendEntriesRequest(
+            group=self.group_id,
+            node_id=self.node_id,
+            target_node_id=peer,
+            term=term,
+            prev_log_index=prev,
+            prev_log_term=prev_term,
+            commit_index=self.commit_index,
+            seq=seq,
+            flush=True,
+            batches=[b.serialize() for b in batches],
+        ).encode()
+        try:
+            raw = await self._send(peer, rt.APPEND_ENTRIES, req, 5.0)
+            rep = rt.AppendEntriesReply.decode(raw)
+        except Exception:
+            return False
+        if self._closed or self.role != Role.LEADER or self.term != term:
+            return False
+        if rep.term > term:
+            self._step_down(int(rep.term))
+            return False
+        if rep.status == rt.AppendEntriesReply.SUCCESS:
+            self.process_append_reply(
+                peer,
+                int(rep.last_dirty_log_index),
+                int(rep.last_flushed_log_index),
+                int(rep.seq),
+            )
+            self._next_index[peer] = int(rep.last_dirty_log_index) + 1
+            return True
+        # log mismatch: back off (consensus.cc follower hints)
+        self._next_index[peer] = min(
+            max(0, next_idx - 1), int(rep.last_dirty_log_index) + 1
+        )
+        return True
+
+    def process_append_reply(
+        self, peer: int, dirty: int, flushed: int, seq: int
+    ) -> None:
+        """Fold one follower reply into the SoA (scalar fast path,
+        update_follower_index consensus.cc:274) and advance commit.
+        The batched tick (heartbeat manager) does the same via the
+        device kernel for whole reply batches."""
+        row = self.row
+        slot = self._slot_map.get(peer)
+        if slot is None:
+            return
+        if seq <= int(self.arrays.last_seq[row, slot]):
+            return  # reordered reply
+        self.arrays.last_seq[row, slot] = seq
+        self.arrays.match_index[row, slot] = max(
+            int(self.arrays.match_index[row, slot]), dirty
+        )
+        self.arrays.flushed_index[row, slot] = max(
+            int(self.arrays.flushed_index[row, slot]), flushed
+        )
+        if self.arrays.scalar_commit_update(row):
+            self._notify_commit()
+
+    def on_batched_commit_advance(self) -> None:
+        """Called by the heartbeat manager after the device sweep
+        advanced this group's commit index."""
+        self._notify_commit()
+
+    # ------------------------------------------------------ membership
+    async def transfer_leadership(self, target: int, timeout: float = 5.0) -> None:
+        """reference: consensus.cc do_transfer_leadership → timeout_now."""
+        if self.role != Role.LEADER:
+            raise NotLeaderError(self.leader_id)
+        if target not in self._slot_map:
+            raise ValueError(f"node {target} not in configuration")
+        # bring the target fully up to date first
+        await self._catch_up(target)
+        req = rt.TimeoutNowRequest(
+            group=self.group_id, node_id=self.node_id, term=self.term
+        ).encode()
+        await self._send(target, rt.TIMEOUT_NOW, req, timeout)
+
+    async def handle_timeout_now(self, req: rt.TimeoutNowRequest) -> rt.TimeoutNowReply:
+        if req.term >= self.term:
+            self._spawn(self.dispatch_vote(leadership_transfer=True))
+        return rt.TimeoutNowReply(group=self.group_id, term=self.term)
+
+    async def change_configuration(self, new_voters: list[int], timeout: float = 10.0) -> None:
+        """Joint-consensus reconfiguration (group_configuration.cc):
+        replicate joint config, commit, then replicate final config."""
+        if self.role != Role.LEADER:
+            raise NotLeaderError(self.leader_id)
+        joint = self.config.enter_joint(new_voters, self.config.revision + 1)
+        await self._replicate_config(joint, timeout)
+        final = joint.leave_joint(joint.revision + 1)
+        await self._replicate_config(final, timeout)
+
+    async def _replicate_config(self, cfg: GroupConfiguration, timeout: float) -> None:
+        self.config = cfg
+        self._rebuild_slots()
+        builder = RecordBatchBuilder(batch_type=RecordBatchType.raft_configuration)
+        builder.add(value=cfg.encode(), key=b"raft_configuration")
+        await self.replicate(builder, acks=-1, timeout=timeout)
+
+    def apply_configuration_batch(self, batch: RecordBatch) -> None:
+        """Follower-side config application when a raft_configuration
+        batch lands in the log (configuration_manager analog)."""
+        for rec in batch.records():
+            if rec.value is not None:
+                self.config = GroupConfiguration.decode(rec.value)
+                self._rebuild_slots()

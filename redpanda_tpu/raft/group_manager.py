@@ -1,0 +1,100 @@
+"""Per-node raft group registry (reference: src/v/raft/group_manager.{h,cc}).
+
+Creates/removes consensus instances, owns the shared shard SoA
+(ShardGroupArrays), the batched HeartbeatManager, and the RaftService,
+and wires peer I/O through a Transport-protocol send function
+(connection cache in production, loopback network in fixtures).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from ..storage.kvstore import KvStore
+from ..storage.log import Log, LogConfig
+from .configuration import GroupConfiguration
+from .consensus import Consensus
+from .heartbeat_manager import HeartbeatManager
+from .service import RaftService
+from .shard_state import ShardGroupArrays
+
+
+class GroupManager:
+    def __init__(
+        self,
+        node_id: int,
+        data_dir: str,
+        send: Callable,  # async (node_id, method_id, payload, timeout) -> bytes
+        election_timeout_s: float = 0.3,
+        heartbeat_interval_s: float = 0.05,
+        kvstore: Optional[KvStore] = None,
+    ):
+        self.node_id = node_id
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self._send = send
+        self._election_timeout = election_timeout_s
+        self.kvstore = kvstore or KvStore(os.path.join(data_dir, "kvstore"))
+        self._owns_kvstore = kvstore is None
+        self.arrays = ShardGroupArrays()
+        self.heartbeat_manager = HeartbeatManager(
+            node_id, send, interval_s=heartbeat_interval_s
+        )
+        self.service = RaftService(self)
+        self._groups: dict[int, Consensus] = {}
+        self._started = False
+
+    def get(self, group_id: int) -> Optional[Consensus]:
+        return self._groups.get(group_id)
+
+    def groups(self) -> list[Consensus]:
+        return list(self._groups.values())
+
+    async def start(self) -> None:
+        self.arrays.prewarm()
+        await self.heartbeat_manager.start()
+        self._started = True
+
+    async def stop(self) -> None:
+        await self.heartbeat_manager.stop()
+        for c in list(self._groups.values()):
+            await c.stop()
+        if self._owns_kvstore:
+            self.kvstore.close()
+        self._started = False
+
+    async def create_group(
+        self,
+        group_id: int,
+        voters: list[int],
+        log: Optional[Log] = None,
+        log_config: Optional[LogConfig] = None,
+        election_timeout_s: Optional[float] = None,
+    ) -> Consensus:
+        if group_id in self._groups:
+            raise ValueError(f"group {group_id} exists")
+        if log is None:
+            log_dir = os.path.join(self.data_dir, f"group_{group_id}")
+            log = Log(log_dir, config=log_config)
+        c = Consensus(
+            group_id=group_id,
+            node_id=self.node_id,
+            config=GroupConfiguration.simple(voters),
+            log=log,
+            kvstore=self.kvstore,
+            arrays=self.arrays,
+            send=self._send,
+            election_timeout_s=election_timeout_s or self._election_timeout,
+        )
+        self._groups[group_id] = c
+        await c.start()
+        self.heartbeat_manager.register(c)
+        return c
+
+    async def remove_group(self, group_id: int) -> None:
+        c = self._groups.pop(group_id, None)
+        if c is not None:
+            self.heartbeat_manager.deregister(group_id)
+            await c.stop()
+            self.arrays.free_row(c.row)

@@ -1,0 +1,174 @@
+"""Batched heartbeat manager — the 50k-partition sweep
+(reference: src/v/raft/heartbeat_manager.{h,cc}).
+
+The reference batches heartbeats of all raft groups per target node
+into one RPC (heartbeat_manager.h:54-83) but still builds and folds
+them with per-group scalar loops (heartbeat_manager.cc:203). Here both
+directions are array programs over the shard SoA:
+
+  build:  numpy gathers over [G] state → per-node parallel vectors
+  fold:   ONE jitted device call (ops.quorum.heartbeat_tick_jit) folds
+          every reply from every node AND advances every group's
+          commit index (the north-star kernel; bench.py measures it)
+
+Leaders whose followers lag (match < dirty) get a catch-up fiber
+scheduled — the recovery_stm hand-off.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, Optional
+
+import numpy as np
+
+from . import types as rt
+from .consensus import Consensus, Role
+
+logger = logging.getLogger("raft.heartbeat")
+
+SendFn = Callable[[int, int, bytes, float], Awaitable[bytes]]
+
+
+class HeartbeatManager:
+    def __init__(
+        self,
+        node_id: int,
+        send: SendFn,
+        interval_s: float = 0.05,
+        rpc_timeout_s: float = 1.0,
+    ):
+        self.node_id = node_id
+        self._send = send
+        self.interval = interval_s
+        self._rpc_timeout = rpc_timeout_s
+        self._groups: dict[int, Consensus] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    def register(self, c: Consensus) -> None:
+        self._groups[c.group_id] = c
+
+    def deregister(self, group_id: int) -> None:
+        self._groups.pop(group_id, None)
+
+    async def start(self) -> None:
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _loop(self) -> None:
+        while not self._closed:
+            try:
+                await self.tick()
+            except Exception:
+                logger.exception("heartbeat tick failed")
+            await asyncio.sleep(self.interval)
+
+    async def tick(self) -> None:
+        """One sweep: build per-node batches, send in parallel, fold
+        ALL replies with one device call."""
+        leaders = [c for c in self._groups.values() if c.role == Role.LEADER]
+        if not leaders:
+            return
+        # build per-target-node vectors (build_heartbeats analog)
+        per_node: dict[int, list[Consensus]] = {}
+        for c in leaders:
+            for peer in c.peers():
+                per_node.setdefault(peer, []).append(c)
+
+        async def one_node(peer: int, groups: list[Consensus]):
+            reqs = []
+            for c in groups:
+                row, slot = c.row, c._slot_map[peer]
+                seq = int(c.arrays.next_seq[row, slot]) + 1
+                c.arrays.next_seq[row, slot] = seq
+                prev = int(c.arrays.match_index[row, slot])
+                prev_term = c.log.get_term(prev) if prev >= 0 else -1
+                if prev_term is None:
+                    prev_term = -1
+                reqs.append(
+                    (c.group_id, c.term, prev, prev_term, c.commit_index, seq)
+                )
+            msg = rt.HeartbeatRequest(
+                node_id=self.node_id,
+                target_node_id=peer,
+                groups=[r[0] for r in reqs],
+                terms=[r[1] for r in reqs],
+                prev_log_indices=[r[2] for r in reqs],
+                prev_log_terms=[r[3] for r in reqs],
+                commit_indices=[r[4] for r in reqs],
+                seqs=[r[5] for r in reqs],
+            ).encode()
+            try:
+                raw = await self._send(peer, rt.HEARTBEAT, msg, self._rpc_timeout)
+                return peer, rt.HeartbeatReply.decode(raw)
+            except Exception:
+                return peer, None
+
+        results = await asyncio.gather(
+            *(one_node(p, gs) for p, gs in per_node.items())
+        )
+        # fold: flatten every successful reply into one batch
+        rows, slots, dirty, flushed, seqs = [], [], [], [], []
+        for peer, reply in results:
+            if reply is None:
+                continue
+            for i, gid in enumerate(reply.groups):
+                c = self._groups.get(gid)
+                if c is None or c.role != Role.LEADER:
+                    continue
+                slot = c._slot_map.get(peer)
+                if slot is None:
+                    continue
+                if reply.statuses[i] != rt.AppendEntriesReply.SUCCESS:
+                    if reply.terms[i] > c.term:
+                        c._step_down(int(reply.terms[i]))
+                    else:
+                        # log-mismatch/gap rejection: our match estimate
+                        # is wrong (e.g. follower lost its tail). Rewind
+                        # it host-side so the catch-up fiber engages —
+                        # the device fold is monotone and cannot.
+                        slot = c._slot_map.get(peer)
+                        if slot is not None and reply.last_dirty[i] >= -1:
+                            c.arrays.match_index[c.row, slot] = min(
+                                int(c.arrays.match_index[c.row, slot]),
+                                int(reply.last_dirty[i]),
+                            )
+                            c._spawn(c._catch_up(peer))
+                    continue
+                rows.append(c.row)
+                slots.append(slot)
+                dirty.append(reply.last_dirty[i])
+                flushed.append(reply.last_flushed[i])
+                seqs.append(reply.seqs[i])
+        if not rows:
+            return  # no successful replies: the sweep cannot advance
+        arrays = leaders[0].arrays
+        advanced = arrays.device_tick(
+            np.array(rows, np.int64),
+            np.array(slots, np.int64),
+            np.array(dirty, np.int64),
+            np.array(flushed, np.int64),
+            np.array(seqs, np.int64),
+        )
+        if len(advanced):
+            advanced_set = set(int(r) for r in advanced)
+            for c in self._groups.values():
+                if c.row in advanced_set:
+                    c.on_batched_commit_advance()
+        # recovery: schedule catch-up for lagging followers
+        for c in leaders:
+            if c.role != Role.LEADER:
+                continue
+            for peer in c.peers():
+                if c._follower_needs_data(peer):
+                    c._spawn(c._catch_up(peer))

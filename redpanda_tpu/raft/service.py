@@ -1,0 +1,97 @@
+"""Raft RPC service (reference: src/v/raft/service.h:45-117).
+
+Dispatches vote/append_entries/timeout_now per group, and handles the
+node-level heartbeat batch: the reference regroups the batch by
+destination shard (service.h:83-90); here all groups of the node live
+on one event loop, so the batch is answered in one pass with no
+per-group RPC overhead — the follower side of the batched sweep.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..rpc import Service, method
+from . import types as rt
+
+logger = logging.getLogger("raft.service")
+
+
+class RaftService(Service):
+    service_name = "raft"
+
+    def __init__(self, group_manager):
+        self._gm = group_manager
+
+    def _consensus(self, group_id: int):
+        return self._gm.get(group_id)
+
+    @method(rt.VOTE)
+    async def vote(self, payload: bytes) -> bytes:
+        req = rt.VoteRequest.decode(payload)
+        c = self._consensus(int(req.group))
+        if c is None:
+            return rt.VoteReply(
+                group=int(req.group), term=-1, granted=False, log_ok=False
+            ).encode()
+        return (await c.handle_vote(req)).encode()
+
+    @method(rt.APPEND_ENTRIES)
+    async def append_entries(self, payload: bytes) -> bytes:
+        req = rt.AppendEntriesRequest.decode(payload)
+        c = self._consensus(int(req.group))
+        if c is None:
+            return rt.AppendEntriesReply(
+                group=int(req.group),
+                node_id=self._gm.node_id,
+                term=-1,
+                last_dirty_log_index=-1,
+                last_flushed_log_index=-1,
+                seq=int(req.seq),
+                status=rt.AppendEntriesReply.GROUP_UNAVAILABLE,
+            ).encode()
+        return (await c.handle_append_entries(req)).encode()
+
+    @method(rt.HEARTBEAT)
+    async def heartbeat(self, payload: bytes) -> bytes:
+        req = rt.HeartbeatRequest.decode(payload)
+        terms, dirty, flushed, seqs, statuses = [], [], [], [], []
+        for i, gid in enumerate(req.groups):
+            c = self._consensus(int(gid))
+            if c is None:
+                terms.append(-1)
+                dirty.append(-1)
+                flushed.append(-1)
+                seqs.append(int(req.seqs[i]))
+                statuses.append(rt.AppendEntriesReply.GROUP_UNAVAILABLE)
+                continue
+            t, d, f, s, st = c.handle_heartbeat(
+                int(req.node_id),
+                int(req.terms[i]),
+                int(req.prev_log_indices[i]),
+                int(req.prev_log_terms[i]),
+                int(req.commit_indices[i]),
+                int(req.seqs[i]),
+            )
+            terms.append(t)
+            dirty.append(d)
+            flushed.append(f)
+            seqs.append(s)
+            statuses.append(st)
+        return rt.HeartbeatReply(
+            node_id=self._gm.node_id,
+            groups=list(req.groups),
+            terms=terms,
+            last_dirty=dirty,
+            last_flushed=flushed,
+            seqs=seqs,
+            statuses=statuses,
+        ).encode()
+
+    @method(rt.TIMEOUT_NOW)
+    async def timeout_now(self, payload: bytes) -> bytes:
+        req = rt.TimeoutNowRequest.decode(payload)
+        c = self._consensus(int(req.group))
+        if c is None:
+            return rt.TimeoutNowReply(group=int(req.group), term=-1).encode()
+        return (await c.handle_timeout_now(req)).encode()

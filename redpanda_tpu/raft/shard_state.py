@@ -1,0 +1,214 @@
+"""Per-shard struct-of-arrays raft state + batched sweep driver.
+
+The host-side mirror of models.consensus_state.GroupState: every
+per-group scalar the quorum/commit math needs is a row in contiguous
+numpy arrays. `Consensus` objects own a row; the heartbeat manager
+steps ALL rows with one jitted device call per tick
+(ops.quorum.heartbeat_tick_jit) — the reference's per-group loops
+(heartbeat_manager.cc:203, consensus.cc:2704) collapsed into one
+program (SURVEY.md §3.3, the north-star sweep).
+
+Rows are recycled through a free list; freed rows are neutralized
+(is_leader=False, voter masks cleared) so they are no-ops in the sweep.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.consensus_state import (
+    DEFAULT_REPLICA_SLOTS,
+    SELF_SLOT,
+    GroupState,
+)
+from . import quorum_scalar as qs
+
+I64_MIN = np.int64(np.iinfo(np.int64).min)
+NO_OFFSET = np.int64(-1)
+
+
+class ShardGroupArrays:
+    def __init__(self, capacity: int = 64, replica_slots: int = DEFAULT_REPLICA_SLOTS):
+        self.replica_slots = replica_slots
+        self._cap = capacity
+        self._free: list[int] = list(range(capacity))
+        self._alloc_count = 0
+        g, r = capacity, replica_slots
+        self.term = np.zeros(g, np.int64)
+        self.is_leader = np.zeros(g, bool)
+        self.commit_index = np.full(g, NO_OFFSET, np.int64)
+        self.term_start = np.zeros(g, np.int64)
+        self.last_visible = np.full(g, NO_OFFSET, np.int64)
+        self.match_index = np.full((g, r), NO_OFFSET, np.int64)
+        self.flushed_index = np.full((g, r), NO_OFFSET, np.int64)
+        self.is_voter = np.zeros((g, r), bool)
+        self.is_voter_old = np.zeros((g, r), bool)
+        self.last_seq = np.zeros((g, r), np.int64)
+        # host-only: next request seq per (group, peer slot)
+        self.next_seq = np.zeros((g, r), np.int64)
+
+    # -- row lifecycle ------------------------------------------------
+    def alloc_row(self) -> int:
+        if not self._free:
+            self._grow()
+        row = self._free.pop()
+        self._alloc_count += 1
+        return row
+
+    def free_row(self, row: int) -> None:
+        self.reset_row(row)
+        self._free.append(row)
+        self._alloc_count -= 1
+
+    def reset_row(self, row: int) -> None:
+        self.term[row] = 0
+        self.is_leader[row] = False
+        self.commit_index[row] = NO_OFFSET
+        self.term_start[row] = 0
+        self.last_visible[row] = NO_OFFSET
+        self.match_index[row] = NO_OFFSET
+        self.flushed_index[row] = NO_OFFSET
+        self.is_voter[row] = False
+        self.is_voter_old[row] = False
+        self.last_seq[row] = 0
+        self.next_seq[row] = 0
+
+    def _grow(self) -> None:
+        old = self._cap
+        new = old * 2
+        for name in (
+            "term",
+            "is_leader",
+            "commit_index",
+            "term_start",
+            "last_visible",
+            "match_index",
+            "flushed_index",
+            "is_voter",
+            "is_voter_old",
+            "last_seq",
+            "next_seq",
+        ):
+            arr = getattr(self, name)
+            shape = (new,) + arr.shape[1:]
+            grown = np.zeros(shape, arr.dtype)
+            grown[:old] = arr
+            if arr.dtype == np.int64 and name in (
+                "commit_index",
+                "last_visible",
+                "match_index",
+                "flushed_index",
+            ):
+                grown[old:] = NO_OFFSET
+            setattr(self, name, grown)
+        self._free.extend(range(old, new))
+        self._cap = new
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    # -- scalar fast path (per-replicate quorum, reference semantics) -
+    def scalar_commit_update(self, row: int) -> bool:
+        """Recompute commit/visible for one group with the scalar
+        backend (quorum_scalar); returns True if commit advanced.
+        Bit-identical to the batched kernel (differential-tested)."""
+        if not self.is_leader[row]:
+            return False
+        replicas = []
+        for slot in range(self.replica_slots):
+            if self.is_voter[row, slot] or self.is_voter_old[row, slot]:
+                replicas.append(
+                    qs.ReplicaState(
+                        match_index=int(self.match_index[row, slot]),
+                        flushed_index=int(self.flushed_index[row, slot]),
+                        is_voter=bool(self.is_voter[row, slot]),
+                        is_voter_old=bool(self.is_voter_old[row, slot]),
+                    )
+                )
+        new_commit = qs.leader_commit_index(
+            replicas,
+            leader_flushed=int(self.flushed_index[row, SELF_SLOT]),
+            commit_index=int(self.commit_index[row]),
+            term_start=int(self.term_start[row]),
+        )
+        advanced = new_commit > self.commit_index[row]
+        self.commit_index[row] = new_commit
+        dirty = qs.leader_majority_dirty(
+            replicas, leader_dirty=int(self.match_index[row, SELF_SLOT])
+        )
+        self.last_visible[row] = max(
+            self.last_visible[row], new_commit, dirty if replicas else I64_MIN
+        )
+        return bool(advanced)
+
+    # -- batched device sweep ----------------------------------------
+    def to_device_state(self) -> GroupState:
+        import jax.numpy as jnp
+
+        return GroupState(
+            term=jnp.asarray(self.term),
+            is_leader=jnp.asarray(self.is_leader),
+            commit_index=jnp.asarray(self.commit_index),
+            term_start=jnp.asarray(self.term_start),
+            last_visible=jnp.asarray(self.last_visible),
+            match_index=jnp.asarray(self.match_index),
+            flushed_index=jnp.asarray(self.flushed_index),
+            is_voter=jnp.asarray(self.is_voter),
+            is_voter_old=jnp.asarray(self.is_voter_old),
+            last_seq=jnp.asarray(self.last_seq),
+        )
+
+    def device_tick(
+        self,
+        group_rows: np.ndarray,
+        replica_slots: np.ndarray,
+        last_dirty: np.ndarray,
+        last_flushed: np.ndarray,
+        seqs: np.ndarray,
+    ) -> np.ndarray:
+        """Fold a reply batch + advance every group's commit in ONE
+        compiled device program. Returns rows whose commit advanced.
+
+        The reply batch is padded to power-of-two buckets so XLA
+        compiles a handful of shapes total, not one per reply count;
+        padding entries carry seq = i64 min, which the fold's
+        reply-reordering guard drops (ops.quorum.fold_replies)."""
+        from ..ops.quorum import heartbeat_tick_jit
+
+        m = len(group_rows)
+        bucket = 8
+        while bucket < m:
+            bucket *= 2
+        pad = bucket - m
+        g_rows = np.zeros(bucket, np.int64)
+        g_slots = np.zeros(bucket, np.int64)
+        g_dirty = np.full(bucket, I64_MIN, np.int64)
+        g_flushed = np.full(bucket, I64_MIN, np.int64)
+        g_seqs = np.full(bucket, I64_MIN, np.int64)
+        if m:
+            g_rows[:m] = group_rows
+            g_slots[:m] = replica_slots
+            g_dirty[:m] = last_dirty
+            g_flushed[:m] = last_flushed
+            g_seqs[:m] = seqs
+
+        before = self.commit_index.copy()
+        state = self.to_device_state()
+        new = heartbeat_tick_jit(state, g_rows, g_slots, g_dirty, g_flushed, g_seqs)
+        # write back the sweep's outputs (np.array: the views produced
+        # from jax buffers are read-only; rows must stay host-writable)
+        self.commit_index = np.array(new.commit_index)
+        self.last_visible = np.array(new.last_visible)
+        self.match_index = np.array(new.match_index)
+        self.flushed_index = np.array(new.flushed_index)
+        self.last_seq = np.array(new.last_seq)
+        return np.flatnonzero(self.commit_index > before)
+
+    def prewarm(self) -> None:
+        """Compile the sweep kernel for the empty bucket up front so
+        the first live tick doesn't stall the event loop on XLA
+        compilation (which would starve heartbeats and trigger
+        spurious elections)."""
+        empty = np.array([], np.int64)
+        self.device_tick(empty, empty, empty, empty, empty)

@@ -1,0 +1,142 @@
+"""Raft RPC wire types (reference: src/v/raft/types.h + raftgen.json).
+
+Serde envelopes for the five raft RPCs: vote, append_entries,
+node-batched heartbeat (heartbeat_manager.h:107-121 node_heartbeat),
+install_snapshot, timeout_now. Record batches travel as their storage
+wire encoding (models.record.RecordBatch.serialize), so the same CRC
+checks guard the log and the wire.
+"""
+
+from __future__ import annotations
+
+from ..utils import serde
+
+# method ids on the raft service (rpc dispatch table)
+VOTE = 100
+APPEND_ENTRIES = 101
+HEARTBEAT = 102
+INSTALL_SNAPSHOT = 103
+TIMEOUT_NOW = 104
+
+
+class VoteRequest(serde.Envelope):
+    SERDE_FIELDS = [
+        ("group", serde.i64),
+        ("node_id", serde.i32),
+        ("term", serde.i64),
+        ("prev_log_index", serde.i64),
+        ("prev_log_term", serde.i64),
+        ("leadership_transfer", serde.boolean),
+        ("prevote", serde.boolean),
+    ]
+
+
+class VoteReply(serde.Envelope):
+    SERDE_FIELDS = [
+        ("group", serde.i64),
+        ("term", serde.i64),
+        ("granted", serde.boolean),
+        ("log_ok", serde.boolean),
+    ]
+
+
+class AppendEntriesRequest(serde.Envelope):
+    SERDE_FIELDS = [
+        ("group", serde.i64),
+        ("node_id", serde.i32),         # leader id
+        ("target_node_id", serde.i32),
+        ("term", serde.i64),
+        ("prev_log_index", serde.i64),
+        ("prev_log_term", serde.i64),
+        ("commit_index", serde.i64),
+        ("seq", serde.i64),             # reply-reordering guard
+        ("flush", serde.boolean),       # acks=all: follower fsyncs before reply
+        ("batches", serde.vector(serde.bytes_t)),  # RecordBatch.serialize()
+    ]
+
+
+class AppendEntriesReply(serde.Envelope):
+    # reference: raft/types.h append_entries_reply status
+    SUCCESS = 0
+    FAILURE = 1           # log mismatch at prev → leader backs off
+    GROUP_UNAVAILABLE = 2
+    TIMEOUT = 3
+
+    SERDE_FIELDS = [
+        ("group", serde.i64),
+        ("node_id", serde.i32),         # responder
+        ("term", serde.i64),
+        ("last_dirty_log_index", serde.i64),
+        ("last_flushed_log_index", serde.i64),
+        ("seq", serde.i64),
+        ("status", serde.i8),
+    ]
+
+
+class HeartbeatRequest(serde.Envelope):
+    """Node-level batch: one RPC carries the heartbeat vectors for all
+    groups shared between two nodes (heartbeat_manager.h:54-83). The
+    parallel arrays are produced by one device/numpy gather."""
+
+    SERDE_FIELDS = [
+        ("node_id", serde.i32),
+        ("target_node_id", serde.i32),
+        ("groups", serde.vector(serde.i64)),
+        ("terms", serde.vector(serde.i64)),
+        ("prev_log_indices", serde.vector(serde.i64)),
+        ("prev_log_terms", serde.vector(serde.i64)),
+        ("commit_indices", serde.vector(serde.i64)),
+        ("seqs", serde.vector(serde.i64)),
+    ]
+
+
+class HeartbeatReply(serde.Envelope):
+    SERDE_FIELDS = [
+        ("node_id", serde.i32),
+        ("groups", serde.vector(serde.i64)),
+        ("terms", serde.vector(serde.i64)),
+        ("last_dirty", serde.vector(serde.i64)),
+        ("last_flushed", serde.vector(serde.i64)),
+        ("seqs", serde.vector(serde.i64)),
+        ("statuses", serde.vector(serde.i8)),
+    ]
+
+
+class InstallSnapshotRequest(serde.Envelope):
+    SERDE_FIELDS = [
+        ("group", serde.i64),
+        ("node_id", serde.i32),
+        ("term", serde.i64),
+        ("last_included_index", serde.i64),
+        ("last_included_term", serde.i64),
+        ("file_offset", serde.i64),
+        ("chunk", serde.bytes_t),
+        ("done", serde.boolean),
+    ]
+
+
+class InstallSnapshotReply(serde.Envelope):
+    SERDE_FIELDS = [
+        ("group", serde.i64),
+        ("term", serde.i64),
+        ("bytes_stored", serde.i64),
+        ("success", serde.boolean),
+    ]
+
+
+class TimeoutNowRequest(serde.Envelope):
+    """Leadership transfer: tell the target to start an election
+    immediately (raft/consensus.cc transfer_leadership)."""
+
+    SERDE_FIELDS = [
+        ("group", serde.i64),
+        ("node_id", serde.i32),
+        ("term", serde.i64),
+    ]
+
+
+class TimeoutNowReply(serde.Envelope):
+    SERDE_FIELDS = [
+        ("group", serde.i64),
+        ("term", serde.i64),
+    ]
