@@ -1,0 +1,151 @@
+"""Broker composition root (reference: src/v/redpanda/application.{h,cc}).
+
+Wires storage → raft → cluster → kafka in the reference's startup
+order (application.cc:1814 wire_up_and_start): storage api + internal
+RPC first, then group_manager/partition_manager, the controller (raft
+group 0 replay rebuilds the topic table, backend reconciles local
+partitions), and finally the Kafka listener.
+
+Two transport modes, both first-class (SURVEY §4.2 fixture strategy):
+- loopback: N brokers in one process over an in-memory network — the
+  cluster_test_fixture analog used by the test suite;
+- tcp: real framed RPC server + kafka listener on sockets.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Optional
+
+from .cluster import (
+    Controller,
+    MetadataCache,
+    PartitionLeadersTable,
+    PartitionManager,
+    ShardTable,
+)
+from .kafka.server import KafkaServer
+from .raft.group_manager import GroupManager
+from .rpc.connection_cache import ConnectionCache
+from .rpc.loopback import LoopbackNetwork, LoopbackTransport
+from .rpc.server import RpcServer
+from .rpc.transport import TcpTransport
+from .storage.log_manager import StorageApi
+
+
+@dataclasses.dataclass
+class BrokerConfig:
+    node_id: int
+    data_dir: str
+    members: list[int]  # seed cluster membership (stage-7: join protocol)
+    # tcp mode: node_id → (host, rpc_port); None = loopback mode
+    peer_addresses: Optional[dict[int, tuple[str, int]]] = None
+    kafka_host: str = "127.0.0.1"
+    kafka_port: int = 0  # 0 = ephemeral
+    rpc_host: str = "127.0.0.1"
+    rpc_port: int = 0
+    advertised_host: Optional[str] = None
+    # node_id → advertised (host, kafka_port) of peers; self is implicit.
+    # stage-7 members_table/gossip replaces this static map.
+    peer_kafka_addresses: Optional[dict[int, tuple[str, int]]] = None
+    election_timeout_s: float = 0.3
+    heartbeat_interval_s: float = 0.05
+
+
+class Broker:
+    def __init__(
+        self,
+        config: BrokerConfig,
+        loopback: Optional[LoopbackNetwork] = None,
+    ):
+        self.config = config
+        self.node_id = config.node_id
+        self._loopback = loopback
+
+        self.storage = StorageApi(config.data_dir)
+
+        if loopback is not None:
+            self._conn_cache = ConnectionCache(
+                lambda nid: LoopbackTransport(loopback, self.node_id, nid)
+            )
+            self._rpc_server: Optional[RpcServer] = None
+            self._dispatcher = loopback.register_node(config.node_id)
+        else:
+            assert config.peer_addresses is not None
+            addrs = config.peer_addresses
+            self._conn_cache = ConnectionCache(
+                lambda nid: TcpTransport(*addrs[nid])
+            )
+            self._rpc_server = RpcServer(config.rpc_host, config.rpc_port)
+            self._dispatcher = None
+
+        send = self._conn_cache.call
+        self.group_manager = GroupManager(
+            config.node_id,
+            config.data_dir,
+            send,
+            election_timeout_s=config.election_timeout_s,
+            heartbeat_interval_s=config.heartbeat_interval_s,
+            kvstore=self.storage.kvs,
+        )
+        self.shard_table = ShardTable()
+        self.partition_manager = PartitionManager(
+            self.storage.log_mgr, self.group_manager
+        )
+        self.controller = Controller(
+            config.node_id,
+            self.group_manager,
+            self.partition_manager,
+            self.shard_table,
+            config.members,
+            send,
+        )
+        self.leaders = PartitionLeadersTable()
+        self.metadata_cache = MetadataCache(
+            self.controller.topic_table, self.partition_manager, self.leaders
+        )
+        self.kafka_server = KafkaServer(self)
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------
+    async def start(self) -> None:
+        for svc in (self.group_manager.service, self.controller.service):
+            if self._rpc_server is not None:
+                self._rpc_server.register(svc)
+            else:
+                self._dispatcher.register(svc)
+        if self._rpc_server is not None:
+            await self._rpc_server.start()
+        await self.group_manager.start()
+        await self.controller.start()
+        await self.kafka_server.start()
+        self._started = True
+
+    async def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        await self.kafka_server.stop()
+        await self.controller.stop()
+        await self.group_manager.stop()
+        await self._conn_cache.close()
+        if self._rpc_server is not None:
+            await self._rpc_server.stop()
+        self.storage.close()
+
+    @property
+    def kafka_advertised(self) -> tuple[str, int]:
+        host = self.config.advertised_host or self.config.kafka_host
+        return host, self.kafka_server.port
+
+    def kafka_address_of(self, node_id: int) -> Optional[tuple[str, int]]:
+        if node_id == self.node_id:
+            return self.kafka_advertised
+        peers = self.config.peer_kafka_addresses
+        if peers is not None:
+            return peers.get(node_id)
+        return None
+
+    async def wait_controller_leader(self, timeout: float = 10.0) -> int:
+        return await self.controller.wait_leader(timeout)

@@ -1,0 +1,71 @@
+"""Replica placement (reference: src/v/cluster/scheduling/partition_allocator.{h,cc}).
+
+Counts-based scoring kept as a numpy vector over brokers (SURVEY §2.11
+P8: allocation scoring is embarrassingly vectorizable): each replica
+goes to the least-loaded eligible broker, leaders (first replica)
+rotate round-robin so leadership spreads like the reference's
+allocation_node round-robin.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .topic_table import PartitionAssignment
+
+
+class AllocationError(Exception):
+    pass
+
+
+class PartitionAllocator:
+    def __init__(self):
+        # broker id → running replica count (decremented on topic delete)
+        self._counts: dict[int, int] = {}
+        self._rr = 0
+
+    def register_node(self, node_id: int) -> None:
+        self._counts.setdefault(node_id, 0)
+
+    def deregister_node(self, node_id: int) -> None:
+        self._counts.pop(node_id, None)
+
+    def account(self, replicas: list[int], sign: int = 1) -> None:
+        for r in replicas:
+            if r in self._counts:
+                self._counts[r] += sign
+
+    def allocate(
+        self,
+        partition_count: int,
+        replication_factor: int,
+        next_group: int,
+    ) -> list[PartitionAssignment]:
+        nodes = sorted(self._counts)
+        if replication_factor > len(nodes):
+            raise AllocationError(
+                f"replication factor {replication_factor} > {len(nodes)} brokers"
+            )
+        counts = np.array([self._counts[n] for n in nodes], dtype=np.int64)
+        out: list[PartitionAssignment] = []
+        for p in range(partition_count):
+            # leader slot rotates; remaining replicas by load
+            leader_pos = self._rr % len(nodes)
+            self._rr += 1
+            order = np.argsort(counts, kind="stable")
+            replicas = [nodes[leader_pos]]
+            counts[leader_pos] += 1
+            for i in order:
+                if len(replicas) == replication_factor:
+                    break
+                if nodes[i] not in replicas:
+                    replicas.append(nodes[i])
+                    counts[i] += 1
+            out.append(
+                PartitionAssignment(
+                    partition=p, group=next_group + p, replicas=replicas
+                )
+            )
+        for a in out:
+            self.account(a.replicas)
+        return out

@@ -1,0 +1,78 @@
+"""Controller commands replicated through raft group 0.
+
+Reference: src/v/cluster/commands.h — typed commands serialized into
+`controller`-type record batches on the controller log; each record
+carries (cmd_type key, envelope value). The controller_stm decodes and
+applies them to the in-memory tables on every node.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from ..models.fundamental import CONTROLLER_NTP
+from ..models.record import RecordBatch, RecordBatchBuilder, RecordBatchType
+from ..utils import serde
+
+
+class CmdType(enum.IntEnum):
+    create_topic = 0
+    delete_topic = 1
+    update_topic = 2
+    create_user = 3
+    delete_user = 4
+    update_user = 5
+    create_acls = 6
+    delete_acls = 7
+    config_set = 8
+
+
+class PartitionAssignmentE(serde.Envelope):
+    SERDE_FIELDS = [
+        ("partition", serde.i32),
+        ("group", serde.i64),
+        ("replicas", serde.vector(serde.i32)),
+    ]
+
+
+class CreateTopicCmd(serde.Envelope):
+    SERDE_FIELDS = [
+        ("ns", serde.string),
+        ("topic", serde.string),
+        ("partition_count", serde.i32),
+        ("replication_factor", serde.i16),
+        ("revision", serde.i64),
+        ("assignments", serde.vector(PartitionAssignmentE.serde())),
+        ("config", serde.mapping(serde.string, serde.optional(serde.string))),
+    ]
+
+
+class DeleteTopicCmd(serde.Envelope):
+    SERDE_FIELDS = [
+        ("ns", serde.string),
+        ("topic", serde.string),
+    ]
+
+
+CMD_CLASSES = {
+    CmdType.create_topic: CreateTopicCmd,
+    CmdType.delete_topic: DeleteTopicCmd,
+}
+
+
+def encode_command(cmd_type: CmdType, cmd: serde.Envelope) -> RecordBatch:
+    """One command → one controller record batch."""
+    b = RecordBatchBuilder(
+        RecordBatchType.topic_management_cmd, base_offset=0
+    )
+    b.add(key=bytes([int(cmd_type)]), value=cmd.encode())
+    return b.build()
+
+
+def decode_commands(batch: RecordBatch) -> list[tuple[CmdType, serde.Envelope]]:
+    out = []
+    for rec in batch.records():
+        cmd_type = CmdType(rec.key[0])
+        cls = CMD_CLASSES[cmd_type]
+        out.append((cmd_type, cls.decode(rec.value)))
+    return out

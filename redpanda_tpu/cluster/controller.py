@@ -1,0 +1,371 @@
+"""Cluster controller (reference: src/v/cluster/controller.{h,cc},
+controller_stm.{h,cc}, topics_frontend.{h,cc}, controller_backend.{h,cc}).
+
+Raft group 0 replicates controller commands to every node; the
+ControllerStm applies them to the topic table; the backend reconciles
+table deltas into local partitions (partition_manager.manage/remove).
+Non-leader nodes route mutations to the controller leader over the
+internal RPC (topics_frontend.cc:681 leader routing).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, Optional
+
+from ..models.fundamental import (
+    CONTROLLER_GROUP,
+    CONTROLLER_NTP,
+    DEFAULT_NS,
+    TopicNamespace,
+)
+from ..models.record import RecordBatch, RecordBatchType
+from ..raft.consensus import NotLeaderError
+from ..raft.group_manager import GroupManager
+from ..raft.state_machine import StateMachine
+from ..rpc.server import Service, method
+from ..utils import serde
+from .allocator import AllocationError, PartitionAllocator
+from .commands import (
+    CmdType,
+    CreateTopicCmd,
+    DeleteTopicCmd,
+    PartitionAssignmentE,
+    decode_commands,
+    encode_command,
+)
+from .partition_manager import PartitionManager
+from .shard_table import ShardTable
+from .topic_table import TopicTable
+
+logger = logging.getLogger("cluster.controller")
+
+# rpc method ids (raft uses 100-104)
+CREATE_TOPIC = 200
+DELETE_TOPIC = 201
+
+
+class TopicError(Exception):
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class _TopicReq(serde.Envelope):
+    SERDE_FIELDS = [
+        ("ns", serde.string),
+        ("topic", serde.string),
+        ("partitions", serde.i32),
+        ("replication_factor", serde.i16),
+        ("config", serde.mapping(serde.string, serde.optional(serde.string))),
+    ]
+
+
+class _TopicReply(serde.Envelope):
+    SERDE_FIELDS = [
+        ("code", serde.string),  # "" = ok
+        ("message", serde.string),
+    ]
+
+
+class ControllerStm(StateMachine):
+    """Applies committed controller batches to the topic table
+    (reference: cluster/controller_stm.h via raft/mux_state_machine)."""
+
+    def __init__(self, consensus, topic_table: TopicTable, allocator):
+        super().__init__(consensus)
+        self.topic_table = topic_table
+        self.allocator = allocator
+
+    async def apply(self, batch: RecordBatch) -> None:
+        if batch.header.type != RecordBatchType.topic_management_cmd:
+            return
+        revision = batch.header.base_offset
+        for cmd_type, cmd in decode_commands(batch):
+            if cmd_type == CmdType.create_topic:
+                for a in cmd.assignments:
+                    self.allocator.account(list(a.replicas))
+            elif cmd_type == CmdType.delete_topic:
+                md = self.topic_table.get(TopicNamespace(cmd.ns, cmd.topic))
+                if md is not None:
+                    for a in md.assignments.values():
+                        self.allocator.account(a.replicas, sign=-1)
+            self.topic_table.apply(cmd_type, cmd, revision)
+
+
+class ControllerService(Service):
+    """Leader-routed topic mutations (reference: cluster/controller.json)."""
+
+    def __init__(self, controller: "Controller"):
+        self._controller = controller
+
+    @method(CREATE_TOPIC)
+    async def create_topic(self, payload: bytes) -> bytes:
+        req = _TopicReq.decode(payload)
+        try:
+            await self._controller.create_topic_local(
+                req.ns,
+                req.topic,
+                int(req.partitions),
+                int(req.replication_factor),
+                dict(req.config),
+            )
+            return _TopicReply(code="", message="").encode()
+        except TopicError as e:
+            return _TopicReply(code=e.code, message=e.message).encode()
+        except NotLeaderError:
+            return _TopicReply(code="not_controller", message="").encode()
+
+    @method(DELETE_TOPIC)
+    async def delete_topic(self, payload: bytes) -> bytes:
+        req = _TopicReq.decode(payload)
+        try:
+            await self._controller.delete_topic_local(req.ns, req.topic)
+            return _TopicReply(code="", message="").encode()
+        except TopicError as e:
+            return _TopicReply(code=e.code, message=e.message).encode()
+        except NotLeaderError:
+            return _TopicReply(code="not_controller", message="").encode()
+
+
+class Controller:
+    def __init__(
+        self,
+        node_id: int,
+        group_manager: GroupManager,
+        partition_manager: PartitionManager,
+        shard_table: ShardTable,
+        members: list[int],
+        send: Callable,  # async (node, method, payload, timeout) -> bytes
+    ):
+        self.node_id = node_id
+        self._gm = group_manager
+        self._pm = partition_manager
+        self._shards = shard_table
+        self.members = list(members)
+        self._send = send
+        self.topic_table = TopicTable()
+        self.allocator = PartitionAllocator()
+        for m in members:
+            self.allocator.register_node(m)
+        self.consensus = None
+        self.stm: Optional[ControllerStm] = None
+        self.service = ControllerService(self)
+        self._backend_task: Optional[asyncio.Task] = None
+        self._create_lock = asyncio.Lock()
+        self._local_next_group = 1
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------
+    async def start(self) -> None:
+        self.consensus = await self._gm.create_group(
+            int(CONTROLLER_GROUP), voters=self.members
+        )
+        self.stm = ControllerStm(self.consensus, self.topic_table, self.allocator)
+        await self.stm.start()
+        self._backend_task = asyncio.ensure_future(self._backend_loop())
+
+    async def stop(self) -> None:
+        self._closed = True
+        if self._backend_task is not None:
+            self._backend_task.cancel()
+            try:
+                await self._backend_task
+            except asyncio.CancelledError:
+                pass
+        if self.stm is not None:
+            await self.stm.stop()
+
+    @property
+    def is_leader(self) -> bool:
+        return self.consensus is not None and self.consensus.is_leader()
+
+    @property
+    def leader_id(self) -> Optional[int]:
+        return None if self.consensus is None else self.consensus.leader_id
+
+    async def wait_leader(self, timeout: float = 10.0) -> int:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            lid = self.leader_id
+            if lid is not None and lid >= 0:
+                return int(lid)
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError("no controller leader")
+            await asyncio.sleep(0.02)
+
+    # -- frontend ----------------------------------------------------
+    async def create_topic(
+        self,
+        topic: str,
+        partitions: int,
+        replication_factor: int,
+        config: dict[str, str | None] | None = None,
+        ns: str = DEFAULT_NS,
+        timeout: float = 10.0,
+    ) -> None:
+        """Create from any node: routes to the controller leader."""
+        req = _TopicReq(
+            ns=ns,
+            topic=topic,
+            partitions=partitions,
+            replication_factor=replication_factor,
+            config=config or {},
+        )
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            if self.is_leader:
+                await self.create_topic_local(
+                    ns, topic, partitions, replication_factor, config or {}
+                )
+                return
+            leader = await self.wait_leader(
+                max(0.01, deadline - asyncio.get_event_loop().time())
+            )
+            raw = await self._send(leader, CREATE_TOPIC, req.encode(), 5.0)
+            reply = _TopicReply.decode(raw)
+            if reply.code == "":
+                # table convergence on THIS node before returning, so a
+                # follow-up metadata request sees the topic
+                await self._wait_topic_visible(ns, topic, deadline)
+                return
+            if reply.code == "not_controller":
+                if asyncio.get_event_loop().time() > deadline:
+                    raise TopicError("request_timed_out", "controller moved")
+                await asyncio.sleep(0.05)
+                continue
+            raise TopicError(reply.code, reply.message)
+
+    async def _wait_topic_visible(
+        self, ns: str, topic: str, deadline: float
+    ) -> None:
+        tp = TopicNamespace(ns, topic)
+        while not self.topic_table.contains(tp):
+            if asyncio.get_event_loop().time() > deadline:
+                raise TopicError("request_timed_out", "topic not visible")
+            await asyncio.sleep(0.01)
+
+    async def create_topic_local(
+        self,
+        ns: str,
+        topic: str,
+        partitions: int,
+        replication_factor: int,
+        config: dict[str, str | None],
+    ) -> None:
+        """Leader-side create (topics_frontend.cc:95 create_topics)."""
+        if self.consensus is None or not self.is_leader:
+            raise NotLeaderError(self.leader_id)
+        if partitions <= 0:
+            raise TopicError("invalid_partitions", f"partitions={partitions}")
+        if replication_factor <= 0 or replication_factor % 2 == 0:
+            raise TopicError(
+                "invalid_replication_factor",
+                f"replication_factor={replication_factor} (must be odd)",
+            )
+        async with self._create_lock:
+            tp = TopicNamespace(ns, topic)
+            if self.topic_table.contains(tp):
+                raise TopicError("topic_already_exists", str(tp))
+            next_group = max(
+                self._local_next_group, self.topic_table.next_group_id
+            )
+            try:
+                assignments = self.allocator.allocate(
+                    partitions, replication_factor, next_group
+                )
+            except AllocationError as e:
+                raise TopicError("invalid_replication_factor", str(e)) from None
+            self._local_next_group = next_group + partitions
+            cmd = CreateTopicCmd(
+                ns=ns,
+                topic=topic,
+                partition_count=partitions,
+                replication_factor=replication_factor,
+                revision=0,
+                assignments=[
+                    PartitionAssignmentE(
+                        partition=a.partition,
+                        group=a.group,
+                        replicas=a.replicas,
+                    )
+                    for a in assignments
+                ],
+                config=config,
+            )
+            batch = encode_command(CmdType.create_topic, cmd)
+            try:
+                base, _ = await self.consensus.replicate(batch, acks=-1)
+            except Exception:
+                # allocation rollback: command never committed
+                for a in assignments:
+                    self.allocator.account(a.replicas, sign=-1)
+                raise
+            # double-account guard: stm apply also accounts — undo ours
+            for a in assignments:
+                self.allocator.account(a.replicas, sign=-1)
+            await self.topic_table.wait_revision(base)
+
+    async def delete_topic_local(self, ns: str, topic: str) -> None:
+        if self.consensus is None or not self.is_leader:
+            raise NotLeaderError(self.leader_id)
+        tp = TopicNamespace(ns, topic)
+        if not self.topic_table.contains(tp):
+            raise TopicError("unknown_topic_or_partition", str(tp))
+        batch = encode_command(
+            CmdType.delete_topic, DeleteTopicCmd(ns=ns, topic=topic)
+        )
+        base, _ = await self.consensus.replicate(batch, acks=-1)
+        await self.topic_table.wait_revision(base)
+
+    async def delete_topic(
+        self, topic: str, ns: str = DEFAULT_NS, timeout: float = 10.0
+    ) -> None:
+        req = _TopicReq(
+            ns=ns, topic=topic, partitions=0, replication_factor=1, config={}
+        )
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            if self.is_leader:
+                await self.delete_topic_local(ns, topic)
+                return
+            leader = await self.wait_leader(
+                max(0.01, deadline - asyncio.get_event_loop().time())
+            )
+            raw = await self._send(leader, DELETE_TOPIC, req.encode(), 5.0)
+            reply = _TopicReply.decode(raw)
+            if reply.code == "":
+                return
+            if reply.code == "not_controller":
+                if asyncio.get_event_loop().time() > deadline:
+                    raise TopicError("request_timed_out", "controller moved")
+                await asyncio.sleep(0.05)
+                continue
+            raise TopicError(reply.code, reply.message)
+
+    # -- backend reconciliation --------------------------------------
+    async def _backend_loop(self) -> None:
+        """Turn topic_table deltas into local partition create/remove
+        (reference: cluster/controller_backend.{h,cc})."""
+        while not self._closed:
+            deltas = self.topic_table.drain_deltas()
+            if not deltas:
+                try:
+                    await self.topic_table.wait_change(timeout=1.0)
+                except Exception:
+                    pass
+                continue
+            for d in deltas:
+                try:
+                    if d.kind == "add" and self.node_id in d.replicas:
+                        await self._pm.manage(d.ntp, d.group, d.replicas)
+                        self._shards.insert(d.ntp, d.group)
+                    elif d.kind == "del" and self.node_id in d.replicas:
+                        self._shards.erase(d.ntp, d.group)
+                        await self._pm.remove(d.ntp)
+                except Exception:
+                    logger.exception(
+                        "node %d: reconciliation failed for %s", self.node_id, d.ntp
+                    )

@@ -1,0 +1,139 @@
+"""Partition facade (reference: src/v/cluster/partition.{h,cc}).
+
+One replica of one data partition: raft consensus + log + offset
+translator, presenting the *Kafka* offset space to the protocol layer
+(the reference splits this between cluster::partition and
+kafka::replicated_partition — here they are one object since the
+translation is the only adaptation needed at this stage).
+"""
+
+from __future__ import annotations
+
+from ..models.fundamental import NTP
+from ..models.record import RecordBatch, RecordBatchType
+from ..raft.consensus import Consensus, NotLeaderError  # noqa: F401 (re-export)
+from ..raft.offset_translator import OffsetTranslator
+from ..storage.log import Log
+
+
+class Partition:
+    def __init__(self, ntp: NTP, group_id: int, consensus: Consensus):
+        self.ntp = ntp
+        self.group_id = group_id
+        self.consensus = consensus
+        self.log: Log = consensus.log
+        self.translator = OffsetTranslator(
+            kvstore=consensus.kvstore, group_id=group_id
+        )
+        self._rebuild_translator()
+        self.log.on_append.append(self._on_append)
+        self.log.on_truncate.append(self._on_truncate)
+
+    # -- offset translator maintenance -------------------------------
+    def _rebuild_translator(self) -> None:
+        """Recover translation state from the log tail (reference
+        raft/offset_translator.cc startup hydration)."""
+        offs = self.log.offsets()
+        pos = max(offs.start_offset, 0)  # re-tracking is idempotent
+        while pos <= offs.dirty_offset:
+            batches = self.log.read(pos, max_bytes=1 << 22)
+            if not batches:
+                break
+            for b in batches:
+                self.translator.track(
+                    b.header.type, b.header.base_offset, b.header.last_offset
+                )
+                pos = b.header.last_offset + 1
+        self.translator.checkpoint()
+
+    def _on_append(self, batch: RecordBatch) -> None:
+        self.translator.track(
+            batch.header.type, batch.header.base_offset, batch.header.last_offset
+        )
+
+    def _on_truncate(self, offset: int) -> None:
+        self.translator.truncate(offset)
+
+    def close(self) -> None:
+        if self._on_append in self.log.on_append:
+            self.log.on_append.remove(self._on_append)
+        if self._on_truncate in self.log.on_truncate:
+            self.log.on_truncate.remove(self._on_truncate)
+        self.translator.checkpoint()
+
+    # -- kafka offset surface ----------------------------------------
+    @property
+    def is_leader(self) -> bool:
+        return self.consensus.is_leader()
+
+    @property
+    def leader_id(self):
+        return self.consensus.leader_id
+
+    def high_watermark(self) -> int:
+        """Next kafka offset past the committed prefix."""
+        commit = self.consensus.commit_index
+        if commit < 0:
+            return 0
+        return self.translator.to_kafka(commit) + 1
+
+    def last_stable_offset(self) -> int:
+        # == HW until transactions land (rm_stm provides the real LSO)
+        return self.high_watermark()
+
+    def start_offset(self) -> int:
+        """First kafka offset = count of data offsets below the raft
+        log start (config batches at the head don't shift it past 0)."""
+        offs = self.log.offsets()
+        if offs.dirty_offset < 0:
+            return 0
+        return self.translator.to_kafka(max(offs.start_offset, 0) - 1) + 1
+
+    # -- write -------------------------------------------------------
+    async def replicate(
+        self, batch: RecordBatch, acks: int = -1, timeout: float = 10.0
+    ) -> int:
+        """Returns the kafka base offset assigned to the batch."""
+        base, _last = await self.consensus.replicate(
+            batch, acks=acks, timeout=timeout
+        )
+        return self.translator.to_kafka(base)
+
+    # -- read --------------------------------------------------------
+    def read_kafka(
+        self, kafka_offset: int, max_bytes: int = 1 << 20
+    ) -> list[tuple[int, RecordBatch]]:
+        """Committed data batches from kafka_offset, as
+        (kafka_base_offset, batch) pairs. The caller frames them for
+        the wire with the translated base (the kafka body CRC does not
+        cover base_offset, so no payload recompute — reference
+        kafka/server/replicated_partition.cc translation)."""
+        hw = self.high_watermark()
+        if kafka_offset >= hw:
+            return []
+        raft_pos = self.translator.from_kafka(kafka_offset)
+        commit = self.consensus.commit_index
+        out: list[tuple[int, RecordBatch]] = []
+        consumed = 0
+        while raft_pos <= commit and consumed < max_bytes:
+            batches = self.log.read(
+                raft_pos, max_bytes=max_bytes - consumed, upto=commit
+            )
+            if not batches:
+                break
+            for b in batches:
+                raft_pos = b.header.last_offset + 1
+                if b.header.type != RecordBatchType.raft_data:
+                    continue
+                kbase = self.translator.to_kafka(b.header.base_offset)
+                out.append((kbase, b))
+                consumed += b.size_bytes()
+                if consumed >= max_bytes:
+                    break
+        return out
+
+    def timequery(self, ts_ms: int) -> int | None:
+        raft_off = self.log.timequery(ts_ms)
+        if raft_off is None:
+            return None
+        return self.translator.to_kafka(raft_off)

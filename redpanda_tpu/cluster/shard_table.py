@@ -1,0 +1,33 @@
+"""ntp/group → shard lookup (reference: src/v/cluster/shard_table.h:26-46).
+
+The host runtime currently runs one asyncio shard per node (SURVEY
+§2.11 P1 maps seastar's shard-per-core onto per-host shards feeding
+batched device kernels); the table preserves the placement seam so the
+kafka layer always resolves a shard before touching a partition, as
+produce.cc:249 does.
+"""
+
+from __future__ import annotations
+
+from ..models.fundamental import NTP
+
+
+class ShardTable:
+    def __init__(self, shard_count: int = 1):
+        self.shard_count = shard_count
+        self._ntp: dict[NTP, int] = {}
+        self._group: dict[int, int] = {}
+
+    def insert(self, ntp: NTP, group_id: int, shard: int = 0) -> None:
+        self._ntp[ntp] = shard
+        self._group[group_id] = shard
+
+    def erase(self, ntp: NTP, group_id: int) -> None:
+        self._ntp.pop(ntp, None)
+        self._group.pop(group_id, None)
+
+    def shard_for(self, ntp: NTP) -> int | None:
+        return self._ntp.get(ntp)
+
+    def shard_for_group(self, group_id: int) -> int | None:
+        return self._group.get(group_id)

@@ -1,0 +1,154 @@
+"""Authoritative topic/partition state (reference: src/v/cluster/topic_table.{h,cc}).
+
+Built purely by applying committed controller commands, so every node
+converges to the same table. `wait_revision` lets frontends block until
+their own command has been applied locally (the reference's
+replicate_and_wait → stm wait pattern, topics_frontend.cc:280).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+
+from ..models.fundamental import NTP, TopicNamespace
+from .commands import CmdType, CreateTopicCmd, DeleteTopicCmd
+
+
+@dataclasses.dataclass(slots=True)
+class PartitionAssignment:
+    partition: int
+    group: int
+    replicas: list[int]
+
+
+@dataclasses.dataclass(slots=True)
+class TopicMetadata:
+    tp_ns: TopicNamespace
+    partition_count: int
+    replication_factor: int
+    revision: int
+    assignments: dict[int, PartitionAssignment]
+    config: dict[str, str | None]
+
+
+@dataclasses.dataclass(slots=True)
+class Delta:
+    """One reconciliation unit emitted to controller_backend."""
+
+    kind: str  # "add" | "del"
+    ntp: NTP
+    group: int
+    replicas: list[int]
+
+
+class TopicTable:
+    def __init__(self):
+        self._topics: dict[TopicNamespace, TopicMetadata] = {}
+        self.next_group_id = 1  # group 0 = controller
+        self.revision = 0  # last applied controller revision (offset)
+        self._pending_deltas: list[Delta] = []
+        self._waiters: list[asyncio.Event] = []
+
+    # -- queries -----------------------------------------------------
+    def topics(self) -> dict[TopicNamespace, TopicMetadata]:
+        return self._topics
+
+    def get(self, tp_ns: TopicNamespace) -> TopicMetadata | None:
+        return self._topics.get(tp_ns)
+
+    def contains(self, tp_ns: TopicNamespace) -> bool:
+        return tp_ns in self._topics
+
+    def group_of(self, ntp: NTP) -> int | None:
+        md = self._topics.get(ntp.tp_ns)
+        if md is None:
+            return None
+        a = md.assignments.get(ntp.partition)
+        return a.group if a else None
+
+    # -- mutation (controller_stm only) ------------------------------
+    def apply(self, cmd_type: CmdType, cmd, revision: int) -> None:
+        if cmd_type == CmdType.create_topic:
+            self._apply_create(cmd, revision)
+        elif cmd_type == CmdType.delete_topic:
+            self._apply_delete(cmd)
+        self.revision = revision
+        self._notify()
+
+    def _apply_create(self, cmd: CreateTopicCmd, revision: int) -> None:
+        tp_ns = TopicNamespace(cmd.ns, cmd.topic)
+        if tp_ns in self._topics:
+            return  # idempotent re-apply (snapshot + replay)
+        assignments = {
+            a.partition: PartitionAssignment(
+                int(a.partition), int(a.group), list(a.replicas)
+            )
+            for a in cmd.assignments
+        }
+        self._topics[tp_ns] = TopicMetadata(
+            tp_ns=tp_ns,
+            partition_count=int(cmd.partition_count),
+            replication_factor=int(cmd.replication_factor),
+            revision=revision,
+            assignments=assignments,
+            config=dict(cmd.config),
+        )
+        for a in assignments.values():
+            self.next_group_id = max(self.next_group_id, a.group + 1)
+            self._pending_deltas.append(
+                Delta(
+                    "add",
+                    NTP(cmd.ns, cmd.topic, a.partition),
+                    a.group,
+                    list(a.replicas),
+                )
+            )
+
+    def _apply_delete(self, cmd: DeleteTopicCmd) -> None:
+        tp_ns = TopicNamespace(cmd.ns, cmd.topic)
+        md = self._topics.pop(tp_ns, None)
+        if md is None:
+            return
+        for a in md.assignments.values():
+            self._pending_deltas.append(
+                Delta(
+                    "del",
+                    NTP(cmd.ns, cmd.topic, a.partition),
+                    a.group,
+                    list(a.replicas),
+                )
+            )
+
+    # -- delta stream (controller_backend) ---------------------------
+    def drain_deltas(self) -> list[Delta]:
+        out = self._pending_deltas
+        self._pending_deltas = []
+        return out
+
+    def _notify(self) -> None:
+        waiters, self._waiters = self._waiters, []
+        for ev in waiters:
+            ev.set()
+
+    async def wait_revision(self, revision: int, timeout: float = 10.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while self.revision < revision:
+            remaining = deadline - asyncio.get_event_loop().time()
+            if remaining <= 0:
+                raise TimeoutError(f"topic_table not at revision {revision}")
+            ev = asyncio.Event()
+            self._waiters.append(ev)
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                continue
+
+    async def wait_change(self, timeout: float = 5.0) -> None:
+        """Block until any table mutation (backend reconciliation tick)."""
+        ev = asyncio.Event()
+        self._waiters.append(ev)
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            self._waiters.remove(ev) if ev in self._waiters else None

@@ -1,0 +1,382 @@
+"""Internal Kafka protocol client.
+
+Reference: src/v/kafka/client/ — the self-contained client
+(client.{h,cc}, producer, consumer, brokers) used by pandaproxy,
+schema registry and the test suite. Speaks the public protocol, so it
+doubles as a protocol-conformance check against our own server (and
+works against any Kafka broker).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import struct
+from typing import Optional, Sequence
+
+from ..models.record import RecordBatch, RecordBatchBuilder
+from .protocol import (
+    API_VERSIONS,
+    CREATE_TOPICS,
+    FETCH,
+    LIST_OFFSETS,
+    METADATA,
+    PRODUCE,
+    ErrorCode,
+    Msg,
+    Reader,
+    RequestHeader,
+    encode_request_header,
+)
+
+_SIZE = struct.Struct(">i")
+
+
+class KafkaClientError(Exception):
+    def __init__(self, code: int, context: str = ""):
+        try:
+            name = ErrorCode(code).name
+        except ValueError:
+            name = str(code)
+        super().__init__(f"{context}: {name}" if context else name)
+        self.code = code
+
+
+class BrokerConnection:
+    def __init__(self, host: str, port: int, client_id: str):
+        self.host = host
+        self.port = port
+        self._client_id = client_id
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._corr = itertools.count(1)
+        self._lock = asyncio.Lock()
+        self.api_versions: dict[int, tuple[int, int]] = {}
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        resp = await self.request(API_VERSIONS, Msg(), version=2)
+        if resp.error_code != 0:
+            raise KafkaClientError(resp.error_code, "api_versions")
+        self.api_versions = {
+            k.api_key: (k.min_version, k.max_version) for k in resp.api_keys
+        }
+
+    def pick_version(self, api, preferred: int) -> int:
+        rng = self.api_versions.get(api.key)
+        if rng is None:
+            return preferred
+        lo, hi = rng
+        v = min(preferred, hi, api.max_version)
+        if v < max(lo, api.min_version):
+            raise KafkaClientError(
+                int(ErrorCode.unsupported_version), api.name
+            )
+        return v
+
+    async def request(self, api, req, version: int) -> Msg:
+        hdr = RequestHeader(api.key, version, next(self._corr), self._client_id)
+        frame = encode_request_header(hdr) + api.encode_request(req, version)
+        async with self._lock:
+            self._writer.write(_SIZE.pack(len(frame)) + frame)
+            await self._writer.drain()
+            raw_size = await self._reader.readexactly(4)
+            (size,) = _SIZE.unpack(raw_size)
+            payload = await self._reader.readexactly(size)
+        r = Reader(payload)
+        corr = r.read_int32()
+        if corr != hdr.correlation_id:
+            raise KafkaClientError(
+                int(ErrorCode.network_exception),
+                f"correlation mismatch {corr} != {hdr.correlation_id}",
+            )
+        from .protocol.headers import response_header_version
+
+        if response_header_version(api.key, version) >= 1:
+            r.skip_tagged_fields()
+        body = payload[len(payload) - r.remaining :]
+        resp = api.decode_response(body, version)
+        # ApiVersions downgrade: server replied v0 UNSUPPORTED_VERSION
+        if (
+            api.key == API_VERSIONS.key
+            and version > 0
+            and resp.error_code == int(ErrorCode.unsupported_version)
+        ):
+            resp = api.decode_response(body, 0)
+        return resp
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+
+
+class KafkaClient:
+    """Metadata-aware client: routes produce/fetch to partition leaders."""
+
+    def __init__(
+        self,
+        bootstrap: Sequence[tuple[str, int]],
+        client_id: str = "redpanda-tpu-client",
+    ):
+        self._bootstrap = list(bootstrap)
+        self._client_id = client_id
+        self._conns: dict[tuple[str, int], BrokerConnection] = {}
+        self._brokers: dict[int, tuple[str, int]] = {}
+        self._leaders: dict[tuple[str, int], int] = {}  # (topic,part)→node
+        self._topic_errors: dict[str, int] = {}
+
+    async def _connect_addr(self, addr: tuple[str, int]) -> BrokerConnection:
+        conn = self._conns.get(addr)
+        if conn is None:
+            conn = BrokerConnection(addr[0], addr[1], self._client_id)
+            await conn.connect()
+            self._conns[addr] = conn
+        return conn
+
+    async def any_conn(self) -> BrokerConnection:
+        last: Exception | None = None
+        for addr in self._bootstrap:
+            try:
+                return await self._connect_addr(addr)
+            except Exception as e:  # broker down: try next seed
+                last = e
+        raise last if last else RuntimeError("no bootstrap brokers")
+
+    async def close(self) -> None:
+        for conn in self._conns.values():
+            await conn.close()
+        self._conns.clear()
+
+    # -- metadata ----------------------------------------------------
+    async def metadata(self, topics: Optional[list[str]] = None) -> Msg:
+        conn = await self.any_conn()
+        v = conn.pick_version(METADATA, 5)
+        req = Msg(
+            topics=None if topics is None else [Msg(name=t) for t in topics]
+        )
+        resp = await conn.request(METADATA, req, v)
+        for b in resp.brokers:
+            self._brokers[b.node_id] = (b.host, b.port)
+        for t in resp.topics:
+            self._topic_errors[t.name] = t.error_code
+            if t.error_code == 0:
+                for p in t.partitions:
+                    if p.leader_id >= 0:
+                        self._leaders[(t.name, p.partition_index)] = p.leader_id
+        return resp
+
+    async def leader_conn(
+        self, topic: str, partition: int, refresh: bool = False
+    ) -> BrokerConnection:
+        """Resolve the partition leader, retrying metadata while the
+        leader is unknown (election in flight) like real clients do."""
+        key = (topic, partition)
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while True:
+            if refresh or key not in self._leaders:
+                await self.metadata([topic])
+            leader = self._leaders.get(key)
+            if leader is not None and leader in self._brokers:
+                return await self._connect_addr(self._brokers[leader])
+            terr = self._topic_errors.get(topic, 0)
+            if terr == int(ErrorCode.unknown_topic_or_partition):
+                raise KafkaClientError(terr, f"{topic}/{partition}")
+            if asyncio.get_event_loop().time() > deadline:
+                raise KafkaClientError(
+                    int(ErrorCode.leader_not_available), f"{topic}/{partition}"
+                )
+            refresh = True
+            await asyncio.sleep(0.05)
+
+    # -- admin -------------------------------------------------------
+    async def create_topic(
+        self,
+        name: str,
+        partitions: int = 1,
+        replication_factor: int = 1,
+        timeout_ms: int = 10000,
+        configs: Optional[dict[str, str]] = None,
+    ) -> None:
+        conn = await self.any_conn()
+        v = conn.pick_version(CREATE_TOPICS, 4)
+        req = Msg(
+            topics=[
+                Msg(
+                    name=name,
+                    num_partitions=partitions,
+                    replication_factor=replication_factor,
+                    assignments=[],
+                    configs=[
+                        Msg(name=k, value=val)
+                        for k, val in (configs or {}).items()
+                    ],
+                )
+            ],
+            timeout_ms=timeout_ms,
+            validate_only=False,
+        )
+        resp = await conn.request(CREATE_TOPICS, req, v)
+        code = resp.topics[0].error_code
+        if code != 0:
+            raise KafkaClientError(code, f"create_topic {name}")
+
+    # -- produce -----------------------------------------------------
+    async def produce(
+        self,
+        topic: str,
+        partition: int,
+        records: Sequence[tuple[bytes | None, bytes | None]],  # (key, value)
+        acks: int = -1,
+        timeout_ms: int = 10000,
+    ) -> int:
+        """Returns the base offset assigned to the batch."""
+        builder = RecordBatchBuilder()
+        for key, value in records:
+            builder.add(value, key=key)
+        wire = builder.build().to_kafka_wire()
+        for attempt in range(2):
+            conn = await self.leader_conn(topic, partition, refresh=attempt > 0)
+            v = conn.pick_version(PRODUCE, 7)
+            req = Msg(
+                transactional_id=None,
+                acks=acks,
+                timeout_ms=timeout_ms,
+                topics=[
+                    Msg(
+                        name=topic,
+                        partitions=[Msg(index=partition, records=wire)],
+                    )
+                ],
+            )
+            if acks == 0:
+                # fire-and-forget: no response frame on the wire
+                hdr = RequestHeader(
+                    PRODUCE.key, v, next(conn._corr), self._client_id
+                )
+                frame = encode_request_header(hdr) + PRODUCE.encode_request(
+                    req, v
+                )
+                async with conn._lock:
+                    conn._writer.write(_SIZE.pack(len(frame)) + frame)
+                    await conn._writer.drain()
+                return -1
+            resp = await conn.request(PRODUCE, req, v)
+            pr = resp.responses[0].partition_responses[0]
+            if pr.error_code == int(ErrorCode.not_leader_for_partition):
+                continue
+            if pr.error_code != 0:
+                raise KafkaClientError(
+                    pr.error_code, f"produce {topic}/{partition}"
+                )
+            return pr.base_offset
+        raise KafkaClientError(
+            int(ErrorCode.not_leader_for_partition), f"produce {topic}/{partition}"
+        )
+
+    # -- fetch -------------------------------------------------------
+    async def fetch(
+        self,
+        topic: str,
+        partition: int,
+        offset: int,
+        max_bytes: int = 1 << 20,
+        max_wait_ms: int = 500,
+        min_bytes: int = 1,
+    ) -> list[tuple[int, bytes | None, bytes | None]]:
+        """Returns [(offset, key, value)] at-or-after `offset`."""
+        for attempt in range(2):
+            conn = await self.leader_conn(topic, partition, refresh=attempt > 0)
+            v = conn.pick_version(FETCH, 11)
+            req = Msg(
+                replica_id=-1,
+                max_wait_ms=max_wait_ms,
+                min_bytes=min_bytes,
+                max_bytes=max_bytes,
+                isolation_level=0,
+                session_id=0,
+                session_epoch=-1,
+                topics=[
+                    Msg(
+                        topic=topic,
+                        partitions=[
+                            Msg(
+                                partition=partition,
+                                current_leader_epoch=-1,
+                                fetch_offset=offset,
+                                log_start_offset=0,
+                                partition_max_bytes=max_bytes,
+                            )
+                        ],
+                    )
+                ],
+                forgotten_topics_data=[],
+                rack_id="",
+            )
+            resp = await conn.request(FETCH, req, v)
+            pr = resp.responses[0].partitions[0]
+            if pr.error_code == int(ErrorCode.not_leader_for_partition):
+                continue
+            if pr.error_code != 0:
+                raise KafkaClientError(
+                    pr.error_code, f"fetch {topic}/{partition}"
+                )
+            return decode_record_set(pr.records, from_offset=offset)
+        raise KafkaClientError(
+            int(ErrorCode.not_leader_for_partition), f"fetch {topic}/{partition}"
+        )
+
+    async def list_offset(
+        self, topic: str, partition: int, timestamp: int
+    ) -> int:
+        """timestamp: -2 earliest, -1 latest, else timequery."""
+        conn = await self.leader_conn(topic, partition)
+        v = conn.pick_version(LIST_OFFSETS, 3)
+        req = Msg(
+            replica_id=-1,
+            isolation_level=0,
+            topics=[
+                Msg(
+                    name=topic,
+                    partitions=[
+                        Msg(
+                            partition_index=partition,
+                            current_leader_epoch=-1,
+                            timestamp=timestamp,
+                        )
+                    ],
+                )
+            ],
+        )
+        resp = await conn.request(LIST_OFFSETS, req, v)
+        pr = resp.topics[0].partitions[0]
+        if pr.error_code != 0:
+            raise KafkaClientError(
+                pr.error_code, f"list_offsets {topic}/{partition}"
+            )
+        return pr.offset
+
+
+def decode_record_set(
+    records: bytes | memoryview | None, from_offset: int = 0
+) -> list[tuple[int, bytes | None, bytes | None]]:
+    """Kafka wire record set → [(abs_offset, key, value)]."""
+    from ..utils.iobuf import IOBufParser
+
+    if records is None or len(records) == 0:
+        return []
+    out: list[tuple[int, bytes | None, bytes | None]] = []
+    parser = IOBufParser(bytes(records))
+    while parser.bytes_left() > 0:
+        batch = RecordBatch.from_kafka_wire(parser, verify=True)
+        base = batch.header.base_offset
+        for rec in batch.records():
+            off = base + rec.offset_delta
+            if off >= from_offset:
+                out.append((off, rec.key, rec.value))
+    return out

@@ -31,6 +31,7 @@ from typing import Awaitable, Callable, Optional
 
 from ..models.record import RecordBatch, RecordBatchBuilder, RecordBatchType
 from ..models.consensus_state import SELF_SLOT
+from ..models.fundamental import NO_OFFSET
 from ..storage.kvstore import KeySpace, KvStore
 from ..storage.log import Log
 from ..utils import serde
@@ -103,11 +104,58 @@ class Consensus:
         self._bg_tasks: set[asyncio.Task] = set()
         self._append_lock = asyncio.Lock()  # append_entries_buffer analog
         self._vote_lock = asyncio.Lock()
+        # (offset, config) of every config batch in the log — lets
+        # truncation roll the active config back (reference:
+        # raft/configuration_manager.{h,cc} persisted history)
+        self._config_history: list[tuple[int, GroupConfiguration]] = []
+        self._initial_config = config
         self._closed = False
 
     # ---------------------------------------------------------- setup
     def _vote_key(self) -> bytes:
         return f"vote/{self.group_id}".encode()
+
+    def _config_key(self) -> bytes:
+        return f"cfg/{self.group_id}".encode()
+
+    def _load_config_state(self) -> None:
+        raw = self._kvstore.get(KeySpace.consensus, self._config_key())
+        if raw is not None:
+            self.config = GroupConfiguration.decode(raw)
+
+    def _persist_config(self) -> None:
+        self._kvstore.put(
+            KeySpace.consensus, self._config_key(), self.config.encode()
+        )
+
+    def _observe_append(self, batch: RecordBatch) -> None:
+        """Log-append hook: raft requires configs take effect the
+        moment they are APPENDED, not committed (consensus.cc applies
+        via configuration_manager at append) — otherwise followers keep
+        voting with a stale voter set after the leader reconfigures."""
+        if batch.header.type != RecordBatchType.raft_configuration:
+            return
+        for rec in batch.records():
+            if rec.value is not None:
+                cfg = GroupConfiguration.decode(rec.value)
+                self._config_history.append((batch.header.base_offset, cfg))
+                self.config = cfg
+                self._rebuild_slots()
+                self._persist_config()
+
+    def _observe_truncate(self, offset: int) -> None:
+        changed = False
+        while self._config_history and self._config_history[-1][0] >= offset:
+            self._config_history.pop()
+            changed = True
+        if changed:
+            self.config = (
+                self._config_history[-1][1]
+                if self._config_history
+                else self._initial_config
+            )
+            self._rebuild_slots()
+            self._persist_config()
 
     def _load_vote_state(self) -> None:
         raw = self._kvstore.get(KeySpace.consensus, self._vote_key())
@@ -125,8 +173,22 @@ class Consensus:
 
     def _rebuild_slots(self) -> None:
         """slot 0 = self; peers in sorted order. Rewrites voter masks
-        (host slow path — membership is a control-plane event)."""
+        AND migrates per-slot replication state by peer id — on
+        reconfiguration a peer may land in a different slot, and
+        inheriting another peer's match/flushed/seq lanes would count
+        unreplicated entries toward quorum (types.h:78-117 keeps this
+        state per-follower, not per-position)."""
         row = self.row
+        old_map = getattr(self, "_slot_map", {})
+        saved = {
+            peer: (
+                int(self.arrays.match_index[row, slot]),
+                int(self.arrays.flushed_index[row, slot]),
+                int(self.arrays.last_seq[row, slot]),
+                int(self.arrays.next_seq[row, slot]),
+            )
+            for peer, slot in old_map.items()
+        }
         self._slot_map = {self.node_id: SELF_SLOT}
         peers = sorted(n for n in self.config.all_nodes() if n != self.node_id)
         if len(peers) + 1 > self.arrays.replica_slots:
@@ -140,10 +202,26 @@ class Consensus:
             self._slot_map[peer] = slot
             self.arrays.is_voter[row, slot] = self.config.is_voter(peer)
             self.arrays.is_voter_old[row, slot] = peer in self.config.old_voters
+            match, flushed, last_seq, next_seq = saved.get(
+                peer, (int(NO_OFFSET), int(NO_OFFSET), 0, 0)
+            )
+            self.arrays.match_index[row, slot] = match
+            self.arrays.flushed_index[row, slot] = flushed
+            self.arrays.last_seq[row, slot] = last_seq
+            self.arrays.next_seq[row, slot] = next_seq
             self._peer_locks.setdefault(peer, asyncio.Lock())
+        # slots past the new peer set hold stale lanes: neutralize them
+        for slot in range(len(peers) + 1, self.arrays.replica_slots):
+            self.arrays.match_index[row, slot] = int(NO_OFFSET)
+            self.arrays.flushed_index[row, slot] = int(NO_OFFSET)
+            self.arrays.last_seq[row, slot] = 0
+            self.arrays.next_seq[row, slot] = 0
 
     async def start(self) -> None:
         self._load_vote_state()
+        self._load_config_state()
+        self.log.on_append.append(self._observe_append)
+        self.log.on_truncate.append(self._observe_truncate)
         self._rebuild_slots()
         offs = self.log.offsets()
         row = self.row
@@ -163,9 +241,17 @@ class Consensus:
         tasks = [t for t in [self._timer_task, *self._bg_tasks] if t is not None]
         if tasks:
             await asyncio.gather(*tasks, return_exceptions=True)
+        if self._observe_append in self.log.on_append:
+            self.log.on_append.remove(self._observe_append)
+        if self._observe_truncate in self.log.on_truncate:
+            self.log.on_truncate.remove(self._observe_truncate)
         self._notify_commit()  # release waiters
 
     # ------------------------------------------------------ properties
+    @property
+    def kvstore(self) -> KvStore:
+        return self._kvstore
+
     @property
     def term(self) -> int:
         return int(self.arrays.term[self.row])
@@ -694,8 +780,21 @@ class Consensus:
             raise NotLeaderError(self.leader_id)
         if target not in self._slot_map:
             raise ValueError(f"node {target} not in configuration")
-        # bring the target fully up to date first
-        await self._catch_up(target)
+        # bring the target fully up to date first. _catch_up returns
+        # immediately when another fiber already drives this follower,
+        # so poll until the target's match actually reaches our dirty
+        # offset instead of trusting one call.
+        deadline = asyncio.get_event_loop().time() + timeout
+        while self._follower_needs_data(target):
+            if self.role != Role.LEADER:
+                raise NotLeaderError(self.leader_id)
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(
+                    f"g{self.group_id}: transfer target {target} not caught up"
+                )
+            await self._catch_up(target)
+            if self._follower_needs_data(target):
+                await asyncio.sleep(0.01)
         req = rt.TimeoutNowRequest(
             group=self.group_id, node_id=self.node_id, term=self.term
         ).encode()

@@ -85,6 +85,8 @@ class HeartbeatManager:
             for peer in c.peers():
                 per_node.setdefault(peer, []).append(c)
 
+        prev_sent: dict[tuple[int, int], int] = {}  # (gid, peer) → prev
+
         async def one_node(peer: int, groups: list[Consensus]):
             reqs = []
             for c in groups:
@@ -95,6 +97,7 @@ class HeartbeatManager:
                 prev_term = c.log.get_term(prev) if prev >= 0 else -1
                 if prev_term is None:
                     prev_term = -1
+                prev_sent[(c.group_id, peer)] = prev
                 reqs.append(
                     (c.group_id, c.term, prev, prev_term, c.commit_index, seq)
                 )
@@ -132,23 +135,32 @@ class HeartbeatManager:
                 if reply.statuses[i] != rt.AppendEntriesReply.SUCCESS:
                     if reply.terms[i] > c.term:
                         c._step_down(int(reply.terms[i]))
-                    else:
+                    elif reply.statuses[i] == rt.AppendEntriesReply.FAILURE:
                         # log-mismatch/gap rejection: our match estimate
                         # is wrong (e.g. follower lost its tail). Rewind
                         # it host-side so the catch-up fiber engages —
                         # the device fold is monotone and cannot.
-                        slot = c._slot_map.get(peer)
-                        if slot is not None and reply.last_dirty[i] >= -1:
-                            c.arrays.match_index[c.row, slot] = min(
-                                int(c.arrays.match_index[c.row, slot]),
-                                int(reply.last_dirty[i]),
-                            )
-                            c._spawn(c._catch_up(peer))
+                        # (GROUP_UNAVAILABLE is NOT a mismatch: the
+                        # group isn't constructed there yet; rewinding
+                        # would force a pointless re-replication from 0.)
+                        c.arrays.match_index[c.row, slot] = min(
+                            int(c.arrays.match_index[c.row, slot]),
+                            int(reply.last_dirty[i]),
+                        )
+                        c._spawn(c._catch_up(peer))
                     continue
+                # a heartbeat SUCCESS only proves the follower's log
+                # matches ours up to the prev we sent — its entries
+                # beyond prev are unverified (possibly a divergent
+                # suffix) and must not count toward quorum. Real
+                # appends advance match through the verified
+                # _dispatch_append path instead.
+                cap = prev_sent.get((gid, peer), -1)
+                d = min(int(reply.last_dirty[i]), cap)
                 rows.append(c.row)
                 slots.append(slot)
-                dirty.append(reply.last_dirty[i])
-                flushed.append(reply.last_flushed[i])
+                dirty.append(d)
+                flushed.append(min(int(reply.last_flushed[i]), d))
                 seqs.append(reply.seqs[i])
         if not rows:
             return  # no successful replies: the sweep cannot advance

@@ -62,19 +62,30 @@ class StateMachine:
             for batch in batches:
                 if batch.header.base_offset > commit:
                     break
-                try:
-                    if (
-                        batch.header.type == RecordBatchType.raft_configuration
-                    ):
-                        self.consensus.apply_configuration_batch(batch)
-                    else:
-                        await self.apply(batch)
-                except Exception:
-                    logger.exception(
-                        "g%d: stm apply failed at %d",
-                        self.consensus.group_id,
-                        batch.header.base_offset,
-                    )
+                while not self._closed:
+                    # a committed batch must never be skipped: silently
+                    # advancing last_applied past a failed apply would
+                    # diverge this replica's state machine from its
+                    # peers'. Retry until it sticks (reference stms
+                    # vassert/abort instead of skipping).
+                    try:
+                        if (
+                            batch.header.type
+                            == RecordBatchType.raft_configuration
+                        ):
+                            self.consensus.apply_configuration_batch(batch)
+                        else:
+                            await self.apply(batch)
+                        break
+                    except Exception:
+                        logger.exception(
+                            "g%d: stm apply failed at %d (retrying)",
+                            self.consensus.group_id,
+                            batch.header.base_offset,
+                        )
+                        await asyncio.sleep(0.1)
+                if self._closed:
+                    return
                 self.last_applied = batch.header.last_offset
             ev = self._applied_event
             self._applied_event = asyncio.Event()

@@ -60,6 +60,11 @@ class Log:
         self._cache_index: BatchCacheIndex | None = (
             cache.make_index() if cache is not None else None
         )
+        # observer hooks (cluster::partition wires its offset
+        # translator here; reference threads the translator through
+        # disk_log_impl appends in raft/offset_translator.cc)
+        self.on_append: list = []  # fn(batch)
+        self.on_truncate: list = []  # fn(offset)
         self._recover()
 
     # -- recovery ----------------------------------------------------
@@ -119,6 +124,8 @@ class Log:
         seg.append(batch)
         if self._cache_index is not None:
             self._cache_index.put(batch)
+        for fn in self.on_append:
+            fn(batch)
         return base, batch.header.last_offset
 
     def append_exactly(self, batch: RecordBatch) -> tuple[int, int]:
@@ -128,6 +135,8 @@ class Log:
         seg.append(batch)
         if self._cache_index is not None:
             self._cache_index.put(batch)
+        for fn in self.on_append:
+            fn(batch)
         return batch.header.base_offset, batch.header.last_offset
 
     def _active_segment(self, term: int) -> Segment:
@@ -215,6 +224,8 @@ class Log:
             self._segments[-1].truncate(offset)
         if self._cache_index is not None:
             self._cache_index.truncate(offset)
+        for fn in self.on_truncate:
+            fn(offset)
 
     def prefix_truncate(self, offset: int) -> None:
         """Drop whole segments entirely below offset (retention,

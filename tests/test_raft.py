@@ -188,7 +188,14 @@ def test_leader_failover_and_data_survival(tmp_path):
             await asyncio.sleep(0.02)
         assert old.role == Role.FOLLOWER
         assert old.dirty_offset() >= l2
-        assert old.commit_index >= l2 or True  # commit propagates next tick
+        # commit index propagates via subsequent heartbeats
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while (
+            old.commit_index < l2
+            and asyncio.get_event_loop().time() < deadline
+        ):
+            await asyncio.sleep(0.02)
+        assert old.commit_index >= l2
         await cluster.stop()
 
     run(main())
