@@ -25,6 +25,7 @@ from .cluster import (
     PartitionManager,
     ShardTable,
 )
+from .kafka.coordinator import GroupCoordinator
 from .kafka.server import KafkaServer
 from .raft.group_manager import GroupManager
 from .rpc.connection_cache import ConnectionCache
@@ -105,6 +106,7 @@ class Broker:
         self.metadata_cache = MetadataCache(
             self.controller.topic_table, self.partition_manager, self.leaders
         )
+        self.group_coordinator = GroupCoordinator(self)
         self.kafka_server = KafkaServer(self)
         self._started = False
 
@@ -119,6 +121,7 @@ class Broker:
             await self._rpc_server.start()
         await self.group_manager.start()
         await self.controller.start()
+        await self.group_coordinator.start()
         await self.kafka_server.start()
         self._started = True
 
@@ -127,6 +130,7 @@ class Broker:
             return
         self._started = False
         await self.kafka_server.stop()
+        await self.group_coordinator.stop()
         await self.controller.stop()
         await self.group_manager.stop()
         await self._conn_cache.close()

@@ -97,15 +97,20 @@ class BrokerConnection:
         if response_header_version(api.key, version) >= 1:
             r.skip_tagged_fields()
         body = payload[len(payload) - r.remaining :]
-        resp = api.decode_response(body, version)
-        # ApiVersions downgrade: server replied v0 UNSUPPORTED_VERSION
-        if (
-            api.key == API_VERSIONS.key
-            and version > 0
-            and resp.error_code == int(ErrorCode.unsupported_version)
-        ):
-            resp = api.decode_response(body, 0)
-        return resp
+        if api.key == API_VERSIONS.key and version > 0:
+            # the broker may have replied with the v0 downgrade body
+            # (error 35 + api_keys, no throttle field), which fails to
+            # decode at the requested version — decode v0 first and
+            # only trust the requested-version decode when the reply
+            # is not a downgrade
+            try:
+                resp = api.decode_response(body, version)
+                if resp.error_code != int(ErrorCode.unsupported_version):
+                    return resp
+            except Exception:
+                pass
+            return api.decode_response(body, 0)
+        return api.decode_response(body, version)
 
     async def close(self) -> None:
         if self._writer is not None:
@@ -225,6 +230,20 @@ class KafkaClient:
         code = resp.topics[0].error_code
         if code != 0:
             raise KafkaClientError(code, f"create_topic {name}")
+
+    def group(self, group_id: str) -> "GroupClient":
+        return GroupClient(self, group_id)
+
+    async def delete_topic(self, name: str, timeout_ms: int = 10000) -> None:
+        from .protocol.group_apis import DELETE_TOPICS
+
+        conn = await self.any_conn()
+        v = conn.pick_version(DELETE_TOPICS, 1)
+        req = Msg(topic_names=[name], timeout_ms=timeout_ms)
+        resp = await conn.request(DELETE_TOPICS, req, v)
+        code = resp.responses[0].error_code
+        if code != 0:
+            raise KafkaClientError(code, f"delete_topic {name}")
 
     # -- produce -----------------------------------------------------
     async def produce(
@@ -360,6 +379,179 @@ class KafkaClient:
                 pr.error_code, f"list_offsets {topic}/{partition}"
             )
         return pr.offset
+
+
+class GroupClient:
+    """Consumer-group protocol driver bound to one group id
+    (reference: kafka/client/consumer.{h,cc} group membership flow)."""
+
+    def __init__(self, client: "KafkaClient", group_id: str):
+        self.client = client
+        self.group_id = group_id
+        self.member_id = ""
+        self.generation = -1
+        self._coord: Optional[BrokerConnection] = None
+
+    async def coordinator(self, refresh: bool = False) -> BrokerConnection:
+        from .protocol.group_apis import FIND_COORDINATOR
+
+        if self._coord is not None and not refresh:
+            return self._coord
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while True:
+            conn = await self.client.any_conn()
+            v = conn.pick_version(FIND_COORDINATOR, 1)
+            req = Msg(key=self.group_id, key_type=0)
+            resp = await conn.request(FIND_COORDINATOR, req, v)
+            if resp.error_code == 0 and resp.node_id >= 0:
+                self._coord = await self.client._connect_addr(
+                    (resp.host, resp.port)
+                )
+                return self._coord
+            if asyncio.get_event_loop().time() > deadline:
+                raise KafkaClientError(
+                    resp.error_code or int(ErrorCode.coordinator_not_available),
+                    f"find_coordinator {self.group_id}",
+                )
+            await asyncio.sleep(0.05)
+
+    async def _coord_request(self, api, req, version: int) -> Msg:
+        """Send to the coordinator, re-resolving on NOT_COORDINATOR."""
+        for attempt in range(3):
+            conn = await self.coordinator(refresh=attempt > 0)
+            resp = await conn.request(api, req, version)
+            code = getattr(resp, "error_code", 0)
+            if code == int(ErrorCode.not_coordinator):
+                await asyncio.sleep(0.05)
+                continue
+            return resp
+        return resp
+
+    async def join(
+        self,
+        protocols: list[tuple[str, bytes]],
+        protocol_type: str = "consumer",
+        session_timeout_ms: int = 10000,
+        rebalance_timeout_ms: int = 30000,
+    ) -> Msg:
+        from .protocol.group_apis import JOIN_GROUP
+
+        conn = await self.coordinator()
+        v = conn.pick_version(JOIN_GROUP, 4)
+        req = Msg(
+            group_id=self.group_id,
+            session_timeout_ms=session_timeout_ms,
+            rebalance_timeout_ms=rebalance_timeout_ms,
+            member_id=self.member_id,
+            protocol_type=protocol_type,
+            protocols=[Msg(name=n, metadata=md) for n, md in protocols],
+        )
+        resp = await self._coord_request(JOIN_GROUP, req, v)
+        if resp.error_code != 0:
+            raise KafkaClientError(resp.error_code, f"join {self.group_id}")
+        self.member_id = resp.member_id
+        self.generation = resp.generation_id
+        return resp
+
+    async def sync(self, assignments: list[tuple[str, bytes]]) -> bytes:
+        from .protocol.group_apis import SYNC_GROUP
+
+        conn = await self.coordinator()
+        v = conn.pick_version(SYNC_GROUP, 1)
+        req = Msg(
+            group_id=self.group_id,
+            generation_id=self.generation,
+            member_id=self.member_id,
+            assignments=[
+                Msg(member_id=m, assignment=a) for m, a in assignments
+            ],
+        )
+        resp = await self._coord_request(SYNC_GROUP, req, v)
+        if resp.error_code != 0:
+            raise KafkaClientError(resp.error_code, f"sync {self.group_id}")
+        return bytes(resp.assignment)
+
+    async def heartbeat(self) -> int:
+        from .protocol.group_apis import HEARTBEAT
+
+        conn = await self.coordinator()
+        v = conn.pick_version(HEARTBEAT, 1)
+        req = Msg(
+            group_id=self.group_id,
+            generation_id=self.generation,
+            member_id=self.member_id,
+        )
+        resp = await self._coord_request(HEARTBEAT, req, v)
+        return resp.error_code
+
+    async def leave(self) -> None:
+        from .protocol.group_apis import LEAVE_GROUP
+
+        conn = await self.coordinator()
+        v = conn.pick_version(LEAVE_GROUP, 1)
+        req = Msg(group_id=self.group_id, member_id=self.member_id)
+        await self._coord_request(LEAVE_GROUP, req, v)
+        self.member_id = ""
+        self.generation = -1
+
+    async def commit_offsets(
+        self, offsets: dict[tuple[str, int], int], metadata: str | None = None
+    ) -> None:
+        from .protocol.group_apis import OFFSET_COMMIT
+
+        conn = await self.coordinator()
+        v = conn.pick_version(OFFSET_COMMIT, 3)
+        by_topic: dict[str, list[Msg]] = {}
+        for (topic, part), off in offsets.items():
+            by_topic.setdefault(topic, []).append(
+                Msg(
+                    partition_index=part,
+                    committed_offset=off,
+                    committed_metadata=metadata,
+                )
+            )
+        req = Msg(
+            group_id=self.group_id,
+            generation_id=self.generation,
+            member_id=self.member_id,
+            retention_time_ms=-1,
+            topics=[Msg(name=t, partitions=ps) for t, ps in by_topic.items()],
+        )
+        resp = await self._coord_request(OFFSET_COMMIT, req, v)
+        for t in resp.topics:
+            for p in t.partitions:
+                if p.error_code != 0:
+                    raise KafkaClientError(
+                        p.error_code, f"offset_commit {t.name}/{p.partition_index}"
+                    )
+
+    async def fetch_offsets(
+        self, topics: dict[str, list[int]] | None = None
+    ) -> dict[tuple[str, int], int]:
+        from .protocol.group_apis import OFFSET_FETCH
+
+        conn = await self.coordinator()
+        v = conn.pick_version(OFFSET_FETCH, 3)
+        req = Msg(
+            group_id=self.group_id,
+            topics=(
+                None
+                if topics is None
+                else [
+                    Msg(name=t, partition_indexes=ps)
+                    for t, ps in topics.items()
+                ]
+            ),
+        )
+        resp = await self._coord_request(OFFSET_FETCH, req, v)
+        if getattr(resp, "error_code", 0) != 0:
+            raise KafkaClientError(resp.error_code, f"offset_fetch {self.group_id}")
+        out = {}
+        for t in resp.topics:
+            for p in t.partitions:
+                if p.committed_offset >= 0:
+                    out[(t.name, p.partition_index)] = p.committed_offset
+        return out
 
 
 def decode_record_set(

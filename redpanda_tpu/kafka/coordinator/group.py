@@ -1,0 +1,343 @@
+"""Consumer group rebalance state machine.
+
+Reference: src/v/kafka/server/group.{h,cc} (996+3,640 LoC) — one
+`Group` per group id living on its coordinator partition: the classic
+Kafka protocol state machine Empty → PreparingRebalance →
+CompletingRebalance → Stable, with member sessions, generation
+numbers, protocol selection and leader-driven assignment distribution.
+
+Pure control logic: persistence and partition leadership live in
+group_manager.py (the reference splits identically: group.cc vs
+group_manager.cc).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import enum
+import time
+import uuid
+from typing import Optional
+
+from ..protocol import ErrorCode
+
+
+class GroupState(enum.Enum):
+    EMPTY = "Empty"
+    PREPARING_REBALANCE = "PreparingRebalance"
+    COMPLETING_REBALANCE = "CompletingRebalance"
+    STABLE = "Stable"
+    DEAD = "Dead"
+
+
+@dataclasses.dataclass
+class Member:
+    member_id: str
+    client_id: str
+    client_host: str
+    session_timeout_ms: int
+    rebalance_timeout_ms: int
+    protocols: list[tuple[str, bytes]]  # (name, metadata)
+    assignment: bytes = b""
+    last_heartbeat: float = dataclasses.field(default_factory=time.monotonic)
+    # set when this member has (re)joined the current rebalance
+    joined: bool = False
+
+    def metadata_for(self, protocol: str) -> bytes:
+        for name, md in self.protocols:
+            if name == protocol:
+                return md
+        return b""
+
+
+@dataclasses.dataclass
+class JoinResult:
+    error: int
+    generation: int = -1
+    protocol_name: str = ""
+    leader: str = ""
+    member_id: str = ""
+    members: list[tuple[str, bytes]] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class SyncResult:
+    error: int
+    assignment: bytes = b""
+
+
+class Group:
+    def __init__(
+        self,
+        group_id: str,
+        initial_rebalance_delay_s: float = 0.05,
+    ):
+        self.group_id = group_id
+        self.state = GroupState.EMPTY
+        self.generation = 0
+        self.protocol_type: str = ""
+        self.protocol: str = ""  # selected protocol name
+        self.leader: Optional[str] = None
+        self.members: dict[str, Member] = {}
+        self.offsets: dict[tuple[str, int], tuple[int, str | None, int]] = {}
+        self._initial_delay = initial_rebalance_delay_s
+        self._join_done = asyncio.Event()  # fires when a rebalance completes
+        self._sync_done = asyncio.Event()  # fires when leader assigns
+        self._rebalance_task: Optional[asyncio.Task] = None
+        # bumped on every persisted transition so the manager knows to
+        # checkpoint metadata
+        self.dirty = False
+
+    # -- queries -----------------------------------------------------
+    def is_empty(self) -> bool:
+        return not self.members
+
+    def member(self, member_id: str) -> Optional[Member]:
+        return self.members.get(member_id)
+
+    # -- join --------------------------------------------------------
+    async def join(
+        self,
+        member_id: str,
+        client_id: str,
+        client_host: str,
+        session_timeout_ms: int,
+        rebalance_timeout_ms: int,
+        protocol_type: str,
+        protocols: list[tuple[str, bytes]],
+    ) -> JoinResult:
+        if self.state == GroupState.DEAD:
+            return JoinResult(error=int(ErrorCode.unknown_member_id))
+        if self.members and self.protocol_type != protocol_type:
+            return JoinResult(error=int(ErrorCode.inconsistent_group_protocol))
+        if self.members:
+            # candidate protocols must intersect the group's
+            common = self._common_protocols(extra=[p for p, _ in protocols])
+            if not common:
+                return JoinResult(
+                    error=int(ErrorCode.inconsistent_group_protocol)
+                )
+
+        if member_id == "":
+            member_id = f"{client_id or 'member'}-{uuid.uuid4()}"
+        elif member_id not in self.members:
+            return JoinResult(error=int(ErrorCode.unknown_member_id))
+
+        m = self.members.get(member_id)
+        if m is None:
+            m = Member(
+                member_id=member_id,
+                client_id=client_id,
+                client_host=client_host,
+                session_timeout_ms=session_timeout_ms,
+                rebalance_timeout_ms=rebalance_timeout_ms,
+                protocols=list(protocols),
+            )
+            self.members[member_id] = m
+            self.protocol_type = protocol_type
+        else:
+            m.protocols = list(protocols)
+            m.session_timeout_ms = session_timeout_ms
+            m.rebalance_timeout_ms = rebalance_timeout_ms
+        m.last_heartbeat = time.monotonic()
+        self._start_rebalance()  # no-op if one is already preparing
+        m.joined = True  # after the reset inside _start_rebalance
+        # wait for the rebalance timer to complete the round. The
+        # timer — not the joiner — finishes the rebalance so that a
+        # burst of concurrent joins coalesces into one generation
+        # (group.initial.rebalance.delay semantics).
+        join_done = self._join_done
+        timeout = max(rebalance_timeout_ms, 5000) / 1000.0 + 5.0
+        try:
+            await asyncio.wait_for(join_done.wait(), timeout)
+        except asyncio.TimeoutError:
+            return JoinResult(error=int(ErrorCode.rebalance_in_progress))
+        if member_id not in self.members:  # expired while waiting
+            return JoinResult(error=int(ErrorCode.unknown_member_id))
+        return self._join_result_for(member_id)
+
+    def _join_result_for(self, member_id: str) -> JoinResult:
+        is_leader = member_id == self.leader
+        return JoinResult(
+            error=0,
+            generation=self.generation,
+            protocol_name=self.protocol,
+            leader=self.leader or "",
+            member_id=member_id,
+            members=(
+                [
+                    (mid, m.metadata_for(self.protocol))
+                    for mid, m in self.members.items()
+                ]
+                if is_leader
+                else []
+            ),
+        )
+
+    def _start_rebalance(self) -> None:
+        if self.state in (
+            GroupState.PREPARING_REBALANCE,
+        ):
+            return
+        self.state = GroupState.PREPARING_REBALANCE
+        self._join_done = asyncio.Event()
+        self._sync_done = asyncio.Event()
+        for m in self.members.values():
+            m.joined = False
+        # the member triggering the rebalance counts as joined; others
+        # must rejoin within the rebalance timeout or be evicted
+        if self._rebalance_task is None or self._rebalance_task.done():
+            self._rebalance_task = asyncio.ensure_future(
+                self._rebalance_timer()
+            )
+
+    async def _rebalance_timer(self) -> None:
+        # initial delay lets a burst of joiners coalesce into one
+        # generation (group.initial.rebalance.delay analog)
+        await asyncio.sleep(self._initial_delay)
+        deadline = time.monotonic() + (
+            max(
+                (m.rebalance_timeout_ms for m in self.members.values()),
+                default=5000,
+            )
+            / 1000.0
+        )
+        while time.monotonic() < deadline:
+            if self.state != GroupState.PREPARING_REBALANCE:
+                return
+            if self.members and all(
+                m.joined for m in self.members.values()
+            ):
+                break
+            await asyncio.sleep(0.02)
+        # evict stragglers that never rejoined
+        for mid in [
+            mid for mid, m in self.members.items() if not m.joined
+        ]:
+            del self.members[mid]
+        if self.state == GroupState.PREPARING_REBALANCE:
+            self._complete_rebalance()
+
+    def _complete_rebalance(self) -> None:
+        if self.state != GroupState.PREPARING_REBALANCE:
+            return
+        if not self.members:
+            self.state = GroupState.EMPTY
+            self.generation += 1
+            self.leader = None
+            self.protocol = ""
+            self.dirty = True
+            self._join_done.set()
+            return
+        self.generation += 1
+        common = self._common_protocols()
+        self.protocol = common[0] if common else ""
+        if self.leader not in self.members:
+            self.leader = next(iter(self.members))
+        self.state = GroupState.COMPLETING_REBALANCE
+        self.dirty = True
+        self._join_done.set()
+
+    def _common_protocols(self, extra: Optional[list[str]] = None) -> list[str]:
+        """Protocol names supported by every member, in first-member
+        preference order (the reference's vote)."""
+        sets = [
+            [name for name, _ in m.protocols] for m in self.members.values()
+        ]
+        if extra is not None:
+            sets.append(extra)
+        if not sets:
+            return []
+        first = sets[0]
+        return [p for p in first if all(p in s for s in sets[1:])]
+
+    # -- sync --------------------------------------------------------
+    async def sync(
+        self,
+        member_id: str,
+        generation: int,
+        assignments: list[tuple[str, bytes]],
+    ) -> SyncResult:
+        m = self.members.get(member_id)
+        if m is None:
+            return SyncResult(error=int(ErrorCode.unknown_member_id))
+        if generation != self.generation:
+            return SyncResult(error=int(ErrorCode.illegal_generation))
+        if self.state == GroupState.PREPARING_REBALANCE:
+            return SyncResult(error=int(ErrorCode.rebalance_in_progress))
+        if self.state == GroupState.STABLE:
+            return SyncResult(error=0, assignment=m.assignment)
+        if self.state != GroupState.COMPLETING_REBALANCE:
+            return SyncResult(error=int(ErrorCode.unknown_member_id))
+
+        if member_id == self.leader:
+            by_member = dict(assignments)
+            for mid, mm in self.members.items():
+                mm.assignment = by_member.get(mid, b"")
+            self.state = GroupState.STABLE
+            self.dirty = True
+            self._sync_done.set()
+            return SyncResult(error=0, assignment=m.assignment)
+
+        sync_done = self._sync_done
+        try:
+            await asyncio.wait_for(sync_done.wait(), 30.0)
+        except asyncio.TimeoutError:
+            return SyncResult(error=int(ErrorCode.rebalance_in_progress))
+        if self.state != GroupState.STABLE or generation != self.generation:
+            return SyncResult(error=int(ErrorCode.rebalance_in_progress))
+        return SyncResult(error=0, assignment=m.assignment)
+
+    # -- heartbeat / leave -------------------------------------------
+    def heartbeat(self, member_id: str, generation: int) -> int:
+        m = self.members.get(member_id)
+        if m is None:
+            return int(ErrorCode.unknown_member_id)
+        if generation != self.generation:
+            return int(ErrorCode.illegal_generation)
+        m.last_heartbeat = time.monotonic()
+        if self.state == GroupState.PREPARING_REBALANCE:
+            return int(ErrorCode.rebalance_in_progress)
+        if self.state not in (GroupState.STABLE, GroupState.COMPLETING_REBALANCE):
+            return int(ErrorCode.unknown_member_id)
+        return 0
+
+    def leave(self, member_id: str) -> int:
+        if member_id not in self.members:
+            return int(ErrorCode.unknown_member_id)
+        del self.members[member_id]
+        if self.state in (
+            GroupState.STABLE,
+            GroupState.COMPLETING_REBALANCE,
+        ):
+            self._start_rebalance()
+            for m in self.members.values():
+                m.joined = False
+        elif self.state == GroupState.PREPARING_REBALANCE and not self.members:
+            self._complete_rebalance()
+        if not self.members and self.state != GroupState.PREPARING_REBALANCE:
+            self.state = GroupState.EMPTY
+            self.dirty = True
+        return 0
+
+    # -- expiration --------------------------------------------------
+    def expire_members(self) -> list[str]:
+        """Evict members whose session timed out; returns evicted ids."""
+        now = time.monotonic()
+        expired = [
+            mid
+            for mid, m in self.members.items()
+            if now - m.last_heartbeat > m.session_timeout_ms / 1000.0
+        ]
+        for mid in expired:
+            self.leave(mid)
+        return expired
+
+    async def close(self) -> None:
+        if self._rebalance_task is not None and not self._rebalance_task.done():
+            self._rebalance_task.cancel()
+            try:
+                await self._rebalance_task
+            except asyncio.CancelledError:
+                pass

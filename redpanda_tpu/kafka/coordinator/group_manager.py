@@ -1,0 +1,403 @@
+"""Group coordinator: groups + offsets on `__consumer_offsets` partitions.
+
+Reference: src/v/kafka/server/group_manager.{h,cc} (group_manager.h:118),
+group_metadata.{h,cc}, group_recovery_consumer.* and
+coordinator_ntp_mapper.h — groups are sharded over the partitions of
+the internal `__consumer_offsets` topic by group-id hash; the leader
+of a coordinator partition serves all its groups; every state
+transition and offset commit is a replicated record batch on that
+partition, so coordinator failover replays the log to rebuild state.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import zlib
+from typing import TYPE_CHECKING, Optional
+
+from ...models.fundamental import DEFAULT_NS, NTP
+from ...models.record import RecordBatch, RecordBatchBuilder, RecordBatchType
+from ...raft.consensus import NotLeaderError, ReplicateTimeout
+from ...utils import serde
+from ..protocol import ErrorCode
+from .group import Group, GroupState
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ...app import Broker
+
+logger = logging.getLogger("kafka.coordinator")
+
+OFFSETS_TOPIC = "__consumer_offsets"
+DEFAULT_OFFSETS_PARTITIONS = 4
+
+_KIND_GROUP_META = 0
+_KIND_OFFSET = 1
+
+
+class _Key(serde.Envelope):
+    SERDE_FIELDS = [
+        ("kind", serde.u8),
+        ("group", serde.string),
+        ("topic", serde.string),
+        ("partition", serde.i32),
+    ]
+
+
+class _MemberMeta(serde.Envelope):
+    SERDE_FIELDS = [
+        ("member_id", serde.string),
+        ("client_id", serde.string),
+        ("client_host", serde.string),
+        ("session_timeout_ms", serde.i32),
+        ("rebalance_timeout_ms", serde.i32),
+        ("protocol_names", serde.vector(serde.string)),
+        ("protocol_metas", serde.vector(serde.bytes_t)),
+        ("assignment", serde.bytes_t),
+    ]
+
+
+class _GroupMetaValue(serde.Envelope):
+    SERDE_FIELDS = [
+        ("generation", serde.i32),
+        ("protocol_type", serde.string),
+        ("protocol", serde.string),
+        ("leader", serde.string),
+        ("state", serde.string),
+        ("members", serde.vector(_MemberMeta.serde())),
+    ]
+
+
+class _OffsetValue(serde.Envelope):
+    SERDE_FIELDS = [
+        ("offset", serde.i64),
+        ("metadata", serde.optional(serde.string)),
+        ("commit_ts_ms", serde.i64),
+    ]
+
+
+class GroupCoordinator:
+    def __init__(
+        self,
+        broker: "Broker",
+        n_partitions: int = DEFAULT_OFFSETS_PARTITIONS,
+        initial_rebalance_delay_s: float = 0.05,
+    ):
+        self.broker = broker
+        self.n_partitions = n_partitions
+        self._initial_delay = initial_rebalance_delay_s
+        # per coordinator-partition group stores
+        self._groups: dict[int, dict[str, Group]] = {}
+        # pid → raft term at replay time: leadership can bounce away
+        # and back with commits happening elsewhere in between, so a
+        # replay is valid only for the term it was taken in
+        self._replayed: dict[int, int] = {}
+        self._create_lock = asyncio.Lock()
+        self._expire_task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    async def start(self) -> None:
+        self._expire_task = asyncio.ensure_future(self._expire_loop())
+
+    async def stop(self) -> None:
+        self._closed = True
+        if self._expire_task is not None:
+            self._expire_task.cancel()
+            try:
+                await self._expire_task
+            except asyncio.CancelledError:
+                pass
+        for shard in self._groups.values():
+            for g in shard.values():
+                await g.close()
+
+    # -- mapping (coordinator_ntp_mapper.h) --------------------------
+    def partition_for(self, group_id: str) -> int:
+        return zlib.crc32(group_id.encode()) % self.n_partitions
+
+    def ntp_for(self, group_id: str) -> NTP:
+        return NTP(DEFAULT_NS, OFFSETS_TOPIC, self.partition_for(group_id))
+
+    async def ensure_offsets_topic(self) -> None:
+        table = self.broker.controller.topic_table
+        from ...models.fundamental import TopicNamespace
+
+        if table.contains(TopicNamespace(DEFAULT_NS, OFFSETS_TOPIC)):
+            return
+        async with self._create_lock:
+            if table.contains(TopicNamespace(DEFAULT_NS, OFFSETS_TOPIC)):
+                return
+            from ...cluster.controller import TopicError
+
+            rf = min(3, len(self.broker.controller.members))
+            rf = rf if rf % 2 == 1 else rf - 1
+            try:
+                await self.broker.controller.create_topic(
+                    OFFSETS_TOPIC,
+                    partitions=self.n_partitions,
+                    replication_factor=max(rf, 1),
+                )
+            except TopicError as e:
+                if e.code != "topic_already_exists":
+                    raise
+
+    # -- coordinator resolution --------------------------------------
+    async def find_coordinator(
+        self, group_id: str
+    ) -> tuple[int, str, int] | None:
+        """(node_id, host, port) of the group's coordinator, or None
+        while leadership is unsettled."""
+        await self.ensure_offsets_topic()
+        ntp = self.ntp_for(group_id)
+        leader = self.broker.metadata_cache.leader_of(ntp)
+        if leader is None:
+            return None
+        addr = self.broker.kafka_address_of(leader)
+        if addr is None:
+            return None
+        return leader, addr[0], addr[1]
+
+    def _local_partition(self, group_id: str):
+        p = self.broker.partition_manager.get(self.ntp_for(group_id))
+        if p is None or not p.is_leader:
+            return None
+        return p
+
+    def _shard(self, pid: int) -> dict[str, Group]:
+        return self._groups.setdefault(pid, {})
+
+    async def _ensure_replayed(self, group_id: str) -> Optional[int]:
+        """Replay the coordinator partition's log if this broker just
+        became its leader (group_recovery_consumer analog). Returns the
+        partition id, or None if not coordinator here."""
+        p = self._local_partition(group_id)
+        pid = self.partition_for(group_id)
+        if p is None:
+            self._replayed.pop(pid, None)
+            return None
+        term = p.consensus.term
+        if self._replayed.get(pid) == term:
+            return pid
+        shard: dict[str, Group] = {}
+        offs = p.log.offsets()
+        pos = max(offs.start_offset, 0)
+        while pos <= p.consensus.commit_index:
+            batches = p.log.read(pos, upto=p.consensus.commit_index)
+            if not batches:
+                break
+            for b in batches:
+                pos = b.header.last_offset + 1
+                if b.header.type != RecordBatchType.raft_data:
+                    continue
+                self._replay_batch(shard, b)
+        # drop superseded in-memory groups: their waiters are parked on
+        # events of a stale generation; closing cancels their timers
+        for g in self._groups.get(pid, {}).values():
+            await g.close()
+        self._groups[pid] = shard
+        self._replayed[pid] = term
+        logger.info(
+            "node %d: coordinator partition %d replayed: %d groups",
+            self.broker.node_id,
+            pid,
+            len(shard),
+        )
+        return pid
+
+    def _replay_batch(self, shard: dict[str, Group], batch: RecordBatch) -> None:
+        import time as _time
+
+        for rec in batch.records():
+            if rec.key is None:
+                continue
+            key = _Key.decode(rec.key)
+            g = shard.get(key.group)
+            if key.kind == _KIND_GROUP_META:
+                if rec.value is None:  # tombstone
+                    shard.pop(key.group, None)
+                    continue
+                val = _GroupMetaValue.decode(rec.value)
+                if g is None:
+                    g = Group(key.group, self._initial_delay)
+                    shard[key.group] = g
+                g.generation = int(val.generation)
+                g.protocol_type = val.protocol_type
+                g.protocol = val.protocol
+                g.leader = val.leader or None
+                g.state = GroupState(val.state)
+                from .group import Member
+
+                g.members = {
+                    m.member_id: Member(
+                        member_id=m.member_id,
+                        client_id=m.client_id,
+                        client_host=m.client_host,
+                        session_timeout_ms=int(m.session_timeout_ms),
+                        rebalance_timeout_ms=int(m.rebalance_timeout_ms),
+                        protocols=list(
+                            zip(m.protocol_names, m.protocol_metas)
+                        ),
+                        assignment=m.assignment,
+                        joined=True,
+                    )
+                    for m in val.members
+                }
+            elif key.kind == _KIND_OFFSET:
+                if g is None:
+                    g = Group(key.group, self._initial_delay)
+                    shard[key.group] = g
+                if rec.value is None:  # tombstone
+                    g.offsets.pop((key.topic, key.partition), None)
+                else:
+                    val = _OffsetValue.decode(rec.value)
+                    g.offsets[(key.topic, key.partition)] = (
+                        int(val.offset),
+                        val.metadata,
+                        int(val.commit_ts_ms),
+                    )
+
+    async def get_group(
+        self, group_id: str, create: bool = False
+    ) -> tuple[Optional[Group], int]:
+        """(group, error). error NOT_COORDINATOR when this broker does
+        not lead the group's coordinator partition."""
+        pid = await self._ensure_replayed(group_id)
+        if pid is None:
+            return None, int(ErrorCode.not_coordinator)
+        shard = self._shard(pid)
+        g = shard.get(group_id)
+        if g is None:
+            if not create:
+                return None, int(ErrorCode.group_id_not_found)
+            g = Group(group_id, self._initial_delay)
+            shard[group_id] = g
+        return g, 0
+
+    # -- persistence -------------------------------------------------
+    async def checkpoint_group(self, g: Group) -> int:
+        """Replicate the group's metadata (returns kafka error code)."""
+        p = self._local_partition(g.group_id)
+        if p is None:
+            return int(ErrorCode.not_coordinator)
+        val = _GroupMetaValue(
+            generation=g.generation,
+            protocol_type=g.protocol_type,
+            protocol=g.protocol,
+            leader=g.leader or "",
+            state=g.state.value,
+            members=[
+                _MemberMeta(
+                    member_id=m.member_id,
+                    client_id=m.client_id,
+                    client_host=m.client_host,
+                    session_timeout_ms=m.session_timeout_ms,
+                    rebalance_timeout_ms=m.rebalance_timeout_ms,
+                    protocol_names=[n for n, _ in m.protocols],
+                    protocol_metas=[md for _, md in m.protocols],
+                    assignment=m.assignment,
+                )
+                for m in g.members.values()
+            ],
+        )
+        b = RecordBatchBuilder()
+        b.add(
+            value=val.encode(),
+            key=_Key(
+                kind=_KIND_GROUP_META, group=g.group_id, topic="", partition=-1
+            ).encode(),
+        )
+        try:
+            await p.replicate(b.build(), acks=-1)
+            g.dirty = False
+            return 0
+        except NotLeaderError:
+            return int(ErrorCode.not_coordinator)
+        except ReplicateTimeout:
+            return int(ErrorCode.request_timed_out)
+
+    async def commit_offsets(
+        self,
+        g: Group,
+        items: list[tuple[str, int, int, str | None]],  # topic, part, off, md
+    ) -> int:
+        import time as _time
+
+        p = self._local_partition(g.group_id)
+        if p is None:
+            return int(ErrorCode.not_coordinator)
+        now = int(_time.time() * 1000)
+        b = RecordBatchBuilder()
+        for topic, part, off, md in items:
+            b.add(
+                value=_OffsetValue(
+                    offset=off, metadata=md, commit_ts_ms=now
+                ).encode(),
+                key=_Key(
+                    kind=_KIND_OFFSET, group=g.group_id, topic=topic, partition=part
+                ).encode(),
+            )
+        try:
+            await p.replicate(b.build(), acks=-1)
+        except NotLeaderError:
+            return int(ErrorCode.not_coordinator)
+        except ReplicateTimeout:
+            return int(ErrorCode.request_timed_out)
+        for topic, part, off, md in items:
+            g.offsets[(topic, part)] = (off, md, now)
+        return 0
+
+    async def delete_group(self, group_id: str) -> int:
+        g, err = await self.get_group(group_id)
+        if err:
+            return err
+        if g.members and g.state not in (GroupState.EMPTY, GroupState.DEAD):
+            return int(ErrorCode.non_empty_group)
+        p = self._local_partition(group_id)
+        if p is None:
+            return int(ErrorCode.not_coordinator)
+        b = RecordBatchBuilder()
+        for topic, part in list(g.offsets):
+            b.add(
+                value=None,
+                key=_Key(
+                    kind=_KIND_OFFSET, group=group_id, topic=topic, partition=part
+                ).encode(),
+            )
+        b.add(
+            value=None,
+            key=_Key(
+                kind=_KIND_GROUP_META, group=group_id, topic="", partition=-1
+            ).encode(),
+        )
+        try:
+            await p.replicate(b.build(), acks=-1)
+        except (NotLeaderError, ReplicateTimeout):
+            return int(ErrorCode.not_coordinator)
+        self._shard(self.partition_for(group_id)).pop(group_id, None)
+        await g.close()
+        return 0
+
+    # -- listing -----------------------------------------------------
+    def local_groups(self) -> list[Group]:
+        out = []
+        for pid, shard in self._groups.items():
+            ntp = NTP(DEFAULT_NS, OFFSETS_TOPIC, pid)
+            p = self.broker.partition_manager.get(ntp)
+            if p is not None and p.is_leader:
+                out.extend(shard.values())
+        return out
+
+    # -- session expiration ------------------------------------------
+    async def _expire_loop(self) -> None:
+        while not self._closed:
+            await asyncio.sleep(0.5)
+            try:
+                for g in self.local_groups():
+                    expired = g.expire_members()
+                    if expired:
+                        logger.info(
+                            "group %s: expired members %s", g.group_id, expired
+                        )
+                        await self.checkpoint_group(g)
+            except Exception:
+                logger.exception("group expiration sweep failed")

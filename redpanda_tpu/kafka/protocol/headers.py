@@ -77,6 +77,7 @@ class ErrorCode(enum.IntEnum):
     operation_not_attempted = 55
     kafka_storage_error = 56
     unknown_server_error = -1
+    non_empty_group = 68
     group_id_not_found = 69
     fetch_session_id_not_found = 70
     invalid_fetch_session_epoch = 71

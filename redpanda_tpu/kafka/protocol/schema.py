@@ -139,7 +139,16 @@ def _decode_value(r: Reader, f: F, version: int, flexible: bool) -> Any:
         if n < 0:
             return None
         if isinstance(t.inner, str):
-            read = _PRIM_READ[t.inner]
+            if t.inner == "string":
+                read = (
+                    Reader.read_compact_string if flexible else Reader.read_string
+                )
+            elif t.inner == "bytes":
+                read = (
+                    Reader.read_compact_bytes if flexible else Reader.read_bytes
+                )
+            else:
+                read = _PRIM_READ[t.inner]
             return [read(r) for _ in range(n)]
         return [_decode_fields(r, t.inner, version, flexible) for _ in range(n)]
     if not isinstance(t, str):  # nested struct
@@ -195,7 +204,18 @@ def _encode_value(w: Writer, f: F, value: Any, version: int, flexible: bool) -> 
             return
         w.write_array_len(len(value), flexible)
         if isinstance(t.inner, str):
-            write = _PRIM_WRITE[t.inner]
+            if t.inner == "string":
+                write = (
+                    Writer.write_compact_string
+                    if flexible
+                    else Writer.write_string
+                )
+            elif t.inner == "bytes":
+                write = (
+                    Writer.write_compact_bytes if flexible else Writer.write_bytes
+                )
+            else:
+                write = _PRIM_WRITE[t.inner]
             for item in value:
                 write(w, item)
         else:

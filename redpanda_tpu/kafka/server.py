@@ -59,6 +59,17 @@ def _topic_error_code(code: str) -> int:
         return int(ErrorCode.unknown_server_error)
 
 
+def _default_rf(n_brokers: int) -> int:
+    """Broker-chosen replication factor: min(3, brokers), forced odd."""
+    rf = min(3, n_brokers)
+    return max(rf - 1 if rf % 2 == 0 else rf, 1)
+
+
+class _CloseConnection(Exception):
+    """Raised by the request pipeline to drop the connection — the
+    reference closes on unparseable/unanswerable requests."""
+
+
 class KafkaServer:
     def __init__(self, broker: "Broker"):
         self.broker = broker
@@ -73,6 +84,9 @@ class KafkaServer:
             FETCH.key: self.handle_fetch,
             LIST_OFFSETS.key: self.handle_list_offsets,
         }
+        from . import server_groups
+
+        server_groups.install(self)
 
     async def start(self) -> None:
         cfg = self.broker.config
@@ -109,7 +123,13 @@ class KafkaServer:
                 if size <= 0 or size > 128 * 1024 * 1024:
                     return
                 frame = await reader.readexactly(size)
-                resp = await self._process(frame)
+                try:
+                    resp = await self._process(frame)
+                except _CloseConnection as e:
+                    if e.args and e.args[0]:
+                        writer.write(_SIZE.pack(len(e.args[0])) + e.args[0])
+                        await writer.drain()
+                    return
                 if resp is not None:
                     writer.write(_SIZE.pack(len(resp)) + resp)
                     await writer.drain()
@@ -128,12 +148,23 @@ class KafkaServer:
         api = API_BY_KEY.get(hdr.api_key)
         if api is None:
             logger.warning("unknown api key %d", hdr.api_key)
-            return None  # reference closes the connection on unknown keys
+            raise _CloseConnection(b"")
         if not api.supports(hdr.api_version):
-            return self._unsupported_version(hdr)
+            # only ApiVersions has a downgrade contract (reply v0 +
+            # UNSUPPORTED_VERSION so the client renegotiates); for any
+            # other api there is no version both sides can parse — send
+            # the ApiVersions-style error THEN close, matching the
+            # reference's disconnect (kafka/server/protocol_utils.cc)
+            if hdr.api_key == API_VERSIONS.key:
+                return self._unsupported_version(hdr)
+            logger.warning(
+                "%s v%d unsupported (range %d-%d): closing connection",
+                api.name, hdr.api_version, api.min_version, api.max_version,
+            )
+            raise _CloseConnection(b"")
         handler = self._handlers.get(hdr.api_key)
         if handler is None:
-            return self._unsupported_version(hdr)
+            raise _CloseConnection(b"")
         try:
             resp = await handler(hdr, api.decode_request(
                 frame[len(frame) - r.remaining :], hdr.api_version
@@ -270,7 +301,7 @@ class KafkaServer:
                         replication_factor=(
                             t.replication_factor
                             if t.replication_factor > 0
-                            else min(3, len(self.broker.controller.members)) | 1
+                            else _default_rf(len(self.broker.controller.members))
                         ),
                         config={c.name: c.value for c in t.configs},
                         timeout=max(req.timeout_ms / 1000.0, 1.0),
@@ -359,8 +390,9 @@ class KafkaServer:
         )
         min_bytes = max(req.min_bytes, 0)
 
-        def read_all() -> tuple[list[Msg], int]:
+        def read_all() -> tuple[list[Msg], int, bool]:
             total = 0
+            has_error = False
             out = []
             budget = req.max_bytes if req.max_bytes > 0 else 1 << 30
             for t in req.topics:
@@ -370,6 +402,7 @@ class KafkaServer:
                     partition = self.broker.partition_manager.get(ntp)
                     if partition is None:
                         known = self.broker.controller.topic_table.group_of(ntp)
+                        has_error = True
                         parts.append(
                             Msg(
                                 partition_index=p.partition,
@@ -387,6 +420,7 @@ class KafkaServer:
                         )
                         continue
                     if not partition.is_leader:
+                        has_error = True
                         parts.append(
                             Msg(
                                 partition_index=p.partition,
@@ -402,6 +436,7 @@ class KafkaServer:
                     hw = partition.high_watermark()
                     start = partition.start_offset()
                     if p.fetch_offset < start or p.fetch_offset > hw:
+                        has_error = True
                         parts.append(
                             Msg(
                                 partition_index=p.partition,
@@ -436,13 +471,15 @@ class KafkaServer:
                         )
                     )
                 out.append(Msg(topic=t.topic, partitions=parts))
-            return out, total
+            return out, total, has_error
 
         # long-poll: debounced re-read until min_bytes or max_wait
         # (fetch.cc:432 over_min_bytes, :546 debounce)
         while True:
-            responses, total = read_all()
-            if total >= min_bytes:
+            responses, total, has_error = read_all()
+            # error partitions complete the fetch immediately — holding
+            # the long-poll would stall the client's metadata refresh
+            if has_error or total >= min_bytes:
                 break
             now = asyncio.get_event_loop().time()
             if now >= deadline:

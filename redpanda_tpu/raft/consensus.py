@@ -143,6 +143,31 @@ class Consensus:
                 self._rebuild_slots()
                 self._persist_config()
 
+    def _hydrate_config_history(self) -> None:
+        """Rebuild the in-log config history on restart so a later
+        truncation of an uncommitted config batch can roll the active
+        config back (configuration_manager.cc recovery)."""
+        offs = self.log.offsets()
+        pos = max(offs.start_offset, 0)
+        while pos <= offs.dirty_offset:
+            batches = self.log.read(pos, max_bytes=1 << 22)
+            if not batches:
+                break
+            for b in batches:
+                pos = b.header.last_offset + 1
+                if b.header.type != RecordBatchType.raft_configuration:
+                    continue
+                for rec in b.records():
+                    if rec.value is not None:
+                        self._config_history.append(
+                            (
+                                b.header.base_offset,
+                                GroupConfiguration.decode(rec.value),
+                            )
+                        )
+        if self._config_history:
+            self.config = self._config_history[-1][1]
+
     def _observe_truncate(self, offset: int) -> None:
         changed = False
         while self._config_history and self._config_history[-1][0] >= offset:
@@ -220,6 +245,7 @@ class Consensus:
     async def start(self) -> None:
         self._load_vote_state()
         self._load_config_state()
+        self._hydrate_config_history()
         self.log.on_append.append(self._observe_append)
         self.log.on_truncate.append(self._observe_truncate)
         self._rebuild_slots()

@@ -1,0 +1,225 @@
+"""Consumer-group coordinator e2e tests.
+
+Reference test model: kafka/server/tests/group_membership_test.cc,
+consumer_groups_test.cc and tests/rptest group membership suites —
+join/sync/heartbeat/leave lifecycle, offset commit/fetch durability,
+two-member rebalance, coordinator routing.
+"""
+
+import asyncio
+
+import pytest
+
+from redpanda_tpu.kafka.client import KafkaClient, KafkaClientError
+from redpanda_tpu.kafka.protocol import ErrorCode
+
+from test_kafka_e2e import broker_cluster, client_for
+
+PROTO = [("range", b"meta-v0")]
+
+
+def test_join_sync_heartbeat_leave(tmp_path):
+    async def run():
+        async with broker_cluster(tmp_path, 1) as brokers:
+            async with client_for(brokers) as client:
+                g = client.group("g1")
+                join = await g.join(PROTO)
+                assert join.leader == join.member_id  # sole member leads
+                assert join.generation_id >= 1
+                assert [m.member_id for m in join.members] == [join.member_id]
+                assignment = await g.sync([(g.member_id, b"assign-0")])
+                assert assignment == b"assign-0"
+                assert await g.heartbeat() == 0
+                await g.leave()
+
+    asyncio.run(run())
+
+
+def test_offset_commit_fetch_roundtrip(tmp_path):
+    async def run():
+        async with broker_cluster(tmp_path, 1) as brokers:
+            async with client_for(brokers) as client:
+                await client.create_topic("t1", partitions=2)
+                g = client.group("g2")
+                await g.join(PROTO)
+                await g.sync([(g.member_id, b"")])
+                await g.commit_offsets({("t1", 0): 5, ("t1", 1): 9})
+                got = await g.fetch_offsets({"t1": [0, 1]})
+                assert got == {("t1", 0): 5, ("t1", 1): 9}
+                # fetch-all form
+                got_all = await g.fetch_offsets(None)
+                assert got_all == {("t1", 0): 5, ("t1", 1): 9}
+                # unknown partition reports no offset
+                got2 = await g.fetch_offsets({"t1": [0, 1, 7]})
+                assert ("t1", 7) not in got2
+
+    asyncio.run(run())
+
+
+def test_two_member_rebalance(tmp_path):
+    async def run():
+        async with broker_cluster(tmp_path, 1) as brokers:
+            async with client_for(brokers) as c1, client_for(brokers) as c2:
+                g1 = c1.group("g3")
+                g2 = c2.group("g3")
+                # both join concurrently → same generation, one leader
+                j1, j2 = await asyncio.gather(g1.join(PROTO), g2.join(PROTO))
+                assert j1.generation_id == j2.generation_id
+                leaders = {j1.leader, j2.leader}
+                assert len(leaders) == 1
+                leader = g1 if j1.leader == j1.member_id else g2
+                follower = g2 if leader is g1 else g1
+                members = (j1 if leader is g1 else j2).members
+                assert len(members) == 2
+                assigns = [
+                    (m.member_id, b"part-%d" % i) for i, m in enumerate(members)
+                ]
+                a_leader, a_follower = await asyncio.gather(
+                    leader.sync(assigns), follower.sync([])
+                )
+                assert {a_leader, a_follower} == {b"part-0", b"part-1"}
+                # leaving triggers a rebalance for the survivor
+                await follower.leave()
+                code = await leader.heartbeat()
+                assert code == int(ErrorCode.rebalance_in_progress)
+                j3 = await leader.join(PROTO)
+                assert j3.generation_id > j1.generation_id
+                assert len(j3.members) == 1
+
+    asyncio.run(run())
+
+
+def test_offsets_survive_restart(tmp_path):
+    async def run():
+        from redpanda_tpu.app import Broker, BrokerConfig
+        from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+        cfg = BrokerConfig(
+            node_id=0,
+            data_dir=str(tmp_path / "node0"),
+            members=[0],
+            election_timeout_s=0.15,
+            heartbeat_interval_s=0.03,
+        )
+        b = Broker(cfg, loopback=LoopbackNetwork())
+        await b.start()
+        client = KafkaClient([b.kafka_advertised])
+        await client.create_topic("t1", partitions=1)
+        g = client.group("g4")
+        await g.join(PROTO)
+        await g.sync([(g.member_id, b"")])
+        await g.commit_offsets({("t1", 0): 42})
+        await client.close()
+        await b.stop()
+
+        b2 = Broker(cfg, loopback=LoopbackNetwork())
+        await b2.start()
+        try:
+            client = KafkaClient([b2.kafka_advertised])
+            g = client.group("g4")
+            deadline = asyncio.get_event_loop().time() + 5
+            while True:
+                try:
+                    got = await g.fetch_offsets({"t1": [0]})
+                    break
+                except KafkaClientError:
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.05)
+            assert got == {("t1", 0): 42}
+            await client.close()
+        finally:
+            await b2.stop()
+
+    asyncio.run(run())
+
+
+def test_session_expiration_evicts_member(tmp_path):
+    async def run():
+        async with broker_cluster(tmp_path, 1) as brokers:
+            async with client_for(brokers) as client:
+                g = client.group("g5")
+                await g.join(PROTO, session_timeout_ms=600)
+                await g.sync([(g.member_id, b"x")])
+                # stop heartbeating; the expiration sweep evicts us
+                await asyncio.sleep(1.5)
+                code = await g.heartbeat()
+                assert code == int(ErrorCode.unknown_member_id)
+
+    asyncio.run(run())
+
+
+def test_describe_and_list_and_delete_groups(tmp_path):
+    async def run():
+        from redpanda_tpu.kafka.protocol.group_apis import (
+            DELETE_GROUPS,
+            DESCRIBE_GROUPS,
+            LIST_GROUPS,
+        )
+        from redpanda_tpu.kafka.protocol import Msg
+
+        async with broker_cluster(tmp_path, 1) as brokers:
+            async with client_for(brokers) as client:
+                g = client.group("g6")
+                await g.join(PROTO)
+                await g.sync([(g.member_id, b"a0")])
+                conn = await g.coordinator()
+                desc = await conn.request(
+                    DESCRIBE_GROUPS, Msg(groups=["g6"]), 1
+                )
+                d = desc.groups[0]
+                assert d.group_state == "Stable"
+                assert d.protocol_data == "range"
+                assert len(d.members) == 1
+                listed = await conn.request(LIST_GROUPS, Msg(), 1)
+                assert "g6" in [x.group_id for x in listed.groups]
+                # delete fails while non-empty, succeeds after leave
+                res = await conn.request(
+                    DELETE_GROUPS, Msg(groups_names=["g6"]), 1
+                )
+                assert res.results[0].error_code == int(
+                    ErrorCode.non_empty_group
+                )
+                await g.leave()
+                res = await conn.request(
+                    DELETE_GROUPS, Msg(groups_names=["g6"]), 1
+                )
+                assert res.results[0].error_code == 0
+
+    asyncio.run(run())
+
+
+def test_delete_topic_via_api(tmp_path):
+    async def run():
+        async with broker_cluster(tmp_path, 1) as brokers:
+            async with client_for(brokers) as client:
+                await client.create_topic("doomed", partitions=1)
+                await client.produce("doomed", 0, [(None, b"x")])
+                await client.delete_topic("doomed")
+                md = await client.metadata(["doomed"])
+                assert md.topics[0].error_code == int(
+                    ErrorCode.unknown_topic_or_partition
+                )
+                with pytest.raises(KafkaClientError):
+                    await client.delete_topic("doomed")
+
+    asyncio.run(run())
+
+
+def test_group_coordinator_on_three_brokers(tmp_path):
+    """Groups work when the coordinator partition lives on any broker;
+    requests land on the right node via FindCoordinator routing."""
+
+    async def run():
+        async with broker_cluster(tmp_path, 3) as brokers:
+            async with client_for(brokers) as client:
+                await client.create_topic("t1", partitions=1, replication_factor=3)
+                for i in range(4):  # several groups → several partitions
+                    g = client.group(f"grp-{i}")
+                    await g.join(PROTO)
+                    await g.sync([(g.member_id, b"")])
+                    await g.commit_offsets({("t1", 0): i * 10})
+                    got = await g.fetch_offsets({"t1": [0]})
+                    assert got == {("t1", 0): i * 10}
+
+    asyncio.run(run())
