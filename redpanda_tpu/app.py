@@ -25,6 +25,7 @@ from .cluster import (
     PartitionManager,
     ShardTable,
 )
+from .cluster.metadata_dissemination import MetadataDissemination
 from .kafka.coordinator import GroupCoordinator
 from .kafka.server import KafkaServer
 from .raft.group_manager import GroupManager
@@ -107,12 +108,17 @@ class Broker:
             self.controller.topic_table, self.partition_manager, self.leaders
         )
         self.group_coordinator = GroupCoordinator(self)
+        self.metadata_dissemination = MetadataDissemination(self)
         self.kafka_server = KafkaServer(self)
         self._started = False
 
     # -- lifecycle ---------------------------------------------------
     async def start(self) -> None:
-        for svc in (self.group_manager.service, self.controller.service):
+        for svc in (
+            self.group_manager.service,
+            self.controller.service,
+            self.metadata_dissemination.service,
+        ):
             if self._rpc_server is not None:
                 self._rpc_server.register(svc)
             else:
@@ -122,6 +128,7 @@ class Broker:
         await self.group_manager.start()
         await self.controller.start()
         await self.group_coordinator.start()
+        await self.metadata_dissemination.start()
         await self.kafka_server.start()
         self._started = True
 
@@ -130,6 +137,7 @@ class Broker:
             return
         self._started = False
         await self.kafka_server.stop()
+        await self.metadata_dissemination.stop()
         await self.group_coordinator.stop()
         await self.controller.stop()
         await self.group_manager.stop()

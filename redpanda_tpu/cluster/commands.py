@@ -25,6 +25,7 @@ class CmdType(enum.IntEnum):
     create_acls = 6
     delete_acls = 7
     config_set = 8
+    allocate_producer_id = 9
 
 
 class PartitionAssignmentE(serde.Envelope):
@@ -54,9 +55,20 @@ class DeleteTopicCmd(serde.Envelope):
     ]
 
 
+class AllocateProducerIdCmd(serde.Envelope):
+    """Producer-id allocation (reference: cluster/id_allocator_stm).
+
+    Carries no payload: the committed controller-log offset of this
+    command IS the allocated id — unique and durable with zero table
+    state, where the reference replicates an explicit counter."""
+
+    SERDE_FIELDS = []
+
+
 CMD_CLASSES = {
     CmdType.create_topic: CreateTopicCmd,
     CmdType.delete_topic: DeleteTopicCmd,
+    CmdType.allocate_producer_id: AllocateProducerIdCmd,
 }
 
 

@@ -28,6 +28,7 @@ from ..rpc.server import Service, method
 from ..utils import serde
 from .allocator import AllocationError, PartitionAllocator
 from .commands import (
+    AllocateProducerIdCmd,
     CmdType,
     CreateTopicCmd,
     DeleteTopicCmd,
@@ -44,6 +45,7 @@ logger = logging.getLogger("cluster.controller")
 # rpc method ids (raft uses 100-104)
 CREATE_TOPIC = 200
 DELETE_TOPIC = 201
+ALLOCATE_PRODUCER_ID = 202
 
 
 class TopicError(Exception):
@@ -67,6 +69,13 @@ class _TopicReply(serde.Envelope):
     SERDE_FIELDS = [
         ("code", serde.string),  # "" = ok
         ("message", serde.string),
+    ]
+
+
+class _IdReply(serde.Envelope):
+    SERDE_FIELDS = [
+        ("id", serde.i64),
+        ("code", serde.string),  # "" = ok
     ]
 
 
@@ -117,6 +126,16 @@ class ControllerService(Service):
             return _TopicReply(code=e.code, message=e.message).encode()
         except NotLeaderError:
             return _TopicReply(code="not_controller", message="").encode()
+
+    @method(ALLOCATE_PRODUCER_ID)
+    async def allocate_producer_id(self, payload: bytes) -> bytes:
+        try:
+            pid = await self._controller.allocate_producer_id_local()
+            return _IdReply(id=pid, code="").encode()
+        except NotLeaderError:
+            return _IdReply(id=-1, code="not_controller").encode()
+        except Exception as e:
+            return _IdReply(id=-1, code=f"error: {e}").encode()
 
     @method(DELETE_TOPIC)
     async def delete_topic(self, payload: bytes) -> bytes:
@@ -307,6 +326,33 @@ class Controller:
             for a in assignments:
                 self.allocator.account(a.replicas, sign=-1)
             await self.topic_table.wait_revision(base)
+
+    async def allocate_producer_id_local(self) -> int:
+        """Leader-side id allocation: the command's committed offset is
+        the id (see AllocateProducerIdCmd)."""
+        if self.consensus is None or not self.is_leader:
+            raise NotLeaderError(self.leader_id)
+        batch = encode_command(
+            CmdType.allocate_producer_id, AllocateProducerIdCmd()
+        )
+        base, _ = await self.consensus.replicate(batch, acks=-1)
+        return base
+
+    async def allocate_producer_id(self, timeout: float = 10.0) -> int:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            if self.is_leader:
+                return await self.allocate_producer_id_local()
+            leader = await self.wait_leader(
+                max(0.01, deadline - asyncio.get_event_loop().time())
+            )
+            raw = await self._send(leader, ALLOCATE_PRODUCER_ID, b"", 5.0)
+            reply = _IdReply.decode(raw)
+            if reply.code == "":
+                return int(reply.id)
+            if asyncio.get_event_loop().time() > deadline:
+                raise TopicError("request_timed_out", "id allocation failed")
+            await asyncio.sleep(0.05)
 
     async def delete_topic_local(self, ns: str, topic: str) -> None:
         if self.consensus is None or not self.is_leader:

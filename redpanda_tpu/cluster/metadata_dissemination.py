@@ -1,0 +1,137 @@
+"""Leadership dissemination to non-replica nodes.
+
+Reference: src/v/cluster/metadata_dissemination_{service,handler}.{h,cc}
+(metadata_dissemination_rpc.json) — brokers that host a partition learn
+its leader from raft directly; everyone else needs the leader hints
+gossiped so their Kafka metadata responses route clients correctly.
+
+Push-based with periodic anti-entropy: each broker batches the
+(ntp, term, leader) of every partition it currently leads into ONE
+RPC per peer per tick (the heartbeat-batching idiom, SURVEY §2.11 P4),
+and receivers keep the highest-term hint per ntp.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import TYPE_CHECKING
+
+from ..models.fundamental import NTP
+from ..rpc.server import Service, method
+from ..utils import serde
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..app import Broker
+
+logger = logging.getLogger("cluster.metadata")
+
+UPDATE_LEADERSHIP = 210
+
+
+class _LeaderEntry(serde.Envelope):
+    SERDE_FIELDS = [
+        ("ns", serde.string),
+        ("topic", serde.string),
+        ("partition", serde.i32),
+        ("term", serde.i64),
+        ("leader", serde.i32),
+    ]
+
+
+class _LeaderUpdate(serde.Envelope):
+    SERDE_FIELDS = [
+        ("from_node", serde.i32),
+        ("entries", serde.vector(_LeaderEntry.serde())),
+    ]
+
+
+class _Ack(serde.Envelope):
+    SERDE_FIELDS = [("ok", serde.boolean)]
+
+
+class MetadataDisseminationService(Service):
+    def __init__(self, dissemination: "MetadataDissemination"):
+        self._d = dissemination
+
+    @method(UPDATE_LEADERSHIP)
+    async def update_leadership(self, payload: bytes) -> bytes:
+        upd = _LeaderUpdate.decode(payload)
+        for e in upd.entries:
+            self._d.apply_hint(
+                NTP(e.ns, e.topic, int(e.partition)),
+                int(e.term),
+                int(e.leader),
+            )
+        return _Ack(ok=True).encode()
+
+
+class MetadataDissemination:
+    def __init__(self, broker: "Broker", interval_s: float = 0.2):
+        self.broker = broker
+        self.interval = interval_s
+        self.service = MetadataDisseminationService(self)
+        # ntp → (term, leader): highest term wins (stale gossip from a
+        # deposed leader must not overwrite the new leader's hint)
+        self._hints: dict[NTP, tuple[int, int]] = {}
+        self._task: asyncio.Task | None = None
+        self._closed = False
+
+    async def start(self) -> None:
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    def apply_hint(self, ntp: NTP, term: int, leader: int) -> None:
+        cur = self._hints.get(ntp)
+        if cur is not None and cur[0] > term:
+            return
+        self._hints[ntp] = (term, leader)
+        self.broker.leaders.update(ntp, leader)
+
+    async def _loop(self) -> None:
+        while not self._closed:
+            try:
+                await self._tick()
+            except Exception:
+                logger.exception("dissemination tick failed")
+            await asyncio.sleep(self.interval)
+
+    async def _tick(self) -> None:
+        entries = [
+            _LeaderEntry(
+                ns=p.ntp.ns,
+                topic=p.ntp.topic,
+                partition=p.ntp.partition,
+                term=p.consensus.term,
+                leader=self.broker.node_id,
+            )
+            for p in self.broker.partition_manager.partitions().values()
+            if p.is_leader
+        ]
+        if not entries:
+            return
+        msg = _LeaderUpdate(
+            from_node=self.broker.node_id, entries=entries
+        ).encode()
+        peers = [
+            m for m in self.broker.controller.members if m != self.broker.node_id
+        ]
+
+        async def push(peer: int) -> None:
+            try:
+                await self.broker._conn_cache.call(
+                    peer, UPDATE_LEADERSHIP, msg, 1.0
+                )
+            except Exception:
+                pass  # peer down: anti-entropy retries next tick
+
+        if peers:
+            await asyncio.gather(*(push(p) for p in peers))

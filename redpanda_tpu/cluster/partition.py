@@ -14,6 +14,7 @@ from ..models.record import RecordBatch, RecordBatchType
 from ..raft.consensus import Consensus, NotLeaderError  # noqa: F401 (re-export)
 from ..raft.offset_translator import OffsetTranslator
 from ..storage.log import Log
+from .producer_state import DuplicateSequence, ProducerStateTable
 
 
 class Partition:
@@ -25,14 +26,16 @@ class Partition:
         self.translator = OffsetTranslator(
             kvstore=consensus.kvstore, group_id=group_id
         )
-        self._rebuild_translator()
+        self.producers = ProducerStateTable()
+        self._rebuild_state()
         self.log.on_append.append(self._on_append)
         self.log.on_truncate.append(self._on_truncate)
 
-    # -- offset translator maintenance -------------------------------
-    def _rebuild_translator(self) -> None:
-        """Recover translation state from the log tail (reference
-        raft/offset_translator.cc startup hydration)."""
+    # -- derived-state maintenance -----------------------------------
+    def _rebuild_state(self) -> None:
+        """Recover offset translation + producer dedupe state from the
+        log (reference: raft/offset_translator.cc hydration and
+        rm_stm.cc log replay)."""
         offs = self.log.offsets()
         pos = max(offs.start_offset, 0)  # re-tracking is idempotent
         while pos <= offs.dirty_offset:
@@ -40,19 +43,55 @@ class Partition:
             if not batches:
                 break
             for b in batches:
-                self.translator.track(
-                    b.header.type, b.header.base_offset, b.header.last_offset
-                )
+                self._observe(b)
                 pos = b.header.last_offset + 1
         self.translator.checkpoint()
 
+    def _observe(self, batch: RecordBatch) -> None:
+        h = batch.header
+        self.translator.track(h.type, h.base_offset, h.last_offset)
+        if (
+            h.type == RecordBatchType.raft_data
+            and h.producer_id >= 0
+            and h.base_sequence >= 0
+        ):
+            self.producers.observe(
+                h.producer_id,
+                h.producer_epoch,
+                h.base_sequence,
+                h.base_sequence + h.record_count - 1,
+                self.translator.to_kafka(h.base_offset),
+            )
+
     def _on_append(self, batch: RecordBatch) -> None:
-        self.translator.track(
-            batch.header.type, batch.header.base_offset, batch.header.last_offset
-        )
+        self._observe(batch)
 
     def _on_truncate(self, offset: int) -> None:
         self.translator.truncate(offset)
+        # sequence state may reference truncated batches: rebuild from
+        # the surviving log (rare path — only divergent-leader healing)
+        self.producers.truncate()
+        offs = self.log.offsets()
+        pos = max(offs.start_offset, 0)
+        while pos <= offs.dirty_offset:
+            batches = self.log.read(pos, max_bytes=1 << 22)
+            if not batches:
+                break
+            for b in batches:
+                h = b.header
+                if (
+                    h.type == RecordBatchType.raft_data
+                    and h.producer_id >= 0
+                    and h.base_sequence >= 0
+                ):
+                    self.producers.observe(
+                        h.producer_id,
+                        h.producer_epoch,
+                        h.base_sequence,
+                        h.base_sequence + h.record_count - 1,
+                        self.translator.to_kafka(h.base_offset),
+                    )
+                pos = h.last_offset + 1
 
     def close(self) -> None:
         if self._on_append in self.log.on_append:
@@ -93,7 +132,24 @@ class Partition:
     async def replicate(
         self, batch: RecordBatch, acks: int = -1, timeout: float = 10.0
     ) -> int:
-        """Returns the kafka base offset assigned to the batch."""
+        """Returns the kafka base offset assigned to the batch.
+
+        Idempotence (rm_stm.cc dedupe): batches carrying a producer id
+        are sequence-checked against the producer table; a retried
+        batch returns its ORIGINAL offset. The check and the log
+        append run without an intervening await, so concurrent
+        producers cannot interleave between validation and append."""
+        h = batch.header
+        if h.producer_id >= 0 and h.base_sequence >= 0:
+            try:
+                self.producers.check(
+                    h.producer_id,
+                    h.producer_epoch,
+                    h.base_sequence,
+                    h.base_sequence + h.record_count - 1,
+                )
+            except DuplicateSequence as dup:
+                return dup.base_offset
         base, _last = await self.consensus.replicate(
             batch, acks=acks, timeout=timeout
         )

@@ -1,0 +1,92 @@
+"""Idempotent producer dedupe state (the rm_stm seam).
+
+Reference: src/v/cluster/rm_stm.{h,cc} (rm_stm.h:57-190) — per
+partition, per producer-id: epoch fencing and the last 5 batch
+sequence ranges with their assigned offsets, so a retried produce
+returns the original offset instead of appending a duplicate. State
+is rebuilt deterministically from the log (every data batch carries
+pid/epoch/base_sequence in its header), which is what makes follower
+takeover safe; the reference adds snapshots as an optimization.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+_CACHED_BATCHES = 5  # kafka's max in-flight per producer
+
+
+class ProducerFenced(Exception):
+    pass
+
+
+class OutOfOrderSequence(Exception):
+    pass
+
+
+class DuplicateSequence(Exception):
+    def __init__(self, base_offset: int):
+        super().__init__(f"duplicate, original at {base_offset}")
+        self.base_offset = base_offset
+
+
+class _Producer:
+    __slots__ = ("epoch", "last_seq", "batches")
+
+    def __init__(self, epoch: int):
+        self.epoch = epoch
+        self.last_seq = -1
+        # (first_seq, last_seq, kafka_base_offset)
+        self.batches: deque[tuple[int, int, int]] = deque(
+            maxlen=_CACHED_BATCHES
+        )
+
+
+class ProducerStateTable:
+    def __init__(self):
+        self._pids: dict[int, _Producer] = {}
+
+    def check(
+        self, pid: int, epoch: int, first_seq: int, last_seq: int
+    ) -> None:
+        """Validate before append. Raises DuplicateSequence (with the
+        original offset) / OutOfOrderSequence / ProducerFenced."""
+        p = self._pids.get(pid)
+        if p is None:
+            return  # new producer (or state aged out): accept
+        if epoch < p.epoch:
+            raise ProducerFenced(f"pid {pid} epoch {epoch} < {p.epoch}")
+        if epoch > p.epoch:
+            return  # new epoch resets sequencing
+        for f, l, base in p.batches:
+            if f == first_seq and l == last_seq:
+                raise DuplicateSequence(base)
+        if first_seq == p.last_seq + 1:
+            return
+        if first_seq > p.last_seq + 1:
+            raise OutOfOrderSequence(
+                f"pid {pid}: seq {first_seq} after {p.last_seq}"
+            )
+        raise OutOfOrderSequence(
+            f"pid {pid}: stale seq {first_seq} <= {p.last_seq} (uncached)"
+        )
+
+    def observe(
+        self, pid: int, epoch: int, first_seq: int, last_seq: int, kafka_base: int
+    ) -> None:
+        """Fold an appended batch into the table (log-replay safe:
+        called from the log-append observer on leader AND follower)."""
+        p = self._pids.get(pid)
+        if p is None or epoch > p.epoch:
+            p = _Producer(epoch)
+            self._pids[pid] = p
+        if epoch < p.epoch:
+            return  # stale batch from a fenced producer (replay)
+        p.batches.append((first_seq, last_seq, kafka_base))
+        p.last_seq = max(p.last_seq, last_seq)
+
+    def truncate(self) -> None:
+        """Raft truncation: rebuild from scratch on next replay — rare
+        event, and partial rollback of seq state is not worth the
+        bookkeeping (the reference snapshots+rebuilds too)."""
+        self._pids.clear()

@@ -310,6 +310,25 @@ DELETE_GROUPS = register(
     )
 )
 
+INIT_PRODUCER_ID = register(
+    Api(
+        key=22,
+        name="init_producer_id",
+        versions=(0, 1),
+        flex_since=None,  # flex at v2
+        request=[
+            F("transactional_id", "string", nullable=(0, None), default=None),
+            F("transaction_timeout_ms", "int32", default=60000),
+        ],
+        response=[
+            F("throttle_time_ms", "int32"),
+            F("error_code", "int16"),
+            F("producer_id", "int64", default=-1),
+            F("producer_epoch", "int16"),
+        ],
+    )
+)
+
 DELETE_TOPICS = register(
     Api(
         key=20,

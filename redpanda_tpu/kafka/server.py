@@ -23,6 +23,7 @@ import logging
 import struct
 from typing import TYPE_CHECKING
 
+from ..cluster.producer_state import OutOfOrderSequence, ProducerFenced
 from ..models.fundamental import NTP, DEFAULT_NS, TopicNamespace, kafka_ntp
 from ..models.record import CrcMismatch, RecordBatch
 from ..raft.consensus import NotLeaderError, ReplicateTimeout
@@ -370,6 +371,10 @@ class KafkaServer:
                 err = int(ErrorCode.not_leader_for_partition)
             except ReplicateTimeout:
                 err = int(ErrorCode.request_timed_out)
+            except OutOfOrderSequence:
+                err = int(ErrorCode.out_of_order_sequence_number)
+            except ProducerFenced:
+                err = int(ErrorCode.invalid_producer_epoch)
             except ValueError:
                 err = int(ErrorCode.corrupt_message)
             return Msg(index=p.index, error_code=err, base_offset=base)

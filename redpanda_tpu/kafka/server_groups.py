@@ -20,6 +20,7 @@ from .protocol.group_apis import (
     DESCRIBE_GROUPS,
     FIND_COORDINATOR,
     HEARTBEAT,
+    INIT_PRODUCER_ID,
     JOIN_GROUP,
     LEAVE_GROUP,
     LIST_GROUPS,
@@ -47,6 +48,7 @@ def install(server: "KafkaServer") -> None:
             LIST_GROUPS.key: h.list_groups,
             DELETE_GROUPS.key: h.delete_groups,
             DELETE_TOPICS.key: h.delete_topics,
+            INIT_PRODUCER_ID.key: h.init_producer_id,
         }
     )
 
@@ -314,6 +316,35 @@ class GroupHandlers:
             code = await self.coordinator.delete_group(group_id)
             results.append(Msg(group_id=group_id, error_code=code))
         return Msg(throttle_time_ms=0, results=results)
+
+    async def init_producer_id(self, hdr, req) -> Msg:
+        """Producer id via the controller-log allocator (reference:
+        cluster/id_allocator_frontend.cc; transactional ids arrive with
+        the tx coordinator)."""
+        from ..cluster.controller import TopicError
+
+        if req.transactional_id is not None:
+            return Msg(
+                throttle_time_ms=0,
+                error_code=int(ErrorCode.transactional_id_authorization_failed),
+                producer_id=-1,
+                producer_epoch=-1,
+            )
+        try:
+            pid = await self.server.broker.controller.allocate_producer_id()
+        except (TopicError, TimeoutError):
+            return Msg(
+                throttle_time_ms=0,
+                error_code=int(ErrorCode.coordinator_not_available),
+                producer_id=-1,
+                producer_epoch=-1,
+            )
+        return Msg(
+            throttle_time_ms=0,
+            error_code=0,
+            producer_id=pid,
+            producer_epoch=0,
+        )
 
     async def delete_topics(self, hdr, req) -> Msg:
         from ..cluster.controller import TopicError
