@@ -415,17 +415,44 @@ class GroupClient:
                 )
             await asyncio.sleep(0.05)
 
+    @staticmethod
+    def _coord_error(resp: Msg) -> int:
+        """Coordinator-level error of a response: the top-level
+        error_code, or — for APIs like OffsetCommit that only carry
+        per-partition codes — a NOT_COORDINATOR /
+        COORDINATOR_LOAD_IN_PROGRESS found inside topics[].partitions[]
+        (the server fans one coordinator error out to every row)."""
+        code = getattr(resp, "error_code", 0)
+        if code:
+            return int(code)
+        for t in getattr(resp, "topics", None) or []:
+            for p in getattr(t, "partitions", None) or []:
+                pc = int(getattr(p, "error_code", 0) or 0)
+                if pc in (
+                    int(ErrorCode.not_coordinator),
+                    int(ErrorCode.coordinator_load_in_progress),
+                ):
+                    return pc
+        return 0
+
     async def _coord_request(self, api, req, version: int) -> Msg:
-        """Send to the coordinator, re-resolving on NOT_COORDINATOR."""
-        for attempt in range(3):
-            conn = await self.coordinator(refresh=attempt > 0)
+        """Send to the coordinator, re-resolving on NOT_COORDINATOR and
+        retrying in place on COORDINATOR_LOAD_IN_PROGRESS (the new
+        leader's replay barrier is settling — same node, just wait)."""
+        refresh = False
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while True:
+            conn = await self.coordinator(refresh=refresh)
+            refresh = False
             resp = await conn.request(api, req, version)
-            code = getattr(resp, "error_code", 0)
+            code = self._coord_error(resp)
             if code == int(ErrorCode.not_coordinator):
-                await asyncio.sleep(0.05)
-                continue
-            return resp
-        return resp
+                refresh = True
+            elif code != int(ErrorCode.coordinator_load_in_progress):
+                return resp
+            if asyncio.get_event_loop().time() > deadline:
+                return resp
+            await asyncio.sleep(0.05)
 
     async def join(
         self,

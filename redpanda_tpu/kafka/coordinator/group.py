@@ -125,6 +125,21 @@ class Group:
             return JoinResult(error=int(ErrorCode.unknown_member_id))
 
         m = self.members.get(member_id)
+        if (
+            m is not None
+            and self.state
+            in (GroupState.STABLE, GroupState.COMPLETING_REBALANCE)
+            and m.protocols == list(protocols)
+            and member_id != self.leader
+        ):
+            # known follower rejoining with unchanged protocols: return
+            # the current generation without forcing a group-wide
+            # rebalance (Kafka semantics; only the leader, new members,
+            # or changed metadata trigger one)
+            m.last_heartbeat = time.monotonic()
+            m.session_timeout_ms = session_timeout_ms
+            m.rebalance_timeout_ms = rebalance_timeout_ms
+            return self._join_result_for(member_id)
         if m is None:
             m = Member(
                 member_id=member_id,
@@ -297,9 +312,14 @@ class Group:
         if generation != self.generation:
             return int(ErrorCode.illegal_generation)
         m.last_heartbeat = time.monotonic()
-        if self.state == GroupState.PREPARING_REBALANCE:
+        if self.state in (
+            GroupState.PREPARING_REBALANCE,
+            GroupState.COMPLETING_REBALANCE,
+        ):
+            # Kafka signals REBALANCE_IN_PROGRESS until the group is
+            # Stable so members re-enter the join/sync cycle
             return int(ErrorCode.rebalance_in_progress)
-        if self.state not in (GroupState.STABLE, GroupState.COMPLETING_REBALANCE):
+        if self.state != GroupState.STABLE:
             return int(ErrorCode.unknown_member_id)
         return 0
 

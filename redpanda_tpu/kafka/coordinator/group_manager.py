@@ -35,6 +35,16 @@ _KIND_GROUP_META = 0
 _KIND_OFFSET = 1
 
 
+class CoordinatorLoading(Exception):
+    """Raised while the new leader's linearizable barrier / log replay
+    is still in flight — served as coordinator_load_in_progress, which
+    clients retry against the same node."""
+
+    def __init__(self, pid: int):
+        super().__init__(f"coordinator partition {pid} loading")
+        self.pid = pid
+
+
 class _Key(serde.Envelope):
     SERDE_FIELDS = [
         ("kind", serde.u8),
@@ -92,6 +102,11 @@ class GroupCoordinator:
         # and back with commits happening elsewhere in between, so a
         # replay is valid only for the term it was taken in
         self._replayed: dict[int, int] = {}
+        # one replay at a time per partition: concurrent replays would
+        # interleave across the `await g.close()` suspension and the
+        # loser's shard assignment would discard groups created by
+        # requests running between the two assignments
+        self._replay_locks: dict[int, asyncio.Lock] = {}
         self._create_lock = asyncio.Lock()
         self._expire_task: Optional[asyncio.Task] = None
         self._closed = False
@@ -169,7 +184,19 @@ class GroupCoordinator:
     async def _ensure_replayed(self, group_id: str) -> Optional[int]:
         """Replay the coordinator partition's log if this broker just
         became its leader (group_recovery_consumer analog). Returns the
-        partition id, or None if not coordinator here."""
+        partition id, or None if not coordinator here. Raises
+        CoordinatorLoading while the leadership barrier / replay is
+        still settling (served as coordinator_load_in_progress).
+
+        Correctness requires a linearizable barrier first: a brand-new
+        leader's commit_index lags the true committed offset until an
+        entry of its OWN term commits (the term_start gate), so a
+        replay taken before that can miss offsets committed under the
+        prior leader — and a later checkpoint would persist that stale
+        state. The reference loops a noop injection until recovery
+        covers dirty_offset (group_manager.cc:548); here the own-term
+        configuration batch appended at election IS the noop, so the
+        barrier is commit_index >= term_start."""
         p = self._local_partition(group_id)
         pid = self.partition_for(group_id)
         if p is None:
@@ -178,31 +205,55 @@ class GroupCoordinator:
         term = p.consensus.term
         if self._replayed.get(pid) == term:
             return pid
-        shard: dict[str, Group] = {}
-        offs = p.log.offsets()
-        pos = max(offs.start_offset, 0)
-        while pos <= p.consensus.commit_index:
-            batches = p.log.read(pos, upto=p.consensus.commit_index)
-            if not batches:
-                break
-            for b in batches:
-                pos = b.header.last_offset + 1
-                if b.header.type != RecordBatchType.raft_data:
-                    continue
-                self._replay_batch(shard, b)
-        # drop superseded in-memory groups: their waiters are parked on
-        # events of a stale generation; closing cancels their timers
-        for g in self._groups.get(pid, {}).values():
-            await g.close()
-        self._groups[pid] = shard
-        self._replayed[pid] = term
-        logger.info(
-            "node %d: coordinator partition %d replayed: %d groups",
-            self.broker.node_id,
-            pid,
-            len(shard),
-        )
-        return pid
+        lock = self._replay_locks.setdefault(pid, asyncio.Lock())
+        async with lock:
+            # re-check under the lock: a concurrent request may have
+            # completed the replay, or leadership may have moved
+            p = self._local_partition(group_id)
+            if p is None:
+                self._replayed.pop(pid, None)
+                return None
+            c = p.consensus
+            term = c.term
+            if self._replayed.get(pid) == term:
+                return pid
+            barrier = c.term_start
+            if c.commit_index < barrier:
+                try:
+                    await c.wait_committed(barrier, timeout=2.0)
+                except Exception:
+                    raise CoordinatorLoading(pid)
+                if not c.is_leader() or c.term != term:
+                    raise CoordinatorLoading(pid)
+            shard: dict[str, Group] = {}
+            offs = p.log.offsets()
+            pos = max(offs.start_offset, 0)
+            while pos <= c.commit_index:
+                batches = p.log.read(pos, upto=c.commit_index)
+                if not batches:
+                    break
+                for b in batches:
+                    pos = b.header.last_offset + 1
+                    if b.header.type != RecordBatchType.raft_data:
+                        continue
+                    self._replay_batch(shard, b)
+            # drop superseded in-memory groups: their waiters are
+            # parked on events of a stale generation; closing cancels
+            # their timers
+            for g in self._groups.get(pid, {}).values():
+                await g.close()
+            self._groups[pid] = shard
+            self._replayed[pid] = term
+            logger.info(
+                "node %d: coordinator partition %d replayed: %d groups "
+                "(term %d, barrier %d)",
+                self.broker.node_id,
+                pid,
+                len(shard),
+                term,
+                barrier,
+            )
+            return pid
 
     def _replay_batch(self, shard: dict[str, Group], batch: RecordBatch) -> None:
         import time as _time
@@ -260,8 +311,12 @@ class GroupCoordinator:
         self, group_id: str, create: bool = False
     ) -> tuple[Optional[Group], int]:
         """(group, error). error NOT_COORDINATOR when this broker does
-        not lead the group's coordinator partition."""
-        pid = await self._ensure_replayed(group_id)
+        not lead the group's coordinator partition,
+        COORDINATOR_LOAD_IN_PROGRESS while the replay barrier settles."""
+        try:
+            pid = await self._ensure_replayed(group_id)
+        except CoordinatorLoading:
+            return None, int(ErrorCode.coordinator_load_in_progress)
         if pid is None:
             return None, int(ErrorCode.not_coordinator)
         shard = self._shard(pid)

@@ -99,7 +99,9 @@ class KafkaServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+        # cancel live connection handlers BEFORE wait_closed(): since
+        # py3.12 wait_closed() waits for handlers, which otherwise sit
+        # in readexactly() for as long as a client keeps the socket open
         for t in list(self._conns):
             t.cancel()
         for t in list(self._conns):
@@ -107,6 +109,8 @@ class KafkaServer:
                 await t
             except (asyncio.CancelledError, Exception):
                 pass
+        if self._server is not None:
+            await self._server.wait_closed()
 
     # -- connection loop ---------------------------------------------
     async def _on_conn(

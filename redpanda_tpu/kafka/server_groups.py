@@ -212,7 +212,12 @@ class GroupHandlers:
 
     async def offset_fetch(self, hdr, req) -> Msg:
         g, code = await self.coordinator.get_group(req.group_id)
-        if code == int(ErrorCode.not_coordinator):
+        if code in (
+            int(ErrorCode.not_coordinator),
+            int(ErrorCode.coordinator_load_in_progress),
+        ):
+            # retriable: the client must NOT interpret this as "no
+            # committed offsets" and reset to its auto-offset policy
             return Msg(throttle_time_ms=0, topics=[], error_code=code)
         offsets = g.offsets if g is not None else {}
         if req.topics is None:

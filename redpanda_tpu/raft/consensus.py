@@ -287,6 +287,15 @@ class Consensus:
         return int(self.arrays.commit_index[self.row])
 
     @property
+    def term_start(self) -> int:
+        """First offset appended in the current leadership term (the
+        own-term configuration batch). commit_index >= term_start is
+        the linearizable barrier condition: once an own-term entry
+        commits, every offset committed under prior leaders is covered
+        (consensus.cc:2741 commit gate / group_manager.cc:548)."""
+        return int(self.arrays.term_start[self.row])
+
+    @property
     def last_visible_index(self) -> int:
         return int(self.arrays.last_visible[self.row])
 
@@ -849,9 +858,20 @@ class Consensus:
         await self.replicate(builder, acks=-1, timeout=timeout)
 
     def apply_configuration_batch(self, batch: RecordBatch) -> None:
-        """Follower-side config application when a raft_configuration
-        batch lands in the log (configuration_manager analog)."""
+        """Commit-time config application hook (configuration_manager
+        analog). Configs take effect at APPEND time via _observe_append;
+        re-applying an older batch here would regress the active voter
+        set when a newer config was already appended, so this is a
+        no-op for any batch at-or-below the latest appended config."""
+        if (
+            self._config_history
+            and batch.header.base_offset <= self._config_history[-1][0]
+        ):
+            return
         for rec in batch.records():
             if rec.value is not None:
-                self.config = GroupConfiguration.decode(rec.value)
+                cfg = GroupConfiguration.decode(rec.value)
+                self._config_history.append((batch.header.base_offset, cfg))
+                self.config = cfg
                 self._rebuild_slots()
+                self._persist_config()

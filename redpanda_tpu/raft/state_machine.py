@@ -26,6 +26,11 @@ class StateMachine:
         self._task: Optional[asyncio.Task] = None
         self._applied_event = asyncio.Event()
         self._closed = False
+        # health flag: set after repeated apply failures at one offset
+        # (a deterministic decode/apply bug — the reference vasserts).
+        # The fiber keeps retrying with capped backoff so a transient
+        # cause can still clear it; health reporting reads this flag.
+        self.failed = False
 
     async def apply(self, batch: RecordBatch) -> None:  # pragma: no cover
         raise NotImplementedError
@@ -62,12 +67,15 @@ class StateMachine:
             for batch in batches:
                 if batch.header.base_offset > commit:
                     break
+                attempts = 0
                 while not self._closed:
                     # a committed batch must never be skipped: silently
                     # advancing last_applied past a failed apply would
                     # diverge this replica's state machine from its
-                    # peers'. Retry until it sticks (reference stms
-                    # vassert/abort instead of skipping).
+                    # peers'. Retry with escalating backoff; after
+                    # enough rounds flag the STM unhealthy so health
+                    # reports surface the wedge instead of it hiding
+                    # behind an apparently-live node.
                     try:
                         if (
                             batch.header.type
@@ -76,14 +84,34 @@ class StateMachine:
                             self.consensus.apply_configuration_batch(batch)
                         else:
                             await self.apply(batch)
+                        if self.failed:
+                            self.failed = False
+                            logger.warning(
+                                "g%d: stm recovered at offset %d",
+                                self.consensus.group_id,
+                                batch.header.base_offset,
+                            )
                         break
                     except Exception:
+                        attempts += 1
+                        delay = min(0.1 * (2 ** min(attempts, 6)), 5.0)
+                        if attempts >= 5 and not self.failed:
+                            self.failed = True
+                            logger.error(
+                                "g%d: stm WEDGED at offset %d after %d "
+                                "attempts — likely deterministic "
+                                "decode/apply failure; marking unhealthy",
+                                self.consensus.group_id,
+                                batch.header.base_offset,
+                                attempts,
+                            )
                         logger.exception(
-                            "g%d: stm apply failed at %d (retrying)",
+                            "g%d: stm apply failed at %d (retry in %.1fs)",
                             self.consensus.group_id,
                             batch.header.base_offset,
+                            delay,
                         )
-                        await asyncio.sleep(0.1)
+                        await asyncio.sleep(delay)
                 if self._closed:
                     return
                 self.last_applied = batch.header.last_offset
