@@ -14,7 +14,18 @@ from ..models.record import RecordBatch, RecordBatchType
 from ..raft.consensus import Consensus, NotLeaderError  # noqa: F401 (re-export)
 from ..raft.offset_translator import OffsetTranslator
 from ..storage.log import Log
+from ..utils import serde
 from .producer_state import DuplicateSequence, ProducerStateTable
+
+
+class _PartitionSnapshot(serde.Envelope):
+    """Partition contribution to the raft snapshot payload
+    (rm_stm snapshot analog: translator + producer dedupe state)."""
+
+    SERDE_FIELDS = [
+        ("translator", serde.bytes_t),
+        ("producers", serde.bytes_t),
+    ]
 
 
 class Partition:
@@ -30,14 +41,18 @@ class Partition:
         self._rebuild_state()
         self.log.on_append.append(self._on_append)
         self.log.on_truncate.append(self._on_truncate)
+        self.log.on_prefix_truncate.append(self._on_prefix_truncate)
+        # raft snapshots carry our derived state so a follower restored
+        # from one need not replay the discarded prefix
+        consensus.register_snapshot_contributor("partition", self)
+        self.log.housekeeping_override = self.housekeeping
 
     # -- derived-state maintenance -----------------------------------
-    def _rebuild_state(self) -> None:
-        """Recover offset translation + producer dedupe state from the
-        log (reference: raft/offset_translator.cc hydration and
-        rm_stm.cc log replay)."""
+    def _replay_from(self, pos: int) -> None:
+        """Re-track log batches from pos (idempotent: translator and
+        producer table both dedupe already-seen entries)."""
         offs = self.log.offsets()
-        pos = max(offs.start_offset, 0)  # re-tracking is idempotent
+        pos = max(pos, offs.start_offset, 0)
         while pos <= offs.dirty_offset:
             batches = self.log.read(pos, max_bytes=1 << 22)
             if not batches:
@@ -45,6 +60,12 @@ class Partition:
             for b in batches:
                 self._observe(b)
                 pos = b.header.last_offset + 1
+
+    def _rebuild_state(self) -> None:
+        """Recover offset translation + producer dedupe state from the
+        log (reference: raft/offset_translator.cc hydration and
+        rm_stm.cc log replay)."""
+        self._replay_from(0)
         self.translator.checkpoint()
 
     def _observe(self, batch: RecordBatch) -> None:
@@ -71,33 +92,53 @@ class Partition:
         # sequence state may reference truncated batches: rebuild from
         # the surviving log (rare path — only divergent-leader healing)
         self.producers.truncate()
-        offs = self.log.offsets()
-        pos = max(offs.start_offset, 0)
-        while pos <= offs.dirty_offset:
-            batches = self.log.read(pos, max_bytes=1 << 22)
-            if not batches:
-                break
-            for b in batches:
-                h = b.header
-                if (
-                    h.type == RecordBatchType.raft_data
-                    and h.producer_id >= 0
-                    and h.base_sequence >= 0
-                ):
-                    self.producers.observe(
-                        h.producer_id,
-                        h.producer_epoch,
-                        h.base_sequence,
-                        h.base_sequence + h.record_count - 1,
-                        self.translator.to_kafka(h.base_offset),
-                    )
-                pos = h.last_offset + 1
+        self._replay_from(0)
+
+    def _on_prefix_truncate(self, new_start: int) -> None:
+        self.translator.prefix_truncate(new_start)
+        self.translator.checkpoint()
+
+    # -- raft snapshot contributor ------------------------------------
+    def capture_snapshot(self, upto: int) -> bytes:
+        """The producer table tracks appends, so its capture may run
+        slightly ahead of `upto`; re-observing those batches after a
+        restore is idempotent (observe() dedupes by epoch/seq)."""
+        return _PartitionSnapshot(
+            translator=self.translator.capture_upto(upto),
+            producers=self.producers.encode(),
+        ).encode()
+
+    def restore_snapshot(self, blob: bytes, last_included: int) -> None:
+        ps = _PartitionSnapshot.decode(blob)
+        self.translator.restore(ps.translator)
+        self.producers = ProducerStateTable.decode(ps.producers)
+        # re-track whatever survives in the log above the boundary
+        # (normally nothing: install resets the log)
+        self._replay_from(last_included + 1)
+        self.translator.checkpoint()
+
+    # -- housekeeping -------------------------------------------------
+    def housekeeping(self, now_ms: int | None = None) -> None:
+        """Retention for a raft-replicated log (log_manager housekeeping
+        + raft max_collectible_offset): take a snapshot covering the
+        reclaimable prefix first, then let retention drop only segments
+        the snapshot covers — a stopped follower recovers via
+        install_snapshot instead of being stranded."""
+        target = self.log.retention_offset(now_ms)
+        if target is None:
+            return
+        self.consensus.write_snapshot(target - 1)
+        self.log.apply_retention(now_ms, max_offset=self.consensus.snapshot_index)
 
     def close(self) -> None:
         if self._on_append in self.log.on_append:
             self.log.on_append.remove(self._on_append)
         if self._on_truncate in self.log.on_truncate:
             self.log.on_truncate.remove(self._on_truncate)
+        if self._on_prefix_truncate in self.log.on_prefix_truncate:
+            self.log.on_prefix_truncate.remove(self._on_prefix_truncate)
+        if self.log.housekeeping_override is self.housekeeping:
+            self.log.housekeeping_override = None
         self.translator.checkpoint()
 
     # -- kafka offset surface ----------------------------------------

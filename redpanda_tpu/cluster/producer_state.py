@@ -11,6 +11,7 @@ takeover safe; the reference adds snapshots as an optimization.
 
 from __future__ import annotations
 
+import struct
 from collections import deque
 
 _CACHED_BATCHES = 5  # kafka's max in-flight per producer
@@ -82,6 +83,9 @@ class ProducerStateTable:
             self._pids[pid] = p
         if epoch < p.epoch:
             return  # stale batch from a fenced producer (replay)
+        for f, l, _ in p.batches:
+            if f == first_seq and l == last_seq:
+                return  # already tracked (snapshot restore + re-replay)
         p.batches.append((first_seq, last_seq, kafka_base))
         p.last_seq = max(p.last_seq, last_seq)
 
@@ -90,3 +94,31 @@ class ProducerStateTable:
         event, and partial rollback of seq state is not worth the
         bookkeeping (the reference snapshots+rebuilds too)."""
         self._pids.clear()
+
+    # -- snapshot capture/restore (rm_stm.h:182 snapshot analog) ------
+    def encode(self) -> bytes:
+        out = bytearray()
+        out += struct.pack("<I", len(self._pids))
+        for pid, p in self._pids.items():
+            out += struct.pack("<qiqI", pid, p.epoch, p.last_seq, len(p.batches))
+            for f, l, base in p.batches:
+                out += struct.pack("<qqq", f, l, base)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ProducerStateTable":
+        t = cls()
+        pos = 0
+        (n,) = struct.unpack_from("<I", data, pos)
+        pos += 4
+        for _ in range(n):
+            pid, epoch, last_seq, nb = struct.unpack_from("<qiqI", data, pos)
+            pos += struct.calcsize("<qiqI")
+            p = _Producer(epoch)
+            p.last_seq = last_seq
+            for _ in range(nb):
+                f, l, base = struct.unpack_from("<qqq", data, pos)
+                pos += 24
+                p.batches.append((f, l, base))
+            t._pids[pid] = p
+        return t

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 from enum import Enum
 from typing import Awaitable, Callable, Optional
@@ -32,6 +33,7 @@ from typing import Awaitable, Callable, Optional
 from ..models.record import RecordBatch, RecordBatchBuilder, RecordBatchType
 from ..models.consensus_state import SELF_SLOT
 from ..models.fundamental import NO_OFFSET
+from ..storage import snapshot as snapfmt
 from ..storage.kvstore import KeySpace, KvStore
 from ..storage.log import Log
 from ..utils import serde
@@ -39,6 +41,7 @@ from . import quorum_scalar as qs
 from . import types as rt
 from .configuration import GroupConfiguration
 from .shard_state import ShardGroupArrays
+from .snapshot import RaftSnapshotMetadata, SnapshotPayload
 
 logger = logging.getLogger("raft")
 
@@ -110,6 +113,18 @@ class Consensus:
         self._config_history: list[tuple[int, GroupConfiguration]] = []
         self._initial_config = config
         self._closed = False
+        # -- raft snapshot state (consensus.cc install_snapshot +
+        # recovery_stm.cc snapshot fallback) --------------------------
+        self._snapshot_path = os.path.join(log.directory, "snapshot")
+        self._snap_index = NO_OFFSET  # last offset covered by snapshot
+        self._snap_term = -1
+        self._accum_size = 0  # install-side chunk accumulator position
+        # named state machines contributing capture/restore blobs
+        # (partition offset-translator+producers, STMs); see snapshot.py
+        self.snapshot_contributors: dict[str, object] = {}
+        # blobs from a snapshot installed/loaded before contributors
+        # registered (crash-recovery ordering)
+        self._install_blobs: dict[str, bytes] = {}
 
     # ---------------------------------------------------------- setup
     def _vote_key(self) -> bytes:
@@ -242,7 +257,48 @@ class Consensus:
             self.arrays.last_seq[row, slot] = 0
             self.arrays.next_seq[row, slot] = 0
 
+    def _load_snapshot(self) -> None:
+        """Hydrate snapshot state on restart. If the log is behind the
+        snapshot (crash between snapshot install and log reset), finish
+        the reset and stage the payload blobs for contributors that
+        register later."""
+        if not os.path.exists(self._snapshot_path):
+            return
+        try:
+            meta_raw, payload = snapfmt.read_snapshot(self._snapshot_path)
+            meta = RaftSnapshotMetadata.decode(meta_raw)
+        except (snapfmt.SnapshotCorruption, serde.SerdeError, OSError):
+            logger.exception("g%d: dropping corrupt snapshot", self.group_id)
+            os.remove(self._snapshot_path)
+            return
+        self._snap_index = int(meta.last_included_index)
+        self._snap_term = int(meta.last_included_term)
+        cfg = GroupConfiguration.decode(meta.config)
+        # the snapshot's config is the floor: any config batches still
+        # in the log (handled by _hydrate_config_history) are newer
+        self._initial_config = cfg
+        self.config = cfg
+        row = self.row
+        self.arrays.commit_index[row] = max(
+            int(self.arrays.commit_index[row]), self._snap_index
+        )
+        self.arrays.last_visible[row] = max(
+            int(self.arrays.last_visible[row]), self._snap_index
+        )
+        if self.log.offsets().dirty_offset < self._snap_index:
+            self.log.install_snapshot_reset(self._snap_index + 1, self._snap_term)
+            sp = SnapshotPayload.decode(payload)
+            self._install_blobs = dict(zip(sp.names, sp.blobs))
+
+    def register_snapshot_contributor(self, name: str, obj) -> None:
+        """obj: capture_snapshot(upto)->bytes, restore_snapshot(blob, last_included)."""
+        self.snapshot_contributors[name] = obj
+        blob = self._install_blobs.get(name)
+        if blob is not None:
+            obj.restore_snapshot(blob, self._snap_index)
+
     async def start(self) -> None:
+        self._load_snapshot()
         self._load_vote_state()
         self._load_config_state()
         self._hydrate_config_history()
@@ -310,6 +366,20 @@ class Consensus:
 
     def flushed_offset(self) -> int:
         return int(self.arrays.flushed_index[self.row, SELF_SLOT])
+
+    @property
+    def snapshot_index(self) -> int:
+        return self._snap_index
+
+    def term_at(self, offset: int) -> Optional[int]:
+        """Term of the entry at offset, answering from the snapshot
+        boundary for the last included offset (Raft: the snapshot's
+        (index, term) pair substitutes for discarded entries)."""
+        if offset < 0:
+            return -1
+        if offset == self._snap_index:
+            return self._snap_term
+        return self.log.get_term(offset)
 
     # ------------------------------------------------------- elections
     async def _election_loop(self) -> None:
@@ -512,10 +582,15 @@ class Consensus:
         # 2. gap check (consensus.cc:1789)
         if req.prev_log_index > offs.dirty_offset:
             return self._reply(rt.AppendEntriesReply.FAILURE, int(req.seq))
-        # 3. prev-term match (consensus.cc:1800-1828)
-        if req.prev_log_index >= offs.start_offset and req.prev_log_index >= 0:
-            local_term = self.log.get_term(req.prev_log_index)
-            if local_term is None or local_term != req.prev_log_term:
+        # 3. prev-term match (consensus.cc:1800-1828). Offsets at-or-
+        # below the snapshot boundary are committed and match by
+        # definition; the boundary itself answers from snapshot state.
+        if req.prev_log_index >= 0 and req.prev_log_index >= self._snap_index:
+            local_term = self.term_at(req.prev_log_index)
+            if (
+                req.prev_log_index >= offs.start_offset
+                or req.prev_log_index == self._snap_index
+            ) and (local_term is None or local_term != req.prev_log_term):
                 return self._reply(rt.AppendEntriesReply.FAILURE, int(req.seq))
 
         # 4. append, truncating on conflict (consensus.cc:1869-1928).
@@ -528,6 +603,10 @@ class Consensus:
         for raw in req.batches:
             batch = RecordBatch.deserialize(raw)
             base = batch.header.base_offset
+            if batch.header.last_offset <= self._snap_index:
+                # fully covered by our snapshot: committed by definition
+                last_new_entry = batch.header.last_offset
+                continue
             cur = self.log.offsets()
             if base <= cur.dirty_offset:
                 local_term = self.log.get_term(base)
@@ -604,8 +683,11 @@ class Consensus:
         if prev_log_index > self.dirty_offset():
             return (self.term, self.dirty_offset(), self.flushed_offset(), seq,
                     rt.AppendEntriesReply.FAILURE)
-        if prev_log_index >= 0 and prev_log_index >= self.log.offsets().start_offset:
-            local_term = self.log.get_term(prev_log_index)
+        if prev_log_index >= 0 and (
+            prev_log_index >= self.log.offsets().start_offset
+            or prev_log_index == self._snap_index
+        ):
+            local_term = self.term_at(prev_log_index)
             if local_term is None or local_term != prev_log_term:
                 return (self.term, self.dirty_offset(), self.flushed_offset(), seq,
                         rt.AppendEntriesReply.FAILURE)
@@ -733,11 +815,26 @@ class Consensus:
         next_idx = self._next_index.get(peer, self.dirty_offset() + 1)
         prev = next_idx - 1
         offs = self.log.offsets()
-        if prev >= 0 and prev < offs.start_offset:
-            # follower needs data below our start: snapshot territory
-            logger.warning("g%d: follower %d below log start", self.group_id, peer)
+        # appends are feasible only when we can both read from next_idx
+        # and state prev's term: prev at the snapshot boundary, at the
+        # head of a never-truncated log, or inside the log. Anything
+        # else (including a brand-new/wiped follower at prev == -1 when
+        # our log starts above 0) needs the snapshot
+        # (recovery_stm.cc install_snapshot fallback).
+        feasible = (
+            prev == self._snap_index
+            or (prev == -1 and offs.start_offset == 0)
+            or prev >= offs.start_offset
+        )
+        if not feasible:
+            if self._snap_index >= 0:
+                return await self._send_snapshot(peer)
+            logger.warning(
+                "g%d: follower %d below log start and no snapshot",
+                self.group_id, peer,
+            )
             return False
-        prev_term = self.log.get_term(prev) if prev >= 0 else -1
+        prev_term = self.term_at(prev) if prev >= 0 else -1
         if prev_term is None:
             prev_term = -1
         batches = self.log.read(next_idx, max_bytes=1 << 20) if next_idx <= offs.dirty_offset else []
@@ -806,6 +903,186 @@ class Consensus:
     def on_batched_commit_advance(self) -> None:
         """Called by the heartbeat manager after the device sweep
         advanced this group's commit index."""
+        self._notify_commit()
+
+    # ------------------------------------------------------- snapshots
+    def _config_at(self, offset: int) -> GroupConfiguration:
+        cfg = self._initial_config
+        for off, c in self._config_history:
+            if off <= offset:
+                cfg = c
+            else:
+                break
+        return cfg
+
+    def write_snapshot(self, last_included: Optional[int] = None) -> int:
+        """Take a local snapshot at-or-below commit_index and prefix-
+        truncate the log past it (consensus.cc write_snapshot). Returns
+        the resulting snapshot index. Contributors capture their state;
+        for log-derived state captured slightly ahead of the snapshot
+        point (producer table tracks appends), re-replay above the
+        boundary is idempotent — see partition.py."""
+        target = self.commit_index
+        if last_included is not None:
+            target = min(target, last_included)
+        if target <= self._snap_index or target < 0:
+            return self._snap_index
+        term = self.term_at(target)
+        if term is None or term < 0:
+            return self._snap_index
+        names, blobs = [], []
+        for name, obj in self.snapshot_contributors.items():
+            names.append(name)
+            blobs.append(obj.capture_snapshot(target))
+        meta = RaftSnapshotMetadata(
+            group=self.group_id,
+            last_included_index=target,
+            last_included_term=term,
+            config=self._config_at(target).encode(),
+        )
+        snapfmt.write_snapshot(
+            self._snapshot_path,
+            meta.encode(),
+            SnapshotPayload(names=names, blobs=blobs).encode(),
+        )
+        self._snap_index, self._snap_term = target, term
+        self._install_blobs = {}
+        self.log.prefix_truncate(target + 1)
+        logger.info(
+            "g%d: snapshot at %d term %d (log start now %d)",
+            self.group_id, target, term, self.log.offsets().start_offset,
+        )
+        return target
+
+    async def _send_snapshot(self, peer: int) -> bool:
+        """Stream the snapshot file to a stranded follower in chunks
+        (recovery_stm.cc install_snapshot loop). On success the
+        follower resumes appends at last_included + 1."""
+        try:
+            with open(self._snapshot_path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return False
+        snap_idx = self._snap_index
+        term = self.term
+        chunk_size = 1 << 17
+        sent = 0
+        logger.info(
+            "g%d: sending snapshot (%d bytes, upto %d) to follower %d",
+            self.group_id, len(data), snap_idx, peer,
+        )
+        while True:
+            chunk = data[sent : sent + chunk_size]
+            done = sent + len(chunk) >= len(data)
+            req = rt.InstallSnapshotRequest(
+                group=self.group_id,
+                node_id=self.node_id,
+                term=term,
+                last_included_index=snap_idx,
+                last_included_term=self._snap_term,
+                file_offset=sent,
+                chunk=chunk,
+                done=done,
+            ).encode()
+            try:
+                raw = await self._send(peer, rt.INSTALL_SNAPSHOT, req, 10.0)
+                rep = rt.InstallSnapshotReply.decode(raw)
+            except Exception:
+                return False
+            if self._closed or self.role != Role.LEADER or self.term != term:
+                return False
+            if rep.term > term:
+                self._step_down(int(rep.term))
+                return False
+            if not rep.success:
+                return False
+            sent += len(chunk)
+            if done:
+                break
+        self._next_index[peer] = snap_idx + 1
+        return True
+
+    async def handle_install_snapshot(
+        self, req: rt.InstallSnapshotRequest
+    ) -> rt.InstallSnapshotReply:
+        async with self._append_lock:
+            return await self._do_install_snapshot(req)
+
+    async def _do_install_snapshot(
+        self, req: rt.InstallSnapshotRequest
+    ) -> rt.InstallSnapshotReply:
+        def reply(ok: bool) -> rt.InstallSnapshotReply:
+            return rt.InstallSnapshotReply(
+                group=self.group_id,
+                term=self.term,
+                bytes_stored=self._accum_size,
+                success=ok,
+            )
+
+        if req.term < self.term:
+            return reply(False)
+        self._last_heartbeat = asyncio.get_event_loop().time()
+        if req.term > self.term or self.role != Role.FOLLOWER:
+            self._step_down(int(req.term))
+        self.leader_id = int(req.node_id)
+        accum = self._snapshot_path + ".accum"
+        file_offset = int(req.file_offset)
+        if file_offset == 0:
+            self._accum_size = 0
+            mode = "wb"
+        else:
+            if not os.path.exists(accum) or self._accum_size != file_offset:
+                return reply(False)  # out of order: leader restarts stream
+            mode = "ab"
+        with open(accum, mode) as f:
+            f.write(req.chunk)
+        self._accum_size = file_offset + len(req.chunk)
+        if not req.done:
+            return reply(True)
+        try:
+            meta_raw, payload = snapfmt.read_snapshot(accum)
+            meta = RaftSnapshotMetadata.decode(meta_raw)
+        except (snapfmt.SnapshotCorruption, serde.SerdeError):
+            logger.exception("g%d: corrupt incoming snapshot", self.group_id)
+            os.remove(accum)
+            return reply(False)
+        if int(meta.last_included_index) <= max(self.commit_index, self._snap_index):
+            os.remove(accum)  # stale: we already have everything it covers
+            return reply(True)
+        os.replace(accum, self._snapshot_path)
+        self._install_snapshot_state(meta, payload)
+        return reply(True)
+
+    def _install_snapshot_state(
+        self, meta: RaftSnapshotMetadata, payload: bytes
+    ) -> None:
+        row = self.row
+        snap_idx = int(meta.last_included_index)
+        snap_term = int(meta.last_included_term)
+        logger.info(
+            "g%d: installing snapshot upto %d term %d", self.group_id,
+            snap_idx, snap_term,
+        )
+        self.log.install_snapshot_reset(snap_idx + 1, snap_term)
+        self._snap_index, self._snap_term = snap_idx, snap_term
+        cfg = GroupConfiguration.decode(meta.config)
+        self._config_history = []
+        self._initial_config = cfg
+        self.config = cfg
+        self._rebuild_slots()
+        self._persist_config()
+        self.arrays.match_index[row, SELF_SLOT] = snap_idx
+        self.arrays.flushed_index[row, SELF_SLOT] = snap_idx
+        self.arrays.commit_index[row] = snap_idx
+        self.arrays.last_visible[row] = max(
+            int(self.arrays.last_visible[row]), snap_idx
+        )
+        sp = SnapshotPayload.decode(payload)
+        self._install_blobs = dict(zip(sp.names, sp.blobs))
+        for name, obj in self.snapshot_contributors.items():
+            blob = self._install_blobs.get(name)
+            if blob is not None:
+                obj.restore_snapshot(blob, snap_idx)
         self._notify_commit()
 
     # ------------------------------------------------------ membership

@@ -94,7 +94,7 @@ class HeartbeatManager:
                 seq = int(c.arrays.next_seq[row, slot]) + 1
                 c.arrays.next_seq[row, slot] = seq
                 prev = int(c.arrays.match_index[row, slot])
-                prev_term = c.log.get_term(prev) if prev >= 0 else -1
+                prev_term = c.term_at(prev) if prev >= 0 else -1
                 if prev_term is None:
                     prev_term = -1
                 prev_sent[(c.group_id, peer)] = prev

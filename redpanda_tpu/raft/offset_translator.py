@@ -85,6 +85,24 @@ class OffsetTranslator:
         del self._filtered[:idx]
         self._base = max(self._base, offset)
 
+    def capture_upto(self, offset: int) -> bytes:
+        """Snapshot capture: state as it should look on a replica whose
+        log starts at offset+1 — entries at-or-below the boundary fold
+        into the running base delta (raft snapshot contributor)."""
+        idx = bisect.bisect_right(self._filtered, offset)
+        return _State(
+            filtered=self._filtered[idx:],
+            base=max(self._base, offset + 1),
+            base_delta=self._base_delta + idx,
+        ).encode()
+
+    def restore(self, blob: bytes) -> None:
+        st = _State.decode(blob)
+        self._filtered = list(st.filtered)
+        self._base = int(st.base)
+        self._base_delta = int(st.base_delta)
+        self.checkpoint()
+
     def to_kafka(self, raft_offset: int) -> int:
         """Raft offset → Kafka offset (delta = filtered ≤ offset,
         including entries dropped by prefix truncation — offsets must

@@ -88,6 +88,16 @@ class RaftService(Service):
             statuses=statuses,
         ).encode()
 
+    @method(rt.INSTALL_SNAPSHOT)
+    async def install_snapshot(self, payload: bytes) -> bytes:
+        req = rt.InstallSnapshotRequest.decode(payload)
+        c = self._consensus(int(req.group))
+        if c is None:
+            return rt.InstallSnapshotReply(
+                group=int(req.group), term=-1, bytes_stored=0, success=False
+            ).encode()
+        return (await c.handle_install_snapshot(req)).encode()
+
     @method(rt.TIMEOUT_NOW)
     async def timeout_now(self, payload: bytes) -> bytes:
         req = rt.TimeoutNowRequest.decode(payload)

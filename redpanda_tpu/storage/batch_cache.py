@@ -50,6 +50,15 @@ class BatchCacheIndex:
             self._cache._evict_key((self._log_id, base))
         del self._offsets[i:]
 
+    def prefix_truncate(self, offset: int) -> None:
+        """Drop cached batches entirely below offset (retention /
+        snapshot prefix truncation): a read below the log's start must
+        miss, not serve phantom pre-truncation data."""
+        i = bisect.bisect_left(self._offsets, offset)
+        for base in self._offsets[:i]:
+            self._cache._evict_key((self._log_id, base))
+        del self._offsets[:i]
+
     def _forget(self, base: int) -> None:
         i = bisect.bisect_left(self._offsets, base)
         if i < len(self._offsets) and self._offsets[i] == base:

@@ -65,7 +65,16 @@ class Log:
         # disk_log_impl appends in raft/offset_translator.cc)
         self.on_append: list = []  # fn(batch)
         self.on_truncate: list = []  # fn(offset)
+        self.on_prefix_truncate: list = []  # fn(new_start_offset)
+        # raft-replicated logs install a snapshot-gated retention pass
+        # here (Partition.housekeeping); LogManager's housekeeping timer
+        # calls it instead of bare apply_retention when present
+        self.housekeeping_override = None  # fn(now_ms) | None
         self._recover()
+
+    @property
+    def directory(self) -> str:
+        return self._dir
 
     # -- recovery ----------------------------------------------------
     def _recover(self) -> None:
@@ -230,35 +239,74 @@ class Log:
     def prefix_truncate(self, offset: int) -> None:
         """Drop whole segments entirely below offset (retention,
         raft snapshots; disk_log_impl prefix truncation)."""
+        dropped = False
         while (
             len(self._segments) > 1 and self._segments[1].base_offset <= offset
         ):
             seg = self._segments.pop(0)
             seg.close()
             seg.remove_files()
+            dropped = True
+        if dropped:
+            new_start = self.offsets().start_offset
+            if self._cache_index is not None:
+                self._cache_index.prefix_truncate(new_start)
+            for fn in self.on_prefix_truncate:
+                fn(new_start)
+
+    def install_snapshot_reset(self, next_offset: int, term: int) -> None:
+        """Drop the ENTIRE log and restart it empty at next_offset —
+        the follower install_snapshot path (raft snapshot replaces the
+        whole local prefix; consensus.cc install_snapshot →
+        drop log + start at last_included + 1). Does NOT fire
+        on_truncate/on_prefix_truncate: the caller restores derived
+        state (offset translator, producer table) from the snapshot
+        payload instead of replaying."""
+        for seg in self._segments:
+            seg.close()
+            seg.remove_files()
+        self._segments = [Segment(self._dir, next_offset, max(term, 0))]
+        if self._cache_index is not None:
+            self._cache_index.truncate(0)
 
     # -- housekeeping -------------------------------------------------
-    def apply_retention(self, now_ms: int | None = None) -> int:
-        """Size/time retention (log_manager housekeeping analog).
-        Returns first retained offset."""
+    def retention_offset(self, now_ms: int | None = None) -> int | None:
+        """First offset retention WANTS to keep (None = nothing to do).
+        Pure query — raft must take a snapshot covering everything
+        below before any data is physically reclaimed
+        (max_collectible_offset in the reference's disk_log_impl)."""
         cfg = self.config
+        drop_upto: int | None = None  # last offset of the last dropped segment
         if cfg.retention_bytes is not None:
             total = sum(s.size_bytes() for s in self._segments)
-            while len(self._segments) > 1 and total > cfg.retention_bytes:
-                seg = self._segments[0]
-                total -= seg.size_bytes()
-                self._segments.pop(0)
-                seg.close()
-                seg.remove_files()
+            i = 0
+            while i + 1 < len(self._segments) and total > cfg.retention_bytes:
+                total -= self._segments[i].size_bytes()
+                drop_upto = self._segments[i].dirty_offset
+                i += 1
         if cfg.retention_ms is not None and now_ms is not None:
+            i = 0
             while (
-                len(self._segments) > 1
-                and self._segments[0].max_timestamp >= 0
-                and self._segments[0].max_timestamp < now_ms - cfg.retention_ms
+                i + 1 < len(self._segments)
+                and self._segments[i].max_timestamp >= 0
+                and self._segments[i].max_timestamp < now_ms - cfg.retention_ms
             ):
-                seg = self._segments.pop(0)
-                seg.close()
-                seg.remove_files()
+                drop_upto = max(drop_upto or -1, self._segments[i].dirty_offset)
+                i += 1
+        return drop_upto + 1 if drop_upto is not None else None
+
+    def apply_retention(
+        self, now_ms: int | None = None, max_offset: int | None = None
+    ) -> int:
+        """Size/time retention (log_manager housekeeping analog).
+        Segments are only reclaimed when entirely below `max_offset`
+        (the raft snapshot boundary — dropping data followers may
+        still need would strand them). Returns first retained offset."""
+        target = self.retention_offset(now_ms)
+        if target is not None:
+            if max_offset is not None:
+                target = min(target, max_offset + 1)
+            self.prefix_truncate(target)
         return self.offsets().start_offset
 
     def segment_count(self) -> int:

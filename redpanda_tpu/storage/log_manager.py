@@ -58,10 +58,15 @@ class LogManager:
                 os.rmdir(d)
 
     def housekeeping(self) -> None:
-        """Retention pass over all logs (log_manager.h:228-244 timer)."""
+        """Retention pass over all logs (log_manager.h:228-244 timer).
+        Raft-replicated logs route through their snapshot-gated
+        override so retention never strands a lagging follower."""
         now_ms = int(time.time() * 1000)
         for log in self._logs.values():
-            log.apply_retention(now_ms)
+            if log.housekeeping_override is not None:
+                log.housekeeping_override(now_ms)
+            else:
+                log.apply_retention(now_ms)
 
     def logs(self) -> dict[NTP, Log]:
         return dict(self._logs)
