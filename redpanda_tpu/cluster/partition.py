@@ -9,10 +9,13 @@ translation is the only adaptation needed at this stage).
 
 from __future__ import annotations
 
+import asyncio
+
 from ..models.fundamental import NTP
 from ..models.record import RecordBatch, RecordBatchType
 from ..raft.consensus import Consensus, NotLeaderError  # noqa: F401 (re-export)
 from ..raft.offset_translator import OffsetTranslator
+from ..raft.replicate_batcher import ReplicateStages, consume_exc
 from ..storage.log import Log
 from ..utils import serde
 from .producer_state import DuplicateSequence, ProducerStateTable
@@ -38,6 +41,12 @@ class Partition:
             kvstore=consensus.kvstore, group_id=group_id
         )
         self.producers = ProducerStateTable()
+        # (pid, epoch, first_seq, last_seq) → in-flight stages: retries
+        # arriving before the first attempt lands alias its result
+        self._inflight: dict[tuple, ReplicateStages] = {}
+        # pid → (epoch, last dispatched seq): the sequencing horizon
+        # ahead of the table while appends sit in the batcher
+        self._inflight_seq: dict[int, tuple[int, int]] = {}
         self._rebuild_state()
         self.log.on_append.append(self._on_append)
         self.log.on_truncate.append(self._on_truncate)
@@ -170,31 +179,118 @@ class Partition:
         return self.translator.to_kafka(max(offs.start_offset, 0) - 1) + 1
 
     # -- write -------------------------------------------------------
+    async def replicate_in_stages(self, batch: RecordBatch, acks: int = -1):
+        """Two-stage write (produce.cc:95-111): returns stages whose
+        `enqueued` resolves with the kafka base offset once the batch
+        is ordered in the log, and `done` at the requested ack level.
+
+        Idempotence (rm_stm.cc dedupe): a retried batch returns its
+        ORIGINAL offset — either from the producer table (already
+        appended) or by aliasing the in-flight stages of the first
+        attempt (enqueued via the batcher but not yet applied)."""
+        h = batch.header
+        key = None
+        if h.producer_id >= 0 and h.base_sequence >= 0:
+            pid, epoch = h.producer_id, h.producer_epoch
+            last_seq = h.base_sequence + h.record_count - 1
+            key = (pid, epoch, h.base_sequence, last_seq)
+            inflight = self._inflight.get(key)
+            if inflight is not None:
+                return inflight
+            horizon = self._inflight_seq.get(pid)
+            self.producers.check(
+                pid,
+                epoch,
+                h.base_sequence,
+                last_seq,
+                inflight_last_seq=(
+                    horizon[1]
+                    if horizon is not None and horizon[0] == epoch
+                    else None
+                ),
+            )
+        ps = ReplicateStages()
+        if key is not None:
+            # register BEFORE any await so a concurrent retry aliases
+            # this attempt instead of double-appending, and advance the
+            # dispatch horizon so the NEXT sequence range checks clean
+            # while this one is still in the batcher
+            self._inflight[key] = ps
+            pid, epoch, _first, last_seq = key
+            cur = self._inflight_seq.get(pid)
+            if cur is None or epoch > cur[0] or last_seq > cur[1]:
+                self._inflight_seq[pid] = (epoch, last_seq)
+            ps.done.add_done_callback(
+                lambda f, k=key: self._settle_inflight(k, f)
+            )
+        try:
+            raw = await self.consensus.replicate_in_stages(batch, acks)
+        except BaseException as e:
+            for fut in (ps.enqueued, ps.done):
+                if not fut.done():
+                    fut.set_exception(e)
+                fut.exception()  # consumed: callers see the raise below
+            raise
+        self._chain(raw.enqueued, ps.enqueued)
+        self._chain(raw.done, ps.done)
+        return ps
+
+    def _settle_inflight(self, key: tuple, fut: "asyncio.Future") -> None:
+        self._inflight.pop(key, None)
+        pid, epoch, _first, last_seq = key
+        cur = self._inflight_seq.get(pid)
+        if cur is None or cur[0] != epoch:
+            return
+        failed = fut.cancelled() or fut.exception() is not None
+        if failed:
+            # roll the horizon back to the table's truth: a retry of
+            # this (or any later) range must not read as out-of-order
+            self._inflight_seq.pop(pid, None)
+        elif cur[1] == last_seq:
+            # nothing dispatched beyond this batch: the table (updated
+            # at append) is current again
+            self._inflight_seq.pop(pid, None)
+
+    def _chain(self, src: "asyncio.Future", dst: "asyncio.Future") -> None:
+        """Map a consensus stage future to a kafka-base future. A
+        (base, last) result translates at resolution time — the append
+        (and its on_append tracking) has already run by then; a None
+        result (the enqueued/dispatched stage) passes through."""
+
+        def cb(f: "asyncio.Future") -> None:
+            if dst.done():
+                return
+            if f.cancelled():
+                dst.cancel()
+                return
+            e = f.exception()
+            if e is not None:
+                dst.set_exception(e)
+            elif f.result() is None:
+                dst.set_result(None)
+            else:
+                base, _last = f.result()
+                dst.set_result(self.translator.to_kafka(base))
+
+        src.add_done_callback(cb)
+
     async def replicate(
         self, batch: RecordBatch, acks: int = -1, timeout: float = 10.0
     ) -> int:
-        """Returns the kafka base offset assigned to the batch.
+        """Returns the kafka base offset assigned to the batch."""
+        try:
+            ps = await self.replicate_in_stages(batch, acks)
+        except DuplicateSequence as dup:
+            return dup.base_offset
+        try:
+            return await asyncio.wait_for(asyncio.shield(ps.done), timeout)
+        except asyncio.TimeoutError:
+            from ..raft.consensus import ReplicateTimeout
 
-        Idempotence (rm_stm.cc dedupe): batches carrying a producer id
-        are sequence-checked against the producer table; a retried
-        batch returns its ORIGINAL offset. The check and the log
-        append run without an intervening await, so concurrent
-        producers cannot interleave between validation and append."""
-        h = batch.header
-        if h.producer_id >= 0 and h.base_sequence >= 0:
-            try:
-                self.producers.check(
-                    h.producer_id,
-                    h.producer_epoch,
-                    h.base_sequence,
-                    h.base_sequence + h.record_count - 1,
-                )
-            except DuplicateSequence as dup:
-                return dup.base_offset
-        base, _last = await self.consensus.replicate(
-            batch, acks=acks, timeout=timeout
-        )
-        return self.translator.to_kafka(base)
+            consume_exc(ps.done)  # abandoned: round settles later
+            raise ReplicateTimeout(
+                f"{self.ntp}: not acked in {timeout}s"
+            ) from None
 
     # -- read --------------------------------------------------------
     def read_kafka(

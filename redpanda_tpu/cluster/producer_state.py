@@ -48,28 +48,44 @@ class ProducerStateTable:
         self._pids: dict[int, _Producer] = {}
 
     def check(
-        self, pid: int, epoch: int, first_seq: int, last_seq: int
+        self,
+        pid: int,
+        epoch: int,
+        first_seq: int,
+        last_seq: int,
+        inflight_last_seq: int | None = None,
     ) -> None:
         """Validate before append. Raises DuplicateSequence (with the
-        original offset) / OutOfOrderSequence / ProducerFenced."""
+        original offset) / OutOfOrderSequence / ProducerFenced.
+
+        `inflight_last_seq`: highest sequence already dispatched to the
+        replicate batcher but not yet applied to this table — with
+        deferred appends the table alone lags dispatch order, and a
+        pipelined next-in-sequence batch must not read as a gap
+        (rm_stm keeps the same in-flight horizon)."""
         p = self._pids.get(pid)
-        if p is None:
+        expected = -1
+        if p is not None:
+            if epoch < p.epoch:
+                raise ProducerFenced(f"pid {pid} epoch {epoch} < {p.epoch}")
+            if epoch > p.epoch:
+                return  # new epoch resets sequencing
+            for f, l, base in p.batches:
+                if f == first_seq and l == last_seq:
+                    raise DuplicateSequence(base)
+            expected = p.last_seq
+        elif inflight_last_seq is None:
             return  # new producer (or state aged out): accept
-        if epoch < p.epoch:
-            raise ProducerFenced(f"pid {pid} epoch {epoch} < {p.epoch}")
-        if epoch > p.epoch:
-            return  # new epoch resets sequencing
-        for f, l, base in p.batches:
-            if f == first_seq and l == last_seq:
-                raise DuplicateSequence(base)
-        if first_seq == p.last_seq + 1:
+        if inflight_last_seq is not None:
+            expected = max(expected, inflight_last_seq)
+        if first_seq == expected + 1:
             return
-        if first_seq > p.last_seq + 1:
+        if first_seq > expected + 1:
             raise OutOfOrderSequence(
-                f"pid {pid}: seq {first_seq} after {p.last_seq}"
+                f"pid {pid}: seq {first_seq} after {expected}"
             )
         raise OutOfOrderSequence(
-            f"pid {pid}: stale seq {first_seq} <= {p.last_seq} (uncached)"
+            f"pid {pid}: stale seq {first_seq} <= {expected} (uncached)"
         )
 
     def observe(

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import struct
+from collections import deque
 from typing import Optional, Sequence
 
 from ..models.record import RecordBatch, RecordBatchBuilder
@@ -51,18 +52,51 @@ class BrokerConnection:
         self._writer: Optional[asyncio.StreamWriter] = None
         self._corr = itertools.count(1)
         self._lock = asyncio.Lock()
+        # pipelining: in-flight requests answered strictly in order
+        # (kafka guarantees per-connection response order)
+        self._pending: "deque[tuple[int, asyncio.Future]]" = deque()
+        self._read_task: Optional[asyncio.Task] = None
+        self._dead: Optional[str] = None  # terminal read-loop error
         self.api_versions: dict[int, tuple[int, int]] = {}
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port
         )
+        self._read_task = asyncio.ensure_future(self._read_loop())
         resp = await self.request(API_VERSIONS, Msg(), version=2)
         if resp.error_code != 0:
             raise KafkaClientError(resp.error_code, "api_versions")
         self.api_versions = {
             k.api_key: (k.min_version, k.max_version) for k in resp.api_keys
         }
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                raw_size = await self._reader.readexactly(4)
+                (size,) = _SIZE.unpack(raw_size)
+                payload = await self._reader.readexactly(size)
+                if not self._pending:
+                    raise KafkaClientError(
+                        int(ErrorCode.network_exception), "unsolicited response"
+                    )
+                corr, fut = self._pending.popleft()
+                if not fut.done():
+                    fut.set_result(payload)
+        except asyncio.CancelledError:
+            self._dead = "closed"
+            raise
+        except Exception as e:
+            self._dead = str(e) or type(e).__name__
+            while self._pending:
+                _corr, fut = self._pending.popleft()
+                if not fut.done():
+                    fut.set_exception(
+                        KafkaClientError(
+                            int(ErrorCode.network_exception), str(e)
+                        )
+                    )
 
     def pick_version(self, api, preferred: int) -> int:
         rng = self.api_versions.get(api.key)
@@ -79,12 +113,27 @@ class BrokerConnection:
     async def request(self, api, req, version: int) -> Msg:
         hdr = RequestHeader(api.key, version, next(self._corr), self._client_id)
         frame = encode_request_header(hdr) + api.encode_request(req, version)
-        async with self._lock:
+        if self._dead is not None:
+            raise KafkaClientError(
+                int(ErrorCode.network_exception), f"connection dead: {self._dead}"
+            )
+        fut = asyncio.get_event_loop().create_future()
+        async with self._lock:  # order registration with the write
+            self._pending.append((hdr.correlation_id, fut))
             self._writer.write(_SIZE.pack(len(frame)) + frame)
             await self._writer.drain()
-            raw_size = await self._reader.readexactly(4)
-            (size,) = _SIZE.unpack(raw_size)
-            payload = await self._reader.readexactly(size)
+        # belt-and-braces: if the read loop died while we drained, our
+        # future was in _pending and is already failed; this catches
+        # any path where it wasn't
+        if self._dead is not None and not fut.done():
+            try:
+                self._pending.remove((hdr.correlation_id, fut))
+            except ValueError:
+                pass
+            raise KafkaClientError(
+                int(ErrorCode.network_exception), f"connection dead: {self._dead}"
+            )
+        payload = await fut
         r = Reader(payload)
         corr = r.read_int32()
         if corr != hdr.correlation_id:
@@ -113,6 +162,15 @@ class BrokerConnection:
         return api.decode_response(body, version)
 
     async def close(self) -> None:
+        if self._read_task is not None:
+            self._read_task.cancel()
+            try:
+                await self._read_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        while self._pending:
+            _corr, fut = self._pending.popleft()
+            fut.cancel()
         if self._writer is not None:
             self._writer.close()
             try:

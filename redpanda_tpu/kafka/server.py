@@ -71,6 +71,17 @@ class _CloseConnection(Exception):
     reference closes on unparseable/unanswerable requests."""
 
 
+def _consume_exc(fut: "asyncio.Future") -> None:
+    """Mark a future's eventual exception as retrieved (abandoned
+    stage after an earlier batch failed)."""
+
+    def cb(f: "asyncio.Future") -> None:
+        if not f.cancelled():
+            f.exception()
+
+    fut.add_done_callback(cb)
+
+
 class KafkaServer:
     def __init__(self, broker: "Broker"):
         self.broker = broker
@@ -116,10 +127,45 @@ class KafkaServer:
     async def _on_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        """Pipelined request loop (connection_context.cc:55 +
+        produce.cc:383 two-stage dispatch): a handler may return its
+        response bytes immediately OR a coroutine producing them later
+        (produce awaiting quorum). The reader keeps parsing the next
+        request while slow responses settle; a writer fiber emits
+        responses strictly in request order."""
         task = asyncio.current_task()
         self._conns.add(task)
-        try:
+        pending: asyncio.Queue = asyncio.Queue()
+        conn_failed = asyncio.Event()
+
+        async def write_loop() -> None:
             while True:
+                fut = await pending.get()
+                if fut is None:
+                    return
+                try:
+                    resp = await fut
+                except _CloseConnection as e:
+                    if e.args and e.args[0]:
+                        writer.write(_SIZE.pack(len(e.args[0])) + e.args[0])
+                        await writer.drain()
+                    conn_failed.set()
+                    writer.close()  # unblocks the reader side
+                    return
+                except Exception:
+                    conn_failed.set()
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+                    raise
+                if resp is not None:
+                    writer.write(_SIZE.pack(len(resp)) + resp)
+                    await writer.drain()
+
+        write_task = asyncio.ensure_future(write_loop())
+        try:
+            while not conn_failed.is_set():
                 try:
                     raw_size = await reader.readexactly(4)
                 except (asyncio.IncompleteReadError, ConnectionError):
@@ -131,17 +177,33 @@ class KafkaServer:
                 try:
                     resp = await self._process(frame)
                 except _CloseConnection as e:
-                    if e.args and e.args[0]:
-                        writer.write(_SIZE.pack(len(e.args[0])) + e.args[0])
-                        await writer.drain()
-                    return
-                if resp is not None:
-                    writer.write(_SIZE.pack(len(resp)) + resp)
-                    await writer.drain()
+                    fut = asyncio.get_event_loop().create_future()
+                    fut.set_exception(e)
+                    await pending.put(fut)
+                    break
+                if asyncio.iscoroutine(resp):
+                    await pending.put(asyncio.ensure_future(resp))
+                else:
+                    fut = asyncio.get_event_loop().create_future()
+                    fut.set_result(resp)
+                    await pending.put(fut)
+            await pending.put(None)  # writer drains then exits
+            await write_task
         except (asyncio.CancelledError, ConnectionError):
             pass
         finally:
             self._conns.discard(task)
+            if not write_task.done():
+                write_task.cancel()
+            try:
+                await write_task
+            except (asyncio.CancelledError, _CloseConnection, Exception):
+                pass
+            # settle any still-pending response futures
+            while not pending.empty():
+                fut = pending.get_nowait()
+                if fut is not None:
+                    fut.cancel()
             try:
                 writer.close()
             except Exception:
@@ -179,6 +241,19 @@ class KafkaServer:
                 "%s v%d handler failed", api.name, hdr.api_version
             )
             raise
+        if asyncio.iscoroutine(resp):
+            # staged handler (produce): dispatch done, response later —
+            # encode when it settles, off the reader path
+            async def finish(inner=resp, hdr=hdr, api=api):
+                body = await inner
+                if body is None:
+                    return None
+                head = encode_response_header(
+                    hdr.api_key, hdr.api_version, hdr.correlation_id
+                )
+                return head + api.encode_response(body, hdr.api_version)
+
+            return finish()
         if resp is None:  # acks=0 produce: no response on the wire
             return None
         head = encode_response_header(
@@ -340,9 +415,26 @@ class KafkaServer:
             )
             return resp
 
-        async def one_partition(topic: str, p: Msg) -> Msg:
+        def produce_error(exc: BaseException) -> int:
+            if isinstance(exc, CrcMismatch):
+                return int(ErrorCode.corrupt_message)
+            if isinstance(exc, NotLeaderError):
+                return int(ErrorCode.not_leader_for_partition)
+            if isinstance(exc, (ReplicateTimeout, asyncio.TimeoutError)):
+                return int(ErrorCode.request_timed_out)
+            if isinstance(exc, OutOfOrderSequence):
+                return int(ErrorCode.out_of_order_sequence_number)
+            if isinstance(exc, ProducerFenced):
+                return int(ErrorCode.invalid_producer_epoch)
+            if isinstance(exc, ValueError):
+                return int(ErrorCode.corrupt_message)
+            return int(ErrorCode.unknown_server_error)
+
+        async def dispatch_partition(topic: str, p: Msg):
+            """Stage 1 (produce.cc dispatched): parse, CRC-verify and
+            enqueue every batch in log order. Returns either an error
+            Msg (terminal) or the list of in-flight stages."""
             ntp = kafka_ntp(topic, p.index)
-            err, base = 0, -1
             partition = self.broker.partition_manager.get(ntp)
             if partition is None:
                 known = self.broker.controller.topic_table.group_of(ntp)
@@ -358,40 +450,82 @@ class KafkaServer:
                     error_code=int(ErrorCode.invalid_request),
                     base_offset=-1,
                 )
+            # request-order entries: ("dup", offset) for already-applied
+            # retries, ("ps", stages) for in-flight batches — the
+            # response base_offset is the FIRST batch's offset either way
+            entries: list[tuple] = []
             try:
                 parser = IOBufParser(bytes(p.records))
-                first = None
                 while parser.bytes_left() > 0:
                     batch = RecordBatch.from_kafka_wire(parser, verify=True)
-                    kbase = await partition.replicate(
-                        batch, acks=acks, timeout=10.0
-                    )
-                    if first is None:
-                        first = kbase
-                base = first if first is not None else -1
-            except CrcMismatch:
-                err = int(ErrorCode.corrupt_message)
-            except NotLeaderError:
-                err = int(ErrorCode.not_leader_for_partition)
-            except ReplicateTimeout:
-                err = int(ErrorCode.request_timed_out)
-            except OutOfOrderSequence:
-                err = int(ErrorCode.out_of_order_sequence_number)
-            except ProducerFenced:
-                err = int(ErrorCode.invalid_producer_epoch)
-            except ValueError:
-                err = int(ErrorCode.corrupt_message)
-            return Msg(index=p.index, error_code=err, base_offset=base)
+                    try:
+                        ps = await partition.replicate_in_stages(
+                            batch, acks=acks
+                        )
+                    except DuplicateSequence as dup:
+                        entries.append(("dup", dup.base_offset))
+                        continue
+                    entries.append(("ps", ps))
+                    # order guard: batch cached in FIFO order before
+                    # the next one dispatches
+                    await asyncio.shield(ps.enqueued)
+            except Exception as e:
+                for kind, v in entries:
+                    if kind == "ps":
+                        _consume_exc(v.enqueued)
+                        _consume_exc(v.done)
+                return Msg(
+                    index=p.index, error_code=produce_error(e), base_offset=-1
+                )
+            return (p.index, entries)
 
-        responses = []
+        async def finish_partition(work) -> Msg:
+            """Stage 2 (produced): await the requested ack level."""
+            if isinstance(work, Msg):
+                return work
+            index, entries = work
+            base = -1
+            err = 0
+            for i, (kind, v) in enumerate(entries):
+                if kind == "dup":
+                    if base < 0:
+                        base = v
+                    continue
+                try:
+                    kbase = await asyncio.wait_for(asyncio.shield(v.done), 10.0)
+                    if base < 0:
+                        base = kbase
+                except Exception as e:
+                    err = produce_error(e)
+                    for kind2, v2 in entries[i:]:
+                        if kind2 == "ps":
+                            _consume_exc(v2.done)
+                    break
+            return Msg(index=index, error_code=err, base_offset=base if not err else -1)
+
+        # stage 1 runs before this handler returns: per-connection
+        # order is fixed by enqueue order
+        work = []
         for t in req.topics:
-            prs = await asyncio.gather(
-                *(one_partition(t.name, p) for p in t.partitions)
-            )
-            responses.append(Msg(name=t.name, partition_responses=list(prs)))
-        if acks == 0:
-            return None
-        return Msg(responses=responses, throttle_time_ms=0)
+            partition_work = [
+                await dispatch_partition(t.name, p) for p in t.partitions
+            ]
+            work.append((t.name, partition_work))
+
+        async def finish():
+            responses = []
+            for name, partition_work in work:
+                prs = await asyncio.gather(
+                    *(finish_partition(w) for w in partition_work)
+                )
+                responses.append(
+                    Msg(name=name, partition_responses=list(prs))
+                )
+            if acks == 0:
+                return None
+            return Msg(responses=responses, throttle_time_ms=0)
+
+        return finish()
 
     async def handle_fetch(self, hdr: RequestHeader, req: Msg) -> Msg:
         deadline = (

@@ -125,6 +125,9 @@ class Consensus:
         # blobs from a snapshot installed/loaded before contributors
         # registered (crash-recovery ordering)
         self._install_blobs: dict[str, bytes] = {}
+        from .replicate_batcher import ReplicateBatcher
+
+        self._batcher = ReplicateBatcher(self)
 
     # ---------------------------------------------------------- setup
     def _vote_key(self) -> bytes:
@@ -317,6 +320,7 @@ class Consensus:
 
     async def stop(self) -> None:
         self._closed = True
+        await self._batcher.stop()
         for t in [self._timer_task, *self._bg_tasks]:
             if t is not None:
                 t.cancel()
@@ -708,6 +712,25 @@ class Consensus:
                 rt.AppendEntriesReply.SUCCESS)
 
     # ------------------------------------------------- leader replicate
+    async def replicate_in_stages(
+        self,
+        builder_or_batch: "RecordBatchBuilder | RecordBatch",
+        acks: int = -1,
+    ):
+        """Two-stage leader write (consensus.cc:728
+        replicate_in_stages): returns ReplicateStages whose `enqueued`
+        future resolves with (base, last) in log order and `done`
+        resolves at the requested ack level. Concurrent calls coalesce
+        into one append+fsync+dispatch round (replicate_batcher)."""
+        if self.role != Role.LEADER:
+            raise NotLeaderError(self.leader_id)
+        batch = (
+            builder_or_batch.build()
+            if isinstance(builder_or_batch, RecordBatchBuilder)
+            else builder_or_batch
+        )
+        return await self._batcher.replicate_in_stages(batch, acks)
+
     async def replicate(
         self,
         builder_or_batch: "RecordBatchBuilder | RecordBatch",
@@ -717,49 +740,18 @@ class Consensus:
         """Leader write path (consensus.cc:717 replicate). acks: -1 =
         quorum (wait for commit), 1 = leader ack (local flush only),
         0 = fire and forget. Returns (base, last) assigned offsets."""
-        if self.role != Role.LEADER:
-            raise NotLeaderError(self.leader_id)
-        row = self.row
-        term = self.term
-        batch = (
-            builder_or_batch.build()
-            if isinstance(builder_or_batch, RecordBatchBuilder)
-            else builder_or_batch
-        )
-        base, last = self.log.append(batch, term=term)
-        flushed = self.log.flush()
-        self.arrays.match_index[row, SELF_SLOT] = last
-        self.arrays.flushed_index[row, SELF_SLOT] = flushed
-        # the local flush itself can complete a quorum (RF=1, or
-        # followers already ahead): consensus.cc:2704 runs after every
-        # flush, not only on replies
-        if self.arrays.scalar_commit_update(row):
-            self._notify_commit()
-        for peer in self.peers():
-            self._spawn(self._catch_up(peer))
-        if acks == 0 or acks == 1:
-            return base, last
-        # acks=all: wait for quorum commit
-        deadline = asyncio.get_event_loop().time() + timeout
-        while self.commit_index < last:
-            if self._closed:
-                raise ReplicateTimeout("node stopped")
-            if self.role != Role.LEADER or self.term != term:
-                raise NotLeaderError(self.leader_id)
-            remaining = deadline - asyncio.get_event_loop().time()
-            if remaining <= 0:
-                raise ReplicateTimeout(
-                    f"g{self.group_id}: offset {last} not committed in {timeout}s"
-                )
-            ev = self._commit_event
-            try:
-                await asyncio.wait_for(ev.wait(), remaining)
-            except asyncio.TimeoutError:
-                continue
-        if self.log.get_term(base) != term:
-            # truncated by a newer leader while waiting
-            raise NotLeaderError(self.leader_id)
-        return base, last
+        stages = await self.replicate_in_stages(builder_or_batch, acks)
+        try:
+            return await asyncio.wait_for(
+                asyncio.shield(stages.done), timeout
+            )
+        except asyncio.TimeoutError:
+            from .replicate_batcher import consume_exc
+
+            consume_exc(stages.done)  # abandoned: round settles later
+            raise ReplicateTimeout(
+                f"g{self.group_id}: not acked in {timeout}s"
+            ) from None
 
     def _notify_commit(self) -> None:
         ev = self._commit_event

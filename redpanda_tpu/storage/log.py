@@ -172,6 +172,18 @@ class Log:
             return -1
         return self._segments[-1].flush()
 
+    async def flush_async(self) -> int:
+        """Executor-thread fsync of the active segment (replicate
+        batcher path: the event loop keeps appending the next round
+        while this one syncs). A roll during the fsync is safe — the
+        captured segment still syncs its own bytes, and rolled
+        segments fsync at roll time."""
+        if not self._segments:
+            return -1
+        seg = self._segments[-1]
+        await seg.flush_async()
+        return self._segments[-1].stable_offset
+
     # -- read --------------------------------------------------------
     def read(
         self, start_offset: int, max_bytes: int = 1 << 20, upto: int | None = None

@@ -17,6 +17,7 @@ checkpoint.
 
 from __future__ import annotations
 
+import asyncio
 import bisect
 import os
 import struct
@@ -109,6 +110,19 @@ class Segment:
         self._file.flush()
         os.fsync(self._file.fileno())
         self.stable_offset = self.dirty_offset
+        return self.stable_offset
+
+    async def flush_async(self) -> int:
+        """fsync on an executor thread so the event loop keeps
+        accepting appends while the disk syncs (segment_appender.cc
+        background flush). Only bytes pushed to the OS before the fsync
+        are counted: the stable offset advances to the dirty offset
+        captured at call time, never past it."""
+        self._file.flush()  # python buffer → OS (loop thread, cheap)
+        target = self.dirty_offset
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(None, os.fsync, self._file.fileno())
+        self.stable_offset = max(self.stable_offset, target)
         return self.stable_offset
 
     # -- read path ---------------------------------------------------
