@@ -26,6 +26,7 @@ from .cluster import (
     ShardTable,
 )
 from .cluster.metadata_dissemination import MetadataDissemination
+from .cluster.tx_coordinator import TxCoordinator
 from .kafka.coordinator import GroupCoordinator
 from .kafka.server import KafkaServer
 from .raft.group_manager import GroupManager
@@ -108,6 +109,7 @@ class Broker:
             self.controller.topic_table, self.partition_manager, self.leaders
         )
         self.group_coordinator = GroupCoordinator(self)
+        self.tx_coordinator = TxCoordinator(self)
         self.metadata_dissemination = MetadataDissemination(self)
         self.kafka_server = KafkaServer(self)
         self._started = False
@@ -118,6 +120,7 @@ class Broker:
             self.group_manager.service,
             self.controller.service,
             self.metadata_dissemination.service,
+            self.tx_coordinator.service,
         ):
             if self._rpc_server is not None:
                 self._rpc_server.register(svc)
@@ -128,6 +131,7 @@ class Broker:
         await self.group_manager.start()
         await self.controller.start()
         await self.group_coordinator.start()
+        await self.tx_coordinator.start()
         await self.metadata_dissemination.start()
         await self.kafka_server.start()
         self._started = True
@@ -138,6 +142,7 @@ class Broker:
         self._started = False
         await self.kafka_server.stop()
         await self.metadata_dissemination.stop()
+        await self.tx_coordinator.stop()
         await self.group_coordinator.stop()
         await self.controller.stop()
         await self.group_manager.stop()
@@ -145,6 +150,12 @@ class Broker:
         if self._rpc_server is not None:
             await self._rpc_server.stop()
         self.storage.close()
+
+    async def send_rpc(
+        self, node_id: int, method_id: int, payload: bytes, timeout: float
+    ) -> bytes:
+        """Internal RPC to a peer (the `send` seam the subsystems use)."""
+        return await self._conn_cache.call(node_id, method_id, payload, timeout)
 
     @property
     def kafka_advertised(self) -> tuple[str, int]:

@@ -12,22 +12,28 @@ from __future__ import annotations
 import asyncio
 
 from ..models.fundamental import NTP
-from ..models.record import RecordBatch, RecordBatchType
+from ..models.record import RecordBatch, RecordBatchBuilder, RecordBatchType
 from ..raft.consensus import Consensus, NotLeaderError  # noqa: F401 (re-export)
 from ..raft.offset_translator import OffsetTranslator
 from ..raft.replicate_batcher import ReplicateStages, consume_exc
 from ..storage.log import Log
 from ..utils import serde
-from .producer_state import DuplicateSequence, ProducerStateTable
+from .producer_state import (
+    DuplicateSequence,
+    ProducerFenced,
+    ProducerStateTable,
+)
+from .tx_state import COMMIT_MARKER, TxTracker, control_record_key, parse_control_key
 
 
 class _PartitionSnapshot(serde.Envelope):
     """Partition contribution to the raft snapshot payload
-    (rm_stm snapshot analog: translator + producer dedupe state)."""
+    (rm_stm snapshot analog: translator + producer dedupe + tx state)."""
 
     SERDE_FIELDS = [
         ("translator", serde.bytes_t),
         ("producers", serde.bytes_t),
+        ("tx", serde.bytes_t),
     ]
 
 
@@ -41,6 +47,7 @@ class Partition:
             kvstore=consensus.kvstore, group_id=group_id
         )
         self.producers = ProducerStateTable()
+        self.tx = TxTracker()
         # (pid, epoch, first_seq, last_seq) → in-flight stages: retries
         # arriving before the first attempt lands alias its result
         self._inflight: dict[tuple, ReplicateStages] = {}
@@ -80,32 +87,50 @@ class Partition:
     def _observe(self, batch: RecordBatch) -> None:
         h = batch.header
         self.translator.track(h.type, h.base_offset, h.last_offset)
-        if (
-            h.type == RecordBatchType.raft_data
-            and h.producer_id >= 0
-            and h.base_sequence >= 0
-        ):
+        if h.type != RecordBatchType.raft_data or h.producer_id < 0:
+            return
+        kbase = self.translator.to_kafka(h.base_offset)
+        if h.is_control:
+            # tx marker written by the coordinator (rm_stm.cc apply of
+            # commit/abort control batches)
+            try:
+                kind = parse_control_key(batch.records()[0].key)
+            except Exception:
+                kind = None
+            if kind is not None:
+                self.tx.observe_marker(
+                    h.producer_id,
+                    h.producer_epoch,
+                    kind == COMMIT_MARKER,
+                    kbase,
+                )
+            return
+        if h.base_sequence >= 0:
             self.producers.observe(
                 h.producer_id,
                 h.producer_epoch,
                 h.base_sequence,
                 h.base_sequence + h.record_count - 1,
-                self.translator.to_kafka(h.base_offset),
+                kbase,
             )
+        if h.is_transactional:
+            self.tx.observe_data(h.producer_id, h.producer_epoch, kbase)
 
     def _on_append(self, batch: RecordBatch) -> None:
         self._observe(batch)
 
     def _on_truncate(self, offset: int) -> None:
         self.translator.truncate(offset)
-        # sequence state may reference truncated batches: rebuild from
-        # the surviving log (rare path — only divergent-leader healing)
+        # sequence/tx state may reference truncated batches: rebuild
+        # from the surviving log (rare path — divergent-leader healing)
         self.producers.truncate()
+        self.tx.clear()
         self._replay_from(0)
 
     def _on_prefix_truncate(self, new_start: int) -> None:
         self.translator.prefix_truncate(new_start)
         self.translator.checkpoint()
+        self.tx.prune(self.start_offset())
 
     # -- raft snapshot contributor ------------------------------------
     def capture_snapshot(self, upto: int) -> bytes:
@@ -115,12 +140,14 @@ class Partition:
         return _PartitionSnapshot(
             translator=self.translator.capture_upto(upto),
             producers=self.producers.encode(),
+            tx=self.tx.encode(),
         ).encode()
 
     def restore_snapshot(self, blob: bytes, last_included: int) -> None:
         ps = _PartitionSnapshot.decode(blob)
         self.translator.restore(ps.translator)
         self.producers = ProducerStateTable.decode(ps.producers)
+        self.tx = TxTracker.decode(ps.tx)
         # re-track whatever survives in the log above the boundary
         # (normally nothing: install resets the log)
         self._replay_from(last_included + 1)
@@ -167,8 +194,17 @@ class Partition:
         return self.translator.to_kafka(commit) + 1
 
     def last_stable_offset(self) -> int:
-        # == HW until transactions land (rm_stm provides the real LSO)
-        return self.high_watermark()
+        """HW bounded by the earliest open transaction (rm_stm LSO):
+        READ_COMMITTED consumers must not observe offsets at or past an
+        undecided transaction's first record."""
+        hw = self.high_watermark()
+        first_open = self.tx.first_open_offset()
+        return hw if first_open is None else min(first_open, hw)
+
+    def aborted_in(self, start: int, end: int) -> list[tuple[int, int]]:
+        """(producer_id, first_offset) aborted-tx entries overlapping
+        the fetch range (fetch response AbortedTransaction rows)."""
+        return self.tx.aborted_in(start, end)
 
     def start_offset(self) -> int:
         """First kafka offset = count of data offsets below the raft
@@ -189,6 +225,16 @@ class Partition:
         appended) or by aliasing the in-flight stages of the first
         attempt (enqueued via the batcher but not yet applied)."""
         h = batch.header
+        if (
+            h.is_transactional
+            and h.producer_id >= 0
+            and h.producer_epoch < self.tx.fence_epoch(h.producer_id)
+        ):
+            # zombie producer from a pre-bump epoch (rm_stm fencing)
+            raise ProducerFenced(
+                f"pid {h.producer_id} epoch {h.producer_epoch} < fence "
+                f"{self.tx.fence_epoch(h.producer_id)}"
+            )
         key = None
         if h.producer_id >= 0 and h.base_sequence >= 0:
             pid, epoch = h.producer_id, h.producer_epoch
@@ -292,9 +338,42 @@ class Partition:
                 f"{self.ntp}: not acked in {timeout}s"
             ) from None
 
+    async def write_tx_marker(
+        self, pid: int, epoch: int, commit: bool, timeout: float = 10.0
+    ) -> None:
+        """Append a commit/abort control marker for the producer's open
+        transaction (the WriteTxnMarkers path the tx coordinator drives
+        through the gateway — reference rm_stm commit_tx/abort_tx).
+        Idempotent: a redelivered marker for an already-closed tx is a
+        no-op success."""
+        from ..raft.consensus import NotLeaderError as _NLE
+
+        if not self.consensus.is_leader():
+            raise _NLE(self.consensus.leader_id)
+        if not self.tx.has_open(pid, epoch) and self.tx.fence_epoch(pid) >= epoch:
+            # nothing open AND the fence already covers this epoch:
+            # duplicate delivery. (When the fence is still below the
+            # marker epoch the marker must be appended even with no
+            # open tx — a bumped-epoch abort racing an in-flight first
+            # produce relies on the marker raising the fence, else the
+            # late old-epoch batch would open an orphan tx that pins
+            # the LSO forever; rm_stm writes its fence unconditionally.)
+            return
+        b = RecordBatchBuilder(
+            producer_id=pid,
+            producer_epoch=epoch,
+            transactional=True,
+            control=True,
+        )
+        b.add(value=b"", key=control_record_key(commit))
+        await self.replicate(b.build(), acks=-1, timeout=timeout)
+
     # -- read --------------------------------------------------------
     def read_kafka(
-        self, kafka_offset: int, max_bytes: int = 1 << 20
+        self,
+        kafka_offset: int,
+        max_bytes: int = 1 << 20,
+        upto_kafka: int | None = None,
     ) -> list[tuple[int, RecordBatch]]:
         """Committed data batches from kafka_offset, as
         (kafka_base_offset, batch) pairs. The caller frames them for
@@ -302,7 +381,8 @@ class Partition:
         cover base_offset, so no payload recompute — reference
         kafka/server/replicated_partition.cc translation)."""
         hw = self.high_watermark()
-        if kafka_offset >= hw:
+        bound = hw if upto_kafka is None else min(hw, upto_kafka)
+        if kafka_offset >= bound:
             return []
         raft_pos = self.translator.from_kafka(kafka_offset)
         commit = self.consensus.commit_index
@@ -319,6 +399,8 @@ class Partition:
                 if b.header.type != RecordBatchType.raft_data:
                     continue
                 kbase = self.translator.to_kafka(b.header.base_offset)
+                if kbase >= bound:
+                    return out
                 out.append((kbase, b))
                 consumed += b.size_bytes()
                 if consumed >= max_bytes:

@@ -365,6 +365,7 @@ class KafkaClient:
         max_bytes: int = 1 << 20,
         max_wait_ms: int = 500,
         min_bytes: int = 1,
+        read_committed: bool = False,
     ) -> list[tuple[int, bytes | None, bytes | None]]:
         """Returns [(offset, key, value)] at-or-after `offset`."""
         for attempt in range(2):
@@ -375,7 +376,7 @@ class KafkaClient:
                 max_wait_ms=max_wait_ms,
                 min_bytes=min_bytes,
                 max_bytes=max_bytes,
-                isolation_level=0,
+                isolation_level=1 if read_committed else 0,
                 session_id=0,
                 session_epoch=-1,
                 topics=[
@@ -403,7 +404,15 @@ class KafkaClient:
                 raise KafkaClientError(
                     pr.error_code, f"fetch {topic}/{partition}"
                 )
-            return decode_record_set(pr.records, from_offset=offset)
+            aborted = None
+            if read_committed:
+                aborted = [
+                    (a.producer_id, a.first_offset)
+                    for a in (pr.aborted_transactions or [])
+                ]
+            return decode_record_set(
+                pr.records, from_offset=offset, aborted=aborted
+            )
         raise KafkaClientError(
             int(ErrorCode.not_leader_for_partition), f"fetch {topic}/{partition}"
         )
@@ -640,20 +649,299 @@ class GroupClient:
 
 
 def decode_record_set(
-    records: bytes | memoryview | None, from_offset: int = 0
+    records: bytes | memoryview | None,
+    from_offset: int = 0,
+    aborted: list[tuple[int, int]] | None = None,
 ) -> list[tuple[int, bytes | None, bytes | None]]:
-    """Kafka wire record set → [(abs_offset, key, value)]."""
+    """Kafka wire record set → [(abs_offset, key, value)].
+
+    Control batches (tx markers) never surface as records. With
+    `aborted` (the fetch response's AbortedTransaction rows as
+    (producer_id, first_offset)), aborted transactional batches are
+    dropped the way a READ_COMMITTED consumer does: a pid enters the
+    aborted set when the scan reaches its range's first offset and
+    leaves it at its abort control marker."""
+    from ..cluster.tx_state import ABORT_MARKER, parse_control_key
     from ..utils.iobuf import IOBufParser
 
     if records is None or len(records) == 0:
         return []
+    pending = sorted(aborted or [], key=lambda a: a[1])  # by first_offset
+    live_aborts: set[int] = set()
     out: list[tuple[int, bytes | None, bytes | None]] = []
     parser = IOBufParser(bytes(records))
     while parser.bytes_left() > 0:
         batch = RecordBatch.from_kafka_wire(parser, verify=True)
-        base = batch.header.base_offset
+        h = batch.header
+        base = h.base_offset
+        while pending and pending[0][1] <= base:
+            live_aborts.add(pending.pop(0)[0])
+        if h.is_control:
+            if h.producer_id in live_aborts:
+                try:
+                    kind = parse_control_key(batch.records()[0].key)
+                except Exception:
+                    kind = None
+                if kind == ABORT_MARKER:
+                    live_aborts.discard(h.producer_id)
+            continue
+        if h.is_transactional and h.producer_id in live_aborts:
+            continue
         for rec in batch.records():
             off = base + rec.offset_delta
             if off >= from_offset:
                 out.append((off, rec.key, rec.value))
     return out
+
+
+class TransactionalProducer:
+    """Exactly-once producer driver (reference: the transactional flow
+    of kafka/client/producer + tx_gateway_frontend semantics): init →
+    begin → produce/send_offsets → commit/abort, with per-partition
+    sequence tracking and automatic AddPartitionsToTxn."""
+
+    def __init__(
+        self, client: "KafkaClient", tx_id: str, timeout_ms: int = 60000
+    ):
+        self.client = client
+        self.tx_id = tx_id
+        self.timeout_ms = timeout_ms
+        self.pid = -1
+        self.epoch = -1
+        self._seqs: dict[tuple[str, int], int] = {}
+        self._in_tx: set[tuple[str, int]] = set()
+        self._coord: Optional[BrokerConnection] = None
+
+    async def _coordinator(self, refresh: bool = False) -> BrokerConnection:
+        from .protocol.group_apis import FIND_COORDINATOR
+
+        if self._coord is not None and not refresh:
+            return self._coord
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while True:
+            conn = await self.client.any_conn()
+            v = conn.pick_version(FIND_COORDINATOR, 1)
+            resp = await conn.request(
+                FIND_COORDINATOR, Msg(key=self.tx_id, key_type=1), v
+            )
+            if resp.error_code == 0 and resp.node_id >= 0:
+                self._coord = await self.client._connect_addr(
+                    (resp.host, resp.port)
+                )
+                return self._coord
+            if asyncio.get_event_loop().time() > deadline:
+                raise KafkaClientError(
+                    resp.error_code or int(ErrorCode.coordinator_not_available),
+                    f"find_tx_coordinator {self.tx_id}",
+                )
+            await asyncio.sleep(0.05)
+
+    async def _coord_request(self, api, req, version: int) -> Msg:
+        refresh = False
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while True:
+            conn = await self._coordinator(refresh=refresh)
+            refresh = False
+            resp = await conn.request(api, req, version)
+            code = int(getattr(resp, "error_code", 0) or 0)
+            if code == int(ErrorCode.not_coordinator):
+                refresh = True
+            elif code != int(ErrorCode.concurrent_transactions):
+                return resp
+            if asyncio.get_event_loop().time() > deadline:
+                return resp
+            await asyncio.sleep(0.05)
+
+    async def init(self) -> None:
+        from .protocol.group_apis import INIT_PRODUCER_ID
+
+        conn = await self._coordinator()
+        v = conn.pick_version(INIT_PRODUCER_ID, 1)
+        resp = await self._coord_request(
+            INIT_PRODUCER_ID,
+            Msg(
+                transactional_id=self.tx_id,
+                transaction_timeout_ms=self.timeout_ms,
+            ),
+            v,
+        )
+        if resp.error_code != 0:
+            raise KafkaClientError(resp.error_code, f"init_tx {self.tx_id}")
+        self.pid = resp.producer_id
+        self.epoch = resp.producer_epoch
+        self._seqs.clear()
+        self._in_tx.clear()
+
+    def begin(self) -> None:
+        self._in_tx.clear()
+
+    async def _add_partitions(self, tps: list[tuple[str, int]]) -> None:
+        from .protocol.tx_apis import ADD_PARTITIONS_TO_TXN
+
+        by_topic: dict[str, list[int]] = {}
+        for t, p in tps:
+            by_topic.setdefault(t, []).append(p)
+        conn = await self._coordinator()
+        v = conn.pick_version(ADD_PARTITIONS_TO_TXN, 1)
+        resp = await self._coord_request(
+            ADD_PARTITIONS_TO_TXN,
+            Msg(
+                transactional_id=self.tx_id,
+                producer_id=self.pid,
+                producer_epoch=self.epoch,
+                topics=[
+                    Msg(name=t, partitions=ps) for t, ps in by_topic.items()
+                ],
+            ),
+            v,
+        )
+        for t in resp.results:
+            for r in t.results:
+                if r.error_code != 0:
+                    raise KafkaClientError(
+                        r.error_code,
+                        f"add_partitions_to_txn {t.name}/{r.partition_index}",
+                    )
+
+    async def produce(
+        self,
+        topic: str,
+        partition: int,
+        records: Sequence[tuple[bytes | None, bytes | None]],
+    ) -> int:
+        if self.pid < 0:
+            raise RuntimeError("init() first")
+        tp = (topic, partition)
+        if tp not in self._in_tx:
+            await self._add_partitions([tp])
+            self._in_tx.add(tp)
+        seq = self._seqs.get(tp, 0)
+        builder = RecordBatchBuilder(
+            producer_id=self.pid,
+            producer_epoch=self.epoch,
+            base_sequence=seq,
+            transactional=True,
+        )
+        for key, value in records:
+            builder.add(value, key=key)
+        wire = builder.build().to_kafka_wire()
+        for attempt in range(2):
+            conn = await self.client.leader_conn(
+                topic, partition, refresh=attempt > 0
+            )
+            v = conn.pick_version(PRODUCE, 7)
+            req = Msg(
+                transactional_id=self.tx_id,
+                acks=-1,
+                timeout_ms=10000,
+                topics=[
+                    Msg(
+                        name=topic,
+                        partitions=[Msg(index=partition, records=wire)],
+                    )
+                ],
+            )
+            resp = await conn.request(PRODUCE, req, v)
+            pr = resp.responses[0].partition_responses[0]
+            if pr.error_code == int(ErrorCode.not_leader_for_partition):
+                continue
+            if pr.error_code != 0:
+                raise KafkaClientError(
+                    pr.error_code, f"tx produce {topic}/{partition}"
+                )
+            self._seqs[tp] = seq + len(records)
+            return pr.base_offset
+        raise KafkaClientError(
+            int(ErrorCode.not_leader_for_partition),
+            f"tx produce {topic}/{partition}",
+        )
+
+    async def send_offsets(
+        self, group_id: str, offsets: dict[tuple[str, int], int]
+    ) -> None:
+        """Commit consumer offsets within the transaction
+        (AddOffsetsToTxn + TxnOffsetCommit to the group coordinator)."""
+        from .protocol.tx_apis import ADD_OFFSETS_TO_TXN, TXN_OFFSET_COMMIT
+
+        conn = await self._coordinator()
+        v = conn.pick_version(ADD_OFFSETS_TO_TXN, 1)
+        resp = await self._coord_request(
+            ADD_OFFSETS_TO_TXN,
+            Msg(
+                transactional_id=self.tx_id,
+                producer_id=self.pid,
+                producer_epoch=self.epoch,
+                group_id=group_id,
+            ),
+            v,
+        )
+        if resp.error_code != 0:
+            raise KafkaClientError(resp.error_code, "add_offsets_to_txn")
+        # stage the offsets at the GROUP coordinator
+        gc = GroupClient(self.client, group_id)
+        gconn = await gc.coordinator()
+        v = gconn.pick_version(TXN_OFFSET_COMMIT, 2)
+        by_topic: dict[str, list[Msg]] = {}
+        for (topic, part), off in offsets.items():
+            by_topic.setdefault(topic, []).append(
+                Msg(
+                    partition_index=part,
+                    committed_offset=off,
+                    committed_metadata=None,
+                )
+            )
+        req = Msg(
+            transactional_id=self.tx_id,
+            group_id=group_id,
+            producer_id=self.pid,
+            producer_epoch=self.epoch,
+            topics=[Msg(name=t, partitions=ps) for t, ps in by_topic.items()],
+        )
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while True:
+            resp = await gconn.request(TXN_OFFSET_COMMIT, req, v)
+            codes = {
+                p.error_code for t in resp.topics for p in t.partitions
+            }
+            if codes <= {0}:
+                return
+            retriable = {
+                int(ErrorCode.not_coordinator),
+                int(ErrorCode.coordinator_load_in_progress),
+            }
+            bad = codes - retriable - {0}
+            if bad:
+                raise KafkaClientError(bad.pop(), "txn_offset_commit")
+            if asyncio.get_event_loop().time() > deadline:
+                raise KafkaClientError(
+                    int(ErrorCode.request_timed_out), "txn_offset_commit"
+                )
+            gconn = await gc.coordinator(refresh=True)
+            await asyncio.sleep(0.05)
+
+    async def _end(self, commit: bool) -> None:
+        from .protocol.tx_apis import END_TXN
+
+        conn = await self._coordinator()
+        v = conn.pick_version(END_TXN, 1)
+        resp = await self._coord_request(
+            END_TXN,
+            Msg(
+                transactional_id=self.tx_id,
+                producer_id=self.pid,
+                producer_epoch=self.epoch,
+                committed=commit,
+            ),
+            v,
+        )
+        if resp.error_code != 0:
+            raise KafkaClientError(
+                resp.error_code, f"end_txn commit={commit}"
+            )
+        self._in_tx.clear()
+
+    async def commit(self) -> None:
+        await self._end(True)
+
+    async def abort(self) -> None:
+        await self._end(False)

@@ -81,6 +81,18 @@ class Group:
         self.leader: Optional[str] = None
         self.members: dict[str, Member] = {}
         self.offsets: dict[tuple[str, int], tuple[int, str | None, int]] = {}
+        # staged transactional offsets: producer_id -> (producer_epoch,
+        # {(topic, part): (offset, metadata, ts)}) — materialized into
+        # `offsets` by the tx coordinator's commit marker iff the
+        # marker carries the same epoch, dropped on abort or when a
+        # newer-epoch marker fences the stale staging
+        # (reference: group.h pending_offset_commits per pid)
+        self.pending_tx: dict[
+            int, tuple[int, dict[tuple[str, int], tuple[int, str | None, int]]]
+        ] = {}
+        # producer_id -> highest epoch whose tx already completed here:
+        # a zombie's TxnOffsetCommit below this is rejected
+        self.tx_fences: dict[int, int] = {}
         self._initial_delay = initial_rebalance_delay_s
         self._join_done = asyncio.Event()  # fires when a rebalance completes
         self._sync_done = asyncio.Event()  # fires when leader assigns

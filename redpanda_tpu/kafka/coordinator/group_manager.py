@@ -33,6 +33,8 @@ DEFAULT_OFFSETS_PARTITIONS = 4
 
 _KIND_GROUP_META = 0
 _KIND_OFFSET = 1
+_KIND_TX_OFFSET = 2  # staged, invisible until the tx commits
+_KIND_TX_MARKER = 3  # commit/abort decision for a pid's staged offsets
 
 
 class CoordinatorLoading(Exception):
@@ -84,6 +86,51 @@ class _OffsetValue(serde.Envelope):
         ("metadata", serde.optional(serde.string)),
         ("commit_ts_ms", serde.i64),
     ]
+
+
+class _TxOffsetValue(serde.Envelope):
+    SERDE_FIELDS = [
+        ("pid", serde.i64),
+        ("epoch", serde.i16),
+        ("offset", serde.i64),
+        ("metadata", serde.optional(serde.string)),
+        ("commit_ts_ms", serde.i64),
+    ]
+
+
+class _TxMarkerValue(serde.Envelope):
+    SERDE_FIELDS = [
+        ("pid", serde.i64),
+        ("epoch", serde.i16),
+        ("commit", serde.u8),
+    ]
+
+
+def _stage_tx_offset(
+    g: Group, pid: int, epoch: int, tp: tuple[str, int], entry: tuple
+) -> None:
+    """Idempotent staging shared by the live path and log replay: a
+    newer epoch supersedes stale staging, an older one is ignored."""
+    cur = g.pending_tx.get(pid)
+    if cur is None or cur[0] < epoch:
+        g.pending_tx[pid] = (epoch, {tp: entry})
+    elif cur[0] == epoch:
+        cur[1][tp] = entry
+    # cur[0] > epoch: fenced zombie staging — drop
+
+
+def _apply_tx_marker(g: Group, pid: int, epoch: int, commit: bool) -> None:
+    """Tx decision shared by the live path and log replay: staged
+    offsets materialize only at the SAME epoch; staging from older
+    epochs is discarded (fenced), newer staging survives."""
+    if epoch > g.tx_fences.get(pid, -1):
+        g.tx_fences[pid] = epoch
+    cur = g.pending_tx.get(pid)
+    if cur is None or cur[0] > epoch:
+        return
+    del g.pending_tx[pid]
+    if commit and cur[0] == epoch:
+        g.offsets.update(cur[1])
 
 
 class GroupCoordinator:
@@ -306,6 +353,25 @@ class GroupCoordinator:
                         val.metadata,
                         int(val.commit_ts_ms),
                     )
+            elif key.kind == _KIND_TX_OFFSET:
+                if g is None:
+                    g = Group(key.group, self._initial_delay)
+                    shard[key.group] = g
+                val = _TxOffsetValue.decode(rec.value)
+                _stage_tx_offset(
+                    g,
+                    int(val.pid),
+                    int(val.epoch),
+                    (key.topic, key.partition),
+                    (int(val.offset), val.metadata, int(val.commit_ts_ms)),
+                )
+            elif key.kind == _KIND_TX_MARKER:
+                if g is None:
+                    continue
+                val = _TxMarkerValue.decode(rec.value)
+                _apply_tx_marker(
+                    g, int(val.pid), int(val.epoch), bool(val.commit)
+                )
 
     async def get_group(
         self, group_id: str, create: bool = False
@@ -399,6 +465,89 @@ class GroupCoordinator:
             return int(ErrorCode.request_timed_out)
         for topic, part, off, md in items:
             g.offsets[(topic, part)] = (off, md, now)
+        return 0
+
+    async def txn_commit_offsets(
+        self,
+        g: Group,
+        pid: int,
+        epoch: int,
+        items: list[tuple[str, int, int, str | None]],  # topic, part, off, md
+    ) -> int:
+        """Stage transactional offsets (group.cc store_txn_offsets):
+        replicated so failover keeps them, but invisible to OffsetFetch
+        until the tx coordinator delivers a commit marker at the same
+        producer epoch. Zombie epochs are fenced."""
+        import time as _time
+
+        p = self._local_partition(g.group_id)
+        if p is None:
+            return int(ErrorCode.not_coordinator)
+        if epoch < g.tx_fences.get(pid, -1):
+            return int(ErrorCode.invalid_producer_epoch)
+        cur = g.pending_tx.get(pid)
+        if cur is not None and cur[0] > epoch:
+            return int(ErrorCode.invalid_producer_epoch)
+        now = int(_time.time() * 1000)
+        b = RecordBatchBuilder()
+        for topic, part, off, md in items:
+            b.add(
+                value=_TxOffsetValue(
+                    pid=pid, epoch=epoch, offset=off, metadata=md, commit_ts_ms=now
+                ).encode(),
+                key=_Key(
+                    kind=_KIND_TX_OFFSET,
+                    group=g.group_id,
+                    topic=topic,
+                    partition=part,
+                ).encode(),
+            )
+        try:
+            await p.replicate(b.build(), acks=-1)
+        except NotLeaderError:
+            return int(ErrorCode.not_coordinator)
+        except ReplicateTimeout:
+            return int(ErrorCode.request_timed_out)
+        for topic, part, off, md in items:
+            _stage_tx_offset(g, pid, epoch, (topic, part), (off, md, now))
+        return 0
+
+    async def complete_tx(
+        self, group_id: str, pid: int, epoch: int, commit: bool
+    ) -> int:
+        """Apply the tx coordinator's decision to staged offsets
+        (group.cc commit_tx/abort_tx via the tx gateway). The marker is
+        persisted whenever it advances the fence, so replay after
+        failover rejects zombie staging the same way the live path
+        does."""
+        g, err = await self.get_group(group_id)
+        if err == int(ErrorCode.group_id_not_found):
+            return 0  # nothing staged anywhere: trivially complete
+        if err:
+            return err
+        cur = g.pending_tx.get(pid)
+        has_effect = cur is not None and cur[0] <= epoch
+        if not has_effect and g.tx_fences.get(pid, -1) >= epoch:
+            return 0  # duplicate marker delivery
+        p = self._local_partition(group_id)
+        if p is None:
+            return int(ErrorCode.not_coordinator)
+        b = RecordBatchBuilder()
+        b.add(
+            value=_TxMarkerValue(
+                pid=pid, epoch=epoch, commit=1 if commit else 0
+            ).encode(),
+            key=_Key(
+                kind=_KIND_TX_MARKER, group=group_id, topic="", partition=-1
+            ).encode(),
+        )
+        try:
+            await p.replicate(b.build(), acks=-1)
+        except NotLeaderError:
+            return int(ErrorCode.not_coordinator)
+        except ReplicateTimeout:
+            return int(ErrorCode.request_timed_out)
+        _apply_tx_marker(g, pid, epoch, commit)
         return 0
 
     async def delete_group(self, group_id: str) -> int:

@@ -20,3 +20,4 @@ from .headers import (  # noqa: F401
 )
 from .schema import Api, Array, F, Msg  # noqa: F401
 from .wire import Reader, Writer, WireError  # noqa: F401
+from . import tx_apis  # noqa: F401  (registers APIs 24-26, 28)

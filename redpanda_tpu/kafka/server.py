@@ -23,7 +23,11 @@ import logging
 import struct
 from typing import TYPE_CHECKING
 
-from ..cluster.producer_state import OutOfOrderSequence, ProducerFenced
+from ..cluster.producer_state import (
+    DuplicateSequence,
+    OutOfOrderSequence,
+    ProducerFenced,
+)
 from ..models.fundamental import NTP, DEFAULT_NS, TopicNamespace, kafka_ntp
 from ..models.record import CrcMismatch, RecordBatch
 from ..raft.consensus import NotLeaderError, ReplicateTimeout
@@ -96,9 +100,10 @@ class KafkaServer:
             FETCH.key: self.handle_fetch,
             LIST_OFFSETS.key: self.handle_list_offsets,
         }
-        from . import server_groups
+        from . import server_groups, server_tx
 
         server_groups.install(self)
+        server_tx.install(self)
 
     async def start(self) -> None:
         cfg = self.broker.config
@@ -532,6 +537,9 @@ class KafkaServer:
             asyncio.get_event_loop().time() + max(req.max_wait_ms, 0) / 1000.0
         )
         min_bytes = max(req.min_bytes, 0)
+        # isolation 1 = READ_COMMITTED: serve only below the LSO and
+        # report aborted ranges (fetch.cc read_result + rm_stm LSO)
+        read_committed = getattr(req, "isolation_level", 0) == 1
 
         def read_all() -> tuple[list[Msg], int, bool]:
             total = 0
@@ -577,7 +585,12 @@ class KafkaServer:
                         )
                         continue
                     hw = partition.high_watermark()
+                    lso = partition.last_stable_offset()
                     start = partition.start_offset()
+                    # range validity is judged against the HW even for
+                    # READ_COMMITTED: an offset in (LSO, HW] is a valid
+                    # position that simply reads empty until the open
+                    # tx resolves and the LSO advances past it
                     if p.fetch_offset < start or p.fetch_offset > hw:
                         has_error = True
                         parts.append(
@@ -585,7 +598,7 @@ class KafkaServer:
                                 partition_index=p.partition,
                                 error_code=int(ErrorCode.offset_out_of_range),
                                 high_watermark=hw,
-                                last_stable_offset=hw,
+                                last_stable_offset=lso,
                                 log_start_offset=start,
                                 aborted_transactions=None,
                                 records=None,
@@ -597,19 +610,33 @@ class KafkaServer:
                         max_bytes=min(p.partition_max_bytes, budget - total)
                         if budget - total > 0
                         else 0,
+                        upto_kafka=lso if read_committed else None,
                     )
                     wire = b"".join(
                         _frame_kafka(batch, kbase) for kbase, batch in pairs
                     )
                     total += len(wire)
+                    aborted = None
+                    if read_committed and pairs:
+                        fetch_end = (
+                            pairs[-1][0]
+                            + pairs[-1][1].header.last_offset_delta
+                            + 1
+                        )
+                        aborted = [
+                            Msg(producer_id=pid, first_offset=first)
+                            for pid, first in partition.aborted_in(
+                                p.fetch_offset, fetch_end
+                            )
+                        ]
                     parts.append(
                         Msg(
                             partition_index=p.partition,
                             error_code=0,
                             high_watermark=hw,
-                            last_stable_offset=partition.last_stable_offset(),
+                            last_stable_offset=lso,
                             log_start_offset=start,
-                            aborted_transactions=None,
+                            aborted_transactions=aborted,
                             records=wire if wire else None,
                         )
                     )

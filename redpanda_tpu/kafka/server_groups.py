@@ -62,16 +62,22 @@ class GroupHandlers:
         return self.server.broker.group_coordinator
 
     async def find_coordinator(self, hdr, req) -> Msg:
-        if getattr(req, "key_type", 0) not in (0, None):
+        key_type = getattr(req, "key_type", 0) or 0
+        if key_type == 1:  # transaction coordinator
+            found = await self.server.broker.tx_coordinator.find_coordinator(
+                req.key
+            )
+        elif key_type == 0:
+            found = await self.coordinator.find_coordinator(req.key)
+        else:
             return Msg(
                 throttle_time_ms=0,
                 error_code=int(ErrorCode.coordinator_not_available),
-                error_message="only group coordination supported",
+                error_message="unknown coordinator key type",
                 node_id=-1,
                 host="",
                 port=-1,
             )
-        found = await self.coordinator.find_coordinator(req.key)
         if found is None:
             return Msg(
                 throttle_time_ms=0,
@@ -323,17 +329,25 @@ class GroupHandlers:
         return Msg(throttle_time_ms=0, results=results)
 
     async def init_producer_id(self, hdr, req) -> Msg:
-        """Producer id via the controller-log allocator (reference:
-        cluster/id_allocator_frontend.cc; transactional ids arrive with
-        the tx coordinator)."""
+        """Producer id: idempotence-only ids come straight from the
+        controller-log allocator (cluster/id_allocator_frontend.cc);
+        transactional ids go through the tx coordinator, which fences
+        the previous incarnation and bumps the epoch
+        (tx_gateway_frontend.cc init_tm_tx)."""
         from ..cluster.controller import TopicError
 
         if req.transactional_id is not None:
+            pid, epoch, code = (
+                await self.server.broker.tx_coordinator.init_producer_id(
+                    req.transactional_id,
+                    getattr(req, "transaction_timeout_ms", 60000),
+                )
+            )
             return Msg(
                 throttle_time_ms=0,
-                error_code=int(ErrorCode.transactional_id_authorization_failed),
-                producer_id=-1,
-                producer_epoch=-1,
+                error_code=code,
+                producer_id=pid,
+                producer_epoch=epoch,
             )
         try:
             pid = await self.server.broker.controller.allocate_producer_id()

@@ -1,0 +1,134 @@
+"""Transaction API handlers, installed into KafkaServer.
+
+Reference: src/v/kafka/server/handlers/{add_partitions_to_txn,
+add_offsets_to_txn,end_txn,txn_offset_commit}.cc — all four are
+served by the leader of the transactional id's coordinator partition
+(clients resolve it with FindCoordinator key_type=1); TxnOffsetCommit
+alone goes to the GROUP coordinator, which stages the offsets until
+the tx coordinator delivers the commit marker.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from ..models.fundamental import kafka_ntp
+from .protocol import ErrorCode, Msg
+from .protocol.tx_apis import (
+    ADD_OFFSETS_TO_TXN,
+    ADD_PARTITIONS_TO_TXN,
+    END_TXN,
+    TXN_OFFSET_COMMIT,
+)
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .server import KafkaServer
+
+
+def install(server: "KafkaServer") -> None:
+    h = TxHandlers(server)
+    server._handlers.update(
+        {
+            ADD_PARTITIONS_TO_TXN.key: h.add_partitions_to_txn,
+            ADD_OFFSETS_TO_TXN.key: h.add_offsets_to_txn,
+            END_TXN.key: h.end_txn,
+            TXN_OFFSET_COMMIT.key: h.txn_offset_commit,
+        }
+    )
+
+
+class TxHandlers:
+    def __init__(self, server: "KafkaServer"):
+        self.server = server
+
+    @property
+    def tx(self):
+        return self.server.broker.tx_coordinator
+
+    async def add_partitions_to_txn(self, hdr, req) -> Msg:
+        ntps = []
+        known = self.server.broker.controller.topic_table
+        unknown: set[tuple[str, int]] = set()
+        for t in req.topics:
+            for p in t.partitions:
+                ntp = kafka_ntp(t.name, p)
+                if known.group_of(ntp) is None:
+                    unknown.add((t.name, p))
+                else:
+                    ntps.append(ntp)
+        code = 0
+        if ntps:
+            code = await self.tx.add_partitions(
+                req.transactional_id,
+                req.producer_id,
+                req.producer_epoch,
+                ntps,
+            )
+        return Msg(
+            throttle_time_ms=0,
+            results=[
+                Msg(
+                    name=t.name,
+                    results=[
+                        Msg(
+                            partition_index=p,
+                            error_code=(
+                                int(ErrorCode.unknown_topic_or_partition)
+                                if (t.name, p) in unknown
+                                else code
+                            ),
+                        )
+                        for p in t.partitions
+                    ],
+                )
+                for t in req.topics
+            ],
+        )
+
+    async def add_offsets_to_txn(self, hdr, req) -> Msg:
+        code = await self.tx.add_offsets(
+            req.transactional_id,
+            req.producer_id,
+            req.producer_epoch,
+            req.group_id,
+        )
+        return Msg(throttle_time_ms=0, error_code=code)
+
+    async def end_txn(self, hdr, req) -> Msg:
+        code = await self.tx.end_txn(
+            req.transactional_id,
+            req.producer_id,
+            req.producer_epoch,
+            bool(req.committed),
+        )
+        return Msg(throttle_time_ms=0, error_code=code)
+
+    async def txn_offset_commit(self, hdr, req) -> Msg:
+        def all_errors(code: int) -> Msg:
+            return Msg(
+                throttle_time_ms=0,
+                topics=[
+                    Msg(
+                        name=t.name,
+                        partitions=[
+                            Msg(partition_index=p.partition_index, error_code=code)
+                            for p in t.partitions
+                        ],
+                    )
+                    for t in req.topics
+                ],
+            )
+
+        coordinator = self.server.broker.group_coordinator
+        g, code = await coordinator.get_group(req.group_id, create=True)
+        if code:
+            return all_errors(code)
+        items = [
+            (t.name, p.partition_index, p.committed_offset, p.committed_metadata)
+            for t in req.topics
+            for p in t.partitions
+        ]
+        code = await coordinator.txn_commit_offsets(
+            g, req.producer_id, req.producer_epoch, items
+        )
+        return all_errors(code)

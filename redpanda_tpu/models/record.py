@@ -411,6 +411,7 @@ class RecordBatchBuilder:
         producer_epoch: int = -1,
         base_sequence: int = -1,
         transactional: bool = False,
+        control: bool = False,
         timestamp_ms: int | None = None,
     ):
         self._type = batch_type
@@ -420,6 +421,7 @@ class RecordBatchBuilder:
         self._producer_epoch = producer_epoch
         self._base_sequence = base_sequence
         self._transactional = transactional
+        self._control = control
         self._base_ts = (
             timestamp_ms if timestamp_ms is not None else int(time.time() * 1000)
         )
@@ -456,6 +458,8 @@ class RecordBatchBuilder:
         attrs = int(self._compression) & _COMPRESSION_MASK
         if self._transactional:
             attrs |= _TRANSACTIONAL_BIT
+        if self._control:
+            attrs |= _CONTROL_BIT
         body = (
             compression_mod.compress(raw, self._compression)
             if self._compression != CompressionType.none
