@@ -431,7 +431,9 @@ class TxCoordinator:
 
     async def _complete(self, meta: TxMeta, commit: bool) -> None:
         """Phase 2+3: deliver markers, then clear to EMPTY. Caller
-        holds the tx lock and has already persisted PREPARING_*."""
+        holds the tx lock and has already persisted PREPARING_*.
+        In-memory state mutates only after the EMPTY record is durable
+        — a failed persist must leave memory matching the log."""
         deadline = asyncio.get_event_loop().time() + 10.0
         for ntp in sorted(meta.partitions, key=str):
             await self._marker_to_partition(
@@ -441,11 +443,18 @@ class TxCoordinator:
             await self._marker_to_group(
                 group, meta.pid, meta.epoch, commit, deadline
             )
+        done = dataclasses.replace(
+            meta,
+            status=TX_EMPTY,
+            partitions=set(),
+            groups=set(),
+            update_ms=int(time.time() * 1000),
+        )
+        await self._persist(done)
         meta.status = TX_EMPTY
         meta.partitions = set()
         meta.groups = set()
-        meta.update_ms = int(time.time() * 1000)
-        await self._persist(meta)
+        meta.update_ms = done.update_ms
 
     # -- frontend operations (all coordinator-local) ------------------
     def _check_producer(self, meta: Optional[TxMeta], pid: int, epoch: int) -> int:
@@ -500,11 +509,17 @@ class TxCoordinator:
                     # abort markers land with the new epoch and raise
                     # the fence on every touched partition (KIP-360
                     # bumped-epoch abort; rm_stm fencing)
-                    meta.epoch += 1
-                    meta.status = TX_PREPARING_ABORT
-                    meta.update_ms = now
+                    candidate = dataclasses.replace(
+                        meta,
+                        epoch=meta.epoch + 1,
+                        status=TX_PREPARING_ABORT,
+                        update_ms=now,
+                    )
                     try:
-                        await self._persist(meta)
+                        await self._persist(candidate)
+                        meta.epoch = candidate.epoch
+                        meta.status = candidate.status
+                        meta.update_ms = now
                         await self._complete(meta, commit=False)
                     except (NotLeaderError, ReplicateTimeout, TimeoutError):
                         return -1, -1, int(_E.coordinator_not_available)
@@ -550,14 +565,21 @@ class TxCoordinator:
             if meta.status in (TX_PREPARING_COMMIT, TX_PREPARING_ABORT):
                 return int(_E.concurrent_transactions)
             if meta.partitions.issuperset(ntps) and meta.status == TX_ONGOING:
-                return 0  # idempotent retry
-            meta.partitions.update(ntps)
-            meta.status = TX_ONGOING
-            meta.update_ms = int(time.time() * 1000)
+                return 0  # idempotent retry (of a DURABLE addition —
+                # failed persists below never reach the in-memory set)
+            candidate = dataclasses.replace(
+                meta,
+                partitions=meta.partitions | set(ntps),
+                status=TX_ONGOING,
+                update_ms=int(time.time() * 1000),
+            )
             try:
-                await self._persist(meta)
+                await self._persist(candidate)
             except (NotLeaderError, ReplicateTimeout):
                 return int(_E.not_coordinator)
+            meta.partitions = candidate.partitions
+            meta.status = TX_ONGOING
+            meta.update_ms = candidate.update_ms
             return 0
 
     async def add_offsets(
@@ -576,13 +598,19 @@ class TxCoordinator:
                 return int(_E.concurrent_transactions)
             if group in meta.groups and meta.status == TX_ONGOING:
                 return 0
-            meta.groups.add(group)
-            meta.status = TX_ONGOING
-            meta.update_ms = int(time.time() * 1000)
+            candidate = dataclasses.replace(
+                meta,
+                groups=meta.groups | {group},
+                status=TX_ONGOING,
+                update_ms=int(time.time() * 1000),
+            )
             try:
-                await self._persist(meta)
+                await self._persist(candidate)
             except (NotLeaderError, ReplicateTimeout):
                 return int(_E.not_coordinator)
+            meta.groups = candidate.groups
+            meta.status = TX_ONGOING
+            meta.update_ms = candidate.update_ms
             return 0
 
     async def end_txn(
@@ -612,10 +640,19 @@ class TxCoordinator:
                 except TimeoutError:
                     return int(_E.request_timed_out)
                 return 0
-            meta.status = TX_PREPARING_COMMIT if commit else TX_PREPARING_ABORT
-            meta.update_ms = int(time.time() * 1000)
+            # the decision must be durable BEFORE any marker exists —
+            # and before the in-memory status says so (a retry against
+            # un-logged PREPARING state would deliver markers for a
+            # decision a failover could reverse)
+            candidate = dataclasses.replace(
+                meta,
+                status=TX_PREPARING_COMMIT if commit else TX_PREPARING_ABORT,
+                update_ms=int(time.time() * 1000),
+            )
             try:
-                await self._persist(meta)
+                await self._persist(candidate)
+                meta.status = candidate.status
+                meta.update_ms = candidate.update_ms
                 await self._complete(meta, commit)
             except (NotLeaderError, ReplicateTimeout):
                 return int(_E.not_coordinator)
@@ -636,6 +673,14 @@ class TxCoordinator:
                     )
                     if p is None or not p.is_leader:
                         continue
+                    # the in-memory shard is authoritative only for the
+                    # term it was replayed in — after regaining
+                    # leadership it is STALE until a frontend op runs
+                    # _ensure_replayed, and acting on it here would
+                    # abort transactions a newer leader already moved
+                    # forward
+                    if self._replayed.get(pid) != p.consensus.term:
+                        continue
                     for meta in list(shard.values()):
                         if (
                             meta.status == TX_ONGOING
@@ -654,11 +699,17 @@ class TxCoordinator:
                                     continue
                                 # bumped-epoch abort: the markers fence
                                 # the expired producer's stragglers
-                                meta.epoch += 1
-                                meta.status = TX_PREPARING_ABORT
-                                meta.update_ms = now
+                                candidate = dataclasses.replace(
+                                    meta,
+                                    epoch=meta.epoch + 1,
+                                    status=TX_PREPARING_ABORT,
+                                    update_ms=now,
+                                )
                                 try:
-                                    await self._persist(meta)
+                                    await self._persist(candidate)
+                                    meta.epoch = candidate.epoch
+                                    meta.status = candidate.status
+                                    meta.update_ms = now
                                     await self._complete(meta, commit=False)
                                 except Exception:
                                     logger.exception(

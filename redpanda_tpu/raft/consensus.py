@@ -95,18 +95,19 @@ class Consensus:
 
         self.row = arrays.alloc_row()
         self.role = Role.FOLLOWER
-        self.leader_id: Optional[int] = None
         self._voted_for: Optional[int] = None
         self._slot_map: dict[int, int] = {}
         self._next_index: dict[int, int] = {}
         self._peer_locks: dict[int, asyncio.Lock] = {}
-        self._last_heartbeat = 0.0
         self._commit_event = asyncio.Event()
         self._leadership_waiters: list[asyncio.Event] = []
         self._timer_task: Optional[asyncio.Task] = None
         self._bg_tasks: set[asyncio.Task] = set()
         self._append_lock = asyncio.Lock()  # append_entries_buffer analog
         self._vote_lock = asyncio.Lock()
+        # fired on role/config/slot changes so the heartbeat manager
+        # can invalidate its cached per-peer build plan
+        self.on_topology_change: list = []
         # (offset, config) of every config batch in the log — lets
         # truncation roll the active config back (reference:
         # raft/configuration_manager.{h,cc} persisted history)
@@ -151,6 +152,12 @@ class Consensus:
         moment they are APPENDED, not committed (consensus.cc applies
         via configuration_manager at append) — otherwise followers keep
         voting with a stale voter set after the leader reconfigures."""
+        if batch.header.term >= 0:
+            # keep the term-boundary mirror current (O(1); feeds the
+            # batched heartbeat build's vectorized term_at)
+            self.arrays.tb_note_append(
+                self.row, batch.header.base_offset, batch.header.term
+            )
         if batch.header.type != RecordBatchType.raft_configuration:
             return
         for rec in batch.records():
@@ -186,7 +193,29 @@ class Consensus:
         if self._config_history:
             self.config = self._config_history[-1][1]
 
+    def _sync_term_bounds(self) -> None:
+        """Rebuild the row's term-boundary + log-offset mirrors from
+        the log and the snapshot boundary (start, truncation, prefix
+        truncation, snapshot install)."""
+        bounds: list[tuple[int, int]] = []
+        if self._snap_index >= 0:
+            bounds.append((self._snap_index, self._snap_term))
+        for start, term in self.log.term_boundaries():
+            if not bounds or term > bounds[-1][1]:
+                bounds.append((start, term))
+        self.arrays.tb_set(self.row, bounds)
+        self.arrays.log_start[self.row] = self.log.offsets().start_offset
+        self.arrays.snap_index[self.row] = self._snap_index
+
+    def _observe_prefix_truncate(self, _new_start: int) -> None:
+        self._sync_term_bounds()
+
+    def _notify_topology(self) -> None:
+        for fn in self.on_topology_change:
+            fn()
+
     def _observe_truncate(self, offset: int) -> None:
+        self._sync_term_bounds()
         changed = False
         while self._config_history and self._config_history[-1][0] >= offset:
             self._config_history.pop()
@@ -259,6 +288,7 @@ class Consensus:
             self.arrays.flushed_index[row, slot] = int(NO_OFFSET)
             self.arrays.last_seq[row, slot] = 0
             self.arrays.next_seq[row, slot] = 0
+        self._notify_topology()
 
     def _load_snapshot(self) -> None:
         """Hydrate snapshot state on restart. If the log is behind the
@@ -307,6 +337,8 @@ class Consensus:
         self._hydrate_config_history()
         self.log.on_append.append(self._observe_append)
         self.log.on_truncate.append(self._observe_truncate)
+        self.log.on_prefix_truncate.append(self._observe_prefix_truncate)
+        self._sync_term_bounds()
         self._rebuild_slots()
         offs = self.log.offsets()
         row = self.row
@@ -331,9 +363,31 @@ class Consensus:
             self.log.on_append.remove(self._observe_append)
         if self._observe_truncate in self.log.on_truncate:
             self.log.on_truncate.remove(self._observe_truncate)
+        if self._observe_prefix_truncate in self.log.on_prefix_truncate:
+            self.log.on_prefix_truncate.remove(self._observe_prefix_truncate)
         self._notify_commit()  # release waiters
 
     # ------------------------------------------------------ properties
+    # hot per-group scalars live as lanes in the shard SoA so the
+    # node-batched heartbeat service can read/write them for every
+    # group with one vector op (service.py heartbeat fast path)
+    @property
+    def leader_id(self) -> Optional[int]:
+        v = int(self.arrays.leader_id[self.row])
+        return None if v < 0 else v
+
+    @leader_id.setter
+    def leader_id(self, v: Optional[int]) -> None:
+        self.arrays.leader_id[self.row] = -1 if v is None else int(v)
+
+    @property
+    def _last_heartbeat(self) -> float:
+        return float(self.arrays.last_hb[self.row])
+
+    @_last_heartbeat.setter
+    def _last_heartbeat(self, v: float) -> None:
+        self.arrays.last_hb[self.row] = v
+
     @property
     def kvstore(self) -> KvStore:
         return self._kvstore
@@ -495,6 +549,7 @@ class Consensus:
         logger.info(
             "g%d: node %d elected leader term %d", self.group_id, self.node_id, self.term
         )
+        self._notify_topology()
         for ev in self._leadership_waiters:
             ev.set()
         # establish leadership immediately
@@ -507,10 +562,13 @@ class Consensus:
             self.arrays.term[row] = term
             self._voted_for = None
             self._persist_vote_state()
-        if self.role == Role.LEADER:
+        was_leader = self.role == Role.LEADER
+        if was_leader:
             logger.info("g%d: node %d stepping down term %d", self.group_id, self.node_id, term)
         self.role = Role.FOLLOWER
         self.arrays.is_leader[row] = False
+        if was_leader:
+            self._notify_topology()
         self._notify_commit()  # wake replicate waiters → they fail fast
 
     async def wait_for_leadership(self, timeout: float = 5.0) -> None:
@@ -1057,6 +1115,7 @@ class Consensus:
         )
         self.log.install_snapshot_reset(snap_idx + 1, snap_term)
         self._snap_index, self._snap_term = snap_idx, snap_term
+        self._sync_term_bounds()
         cfg = GroupConfiguration.decode(meta.config)
         self._config_history = []
         self._initial_config = cfg

@@ -6,10 +6,17 @@ into one RPC (heartbeat_manager.h:54-83) but still builds and folds
 them with per-group scalar loops (heartbeat_manager.cc:203). Here both
 directions are array programs over the shard SoA:
 
-  build:  numpy gathers over [G] state → per-node parallel vectors
+  build:  a CACHED per-peer plan (rows/slots arrays, invalidated on
+          role/config changes via Consensus.on_topology_change) turns
+          the steady-state build into a handful of numpy gathers —
+          seq increment, match/term/commit reads and the prev-term
+          lookup (term-boundary mirror, shard_state.term_at_batch)
+          are all vectorized; no per-group log walks on the tick.
   fold:   ONE jitted device call (ops.quorum.heartbeat_tick_jit) folds
           every reply from every node AND advances every group's
-          commit index (the north-star kernel; bench.py measures it)
+          commit index (the north-star kernel; bench.py measures it).
+          Replies aligned with the request (the common case) fold via
+          vector ops; stragglers take the per-entry slow path.
 
 Leaders whose followers lag (match < dirty) get a catch-up fiber
 scheduled — the recovery_stm hand-off.
@@ -25,10 +32,25 @@ import numpy as np
 
 from . import types as rt
 from .consensus import Consensus, Role
+from ..models.consensus_state import SELF_SLOT
 
 logger = logging.getLogger("raft.heartbeat")
 
 SendFn = Callable[[int, int, bytes, float], Awaitable[bytes]]
+
+
+class _PeerPlan:
+    """Precomputed build vectors for one target node."""
+
+    __slots__ = ("rows", "slots", "gids", "gids_arr", "cons", "pos_by_gid")
+
+    def __init__(self, pairs: list[tuple[Consensus, int]]):
+        self.rows = np.array([c.row for c, _ in pairs], np.int64)
+        self.slots = np.array([s for _, s in pairs], np.int64)
+        self.gids = [c.group_id for c, _ in pairs]
+        self.gids_arr = np.array(self.gids, np.int64)
+        self.cons = [c for c, _ in pairs]
+        self.pos_by_gid = {g: i for i, g in enumerate(self.gids)}
 
 
 class HeartbeatManager:
@@ -44,14 +66,27 @@ class HeartbeatManager:
         self.interval = interval_s
         self._rpc_timeout = rpc_timeout_s
         self._groups: dict[int, Consensus] = {}
+        self._by_row: dict[int, Consensus] = {}
+        self._plan: Optional[dict[int, _PeerPlan]] = None
         self._task: Optional[asyncio.Task] = None
         self._closed = False
 
     def register(self, c: Consensus) -> None:
         self._groups[c.group_id] = c
+        self._by_row[c.row] = c
+        c.on_topology_change.append(self._invalidate_plan)
+        self._plan = None
 
     def deregister(self, group_id: int) -> None:
-        self._groups.pop(group_id, None)
+        c = self._groups.pop(group_id, None)
+        if c is not None:
+            self._by_row.pop(c.row, None)
+            if self._invalidate_plan in c.on_topology_change:
+                c.on_topology_change.remove(self._invalidate_plan)
+        self._plan = None
+
+    def _invalidate_plan(self) -> None:
+        self._plan = None
 
     async def start(self) -> None:
         self._task = asyncio.ensure_future(self._loop())
@@ -73,44 +108,56 @@ class HeartbeatManager:
                 logger.exception("heartbeat tick failed")
             await asyncio.sleep(self.interval)
 
-    async def tick(self) -> None:
-        """One sweep: build per-node batches, send in parallel, fold
-        ALL replies with one device call."""
-        leaders = [c for c in self._groups.values() if c.role == Role.LEADER]
-        if not leaders:
-            return
-        # build per-target-node vectors (build_heartbeats analog)
-        per_node: dict[int, list[Consensus]] = {}
-        for c in leaders:
+    def _build_plan(self) -> dict[int, _PeerPlan]:
+        per_node: dict[int, list[tuple[Consensus, int]]] = {}
+        for c in self._groups.values():
+            if c.role != Role.LEADER:
+                continue
             for peer in c.peers():
-                per_node.setdefault(peer, []).append(c)
+                slot = c._slot_map.get(peer)
+                if slot is not None:
+                    per_node.setdefault(peer, []).append((c, slot))
+        return {peer: _PeerPlan(pairs) for peer, pairs in per_node.items()}
 
-        prev_sent: dict[tuple[int, int], int] = {}  # (gid, peer) → prev
+    async def tick(self) -> None:
+        """One sweep: vector-build per-node batches from the SoA, send
+        in parallel, fold ALL replies with one device call."""
+        if self._plan is None:
+            self._plan = self._build_plan()
+        plan = self._plan
+        if not plan:
+            return
+        arrays = next(iter(self._groups.values())).arrays
 
-        async def one_node(peer: int, groups: list[Consensus]):
-            reqs = []
-            for c in groups:
-                row, slot = c.row, c._slot_map[peer]
-                seq = int(c.arrays.next_seq[row, slot]) + 1
-                c.arrays.next_seq[row, slot] = seq
-                prev = int(c.arrays.match_index[row, slot])
-                prev_term = c.term_at(prev) if prev >= 0 else -1
-                if prev_term is None:
-                    prev_term = -1
-                prev_sent[(c.group_id, peer)] = prev
-                reqs.append(
-                    (c.group_id, c.term, prev, prev_term, c.commit_index, seq)
-                )
+        # vector build per peer (build_heartbeats analog): seqs, prevs,
+        # terms, commits and prev-terms in a handful of gathers
+        sent: dict[int, tuple[_PeerPlan, np.ndarray, np.ndarray]] = {}
+        for peer, p in plan.items():
+            arrays.next_seq[p.rows, p.slots] += 1
+            seqs = arrays.next_seq[p.rows, p.slots]
+            prevs = arrays.match_index[p.rows, p.slots]
+            terms = arrays.term[p.rows]
+            commits = arrays.commit_index[p.rows]
+            prev_terms, known = arrays.term_at_batch(p.rows, prevs)
+            if not known.all():
+                # rare laggards below the mirrored boundary window:
+                # per-group log walk fallback
+                for i in np.flatnonzero(~known):
+                    t = p.cons[i].term_at(int(prevs[i]))
+                    prev_terms[i] = t if t is not None else -1
             msg = rt.HeartbeatRequest(
                 node_id=self.node_id,
                 target_node_id=peer,
-                groups=[r[0] for r in reqs],
-                terms=[r[1] for r in reqs],
-                prev_log_indices=[r[2] for r in reqs],
-                prev_log_terms=[r[3] for r in reqs],
-                commit_indices=[r[4] for r in reqs],
-                seqs=[r[5] for r in reqs],
+                groups=p.gids,
+                terms=terms.tolist(),
+                prev_log_indices=prevs.tolist(),
+                prev_log_terms=prev_terms.tolist(),
+                commit_indices=commits.tolist(),
+                seqs=seqs.tolist(),
             ).encode()
+            sent[peer] = (p, prevs, seqs, msg)
+
+        async def one_node(peer: int, msg: bytes):
             try:
                 raw = await self._send(peer, rt.HEARTBEAT, msg, self._rpc_timeout)
                 return peer, rt.HeartbeatReply.decode(raw)
@@ -118,69 +165,127 @@ class HeartbeatManager:
                 return peer, None
 
         results = await asyncio.gather(
-            *(one_node(p, gs) for p, gs in per_node.items())
+            *(one_node(peer, entry[3]) for peer, entry in sent.items())
         )
+
         # fold: flatten every successful reply into one batch
-        rows, slots, dirty, flushed, seqs = [], [], [], [], []
+        rows_acc: list[np.ndarray] = []
+        slots_acc: list[np.ndarray] = []
+        dirty_acc: list[np.ndarray] = []
+        flushed_acc: list[np.ndarray] = []
+        seqs_acc: list[np.ndarray] = []
         for peer, reply in results:
             if reply is None:
                 continue
-            for i, gid in enumerate(reply.groups):
-                c = self._groups.get(gid)
-                if c is None or c.role != Role.LEADER:
-                    continue
-                slot = c._slot_map.get(peer)
-                if slot is None:
-                    continue
-                if reply.statuses[i] != rt.AppendEntriesReply.SUCCESS:
-                    if reply.terms[i] > c.term:
-                        c._step_down(int(reply.terms[i]))
-                    elif reply.statuses[i] == rt.AppendEntriesReply.FAILURE:
-                        # log-mismatch/gap rejection: our match estimate
-                        # is wrong (e.g. follower lost its tail). Rewind
-                        # it host-side so the catch-up fiber engages —
-                        # the device fold is monotone and cannot.
-                        # (GROUP_UNAVAILABLE is NOT a mismatch: the
-                        # group isn't constructed there yet; rewinding
-                        # would force a pointless re-replication from 0.)
-                        c.arrays.match_index[c.row, slot] = min(
-                            int(c.arrays.match_index[c.row, slot]),
-                            int(reply.last_dirty[i]),
-                        )
-                        c._spawn(c._catch_up(peer))
-                    continue
-                # a heartbeat SUCCESS only proves the follower's log
-                # matches ours up to the prev we sent — its entries
-                # beyond prev are unverified (possibly a divergent
-                # suffix) and must not count toward quorum. Real
-                # appends advance match through the verified
-                # _dispatch_append path instead.
-                cap = prev_sent.get((gid, peer), -1)
-                d = min(int(reply.last_dirty[i]), cap)
-                rows.append(c.row)
-                slots.append(slot)
-                dirty.append(d)
-                flushed.append(min(int(reply.last_flushed[i]), d))
-                seqs.append(reply.seqs[i])
-        if not rows:
-            return  # no successful replies: the sweep cannot advance
-        arrays = leaders[0].arrays
-        advanced = arrays.device_tick(
-            np.array(rows, np.int64),
-            np.array(slots, np.int64),
-            np.array(dirty, np.int64),
-            np.array(flushed, np.int64),
-            np.array(seqs, np.int64),
-        )
-        if len(advanced):
-            advanced_set = set(int(r) for r in advanced)
-            for c in self._groups.values():
-                if c.row in advanced_set:
-                    c.on_batched_commit_advance()
-        # recovery: schedule catch-up for lagging followers
-        for c in leaders:
-            if c.role != Role.LEADER:
+            entry = sent.get(peer)
+            if entry is None:
                 continue
-            for peer in c.peers():
-                if c._follower_needs_data(peer):
+            p, prevs, seqs, _msg = entry
+            r_groups = np.asarray(reply.groups, np.int64)
+            statuses = np.asarray(reply.statuses, np.int64)
+            # the fast path indexes through the plan's row/slot vectors,
+            # which is only sound while the plan is still current — a
+            # topology change during the RPC gather (reconfig moving a
+            # peer to a different slot) sends stragglers down the
+            # per-entry path with fresh slot lookups
+            aligned = (
+                self._plan is plan
+                and len(r_groups) == len(p.gids_arr)
+                and bool((r_groups == p.gids_arr).all())
+            )
+            if aligned:
+                still_leader = arrays.is_leader[p.rows]
+                ok = (statuses == rt.AppendEntriesReply.SUCCESS) & still_leader
+                if ok.any():
+                    # heartbeat SUCCESS only proves the follower
+                    # matches up to the prev we sent: cap at prev
+                    d = np.minimum(
+                        np.asarray(reply.last_dirty, np.int64), prevs
+                    )
+                    f = np.minimum(np.asarray(reply.last_flushed, np.int64), d)
+                    rows_acc.append(p.rows[ok])
+                    slots_acc.append(p.slots[ok])
+                    dirty_acc.append(d[ok])
+                    flushed_acc.append(f[ok])
+                    seqs_acc.append(np.asarray(reply.seqs, np.int64)[ok])
+                bad = np.flatnonzero(
+                    (statuses != rt.AppendEntriesReply.SUCCESS) & still_leader
+                )
+                for i in bad:
+                    self._handle_failure(p.cons[int(i)], peer, reply, int(i))
+            else:
+                # misaligned reply (defensive): per-entry slow path
+                for i, gid in enumerate(reply.groups):
+                    pos = p.pos_by_gid.get(gid)
+                    c = self._groups.get(gid)
+                    if pos is None or c is None or c.role != Role.LEADER:
+                        continue
+                    if reply.statuses[i] != rt.AppendEntriesReply.SUCCESS:
+                        self._handle_failure(c, peer, reply, i)
+                        continue
+                    slot = c._slot_map.get(peer)
+                    if slot is None:
+                        continue
+                    cap = int(prevs[pos])
+                    d = min(int(reply.last_dirty[i]), cap)
+                    rows_acc.append(np.array([c.row], np.int64))
+                    slots_acc.append(np.array([slot], np.int64))
+                    dirty_acc.append(np.array([d], np.int64))
+                    flushed_acc.append(
+                        np.array([min(int(reply.last_flushed[i]), d)], np.int64)
+                    )
+                    seqs_acc.append(np.array([int(reply.seqs[i])], np.int64))
+        if not rows_acc:
+            return  # no successful replies: the sweep cannot advance
+        advanced = arrays.device_tick(
+            np.concatenate(rows_acc),
+            np.concatenate(slots_acc),
+            np.concatenate(dirty_acc),
+            np.concatenate(flushed_acc),
+            np.concatenate(seqs_acc),
+        )
+        for r in advanced:
+            c = self._by_row.get(int(r))
+            if c is not None:
+                c.on_batched_commit_advance()
+        # recovery: schedule catch-up for lagging followers, found with
+        # one vector compare per peer (match/flushed vs leader dirty)
+        for peer, p in plan.items():
+            lag = (
+                arrays.is_leader[p.rows]
+                & (
+                    (
+                        arrays.match_index[p.rows, p.slots]
+                        < arrays.match_index[p.rows, SELF_SLOT]
+                    )
+                    | (
+                        arrays.flushed_index[p.rows, p.slots]
+                        < arrays.match_index[p.rows, p.slots]
+                    )
+                )
+            )
+            for i in np.flatnonzero(lag):
+                c = p.cons[int(i)]
+                if c.role == Role.LEADER:
                     c._spawn(c._catch_up(peer))
+
+    def _handle_failure(
+        self, c: Consensus, peer: int, reply: rt.HeartbeatReply, i: int
+    ) -> None:
+        if reply.terms[i] > c.term:
+            c._step_down(int(reply.terms[i]))
+        elif reply.statuses[i] == rt.AppendEntriesReply.FAILURE:
+            # log-mismatch/gap rejection: our match estimate is wrong
+            # (e.g. follower lost its tail). Rewind it host-side so the
+            # catch-up fiber engages — the device fold is monotone and
+            # cannot. (GROUP_UNAVAILABLE is NOT a mismatch: the group
+            # isn't constructed there yet; rewinding would force a
+            # pointless re-replication from 0.)
+            slot = c._slot_map.get(peer)
+            if slot is None:
+                return
+            c.arrays.match_index[c.row, slot] = min(
+                int(c.arrays.match_index[c.row, slot]),
+                int(reply.last_dirty[i]),
+            )
+            c._spawn(c._catch_up(peer))

@@ -54,38 +54,107 @@ class RaftService(Service):
 
     @method(rt.HEARTBEAT)
     async def heartbeat(self, payload: bytes) -> bytes:
+        """Answer the whole node-batch with vector ops over the shard
+        SoA — the follower half of the batched sweep. Mirrors
+        Consensus.handle_heartbeat exactly; groups that need state
+        transitions the arrays can't express (term bumps/step-downs,
+        term lookups below the mirrored boundary window) drop to the
+        per-group scalar path."""
+        import asyncio
+
+        import numpy as np
+
+        from ..models.consensus_state import SELF_SLOT
+        from .consensus import Role
+
         req = rt.HeartbeatRequest.decode(payload)
-        terms, dirty, flushed, seqs, statuses = [], [], [], [], []
-        for i, gid in enumerate(req.groups):
-            c = self._consensus(int(gid))
-            if c is None:
-                terms.append(-1)
-                dirty.append(-1)
-                flushed.append(-1)
-                seqs.append(int(req.seqs[i]))
-                statuses.append(rt.AppendEntriesReply.GROUP_UNAVAILABLE)
-                continue
-            t, d, f, s, st = c.handle_heartbeat(
-                int(req.node_id),
-                int(req.terms[i]),
-                int(req.prev_log_indices[i]),
-                int(req.prev_log_terms[i]),
-                int(req.commit_indices[i]),
-                int(req.seqs[i]),
+        gm = self._gm
+        arrays = gm.arrays
+        n = len(req.groups)
+        cons = [gm.get(int(g)) for g in req.groups]
+        rows = np.fromiter(
+            (c.row if c is not None else -1 for c in cons), np.int64, n
+        )
+        avail = rows >= 0
+        r = np.where(avail, rows, 0)
+        t_req = np.asarray(req.terms, np.int64)
+        prevs = np.asarray(req.prev_log_indices, np.int64)
+        pterms = np.asarray(req.prev_log_terms, np.int64)
+        lcommits = np.asarray(req.commit_indices, np.int64)
+
+        my_term = arrays.term[r]
+        dirty_out = np.where(avail, arrays.match_index[r, SELF_SLOT], -1)
+        flushed_out = np.where(avail, arrays.flushed_index[r, SELF_SLOT], -1)
+        terms_out = np.where(avail, my_term, -1)
+        statuses = np.full(n, rt.AppendEntriesReply.GROUP_UNAVAILABLE, np.int64)
+
+        follower = np.fromiter(
+            (c is not None and c.role is Role.FOLLOWER for c in cons), bool, n
+        )
+        tb_terms, known = arrays.term_at_batch(r, prevs)
+        in_log = (prevs >= 0) & (
+            (prevs >= arrays.log_start[r]) | (prevs == arrays.snap_index[r])
+        )
+        # scalar-path groups: term bump / step-down needed, or the
+        # prev-term answer lies below the mirrored boundary window
+        slow = avail & (
+            (t_req > my_term)
+            | (~follower & (t_req >= my_term))
+            | (in_log & ~known)
+        )
+        fast = avail & ~slow
+        stale = fast & (t_req < my_term)
+        statuses[stale] = rt.AppendEntriesReply.FAILURE
+        live = fast & ~stale  # term == my_term, role FOLLOWER
+        if live.any():
+            now = asyncio.get_event_loop().time()
+            lr = r[live]
+            arrays.last_hb[lr] = now
+            arrays.leader_id[lr] = int(req.node_id)
+        gap = live & (prevs > dirty_out)
+        mismatch = live & in_log & known & (tb_terms != pterms)
+        bad = gap | mismatch
+        statuses[bad] = rt.AppendEntriesReply.FAILURE
+        ok = live & ~bad
+        statuses[ok] = rt.AppendEntriesReply.SUCCESS
+        # follower commit rule (qs.follower_commit_index), Raft §5.3:
+        # only the prefix confirmed identical to the leader may commit
+        capped = np.where(prevs >= 0, np.minimum(lcommits, prevs), -1)
+        my_commit = arrays.commit_index[r]
+        proposed = np.minimum(capped, flushed_out)
+        adv = ok & (capped > my_commit) & (proposed > my_commit)
+        if adv.any():
+            idxs = np.flatnonzero(adv)
+            ar = r[idxs]
+            arrays.commit_index[ar] = proposed[idxs]
+            arrays.last_visible[ar] = np.maximum(
+                arrays.last_visible[ar], proposed[idxs]
             )
-            terms.append(t)
-            dirty.append(d)
-            flushed.append(f)
-            seqs.append(s)
-            statuses.append(st)
+            for i in idxs:
+                cons[int(i)]._notify_commit()
+        seqs = [int(s) for s in req.seqs]
+        for i in np.flatnonzero(slow):
+            i = int(i)
+            t, d, f, _s, st = cons[i].handle_heartbeat(
+                int(req.node_id),
+                int(t_req[i]),
+                int(prevs[i]),
+                int(pterms[i]),
+                int(lcommits[i]),
+                seqs[i],
+            )
+            terms_out[i] = t
+            dirty_out[i] = d
+            flushed_out[i] = f
+            statuses[i] = st
         return rt.HeartbeatReply(
-            node_id=self._gm.node_id,
+            node_id=gm.node_id,
             groups=list(req.groups),
-            terms=terms,
-            last_dirty=dirty,
-            last_flushed=flushed,
+            terms=terms_out.tolist(),
+            last_dirty=dirty_out.tolist(),
+            last_flushed=flushed_out.tolist(),
             seqs=seqs,
-            statuses=statuses,
+            statuses=statuses.tolist(),
         ).encode()
 
     @method(rt.INSTALL_SNAPSHOT)

@@ -116,6 +116,19 @@ class Log:
                 return seg.term
         return None
 
+    def term_boundaries(self) -> list[tuple[int, int]]:
+        """Ascending (first_offset, term) pairs — the per-term start
+        offsets (segments roll on term change, so the first segment of
+        each term marks the boundary). Feeds the shard-array
+        term-boundary mirror used by the batched heartbeat build."""
+        out: list[tuple[int, int]] = []
+        for seg in self._segments:
+            if seg.dirty_offset < seg.base_offset:
+                continue  # empty tail segment
+            if not out or seg.term != out[-1][1]:
+                out.append((seg.base_offset, seg.term))
+        return out
+
     # -- append ------------------------------------------------------
     def append(self, batch: RecordBatch, term: int | None = None) -> tuple[int, int]:
         """Assign offsets and append; returns (base, last) offsets.

@@ -97,7 +97,28 @@ def optional(t: SerdeType) -> SerdeType:
     return SerdeType(enc, dec)
 
 
+_FIXED_FMT = {}  # SerdeType -> struct letter, filled after the fixed defs
+
+
 def vector(t: SerdeType) -> SerdeType:
+    # bulk fast path for fixed-width scalars: one struct call for the
+    # whole vector instead of one per element. Node-batched heartbeats
+    # carry 5 such vectors of one entry per raft group — the per-item
+    # path was the top profile line at 5k groups/node.
+    letter = _FIXED_FMT.get(t)
+    if letter is not None:
+        item = struct.Struct("<" + letter)
+
+        def enc_fast(out: bytearray, v: Any) -> None:
+            out += struct.pack("<I", len(v))
+            out += struct.pack(f"<{len(v)}{letter}", *v)
+
+        def dec_fast(p: IOBufParser) -> list:
+            (n,) = struct.unpack("<I", p.read(4))
+            return list(struct.unpack(f"<{n}{letter}", p.read(n * item.size)))
+
+        return SerdeType(enc_fast, dec_fast)
+
     def enc(out: bytearray, v: Any) -> None:
         out += struct.pack("<I", len(v))
         for item in v:
@@ -108,6 +129,21 @@ def vector(t: SerdeType) -> SerdeType:
         return [t.decode(p) for _ in range(n)]
 
     return SerdeType(enc, dec)
+
+
+_FIXED_FMT.update(
+    {
+        i8: "b",
+        u8: "B",
+        i16: "h",
+        u16: "H",
+        i32: "i",
+        u32: "I",
+        i64: "q",
+        u64: "Q",
+        f64: "d",
+    }
+)
 
 
 def mapping(kt: SerdeType, vt: SerdeType) -> SerdeType:

@@ -294,3 +294,63 @@ class TestClusterStep:
         state, total2 = tick(state, zero)
         assert int(total2) == 0
         assert np.all(np.asarray(state.fol_commit) == 5)
+
+
+class TestHostDeviceTickParity:
+    """The numpy host fold (shard_state.host_tick) must be bit-identical
+    to the compiled device sweep (ops.quorum.heartbeat_tick) — the
+    backend choice is a pure performance decision."""
+
+    def test_differential_random(self):
+        import numpy as np
+
+        from redpanda_tpu.raft.shard_state import ShardGroupArrays
+
+        rng = np.random.default_rng(7)
+        for trial in range(5):
+            g, r = 64, 8
+            mk = lambda: ShardGroupArrays(capacity=g, replica_slots=r)
+            a_host, a_dev = mk(), mk()
+            # random-but-valid state, mirrored into both
+            for arrs in (a_host, a_dev):
+                arrs.is_leader[:] = rng.random(g) < 0.7
+                nv = rng.integers(1, 4, g)
+                for row in range(g):
+                    arrs.is_voter[row, : 2 * nv[row] + 1] = True
+                    if rng.random() < 0.2:
+                        arrs.is_voter_old[row, : 2 * nv[row] - 1] = True
+                arrs.match_index[:] = rng.integers(-1, 50, (g, r))
+                arrs.flushed_index[:] = np.minimum(
+                    arrs.match_index, rng.integers(-1, 50, (g, r))
+                )
+                arrs.commit_index[:] = rng.integers(-1, 10, g)
+                arrs.term_start[:] = rng.integers(0, 5, g)
+                arrs.last_visible[:] = arrs.commit_index
+                arrs.last_seq[:] = rng.integers(0, 3, (g, r))
+            # identical state in both (copy from host arrays)
+            for name in ("is_leader", "is_voter", "is_voter_old",
+                         "match_index", "flushed_index", "commit_index",
+                         "term_start", "last_visible", "last_seq"):
+                getattr(a_dev, name)[:] = getattr(a_host, name)
+
+            m = 96
+            rows = rng.integers(0, g, m).astype(np.int64)
+            slots = rng.integers(1, r, m).astype(np.int64)
+            dirty = rng.integers(-1, 60, m).astype(np.int64)
+            flushed = np.minimum(dirty, rng.integers(-1, 60, m)).astype(np.int64)
+            seqs = rng.integers(0, 6, m).astype(np.int64)
+
+            adv_h = a_host.host_tick(rows, slots, dirty, flushed, seqs)
+            import os
+            os.environ["RP_QUORUM_BACKEND"] = "device"
+            try:
+                adv_d = a_dev.device_tick(rows, slots, dirty, flushed, seqs)
+            finally:
+                del os.environ["RP_QUORUM_BACKEND"]
+
+            assert np.array_equal(adv_h, adv_d), f"trial {trial}"
+            for name in ("match_index", "flushed_index", "commit_index",
+                         "last_visible", "last_seq"):
+                assert np.array_equal(
+                    getattr(a_host, name), getattr(a_dev, name)
+                ), f"trial {trial}: {name} diverged"
