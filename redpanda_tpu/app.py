@@ -54,6 +54,11 @@ class BrokerConfig:
     peer_kafka_addresses: Optional[dict[int, tuple[str, int]]] = None
     election_timeout_s: float = 0.3
     heartbeat_interval_s: float = 0.05
+    # SASL/SCRAM authentication on the kafka listener; when on,
+    # authorization (ACLs) is enforced too unless overridden
+    enable_sasl: bool = False
+    enable_authorization: Optional[bool] = None  # None = follow enable_sasl
+    superusers: Optional[list[str]] = None
 
 
 class Broker:
@@ -104,6 +109,7 @@ class Broker:
             config.members,
             send,
         )
+        self.controller.authorizer.superusers = set(config.superusers or [])
         self.leaders = PartitionLeadersTable()
         self.metadata_cache = MetadataCache(
             self.controller.topic_table, self.partition_manager, self.leaders

@@ -26,6 +26,7 @@ class CmdType(enum.IntEnum):
     delete_acls = 7
     config_set = 8
     allocate_producer_id = 9
+    create_partitions = 10
 
 
 class PartitionAssignmentE(serde.Envelope):
@@ -65,10 +66,75 @@ class AllocateProducerIdCmd(serde.Envelope):
     SERDE_FIELDS = []
 
 
+class UpdateTopicConfigCmd(serde.Envelope):
+    """Topic config overrides (reference: update_topic_properties_cmd).
+    `set_configs` merge in; names in `remove_configs` revert to
+    defaults."""
+
+    SERDE_FIELDS = [
+        ("ns", serde.string),
+        ("topic", serde.string),
+        ("set_configs", serde.mapping(serde.string, serde.optional(serde.string))),
+        ("remove_configs", serde.vector(serde.string)),
+    ]
+
+
+class CreatePartitionsCmd(serde.Envelope):
+    """Grow a topic's partition count (create_partition_cmd)."""
+
+    SERDE_FIELDS = [
+        ("ns", serde.string),
+        ("topic", serde.string),
+        ("new_total", serde.i32),
+        ("assignments", serde.vector(PartitionAssignmentE.serde())),
+    ]
+
+
+class CreateUserCmd(serde.Envelope):
+    """SCRAM credential upsert (user_management_cmd). `credential` is
+    an encoded security.scram._CredentialE."""
+
+    SERDE_FIELDS = [
+        ("user", serde.string),
+        ("credential", serde.bytes_t),
+    ]
+
+
+class DeleteUserCmd(serde.Envelope):
+    SERDE_FIELDS = [("user", serde.string)]
+
+
+class CreateAclsCmd(serde.Envelope):
+    """Bindings are encoded security.acl.AclBindingE envelopes."""
+
+    SERDE_FIELDS = [("bindings", serde.vector(serde.bytes_t))]
+
+
+class DeleteAclsCmd(serde.Envelope):
+    """Filter fields mirror security.acl.AclFilter; empty string for
+    name/principal/host means 'any'."""
+
+    SERDE_FIELDS = [
+        ("resource_type", serde.u8),
+        ("pattern_type", serde.u8),
+        ("resource_name", serde.optional(serde.string)),
+        ("principal", serde.optional(serde.string)),
+        ("host", serde.optional(serde.string)),
+        ("operation", serde.u8),
+        ("permission", serde.u8),
+    ]
+
+
 CMD_CLASSES = {
     CmdType.create_topic: CreateTopicCmd,
     CmdType.delete_topic: DeleteTopicCmd,
     CmdType.allocate_producer_id: AllocateProducerIdCmd,
+    CmdType.update_topic: UpdateTopicConfigCmd,
+    CmdType.create_partitions: CreatePartitionsCmd,
+    CmdType.create_user: CreateUserCmd,
+    CmdType.delete_user: DeleteUserCmd,
+    CmdType.create_acls: CreateAclsCmd,
+    CmdType.delete_acls: DeleteAclsCmd,
 }
 
 

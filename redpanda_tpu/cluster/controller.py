@@ -27,12 +27,21 @@ from ..raft.state_machine import StateMachine
 from ..rpc.server import Service, method
 from ..utils import serde
 from .allocator import AllocationError, PartitionAllocator
+from ..security import AclStore, Authorizer, CredentialStore
+from ..security.acl import AclBinding, AclBindingE, AclFilter
+from ..security.scram import decode_credential
 from .commands import (
     AllocateProducerIdCmd,
     CmdType,
+    CreateAclsCmd,
+    CreatePartitionsCmd,
     CreateTopicCmd,
+    CreateUserCmd,
+    DeleteAclsCmd,
     DeleteTopicCmd,
+    DeleteUserCmd,
     PartitionAssignmentE,
+    UpdateTopicConfigCmd,
     decode_commands,
     encode_command,
 )
@@ -42,10 +51,11 @@ from .topic_table import TopicTable
 
 logger = logging.getLogger("cluster.controller")
 
-# rpc method ids (raft uses 100-104)
+# rpc method ids (raft uses 100-104; dissemination 210; tx 220-221)
 CREATE_TOPIC = 200
 DELETE_TOPIC = 201
 ALLOCATE_PRODUCER_ID = 202
+REPLICATE_CMD = 203  # generic leader-routed controller command
 
 
 class TopicError(Exception):
@@ -69,6 +79,10 @@ class _TopicReply(serde.Envelope):
     SERDE_FIELDS = [
         ("code", serde.string),  # "" = ok
         ("message", serde.string),
+        # controller-log revision of the committed command (-1 when the
+        # request failed) — the router barriers its local table on this
+        # so routed mutations are read-your-writes on the calling node
+        ("revision", serde.i64),
     ]
 
 
@@ -79,14 +93,27 @@ class _IdReply(serde.Envelope):
     ]
 
 
-class ControllerStm(StateMachine):
-    """Applies committed controller batches to the topic table
-    (reference: cluster/controller_stm.h via raft/mux_state_machine)."""
+class _CmdReq(serde.Envelope):
+    """Generic leader-routed controller command: the follower ships the
+    already-encoded command envelope; the leader validates + replicates
+    (topics_frontend.cc leader routing generalized)."""
 
-    def __init__(self, consensus, topic_table: TopicTable, allocator):
+    SERDE_FIELDS = [
+        ("cmd_type", serde.u8),
+        ("payload", serde.bytes_t),
+    ]
+
+
+class ControllerStm(StateMachine):
+    """Applies committed controller batches to the topic table and the
+    security stores (reference: cluster/controller_stm.h via
+    raft/mux_state_machine — the mux dispatch by command family)."""
+
+    def __init__(self, consensus, controller: "Controller"):
         super().__init__(consensus)
-        self.topic_table = topic_table
-        self.allocator = allocator
+        self._c = controller
+        self.topic_table = controller.topic_table
+        self.allocator = controller.allocator
 
     async def apply(self, batch: RecordBatch) -> None:
         if batch.header.type != RecordBatchType.topic_management_cmd:
@@ -101,7 +128,45 @@ class ControllerStm(StateMachine):
                 if md is not None:
                     for a in md.assignments.values():
                         self.allocator.account(a.replicas, sign=-1)
+            elif cmd_type == CmdType.create_partitions:
+                for a in cmd.assignments:
+                    self.allocator.account(list(a.replicas))
+            elif cmd_type == CmdType.create_user:
+                self._c.credentials.put(
+                    cmd.user, decode_credential(cmd.credential)
+                )
+            elif cmd_type == CmdType.delete_user:
+                self._c.credentials.remove(cmd.user)
+            elif cmd_type == CmdType.create_acls:
+                self._c.acls.add(
+                    AclBindingE.decode(raw).to_binding()
+                    for raw in cmd.bindings
+                )
+            elif cmd_type == CmdType.delete_acls:
+                self._c.acls.remove_matching(_cmd_to_filter(cmd))
+            # topic_table.apply handles its own families and bumps the
+            # applied revision for every command type, which is what
+            # wait_revision barriers on
             self.topic_table.apply(cmd_type, cmd, revision)
+
+
+def _cmd_to_filter(cmd: DeleteAclsCmd) -> AclFilter:
+    from ..security.acl import (
+        AclOperation,
+        AclPatternType,
+        AclPermission,
+        AclResourceType,
+    )
+
+    return AclFilter(
+        resource_type=AclResourceType(int(cmd.resource_type)),
+        pattern_type=AclPatternType(int(cmd.pattern_type)),
+        resource_name=cmd.resource_name,
+        principal=cmd.principal,
+        host=cmd.host,
+        operation=AclOperation(int(cmd.operation)),
+        permission=AclPermission(int(cmd.permission)),
+    )
 
 
 class ControllerService(Service):
@@ -121,11 +186,11 @@ class ControllerService(Service):
                 int(req.replication_factor),
                 dict(req.config),
             )
-            return _TopicReply(code="", message="").encode()
+            return _TopicReply(code="", message="", revision=-1).encode()
         except TopicError as e:
-            return _TopicReply(code=e.code, message=e.message).encode()
+            return _TopicReply(code=e.code, message=e.message, revision=-1).encode()
         except NotLeaderError:
-            return _TopicReply(code="not_controller", message="").encode()
+            return _TopicReply(code="not_controller", message="", revision=-1).encode()
 
     @method(ALLOCATE_PRODUCER_ID)
     async def allocate_producer_id(self, payload: bytes) -> bytes:
@@ -137,16 +202,43 @@ class ControllerService(Service):
         except Exception as e:
             return _IdReply(id=-1, code=f"error: {e}").encode()
 
+    @method(REPLICATE_CMD)
+    async def replicate_cmd(self, payload: bytes) -> bytes:
+        req = _CmdReq.decode(payload)
+        from .commands import CMD_CLASSES
+
+        cmd_type = CmdType(int(req.cmd_type))
+        cmd = CMD_CLASSES[cmd_type].decode(req.payload)
+        try:
+            if cmd_type == CmdType.create_partitions and not cmd.assignments:
+                # follower-routed grow request: the LEADER allocates
+                base = await self._controller._create_partitions_local(
+                    cmd.ns, cmd.topic, int(cmd.new_total)
+                )
+            else:
+                base = await self._controller.replicate_cmd_local(
+                    cmd_type, cmd
+                )
+            return _TopicReply(code="", message="", revision=base).encode()
+        except TopicError as e:
+            return _TopicReply(
+                code=e.code, message=e.message, revision=-1
+            ).encode()
+        except NotLeaderError:
+            return _TopicReply(
+                code="not_controller", message="", revision=-1
+            ).encode()
+
     @method(DELETE_TOPIC)
     async def delete_topic(self, payload: bytes) -> bytes:
         req = _TopicReq.decode(payload)
         try:
             await self._controller.delete_topic_local(req.ns, req.topic)
-            return _TopicReply(code="", message="").encode()
+            return _TopicReply(code="", message="", revision=-1).encode()
         except TopicError as e:
-            return _TopicReply(code=e.code, message=e.message).encode()
+            return _TopicReply(code=e.code, message=e.message, revision=-1).encode()
         except NotLeaderError:
-            return _TopicReply(code="not_controller", message="").encode()
+            return _TopicReply(code="not_controller", message="", revision=-1).encode()
 
 
 class Controller:
@@ -167,6 +259,9 @@ class Controller:
         self._send = send
         self.topic_table = TopicTable()
         self.allocator = PartitionAllocator()
+        self.credentials = CredentialStore()
+        self.acls = AclStore()
+        self.authorizer = Authorizer(self.acls)
         for m in members:
             self.allocator.register_node(m)
         self.consensus = None
@@ -182,7 +277,7 @@ class Controller:
         self.consensus = await self._gm.create_group(
             int(CONTROLLER_GROUP), voters=self.members
         )
-        self.stm = ControllerStm(self.consensus, self.topic_table, self.allocator)
+        self.stm = ControllerStm(self.consensus, self)
         await self.stm.start()
         self._backend_task = asyncio.ensure_future(self._backend_loop())
 
@@ -326,6 +421,191 @@ class Controller:
             for a in assignments:
                 self.allocator.account(a.replicas, sign=-1)
             await self.topic_table.wait_revision(base)
+
+    # -- generic command replication (users/acls/config/partitions) ---
+    async def replicate_cmd_local(self, cmd_type: CmdType, cmd) -> int:
+        if self.consensus is None or not self.is_leader:
+            raise NotLeaderError(self.leader_id)
+        self._validate_cmd(cmd_type, cmd)
+        batch = encode_command(cmd_type, cmd)
+        base, _ = await self.consensus.replicate(batch, acks=-1)
+        await self.topic_table.wait_revision(base)
+        return base
+
+    def _validate_cmd(self, cmd_type: CmdType, cmd) -> None:
+        if cmd_type in (CmdType.update_topic, CmdType.create_partitions):
+            tp = TopicNamespace(cmd.ns, cmd.topic)
+            if not self.topic_table.contains(tp):
+                raise TopicError("unknown_topic_or_partition", str(tp))
+        if cmd_type == CmdType.delete_user and not self.credentials.contains(
+            cmd.user
+        ):
+            raise TopicError("unknown_server_error", f"no such user {cmd.user}")
+
+    async def replicate_cmd(
+        self,
+        cmd_type: CmdType,
+        cmd,
+        timeout: float = 10.0,
+        local: Optional[Callable] = None,
+    ) -> None:
+        """Replicate a controller command from any node (leader-routed).
+
+        `local` overrides the leader-side execution (e.g. partition
+        growth, where only the leader may allocate). On the routed path
+        the reply's revision barriers this node's table so the mutation
+        is read-your-writes wherever the client is connected."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        req = _CmdReq(cmd_type=int(cmd_type), payload=cmd.encode()).encode()
+        while True:
+            if self.is_leader:
+                if local is not None:
+                    await local()
+                else:
+                    await self.replicate_cmd_local(cmd_type, cmd)
+                return
+            leader = await self.wait_leader(
+                max(0.01, deadline - asyncio.get_event_loop().time())
+            )
+            raw = await self._send(leader, REPLICATE_CMD, req, 5.0)
+            reply = _TopicReply.decode(raw)
+            if reply.code == "":
+                if reply.revision >= 0:
+                    await self.topic_table.wait_revision(
+                        reply.revision,
+                        max(
+                            0.01,
+                            deadline - asyncio.get_event_loop().time(),
+                        ),
+                    )
+                return
+            if reply.code == "not_controller":
+                if asyncio.get_event_loop().time() > deadline:
+                    raise TopicError("request_timed_out", "controller moved")
+                await asyncio.sleep(0.05)
+                continue
+            raise TopicError(reply.code, reply.message)
+
+    # -- security frontends -------------------------------------------
+    async def create_user(self, user: str, credential_raw: bytes) -> None:
+        await self.replicate_cmd(
+            CmdType.create_user,
+            CreateUserCmd(user=user, credential=credential_raw),
+        )
+
+    async def delete_user(self, user: str) -> None:
+        await self.replicate_cmd(CmdType.delete_user, DeleteUserCmd(user=user))
+
+    async def create_acls(self, bindings: list[AclBinding]) -> None:
+        await self.replicate_cmd(
+            CmdType.create_acls,
+            CreateAclsCmd(
+                bindings=[AclBindingE.from_binding(b).encode() for b in bindings]
+            ),
+        )
+
+    async def delete_acls(self, flt: AclFilter) -> list[AclBinding]:
+        """Replicates the delete; returns the bindings that matched
+        LOCALLY at call time (the response preview — the authoritative
+        removal happens in every node's stm apply)."""
+        matched = self.acls.describe(flt)
+        await self.replicate_cmd(
+            CmdType.delete_acls,
+            DeleteAclsCmd(
+                resource_type=int(flt.resource_type),
+                pattern_type=int(flt.pattern_type),
+                resource_name=flt.resource_name,
+                principal=flt.principal,
+                host=flt.host,
+                operation=int(flt.operation),
+                permission=int(flt.permission),
+            ),
+        )
+        return matched
+
+    # -- topic mutation frontends -------------------------------------
+    async def update_topic_config(
+        self,
+        topic: str,
+        set_configs: dict[str, str | None],
+        remove_configs: list[str],
+        ns: str = DEFAULT_NS,
+    ) -> None:
+        await self.replicate_cmd(
+            CmdType.update_topic,
+            UpdateTopicConfigCmd(
+                ns=ns,
+                topic=topic,
+                set_configs=set_configs,
+                remove_configs=remove_configs,
+            ),
+        )
+
+    async def create_partitions(
+        self, topic: str, new_total: int, ns: str = DEFAULT_NS
+    ) -> None:
+        """Grow partition count; allocation happens on the leader, so
+        the routed command ships empty assignments (the leader branch
+        of the REPLICATE_CMD service allocates + fills them in)."""
+        if self.topic_table.get(TopicNamespace(ns, topic)) is None:
+            raise TopicError("unknown_topic_or_partition", topic)
+        await self.replicate_cmd(
+            CmdType.create_partitions,
+            CreatePartitionsCmd(
+                ns=ns, topic=topic, new_total=new_total, assignments=[]
+            ),
+            local=lambda: self._create_partitions_local(ns, topic, new_total),
+        )
+
+    async def _create_partitions_local(
+        self, ns: str, topic: str, new_total: int
+    ) -> int:
+        if self.consensus is None or not self.is_leader:
+            raise NotLeaderError(self.leader_id)
+        async with self._create_lock:
+            md = self.topic_table.get(TopicNamespace(ns, topic))
+            if md is None:
+                raise TopicError("unknown_topic_or_partition", topic)
+            if new_total <= md.partition_count:
+                raise TopicError(
+                    "invalid_partitions",
+                    f"new count {new_total} <= current {md.partition_count}",
+                )
+            add = new_total - md.partition_count
+            next_group = max(
+                self._local_next_group, self.topic_table.next_group_id
+            )
+            try:
+                assignments = self.allocator.allocate(
+                    add, md.replication_factor, next_group
+                )
+            except AllocationError as e:
+                raise TopicError("invalid_replication_factor", str(e)) from None
+            self._local_next_group = next_group + add
+            cmd = CreatePartitionsCmd(
+                ns=ns,
+                topic=topic,
+                new_total=new_total,
+                assignments=[
+                    PartitionAssignmentE(
+                        partition=md.partition_count + i,
+                        group=a.group,
+                        replicas=a.replicas,
+                    )
+                    for i, a in enumerate(assignments)
+                ],
+            )
+            batch = encode_command(CmdType.create_partitions, cmd)
+            try:
+                base, _ = await self.consensus.replicate(batch, acks=-1)
+            except Exception:
+                for a in assignments:
+                    self.allocator.account(a.replicas, sign=-1)
+                raise
+            for a in assignments:
+                self.allocator.account(a.replicas, sign=-1)
+            await self.topic_table.wait_revision(base)
+            return base
 
     async def allocate_producer_id_local(self) -> int:
         """Leader-side id allocation: the command's committed offset is

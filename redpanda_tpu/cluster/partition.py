@@ -407,6 +407,29 @@ class Partition:
                     break
         return out
 
+    def offset_for_leader_epoch(self, epoch: int) -> tuple[int, int]:
+        """(largest epoch <= requested, its exclusive end offset in
+        kafka space) — the OffsetForLeaderEpoch contract clients use to
+        detect divergence after leadership changes (reference:
+        kafka/server/handlers/offset_for_leader_epoch.cc; leader epoch
+        == raft term here). Returns (-1, -1) when no such epoch."""
+        all_bounds = self.log.term_boundaries()
+        # terms ascend, so matching bounds are a prefix of all_bounds
+        idx = -1
+        for i, (_start, term) in enumerate(all_bounds):
+            if term > epoch:
+                break
+            idx = i
+        if idx < 0:
+            return -1, -1
+        term = all_bounds[idx][1]
+        if idx + 1 < len(all_bounds):
+            next_start = all_bounds[idx + 1][0]
+            end = self.translator.to_kafka(next_start - 1) + 1
+        else:
+            end = self.high_watermark()
+        return term, end
+
     def timequery(self, ts_ms: int) -> int | None:
         raft_off = self.log.timequery(ts_ms)
         if raft_off is None:

@@ -73,8 +73,42 @@ class TopicTable:
             self._apply_create(cmd, revision)
         elif cmd_type == CmdType.delete_topic:
             self._apply_delete(cmd)
+        elif cmd_type == CmdType.update_topic:
+            self._apply_update_config(cmd)
+        elif cmd_type == CmdType.create_partitions:
+            self._apply_create_partitions(cmd)
         self.revision = revision
         self._notify()
+
+    def _apply_update_config(self, cmd) -> None:
+        md = self._topics.get(TopicNamespace(cmd.ns, cmd.topic))
+        if md is None:
+            return
+        md.config.update(dict(cmd.set_configs))
+        for name in cmd.remove_configs:
+            md.config.pop(name, None)
+
+    def _apply_create_partitions(self, cmd) -> None:
+        md = self._topics.get(TopicNamespace(cmd.ns, cmd.topic))
+        if md is None:
+            return
+        for a in cmd.assignments:
+            if int(a.partition) in md.assignments:
+                continue  # idempotent re-apply
+            pa = PartitionAssignment(
+                int(a.partition), int(a.group), list(a.replicas)
+            )
+            md.assignments[pa.partition] = pa
+            self.next_group_id = max(self.next_group_id, pa.group + 1)
+            self._pending_deltas.append(
+                Delta(
+                    "add",
+                    NTP(cmd.ns, cmd.topic, pa.partition),
+                    pa.group,
+                    list(pa.replicas),
+                )
+            )
+        md.partition_count = max(md.partition_count, int(cmd.new_total))
 
     def _apply_create(self, cmd: CreateTopicCmd, revision: int) -> None:
         tp_ns = TopicNamespace(cmd.ns, cmd.topic)

@@ -44,10 +44,17 @@ class KafkaClientError(Exception):
 
 
 class BrokerConnection:
-    def __init__(self, host: str, port: int, client_id: str):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        client_id: str,
+        sasl: tuple[str, str, str] | None = None,  # (user, password, mechanism)
+    ):
         self.host = host
         self.port = port
         self._client_id = client_id
+        self._sasl = sasl
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._corr = itertools.count(1)
@@ -70,6 +77,44 @@ class BrokerConnection:
         self.api_versions = {
             k.api_key: (k.min_version, k.max_version) for k in resp.api_keys
         }
+        if self._sasl is not None:
+            await self._authenticate(*self._sasl)
+
+    async def _authenticate(
+        self, user: str, password: str, mechanism: str
+    ) -> None:
+        """SCRAM client exchange (RFC 5802) over SaslHandshake +
+        SaslAuthenticate."""
+        from ..security import scram as sc
+        from .protocol.admin_apis import SASL_AUTHENTICATE, SASL_HANDSHAKE
+
+        resp = await self.request(
+            SASL_HANDSHAKE, Msg(mechanism=mechanism), version=1
+        )
+        if resp.error_code != 0:
+            raise KafkaClientError(resp.error_code, "sasl_handshake")
+        first, nonce = sc.client_first_message(user)
+        resp = await self.request(
+            SASL_AUTHENTICATE, Msg(auth_bytes=first.encode()), version=1
+        )
+        if resp.error_code != 0:
+            raise KafkaClientError(resp.error_code, "sasl server-first")
+        final, expect_sig = sc.client_final_message(
+            password, mechanism, first, bytes(resp.auth_bytes), nonce
+        )
+        resp = await self.request(
+            SASL_AUTHENTICATE, Msg(auth_bytes=final.encode()), version=1
+        )
+        if resp.error_code != 0:
+            raise KafkaClientError(resp.error_code, "sasl client-final")
+        server_final = bytes(resp.auth_bytes).decode()
+        import base64
+
+        if server_final != f"v={base64.b64encode(expect_sig).decode()}":
+            raise KafkaClientError(
+                int(ErrorCode.sasl_authentication_failed),
+                "server signature mismatch",
+            )
 
     async def _read_loop(self) -> None:
         try:
@@ -186,9 +231,11 @@ class KafkaClient:
         self,
         bootstrap: Sequence[tuple[str, int]],
         client_id: str = "redpanda-tpu-client",
+        sasl: tuple[str, str, str] | None = None,  # (user, password, mechanism)
     ):
         self._bootstrap = list(bootstrap)
         self._client_id = client_id
+        self._sasl = sasl
         self._conns: dict[tuple[str, int], BrokerConnection] = {}
         self._brokers: dict[int, tuple[str, int]] = {}
         self._leaders: dict[tuple[str, int], int] = {}  # (topic,part)→node
@@ -197,7 +244,9 @@ class KafkaClient:
     async def _connect_addr(self, addr: tuple[str, int]) -> BrokerConnection:
         conn = self._conns.get(addr)
         if conn is None:
-            conn = BrokerConnection(addr[0], addr[1], self._client_id)
+            conn = BrokerConnection(
+                addr[0], addr[1], self._client_id, sasl=self._sasl
+            )
             await conn.connect()
             self._conns[addr] = conn
         return conn
@@ -248,7 +297,10 @@ class KafkaClient:
             if leader is not None and leader in self._brokers:
                 return await self._connect_addr(self._brokers[leader])
             terr = self._topic_errors.get(topic, 0)
-            if terr == int(ErrorCode.unknown_topic_or_partition):
+            if terr in (
+                int(ErrorCode.unknown_topic_or_partition),
+                int(ErrorCode.topic_authorization_failed),
+            ):
                 raise KafkaClientError(terr, f"{topic}/{partition}")
             if asyncio.get_event_loop().time() > deadline:
                 raise KafkaClientError(
@@ -302,6 +354,91 @@ class KafkaClient:
         code = resp.responses[0].error_code
         if code != 0:
             raise KafkaClientError(code, f"delete_topic {name}")
+
+    async def delete_topics(
+        self, names: list[str], timeout_ms: int = 10000
+    ) -> list[tuple[str, int]]:
+        """Per-topic (name, error_code) — does not raise on denial."""
+        from .protocol.group_apis import DELETE_TOPICS
+
+        conn = await self.any_conn()
+        v = conn.pick_version(DELETE_TOPICS, 1)
+        resp = await conn.request(
+            DELETE_TOPICS, Msg(topic_names=names, timeout_ms=timeout_ms), v
+        )
+        return [(r.name, r.error_code) for r in resp.responses]
+
+    async def describe_configs(
+        self, topic: str, keys: Optional[list[str]] = None
+    ) -> list[tuple[str, Optional[str]]]:
+        from .protocol.admin_apis import DESCRIBE_CONFIGS
+
+        conn = await self.any_conn()
+        v = conn.pick_version(DESCRIBE_CONFIGS, 1)
+        resp = await conn.request(
+            DESCRIBE_CONFIGS,
+            Msg(
+                resources=[
+                    Msg(
+                        resource_type=2,
+                        resource_name=topic,
+                        configuration_keys=keys,
+                    )
+                ],
+                include_synonyms=False,
+            ),
+            v,
+        )
+        r = resp.results[0]
+        if r.error_code != 0:
+            raise KafkaClientError(r.error_code, f"describe_configs {topic}")
+        return [(c.name, c.value) for c in r.configs]
+
+    async def alter_topic_configs(
+        self, topic: str, sets: dict[str, str], removes: Sequence[str] = ()
+    ) -> None:
+        """Incremental alter: SET the given keys, DELETE `removes`."""
+        from .protocol.admin_apis import INCREMENTAL_ALTER_CONFIGS
+
+        conn = await self.any_conn()
+        v = conn.pick_version(INCREMENTAL_ALTER_CONFIGS, 0)
+        cfgs = [
+            Msg(name=k, config_operation=0, value=val)
+            for k, val in sets.items()
+        ] + [Msg(name=k, config_operation=1, value=None) for k in removes]
+        resp = await conn.request(
+            INCREMENTAL_ALTER_CONFIGS,
+            Msg(
+                resources=[
+                    Msg(resource_type=2, resource_name=topic, configs=cfgs)
+                ],
+                validate_only=False,
+            ),
+            v,
+        )
+        r = resp.responses[0]
+        if r.error_code != 0:
+            raise KafkaClientError(r.error_code, f"alter_configs {topic}")
+
+    async def create_partitions(
+        self, topic: str, count: int, timeout_ms: int = 10000
+    ) -> None:
+        from .protocol.admin_apis import CREATE_PARTITIONS
+
+        conn = await self.any_conn()
+        v = conn.pick_version(CREATE_PARTITIONS, 1)
+        resp = await conn.request(
+            CREATE_PARTITIONS,
+            Msg(
+                topics=[Msg(name=topic, count=count, assignments=None)],
+                timeout_ms=timeout_ms,
+                validate_only=False,
+            ),
+            v,
+        )
+        r = resp.results[0]
+        if r.error_code != 0:
+            raise KafkaClientError(r.error_code, f"create_partitions {topic}")
 
     # -- produce -----------------------------------------------------
     async def produce(

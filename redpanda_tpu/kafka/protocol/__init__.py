@@ -21,3 +21,4 @@ from .headers import (  # noqa: F401
 from .schema import Api, Array, F, Msg  # noqa: F401
 from .wire import Reader, Writer, WireError  # noqa: F401
 from . import tx_apis  # noqa: F401  (registers APIs 24-26, 28)
+from . import admin_apis  # noqa: F401  (registers 17,23,29-33,36,37,44)

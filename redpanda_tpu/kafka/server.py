@@ -18,6 +18,7 @@ CRC-checked before replication.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import dataclasses
 import logging
 import struct
@@ -31,6 +32,7 @@ from ..cluster.producer_state import (
 from ..models.fundamental import NTP, DEFAULT_NS, TopicNamespace, kafka_ntp
 from ..models.record import CrcMismatch, RecordBatch
 from ..raft.consensus import NotLeaderError, ReplicateTimeout
+from ..security.acl import AclOperation, AclResourceType
 from ..utils.iobuf import IOBufParser
 from .protocol import (
     ALL_APIS,
@@ -86,6 +88,26 @@ def _consume_exc(fut: "asyncio.Future") -> None:
     fut.add_done_callback(cb)
 
 
+class ConnectionContext:
+    """Per-connection state: SASL exchange + authenticated principal
+    (reference: kafka/server/connection_context.h sasl state)."""
+
+    __slots__ = ("principal", "mechanism", "scram", "authenticated")
+
+    def __init__(self) -> None:
+        self.principal: str | None = None
+        self.mechanism: str | None = None
+        self.scram = None
+        self.authenticated = False
+
+
+# the principal of the request currently being handled (set around the
+# handler call so deep call-sites can authorize without threading ctx)
+CURRENT_PRINCIPAL: "contextvars.ContextVar[str | None]" = contextvars.ContextVar(
+    "kafka_principal", default=None
+)
+
+
 class KafkaServer:
     def __init__(self, broker: "Broker"):
         self.broker = broker
@@ -100,10 +122,29 @@ class KafkaServer:
             FETCH.key: self.handle_fetch,
             LIST_OFFSETS.key: self.handle_list_offsets,
         }
-        from . import server_groups, server_tx
+        from . import server_admin, server_groups, server_tx
 
         server_groups.install(self)
         server_tx.install(self)
+        server_admin.install(self)
+
+    # -- authorization -------------------------------------------------
+    @property
+    def authorization_enabled(self) -> bool:
+        cfg = self.broker.config
+        if cfg.enable_authorization is not None:
+            return cfg.enable_authorization
+        return cfg.enable_sasl
+
+    def authorize(self, operation, resource_type, name: str) -> bool:
+        """ACL check for the current request's principal; always true
+        when authorization is off (authorizer.h authorized())."""
+        if not self.authorization_enabled:
+            return True
+        principal = CURRENT_PRINCIPAL.get() or "User:anonymous"
+        return self.broker.controller.authorizer.authorized(
+            resource_type, name, operation, principal
+        )
 
     async def start(self) -> None:
         cfg = self.broker.config
@@ -140,6 +181,7 @@ class KafkaServer:
         responses strictly in request order."""
         task = asyncio.current_task()
         self._conns.add(task)
+        ctx = ConnectionContext()
         pending: asyncio.Queue = asyncio.Queue()
         conn_failed = asyncio.Event()
 
@@ -180,7 +222,7 @@ class KafkaServer:
                     return
                 frame = await reader.readexactly(size)
                 try:
-                    resp = await self._process(frame)
+                    resp = await self._process(frame, ctx)
                 except _CloseConnection as e:
                     fut = asyncio.get_event_loop().create_future()
                     fut.set_exception(e)
@@ -214,12 +256,26 @@ class KafkaServer:
             except Exception:
                 pass
 
-    async def _process(self, frame: bytes) -> bytes | None:
+    async def _process(self, frame: bytes, ctx: ConnectionContext) -> bytes | None:
+        from .protocol.admin_apis import SASL_AUTHENTICATE, SASL_HANDSHAKE
+
         r = Reader(frame)
         hdr = decode_request_header(r)
         api = API_BY_KEY.get(hdr.api_key)
         if api is None:
             logger.warning("unknown api key %d", hdr.api_key)
+            raise _CloseConnection(b"")
+        if (
+            self.broker.config.enable_sasl
+            and not ctx.authenticated
+            and hdr.api_key
+            not in (API_VERSIONS.key, SASL_HANDSHAKE.key, SASL_AUTHENTICATE.key)
+        ):
+            # the reference disconnects unauthenticated requests
+            # (connection_context.cc sasl gate)
+            logger.warning(
+                "unauthenticated %s request: closing connection", api.name
+            )
             raise _CloseConnection(b"")
         if not api.supports(hdr.api_version):
             # only ApiVersions has a downgrade contract (reply v0 +
@@ -234,18 +290,27 @@ class KafkaServer:
                 api.name, hdr.api_version, api.min_version, api.max_version,
             )
             raise _CloseConnection(b"")
-        handler = self._handlers.get(hdr.api_key)
-        if handler is None:
-            raise _CloseConnection(b"")
-        try:
-            resp = await handler(hdr, api.decode_request(
-                frame[len(frame) - r.remaining :], hdr.api_version
-            ))
-        except Exception:
-            logger.exception(
-                "%s v%d handler failed", api.name, hdr.api_version
-            )
-            raise
+        req = api.decode_request(
+            frame[len(frame) - r.remaining :], hdr.api_version
+        )
+        if hdr.api_key == SASL_HANDSHAKE.key:
+            resp = self.handle_sasl_handshake(ctx, hdr, req)
+        elif hdr.api_key == SASL_AUTHENTICATE.key:
+            resp = self.handle_sasl_authenticate(ctx, hdr, req)
+        else:
+            handler = self._handlers.get(hdr.api_key)
+            if handler is None:
+                raise _CloseConnection(b"")
+            token = CURRENT_PRINCIPAL.set(ctx.principal)
+            try:
+                resp = await handler(hdr, req)
+            except Exception:
+                logger.exception(
+                    "%s v%d handler failed", api.name, hdr.api_version
+                )
+                raise
+            finally:
+                CURRENT_PRINCIPAL.reset(token)
         if asyncio.iscoroutine(resp):
             # staged handler (produce): dispatch done, response later —
             # encode when it settles, off the reader path
@@ -290,6 +355,70 @@ class KafkaServer:
             for a in sorted(ALL_APIS, key=lambda a: a.key)
         ]
 
+    # -- sasl ---------------------------------------------------------
+    def handle_sasl_handshake(
+        self, ctx: ConnectionContext, hdr: RequestHeader, req: Msg
+    ) -> Msg:
+        from ..security.scram import MECHANISMS, ScramServerExchange
+
+        if req.mechanism not in MECHANISMS:
+            return Msg(
+                error_code=int(ErrorCode.unsupported_sasl_mechanism),
+                mechanisms=list(MECHANISMS),
+            )
+        ctx.mechanism = req.mechanism
+        ctx.scram = ScramServerExchange(
+            self.broker.controller.credentials, req.mechanism
+        )
+        return Msg(error_code=0, mechanisms=list(MECHANISMS))
+
+    def handle_sasl_authenticate(
+        self, ctx: ConnectionContext, hdr: RequestHeader, req: Msg
+    ) -> Msg:
+        from ..security.scram import ScramError
+
+        def err(code: int, message: str) -> Msg:
+            return Msg(
+                error_code=code,
+                error_message=message,
+                auth_bytes=b"",
+                session_lifetime_ms=0,
+            )
+
+        if ctx.scram is None:
+            return err(int(ErrorCode.illegal_sasl_state), "handshake first")
+        try:
+            if ctx.scram.state == "start":
+                out = ctx.scram.handle_client_first(bytes(req.auth_bytes))
+            elif ctx.scram.state == "sent-first":
+                out = ctx.scram.handle_client_final(bytes(req.auth_bytes))
+            else:
+                return err(
+                    int(ErrorCode.illegal_sasl_state), "exchange complete"
+                )
+        except ScramError as e:
+            logger.info("sasl authentication failed: %s", e)
+            return err(int(ErrorCode.sasl_authentication_failed), str(e))
+        except Exception as e:
+            # malformed client-first/final messages (bad UTF-8, missing
+            # fields, invalid base64) must fail the exchange, not the
+            # connection task
+            logger.info("sasl: malformed auth bytes: %r", e)
+            return err(
+                int(ErrorCode.sasl_authentication_failed),
+                "malformed SASL message",
+            )
+        if ctx.scram.done:
+            ctx.principal = f"User:{ctx.scram.username}"
+            ctx.authenticated = True
+            logger.info("sasl: authenticated %s", ctx.principal)
+        return Msg(
+            error_code=0,
+            error_message=None,
+            auth_bytes=out,
+            session_lifetime_ms=0,
+        )
+
     # -- handlers ----------------------------------------------------
     async def handle_api_versions(self, hdr: RequestHeader, req: Msg) -> Msg:
         return Msg(
@@ -306,12 +435,33 @@ class KafkaServer:
             hdr.api_version == 0 and len(req.topics) == 0
         )
         if want_all:
-            names = [tp.topic for tp in cache.topics() if tp.ns == DEFAULT_NS]
+            # unauthorized topics are silently filtered from a
+            # list-all, matching metadata.cc (no existence leak)
+            names = [
+                tp.topic
+                for tp in cache.topics()
+                if tp.ns == DEFAULT_NS
+                and self.authorize(
+                    AclOperation.describe, AclResourceType.topic, tp.topic
+                )
+            ]
         else:
             names = [t.name for t in req.topics]
 
         topics_out = []
         for name in names:
+            if not want_all and not self.authorize(
+                AclOperation.describe, AclResourceType.topic, name
+            ):
+                topics_out.append(
+                    Msg(
+                        error_code=int(ErrorCode.topic_authorization_failed),
+                        name=name,
+                        is_internal=False,
+                        partitions=[],
+                    )
+                )
+                continue
             md = cache.get_topic(TopicNamespace(DEFAULT_NS, name))
             if md is None:
                 topics_out.append(
@@ -373,6 +523,19 @@ class KafkaServer:
         out = []
         for t in req.topics:
             code, message = 0, None
+            if not self.authorize(
+                AclOperation.create, AclResourceType.topic, t.name
+            ) and not self.authorize(
+                AclOperation.create, AclResourceType.cluster, "kafka-cluster"
+            ):
+                out.append(
+                    Msg(
+                        name=t.name,
+                        error_code=int(ErrorCode.topic_authorization_failed),
+                        error_message=None,
+                    )
+                )
+                continue
             if req.validate_only:
                 if self.broker.controller.topic_table.contains(
                     TopicNamespace(DEFAULT_NS, t.name)
@@ -439,6 +602,12 @@ class KafkaServer:
             """Stage 1 (produce.cc dispatched): parse, CRC-verify and
             enqueue every batch in log order. Returns either an error
             Msg (terminal) or the list of in-flight stages."""
+            if not self.authorize(AclOperation.write, AclResourceType.topic, topic):
+                return Msg(
+                    index=p.index,
+                    error_code=int(ErrorCode.topic_authorization_failed),
+                    base_offset=-1,
+                )
             ntp = kafka_ntp(topic, p.index)
             partition = self.broker.partition_manager.get(ntp)
             if partition is None:
@@ -540,6 +709,14 @@ class KafkaServer:
         # isolation 1 = READ_COMMITTED: serve only below the LSO and
         # report aborted ranges (fetch.cc read_result + rm_stm LSO)
         read_committed = getattr(req, "isolation_level", 0) == 1
+        # authorize once per request, not once per ~5ms poll iteration
+        # (fetch.cc authorizes at plan time)
+        authorized = {
+            t.topic: self.authorize(
+                AclOperation.read, AclResourceType.topic, t.topic
+            )
+            for t in req.topics
+        }
 
         def read_all() -> tuple[list[Msg], int, bool]:
             total = 0
@@ -548,7 +725,24 @@ class KafkaServer:
             budget = req.max_bytes if req.max_bytes > 0 else 1 << 30
             for t in req.topics:
                 parts = []
+                topic_ok = authorized[t.topic]
                 for p in t.partitions:
+                    if not topic_ok:
+                        has_error = True
+                        parts.append(
+                            Msg(
+                                partition_index=p.partition,
+                                error_code=int(
+                                    ErrorCode.topic_authorization_failed
+                                ),
+                                high_watermark=-1,
+                                last_stable_offset=-1,
+                                log_start_offset=-1,
+                                aborted_transactions=None,
+                                records=None,
+                            )
+                        )
+                        continue
                     ntp = kafka_ntp(t.topic, p.partition)
                     partition = self.broker.partition_manager.get(ntp)
                     if partition is None:
@@ -666,7 +860,23 @@ class KafkaServer:
         out = []
         for t in req.topics:
             parts = []
+            topic_ok = self.authorize(
+                AclOperation.describe, AclResourceType.topic, t.name
+            )
             for p in t.partitions:
+                if not topic_ok:
+                    parts.append(
+                        Msg(
+                            partition_index=p.partition_index,
+                            error_code=int(
+                                ErrorCode.topic_authorization_failed
+                            ),
+                            old_style_offsets=[],
+                            timestamp=-1,
+                            offset=-1,
+                        )
+                    )
+                    continue
                 ntp = kafka_ntp(t.name, p.partition_index)
                 partition = self.broker.partition_manager.get(ntp)
                 if partition is None:

@@ -1,0 +1,553 @@
+"""ACL / config / partition-admin / leader-epoch handlers.
+
+Reference: src/v/kafka/server/handlers/{describe_acls,create_acls,
+delete_acls,describe_configs,alter_configs,incremental_alter_configs,
+offset_for_leader_epoch,create_partitions}.cc.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from ..models.fundamental import DEFAULT_NS, TopicNamespace, kafka_ntp
+from ..security.acl import (
+    AclBinding,
+    AclFilter,
+    AclOperation,
+    AclPatternType,
+    AclPermission,
+    AclResourceType,
+)
+from .protocol import ErrorCode, Msg
+from .protocol.admin_apis import (
+    ALTER_CONFIGS,
+    CREATE_ACLS,
+    CREATE_PARTITIONS,
+    DELETE_ACLS,
+    DESCRIBE_ACLS,
+    DESCRIBE_CONFIGS,
+    INCREMENTAL_ALTER_CONFIGS,
+    OFFSET_FOR_LEADER_EPOCH,
+)
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .server import KafkaServer
+
+# ConfigResource types (Kafka wire)
+_RES_TOPIC = 2
+_RES_BROKER = 4
+
+# defaults surfaced by DescribeConfigs when a topic has no override
+TOPIC_CONFIG_DEFAULTS: dict[str, str] = {
+    "cleanup.policy": "delete",
+    "compression.type": "producer",
+    "retention.ms": "604800000",
+    "retention.bytes": "-1",
+    "segment.bytes": "134217728",
+    "min.insync.replicas": "1",
+    "max.message.bytes": "1048576",
+}
+
+BROKER_CONFIG: dict[str, str] = {
+    "log.dirs": "<data-dir>",
+    "num.network.threads": "1",
+    "auto.create.topics.enable": "false",
+}
+
+
+def install(server: "KafkaServer") -> None:
+    h = AdminHandlers(server)
+    server._handlers.update(
+        {
+            DESCRIBE_ACLS.key: h.describe_acls,
+            CREATE_ACLS.key: h.create_acls,
+            DELETE_ACLS.key: h.delete_acls,
+            DESCRIBE_CONFIGS.key: h.describe_configs,
+            ALTER_CONFIGS.key: h.alter_configs,
+            INCREMENTAL_ALTER_CONFIGS.key: h.incremental_alter_configs,
+            OFFSET_FOR_LEADER_EPOCH.key: h.offset_for_leader_epoch,
+            CREATE_PARTITIONS.key: h.create_partitions,
+        }
+    )
+
+
+def _filter_from(req_or_row, v1: bool) -> AclFilter:
+    """Raises ValueError on out-of-range enum values (newer clients send
+    operations/resource types we don't model); callers map that to
+    invalid_request rather than dropping the connection."""
+    # v0 has no pattern-type field and means LITERAL (plus the implicit
+    # wildcard name), not ANY — a v0 filter must not match PREFIXED
+    # bindings it cannot represent
+    pt = getattr(req_or_row, "pattern_type_filter", 3) if v1 else 3
+    return AclFilter(
+        resource_type=AclResourceType(req_or_row.resource_type_filter or 1),
+        pattern_type=AclPatternType(pt or 1),
+        resource_name=req_or_row.resource_name_filter,
+        principal=req_or_row.principal_filter,
+        host=req_or_row.host_filter,
+        operation=AclOperation(req_or_row.operation or 1),
+        permission=AclPermission(req_or_row.permission_type or 1),
+    )
+
+
+class AdminHandlers:
+    def __init__(self, server: "KafkaServer"):
+        self.server = server
+
+    @property
+    def controller(self):
+        return self.server.broker.controller
+
+    # -- acls ---------------------------------------------------------
+    async def describe_acls(self, hdr, req) -> Msg:
+        if not self.server.authorize(
+            AclOperation.describe, AclResourceType.cluster, "kafka-cluster"
+        ):
+            return Msg(
+                throttle_time_ms=0,
+                error_code=int(ErrorCode.cluster_authorization_failed),
+                error_message=None,
+                resources=[],
+            )
+        try:
+            flt = _filter_from(req, hdr.api_version >= 1)
+        except ValueError as e:
+            return Msg(
+                throttle_time_ms=0,
+                error_code=int(ErrorCode.invalid_request),
+                error_message=str(e),
+                resources=[],
+            )
+        by_resource: dict[tuple, list[AclBinding]] = {}
+        for b in self.controller.acls.describe(flt):
+            by_resource.setdefault(
+                (int(b.resource_type), b.resource_name, int(b.pattern_type)), []
+            ).append(b)
+        return Msg(
+            throttle_time_ms=0,
+            error_code=0,
+            error_message=None,
+            resources=[
+                Msg(
+                    resource_type=rt,
+                    resource_name=name,
+                    pattern_type=pt,
+                    acls=[
+                        Msg(
+                            principal=b.principal,
+                            host=b.host,
+                            operation=int(b.operation),
+                            permission_type=int(b.permission),
+                        )
+                        for b in rows
+                    ],
+                )
+                for (rt, name, pt), rows in sorted(by_resource.items())
+            ],
+        )
+
+    async def create_acls(self, hdr, req) -> Msg:
+        from ..cluster.controller import TopicError
+
+        if not self.server.authorize(
+            AclOperation.alter, AclResourceType.cluster, "kafka-cluster"
+        ):
+            return Msg(
+                throttle_time_ms=0,
+                results=[
+                    Msg(
+                        error_code=int(ErrorCode.cluster_authorization_failed),
+                        error_message=None,
+                    )
+                    for _ in req.creations
+                ],
+            )
+        bindings = []
+        rows = []
+        for c in req.creations:
+            try:
+                rt = AclResourceType(c.resource_type)
+                pt = AclPatternType(getattr(c, "resource_pattern_type", 3) or 3)
+                op = AclOperation(c.operation)
+                perm = AclPermission(c.permission_type)
+                # filter-only wildcards (ANY/MATCH) describe nothing the
+                # authorizer can evaluate — a binding stored with them
+                # would be silently dead, so reject at creation like
+                # create_acls.cc does
+                if (
+                    rt in (AclResourceType.any,)
+                    or pt in (AclPatternType.any, AclPatternType.match)
+                    or op == AclOperation.any
+                    or perm == AclPermission.any
+                ):
+                    raise ValueError(
+                        "filter-only enum value in ACL binding"
+                    )
+                bindings.append(
+                    AclBinding(
+                        rt,
+                        pt,
+                        c.resource_name,
+                        c.principal,
+                        c.host,
+                        op,
+                        perm,
+                    )
+                )
+                rows.append(Msg(error_code=0, error_message=None))
+            except ValueError as e:
+                rows.append(
+                    Msg(
+                        error_code=int(ErrorCode.invalid_request),
+                        error_message=str(e),
+                    )
+                )
+        if bindings:
+            try:
+                await self.controller.create_acls(bindings)
+            except (TopicError, TimeoutError):
+                rows = [
+                    Msg(
+                        error_code=int(ErrorCode.request_timed_out),
+                        error_message=None,
+                    )
+                    for _ in req.creations
+                ]
+        return Msg(throttle_time_ms=0, results=rows)
+
+    async def delete_acls(self, hdr, req) -> Msg:
+        from ..cluster.controller import TopicError
+
+        if not self.server.authorize(
+            AclOperation.alter, AclResourceType.cluster, "kafka-cluster"
+        ):
+            return Msg(
+                throttle_time_ms=0,
+                filter_results=[
+                    Msg(
+                        error_code=int(ErrorCode.cluster_authorization_failed),
+                        error_message=None,
+                        matching_acls=[],
+                    )
+                    for _ in req.filters
+                ],
+            )
+        out = []
+        for f in req.filters:
+            try:
+                flt = _filter_from(f, hdr.api_version >= 1)
+            except ValueError as e:
+                out.append(
+                    Msg(
+                        error_code=int(ErrorCode.invalid_request),
+                        error_message=str(e),
+                        matching_acls=[],
+                    )
+                )
+                continue
+            try:
+                matched = await self.controller.delete_acls(flt)
+                out.append(
+                    Msg(
+                        error_code=0,
+                        error_message=None,
+                        matching_acls=[
+                            Msg(
+                                error_code=0,
+                                error_message=None,
+                                resource_type=int(b.resource_type),
+                                resource_name=b.resource_name,
+                                pattern_type=int(b.pattern_type),
+                                principal=b.principal,
+                                host=b.host,
+                                operation=int(b.operation),
+                                permission_type=int(b.permission),
+                            )
+                            for b in matched
+                        ],
+                    )
+                )
+            except (TopicError, TimeoutError):
+                out.append(
+                    Msg(
+                        error_code=int(ErrorCode.request_timed_out),
+                        error_message=None,
+                        matching_acls=[],
+                    )
+                )
+        return Msg(throttle_time_ms=0, filter_results=out)
+
+    # -- configs ------------------------------------------------------
+    def _topic_configs(self, name: str) -> dict[str, tuple[str | None, bool]]:
+        """name -> (value, is_default)."""
+        md = self.controller.topic_table.get(TopicNamespace(DEFAULT_NS, name))
+        if md is None:
+            return {}
+        out = {k: (v, True) for k, v in TOPIC_CONFIG_DEFAULTS.items()}
+        for k, v in md.config.items():
+            out[k] = (v, False)
+        return out
+
+    async def describe_configs(self, hdr, req) -> Msg:
+        results = []
+        for r in req.resources:
+            if not self.server.authorize(
+                AclOperation.describe_configs,
+                AclResourceType.topic
+                if r.resource_type == _RES_TOPIC
+                else AclResourceType.cluster,
+                r.resource_name if r.resource_type == _RES_TOPIC else "kafka-cluster",
+            ):
+                results.append(
+                    Msg(
+                        error_code=int(
+                            ErrorCode.topic_authorization_failed
+                            if r.resource_type == _RES_TOPIC
+                            else ErrorCode.cluster_authorization_failed
+                        ),
+                        error_message=None,
+                        resource_type=r.resource_type,
+                        resource_name=r.resource_name,
+                        configs=[],
+                    )
+                )
+                continue
+            if r.resource_type == _RES_TOPIC:
+                cfg = self._topic_configs(r.resource_name)
+                if not cfg:
+                    results.append(
+                        Msg(
+                            error_code=int(ErrorCode.unknown_topic_or_partition),
+                            error_message=None,
+                            resource_type=r.resource_type,
+                            resource_name=r.resource_name,
+                            configs=[],
+                        )
+                    )
+                    continue
+            elif r.resource_type == _RES_BROKER:
+                cfg = {k: (v, True) for k, v in BROKER_CONFIG.items()}
+            else:
+                results.append(
+                    Msg(
+                        error_code=int(ErrorCode.invalid_request),
+                        error_message=f"resource type {r.resource_type}",
+                        resource_type=r.resource_type,
+                        resource_name=r.resource_name,
+                        configs=[],
+                    )
+                )
+                continue
+            wanted = (
+                set(r.configuration_keys)
+                if r.configuration_keys is not None
+                else None
+            )
+            results.append(
+                Msg(
+                    error_code=0,
+                    error_message=None,
+                    resource_type=r.resource_type,
+                    resource_name=r.resource_name,
+                    configs=[
+                        Msg(
+                            name=k,
+                            value=v,
+                            read_only=False,
+                            is_default=is_default,
+                            config_source=5 if is_default else 1,
+                            is_sensitive=False,
+                            synonyms=[],
+                        )
+                        for k, (v, is_default) in sorted(cfg.items())
+                        if wanted is None or k in wanted
+                    ],
+                )
+            )
+        return Msg(throttle_time_ms=0, results=results)
+
+    async def _alter_topic(self, name: str, sets, removes) -> int:
+        from ..cluster.controller import TopicError
+
+        try:
+            await self.controller.update_topic_config(
+                name, set_configs=sets, remove_configs=removes
+            )
+            return 0
+        except TopicError as e:
+            from .server import _topic_error_code
+
+            return _topic_error_code(e.code)
+        except TimeoutError:
+            return int(ErrorCode.request_timed_out)
+
+    async def alter_configs(self, hdr, req) -> Msg:
+        out = []
+        for r in req.resources:
+            if r.resource_type != _RES_TOPIC:
+                out.append(
+                    Msg(
+                        error_code=int(ErrorCode.invalid_request),
+                        error_message="only topic configs are alterable",
+                        resource_type=r.resource_type,
+                        resource_name=r.resource_name,
+                    )
+                )
+                continue
+            if not self.server.authorize(
+                AclOperation.alter_configs,
+                AclResourceType.topic,
+                r.resource_name,
+            ):
+                out.append(
+                    Msg(
+                        error_code=int(ErrorCode.topic_authorization_failed),
+                        error_message=None,
+                        resource_type=r.resource_type,
+                        resource_name=r.resource_name,
+                    )
+                )
+                continue
+            code = 0
+            if not req.validate_only:
+                # AlterConfigs semantics: the FULL config set is
+                # replaced — unlisted overrides revert to defaults
+                sets = {c.name: c.value for c in r.configs}
+                current = self._topic_configs(r.resource_name)
+                removes = [
+                    k
+                    for k, (_v, is_default) in current.items()
+                    if not is_default and k not in sets
+                ]
+                code = await self._alter_topic(r.resource_name, sets, removes)
+            out.append(
+                Msg(
+                    error_code=code,
+                    error_message=None,
+                    resource_type=r.resource_type,
+                    resource_name=r.resource_name,
+                )
+            )
+        return Msg(throttle_time_ms=0, responses=out)
+
+    async def incremental_alter_configs(self, hdr, req) -> Msg:
+        out = []
+        for r in req.resources:
+            if r.resource_type != _RES_TOPIC:
+                out.append(
+                    Msg(
+                        error_code=int(ErrorCode.invalid_request),
+                        error_message="only topic configs are alterable",
+                        resource_type=r.resource_type,
+                        resource_name=r.resource_name,
+                    )
+                )
+                continue
+            if not self.server.authorize(
+                AclOperation.alter_configs,
+                AclResourceType.topic,
+                r.resource_name,
+            ):
+                out.append(
+                    Msg(
+                        error_code=int(ErrorCode.topic_authorization_failed),
+                        error_message=None,
+                        resource_type=r.resource_type,
+                        resource_name=r.resource_name,
+                    )
+                )
+                continue
+            sets: dict[str, str | None] = {}
+            removes: list[str] = []
+            bad = False
+            for c in r.configs:
+                if c.config_operation == 0:  # SET
+                    sets[c.name] = c.value
+                elif c.config_operation == 1:  # DELETE
+                    removes.append(c.name)
+                else:  # APPEND/SUBTRACT (list configs) unsupported
+                    bad = True
+            if bad:
+                out.append(
+                    Msg(
+                        error_code=int(ErrorCode.invalid_request),
+                        error_message="unsupported config operation",
+                        resource_type=r.resource_type,
+                        resource_name=r.resource_name,
+                    )
+                )
+                continue
+            code = 0
+            if not req.validate_only:
+                code = await self._alter_topic(r.resource_name, sets, removes)
+            out.append(
+                Msg(
+                    error_code=code,
+                    error_message=None,
+                    resource_type=r.resource_type,
+                    resource_name=r.resource_name,
+                )
+            )
+        return Msg(throttle_time_ms=0, responses=out)
+
+    # -- offsets / partitions -----------------------------------------
+    async def offset_for_leader_epoch(self, hdr, req) -> Msg:
+        topics = []
+        for t in req.topics:
+            parts = []
+            for p in t.partitions:
+                partition = self.server.broker.partition_manager.get(
+                    kafka_ntp(t.topic, p.partition)
+                )
+                if partition is None or not partition.is_leader:
+                    parts.append(
+                        Msg(
+                            error_code=int(ErrorCode.not_leader_for_partition),
+                            partition=p.partition,
+                            leader_epoch=-1,
+                            end_offset=-1,
+                        )
+                    )
+                    continue
+                epoch, end = partition.offset_for_leader_epoch(p.leader_epoch)
+                parts.append(
+                    Msg(
+                        error_code=0,
+                        partition=p.partition,
+                        leader_epoch=epoch,
+                        end_offset=end,
+                    )
+                )
+            topics.append(Msg(topic=t.topic, partitions=parts))
+        return Msg(topics=topics)
+
+    async def create_partitions(self, hdr, req) -> Msg:
+        from ..cluster.controller import TopicError
+        from .server import _topic_error_code
+
+        out = []
+        for t in req.topics:
+            if not self.server.authorize(
+                AclOperation.alter, AclResourceType.topic, t.name
+            ):
+                out.append(
+                    Msg(
+                        name=t.name,
+                        error_code=int(ErrorCode.topic_authorization_failed),
+                        error_message=None,
+                    )
+                )
+                continue
+            code, message = 0, None
+            if t.assignments is not None:
+                code = int(ErrorCode.invalid_request)
+                message = "manual assignments not supported"
+            elif not req.validate_only:
+                try:
+                    await self.controller.create_partitions(t.name, t.count)
+                except TopicError as e:
+                    code, message = _topic_error_code(e.code), e.message
+                except TimeoutError:
+                    code = int(ErrorCode.request_timed_out)
+            out.append(Msg(name=t.name, error_code=code, error_message=message))
+        return Msg(throttle_time_ms=0, results=out)

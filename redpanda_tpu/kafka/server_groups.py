@@ -61,6 +61,15 @@ class GroupHandlers:
     def coordinator(self):
         return self.server.broker.group_coordinator
 
+    def _group_ok(self, group_id: str, operation=None) -> bool:
+        from ..security.acl import AclOperation, AclResourceType
+
+        return self.server.authorize(
+            operation if operation is not None else AclOperation.read,
+            AclResourceType.group,
+            group_id,
+        )
+
     async def find_coordinator(self, hdr, req) -> Msg:
         key_type = getattr(req, "key_type", 0) or 0
         if key_type == 1:  # transaction coordinator
@@ -109,6 +118,8 @@ class GroupHandlers:
                 members=[],
             )
 
+        if not self._group_ok(req.group_id):
+            return err(int(ErrorCode.group_authorization_failed))
         g, code = await self.coordinator.get_group(req.group_id, create=True)
         if code:
             return err(code)
@@ -141,6 +152,12 @@ class GroupHandlers:
         )
 
     async def sync_group(self, hdr, req) -> Msg:
+        if not self._group_ok(req.group_id):
+            return Msg(
+                throttle_time_ms=0,
+                error_code=int(ErrorCode.group_authorization_failed),
+                assignment=b"",
+            )
         g, code = await self.coordinator.get_group(req.group_id)
         if code:
             return Msg(throttle_time_ms=0, error_code=code, assignment=b"")
@@ -162,6 +179,11 @@ class GroupHandlers:
         )
 
     async def heartbeat(self, hdr, req) -> Msg:
+        if not self._group_ok(req.group_id):
+            return Msg(
+                throttle_time_ms=0,
+                error_code=int(ErrorCode.group_authorization_failed),
+            )
         g, code = await self.coordinator.get_group(req.group_id)
         if code:
             return Msg(throttle_time_ms=0, error_code=code)
@@ -171,6 +193,11 @@ class GroupHandlers:
         )
 
     async def leave_group(self, hdr, req) -> Msg:
+        if not self._group_ok(req.group_id):
+            return Msg(
+                throttle_time_ms=0,
+                error_code=int(ErrorCode.group_authorization_failed),
+            )
         g, code = await self.coordinator.get_group(req.group_id)
         if code:
             return Msg(throttle_time_ms=0, error_code=code)
@@ -195,6 +222,8 @@ class GroupHandlers:
                 ],
             )
 
+        if not self._group_ok(req.group_id):
+            return all_errors(int(ErrorCode.group_authorization_failed))
         g, code = await self.coordinator.get_group(req.group_id, create=True)
         if code:
             return all_errors(code)
@@ -217,6 +246,14 @@ class GroupHandlers:
         return all_errors(code)
 
     async def offset_fetch(self, hdr, req) -> Msg:
+        from ..security.acl import AclOperation
+
+        if not self._group_ok(req.group_id, AclOperation.describe):
+            return Msg(
+                throttle_time_ms=0,
+                topics=[],
+                error_code=int(ErrorCode.group_authorization_failed),
+            )
         g, code = await self.coordinator.get_group(req.group_id)
         if code in (
             int(ErrorCode.not_coordinator),
@@ -261,8 +298,22 @@ class GroupHandlers:
         return Msg(throttle_time_ms=0, topics=topics, error_code=0)
 
     async def describe_groups(self, hdr, req) -> Msg:
+        from ..security.acl import AclOperation
+
         out = []
         for group_id in req.groups:
+            if not self._group_ok(group_id, AclOperation.describe):
+                out.append(
+                    Msg(
+                        error_code=int(ErrorCode.group_authorization_failed),
+                        group_id=group_id,
+                        group_state="",
+                        protocol_type="",
+                        protocol_data="",
+                        members=[],
+                    )
+                )
+                continue
             g, code = await self.coordinator.get_group(group_id)
             if code == int(ErrorCode.group_id_not_found):
                 out.append(
@@ -322,8 +373,18 @@ class GroupHandlers:
         )
 
     async def delete_groups(self, hdr, req) -> Msg:
+        from ..security.acl import AclOperation
+
         results = []
         for group_id in req.groups_names:
+            if not self._group_ok(group_id, AclOperation.remove):
+                results.append(
+                    Msg(
+                        group_id=group_id,
+                        error_code=int(ErrorCode.group_authorization_failed),
+                    )
+                )
+                continue
             code = await self.coordinator.delete_group(group_id)
             results.append(Msg(group_id=group_id, error_code=code))
         return Msg(throttle_time_ms=0, results=results)
@@ -369,8 +430,20 @@ class GroupHandlers:
         from ..cluster.controller import TopicError
         from .server import _topic_error_code
 
+        from ..security.acl import AclOperation, AclResourceType
+
         out = []
         for name in req.topic_names:
+            if not self.server.authorize(
+                AclOperation.remove, AclResourceType.topic, name
+            ):
+                out.append(
+                    Msg(
+                        name=name,
+                        error_code=int(ErrorCode.topic_authorization_failed),
+                    )
+                )
+                continue
             code = 0
             try:
                 await self.server.broker.controller.delete_topic(
