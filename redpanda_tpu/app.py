@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import logging
 from typing import Optional
 
 from .cluster import (
@@ -59,6 +60,9 @@ class BrokerConfig:
     enable_sasl: bool = False
     enable_authorization: Optional[bool] = None  # None = follow enable_sasl
     superusers: Optional[list[str]] = None
+    # retention + compaction pass interval (log_compaction_interval_ms
+    # analog); <= 0 disables the timer (tests drive housekeeping directly)
+    housekeeping_interval_s: float = 10.0
 
 
 class Broker:
@@ -140,12 +144,36 @@ class Broker:
         await self.tx_coordinator.start()
         await self.metadata_dissemination.start()
         await self.kafka_server.start()
+        self._housekeeping_task = None
+        if self.config.housekeeping_interval_s > 0:
+            self._housekeeping_task = asyncio.ensure_future(
+                self._housekeeping_loop()
+            )
         self._started = True
+
+    async def _housekeeping_loop(self) -> None:
+        """Periodic retention + compaction sweep (log_manager.h:228-244
+        housekeeping timer). Runs ON the event loop: segment state is
+        mutated by concurrent appends/rolls, and single-threading is the
+        synchronization model everywhere else in this runtime."""
+        while True:
+            await asyncio.sleep(self.config.housekeeping_interval_s)
+            try:
+                self.storage.log_mgr.housekeeping()
+            except Exception:
+                logging.getLogger("app").exception("housekeeping pass failed")
 
     async def stop(self) -> None:
         if not self._started:
             return
         self._started = False
+        if self._housekeeping_task is not None:
+            self._housekeeping_task.cancel()
+            try:
+                await self._housekeeping_task
+            except asyncio.CancelledError:
+                pass
+            self._housekeeping_task = None
         await self.kafka_server.stop()
         await self.metadata_dissemination.stop()
         await self.tx_coordinator.stop()

@@ -18,6 +18,7 @@ from ..models.fundamental import (
     CONTROLLER_GROUP,
     CONTROLLER_NTP,
     DEFAULT_NS,
+    NTP,
     TopicNamespace,
 )
 from ..models.record import RecordBatch, RecordBatchType
@@ -686,12 +687,27 @@ class Controller:
             for d in deltas:
                 try:
                     if d.kind == "add" and self.node_id in d.replicas:
-                        await self._pm.manage(d.ntp, d.group, d.replicas)
+                        await self._pm.manage(
+                            d.ntp,
+                            d.group,
+                            d.replicas,
+                            log_config=self._log_config_for(d.ntp),
+                        )
                         self._shards.insert(d.ntp, d.group)
                     elif d.kind == "del" and self.node_id in d.replicas:
                         self._shards.erase(d.ntp, d.group)
                         await self._pm.remove(d.ntp)
+                    elif d.kind == "cfg":
+                        p = self._pm.get(d.ntp)
+                        if p is not None:
+                            p.log.config = self._log_config_for(d.ntp)
                 except Exception:
                     logger.exception(
                         "node %d: reconciliation failed for %s", self.node_id, d.ntp
                     )
+
+    def _log_config_for(self, ntp: NTP):
+        from ..storage.log import LogConfig
+
+        md = self.topic_table.get(ntp.tp_ns)
+        return LogConfig.from_topic_config(md.config if md else {})

@@ -106,11 +106,14 @@ class Partition:
                 )
             return
         if h.base_sequence >= 0:
+            # last_offset_delta (NOT record_count-1): compaction may
+            # shrink record_count but preserves the offset span, and
+            # the producer's sequence range tracks the original span
             self.producers.observe(
                 h.producer_id,
                 h.producer_epoch,
                 h.base_sequence,
-                h.base_sequence + h.record_count - 1,
+                h.base_sequence + h.last_offset_delta,
                 kbase,
             )
         if h.is_transactional:
@@ -155,16 +158,50 @@ class Partition:
 
     # -- housekeeping -------------------------------------------------
     def housekeeping(self, now_ms: int | None = None) -> None:
-        """Retention for a raft-replicated log (log_manager housekeeping
-        + raft max_collectible_offset): take a snapshot covering the
-        reclaimable prefix first, then let retention drop only segments
-        the snapshot covers — a stopped follower recovers via
-        install_snapshot instead of being stranded."""
+        """Retention + compaction for a raft-replicated log
+        (log_manager housekeeping + raft max_collectible_offset).
+
+        Compaction rewrites only segments fully below the raft commit
+        boundary — compaction preserves every batch's [base, last]
+        range, so replication and the offset translator are unaffected,
+        but uncommitted suffixes may still be truncated by a new leader
+        and must stay byte-identical.
+
+        Retention takes a snapshot covering the reclaimable prefix
+        first, then drops only segments the snapshot covers — a stopped
+        follower recovers via install_snapshot instead of being
+        stranded."""
+        if self.log.config.compaction_enabled:
+            boundary = min(
+                self.consensus.commit_index, self.log.offsets().committed_offset
+            )
+            if boundary >= 0:
+                self.log.compact(boundary, visible=self._record_decided)
+        if not self.log.config.deletion_enabled:
+            return
         target = self.log.retention_offset(now_ms)
         if target is None:
             return
         self.consensus.write_snapshot(target - 1)
         self.log.apply_retention(now_ms, max_offset=self.consensus.snapshot_index)
+
+    def _record_decided(self, batch, raft_offset: int) -> bool:
+        """Compaction participation gate for transactional data: only a
+        COMMITTED record may supersede (and be superseded). Aborted and
+        undecided records neither supersede nor get removed — the
+        fetch-side aborted-range filter owns their invisibility
+        (rm_stm compaction gating on LSO + aborted-tx index)."""
+        h = batch.header
+        if not h.is_transactional:
+            return True
+        koff = self.translator.to_kafka(raft_offset)
+        cur = self.tx.open.get(h.producer_id)
+        if cur is not None and koff >= cur[1]:
+            return False  # inside a still-open transaction
+        return not any(
+            pid == h.producer_id
+            for pid, _first in self.tx.aborted_in(koff, koff + 1)
+        )
 
     def close(self) -> None:
         if self._on_append in self.log.on_append:

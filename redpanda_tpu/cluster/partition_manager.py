@@ -33,11 +33,15 @@ class PartitionManager:
         return self._ntp_table
 
     async def manage(
-        self, ntp: NTP, group_id: int, replicas: list[int]
+        self,
+        ntp: NTP,
+        group_id: int,
+        replicas: list[int],
+        log_config=None,
     ) -> Partition:
         if ntp in self._ntp_table:
             return self._ntp_table[ntp]
-        log = self._log_manager.manage(ntp)
+        log = self._log_manager.manage(ntp, log_config)
         consensus = await self._group_manager.create_group(
             group_id, voters=replicas, log=log
         )

@@ -36,7 +36,7 @@ class TopicMetadata:
 class Delta:
     """One reconciliation unit emitted to controller_backend."""
 
-    kind: str  # "add" | "del"
+    kind: str  # "add" | "del" | "cfg"
     ntp: NTP
     group: int
     replicas: list[int]
@@ -87,6 +87,17 @@ class TopicTable:
         md.config.update(dict(cmd.set_configs))
         for name in cmd.remove_configs:
             md.config.pop(name, None)
+        # live-rebind storage knobs (retention/segment/cleanup.policy)
+        # on every hosting node
+        for a in md.assignments.values():
+            self._pending_deltas.append(
+                Delta(
+                    "cfg",
+                    NTP(cmd.ns, cmd.topic, a.partition),
+                    a.group,
+                    list(a.replicas),
+                )
+            )
 
     def _apply_create_partitions(self, cmd) -> None:
         md = self._topics.get(TopicNamespace(cmd.ns, cmd.topic))

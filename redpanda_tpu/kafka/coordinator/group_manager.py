@@ -198,6 +198,9 @@ class GroupCoordinator:
                     OFFSETS_TOPIC,
                     partitions=self.n_partitions,
                     replication_factor=max(rf, 1),
+                    # latest group/offset state per key is all that
+                    # matters: compact, never time/size-expire
+                    config={"cleanup.policy": "compact"},
                 )
             except TopicError as e:
                 if e.code != "topic_already_exists":

@@ -59,6 +59,15 @@ class BatchCacheIndex:
             self._cache._evict_key((self._log_id, base))
         del self._offsets[:i]
 
+    def evict_range(self, first: int, last: int) -> None:
+        """Drop cached batches whose base falls in [first, last] —
+        compaction rewrote that range; the hot tail above stays cached."""
+        i = bisect.bisect_left(self._offsets, first)
+        j = bisect.bisect_right(self._offsets, last)
+        for base in self._offsets[i:j]:
+            self._cache._evict_key((self._log_id, base))
+        del self._offsets[i:j]
+
     def _forget(self, base: int) -> None:
         i = bisect.bisect_left(self._offsets, base)
         if i < len(self._offsets) and self._offsets[i] == base:

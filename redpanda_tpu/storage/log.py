@@ -23,10 +23,59 @@ class LogConfig:
         segment_max_bytes: int = 128 * 1024 * 1024,
         retention_bytes: int | None = None,
         retention_ms: int | None = None,
+        cleanup_policy: str = "delete",
+        max_compacted_segment_bytes: int = 256 * 1024 * 1024,
     ):
         self.segment_max_bytes = segment_max_bytes
         self.retention_bytes = retention_bytes
         self.retention_ms = retention_ms
+        # "delete", "compact", or "compact,delete" (Kafka cleanup.policy)
+        self.cleanup_policy = cleanup_policy
+        # adjacent-merge budget for compacted segments — deliberately
+        # independent of segment_max_bytes (the reference's
+        # max_compacted_log_segment_size), so heavily-deduped small
+        # segments coalesce even when segment.bytes is small
+        self.max_compacted_segment_bytes = max(
+            max_compacted_segment_bytes, segment_max_bytes
+        )
+
+    @property
+    def compaction_enabled(self) -> bool:
+        return "compact" in self.cleanup_policy
+
+    @property
+    def deletion_enabled(self) -> bool:
+        return "delete" in self.cleanup_policy
+
+    @staticmethod
+    def from_topic_config(config: dict) -> "LogConfig":
+        """Map Kafka topic configs onto storage knobs (the reference
+        threads these through cluster::topic_properties into
+        storage::ntp_config)."""
+
+        def _int(key: str) -> int | None:
+            v = config.get(key)
+            if v is None:
+                return None
+            try:
+                n = int(v)
+            except (TypeError, ValueError):
+                return None
+            return n if n >= 0 else None  # -1 = unlimited
+
+        out = LogConfig()
+        seg = _int("segment.bytes")
+        if seg:
+            out.segment_max_bytes = seg
+        mcs = _int("max.compacted.segment.bytes")
+        if mcs:
+            out.max_compacted_segment_bytes = mcs
+        out.retention_bytes = _int("retention.bytes")
+        out.retention_ms = _int("retention.ms")
+        policy = config.get("cleanup.policy")
+        if policy:
+            out.cleanup_policy = str(policy)
+        return out
 
 
 class LogOffsets:
@@ -333,6 +382,15 @@ class Log:
                 target = min(target, max_offset + 1)
             self.prefix_truncate(target)
         return self.offsets().start_offset
+
+    def compact(self, max_offset: int, visible=None) -> dict:
+        """Key-dedupe compaction of closed segments below max_offset
+        (see storage/compaction.py for the offset-preserving design).
+        `visible(batch, offset)` optionally excludes records (aborted
+        tx data) from participating."""
+        from .compaction import compact_log
+
+        return compact_log(self, max_offset, visible)
 
     def segment_count(self) -> int:
         return len(self._segments)
