@@ -452,9 +452,44 @@ class Consensus:
             if not self.config.is_voter(self.node_id):
                 continue
             try:
-                await self.dispatch_vote()
+                if await self.dispatch_prevote():
+                    await self.dispatch_vote()
             except Exception:
                 logger.exception("g%d: election round failed", self.group_id)
+
+    async def dispatch_prevote(self) -> bool:
+        """Prevote round (prevote_stm.cc): ask voters whether a REAL
+        election at term+1 could win, without mutating any state. A
+        partitioned or flapping node therefore stops bumping terms
+        cluster-wide — its prevotes are denied (peers still hear the
+        leader) or unanswerable (it is cut off), and its term never
+        moves. Grants carry no durable state: no voted_for write, no
+        step-down, no heartbeat-suppression on the receiving side."""
+        offs = self.log.offsets()
+        req = rt.VoteRequest(
+            group=self.group_id,
+            node_id=self.node_id,
+            term=self.term + 1,
+            prev_log_index=offs.dirty_offset,
+            prev_log_term=self.log.term_of_last_batch(),
+            leadership_transfer=False,
+            prevote=True,
+        ).encode()
+
+        async def ask(peer: int) -> Optional[rt.VoteReply]:
+            try:
+                raw = await self._send(peer, rt.VOTE, req, self._election_timeout)
+                return rt.VoteReply.decode(raw)
+            except Exception:
+                return None
+
+        peers = self.peers()
+        replies = await asyncio.gather(*(ask(p) for p in peers))
+        granted = {self.node_id}
+        for peer, rep in zip(peers, replies):
+            if rep is not None and rep.granted:
+                granted.add(peer)
+        return self._has_majority(granted)
 
     async def dispatch_vote(self, leadership_transfer: bool = False) -> bool:
         """One election round (vote_stm.cc). Returns True on win.
@@ -594,6 +629,25 @@ class Consensus:
                 req.prev_log_term == last_term
                 and req.prev_log_index >= offs.dirty_offset
             )
+            if req.prevote:
+                # advisory only: no step-down, no voted_for write, no
+                # election suppression. Deny while a leader is live
+                # (Raft §4.2.3 leader stickiness) so a flapping node
+                # cannot talk a healthy cluster into an election.
+                now = asyncio.get_event_loop().time()
+                leader_live = (
+                    self.role == Role.LEADER
+                    or (
+                        self.leader_id is not None
+                        and now - self._last_heartbeat < self._election_timeout
+                    )
+                )
+                return rt.VoteReply(
+                    group=self.group_id,
+                    term=self.term,
+                    granted=log_ok and not leader_live,
+                    log_ok=log_ok,
+                )
             if req.term > self.term:
                 self._step_down(int(req.term))
             granted = log_ok and (
@@ -847,8 +901,28 @@ class Consensus:
                 and self.role == Role.LEADER
                 and self._follower_needs_data(peer)
             ):
+                slot = self._slot_map.get(peer)
+                if slot is None:
+                    return  # peer left the configuration
+                before = (
+                    int(self.arrays.match_index[self.row, slot]),
+                    int(self.arrays.flushed_index[self.row, slot]),
+                )
                 if not await self._dispatch_append(peer):
                     return
+                slot = self._slot_map.get(peer)
+                if slot is None:
+                    return
+                after = (
+                    int(self.arrays.match_index[self.row, slot]),
+                    int(self.arrays.flushed_index[self.row, slot]),
+                )
+                if after <= before:
+                    # no forward progress this round (mismatch backoff,
+                    # reordered reply, stuck follower): yield — a hot
+                    # retry loop here monopolizes the event loop with
+                    # full-size append payloads (recovery_stm backoff)
+                    await asyncio.sleep(0.02)
 
     def _follower_needs_data(self, peer: int) -> bool:
         slot = self._slot_map[peer]

@@ -273,6 +273,64 @@ def test_state_machine_applies_committed(tmp_path):
     run(main())
 
 
+def test_prevote_isolated_node_does_not_bump_terms(tmp_path):
+    """A partitioned node must not advance its term (prevote_stm.cc):
+    its prevotes go unanswered, so the real election never starts, and
+    on heal it rejoins without forcing the leader to step down."""
+
+    async def main():
+        cluster = RaftCluster(tmp_path, n_nodes=3)
+        await cluster.start()
+        await cluster.create_group()
+        leader = await cluster.wait_leader()
+        stable_term = leader.term
+        victim = next(
+            nid for nid in cluster.nodes if nid != leader.node_id
+        )
+        victim_c = cluster.consensus(victim)
+        cluster.net.isolate(victim)
+        # several election timeouts' worth of isolation
+        await asyncio.sleep(1.0)
+        assert victim_c.term == stable_term, (
+            "isolated node bumped its term despite prevote"
+        )
+        assert victim_c.role != Role.LEADER
+        cluster.net.heal(victim)
+        await asyncio.sleep(0.3)
+        # leader undisturbed, victim follows at the same term
+        assert leader.role == Role.LEADER
+        assert leader.term == stable_term
+        assert victim_c.term == stable_term
+        await cluster.stop()
+
+    run(main())
+
+
+def test_prevote_denied_while_leader_live(tmp_path):
+    """A node that merely missed heartbeats (not partitioned) asks for
+    prevotes; peers that still hear the leader deny them."""
+
+    async def main():
+        cluster = RaftCluster(tmp_path, n_nodes=3)
+        await cluster.start()
+        await cluster.create_group()
+        leader = await cluster.wait_leader()
+        follower = next(
+            nid for nid in cluster.nodes if nid != leader.node_id
+        )
+        fc = cluster.consensus(follower)
+        # peers hear the leader: prevote at term+1 must be denied
+        granted = await fc.dispatch_prevote()
+        assert not granted
+        # kill the leader: prevotes are now granted and an election runs
+        cluster.net.isolate(leader.node_id)
+        new_leader = await cluster.wait_leader()
+        assert new_leader.node_id != leader.node_id
+        await cluster.stop()
+
+    run(main())
+
+
 def test_leadership_transfer(tmp_path):
     async def main():
         cluster = RaftCluster(tmp_path, n_nodes=3)
