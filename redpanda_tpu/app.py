@@ -26,7 +26,9 @@ from .cluster import (
     PartitionManager,
     ShardTable,
 )
+from .cluster.health_monitor import HealthMonitor
 from .cluster.metadata_dissemination import MetadataDissemination
+from .cluster.node_status import NodeStatusBackend, NodeStatusService
 from .cluster.tx_coordinator import TxCoordinator
 from .kafka.coordinator import GroupCoordinator
 from .kafka.server import KafkaServer
@@ -50,11 +52,18 @@ class BrokerConfig:
     rpc_host: str = "127.0.0.1"
     rpc_port: int = 0
     advertised_host: Optional[str] = None
-    # node_id → advertised (host, kafka_port) of peers; self is implicit.
-    # stage-7 members_table/gossip replaces this static map.
+    # node_id → advertised (host, kafka_port) of peers; bootstrap
+    # fallback only — the replicated members table takes precedence
+    # once nodes register
     peer_kafka_addresses: Optional[dict[int, tuple[str, int]]] = None
     election_timeout_s: float = 0.3
     heartbeat_interval_s: float = 0.05
+    # liveness ping cadence (node_status_backend); <= 0 disables
+    node_status_interval_s: float = 0.5
+    # register this node's endpoints with the cluster at startup (and
+    # join raft0 as a voter when not a seed); loopback fixtures that
+    # don't exercise membership can turn it off
+    auto_join: bool = True
     # SASL/SCRAM authentication on the kafka listener; when on,
     # authorization (ACLs) is enforced too unless overridden
     enable_sasl: bool = False
@@ -84,10 +93,8 @@ class Broker:
             self._rpc_server: Optional[RpcServer] = None
             self._dispatcher = loopback.register_node(config.node_id)
         else:
-            assert config.peer_addresses is not None
-            addrs = config.peer_addresses
             self._conn_cache = ConnectionCache(
-                lambda nid: TcpTransport(*addrs[nid])
+                lambda nid: TcpTransport(*self._rpc_addr_of(nid))
             )
             self._rpc_server = RpcServer(config.rpc_host, config.rpc_port)
             self._dispatcher = None
@@ -122,7 +129,26 @@ class Broker:
         self.tx_coordinator = TxCoordinator(self)
         self.metadata_dissemination = MetadataDissemination(self)
         self.kafka_server = KafkaServer(self)
+        self.node_status = NodeStatusBackend(
+            config.node_id,
+            send,
+            peers=lambda: self.controller.members,
+            interval_s=config.node_status_interval_s,
+        )
+        self.node_status_service = NodeStatusService(config.node_id)
+        self.health_monitor = HealthMonitor(self)
         self._started = False
+
+    def _rpc_addr_of(self, node_id: int) -> tuple[str, int]:
+        """Peer RPC address: replicated members table first (dynamic
+        joins), static seed map as bootstrap fallback."""
+        addr = self.controller.members_table.rpc_addr(node_id)
+        if addr is not None:
+            return addr
+        static = self.config.peer_addresses or {}
+        if node_id in static:
+            return static[node_id]
+        raise KeyError(f"no rpc address for node {node_id}")
 
     # -- lifecycle ---------------------------------------------------
     async def start(self) -> None:
@@ -131,6 +157,7 @@ class Broker:
             self.controller.service,
             self.metadata_dissemination.service,
             self.tx_coordinator.service,
+            self.node_status_service,
         ):
             if self._rpc_server is not None:
                 self._rpc_server.register(svc)
@@ -144,12 +171,34 @@ class Broker:
         await self.tx_coordinator.start()
         await self.metadata_dissemination.start()
         await self.kafka_server.start()
+        if self.config.node_status_interval_s > 0:
+            await self.node_status.start()
+        self._join_task = None
+        if self.config.auto_join:
+            self._join_task = asyncio.ensure_future(self._register_self())
         self._housekeeping_task = None
         if self.config.housekeeping_interval_s > 0:
             self._housekeeping_task = asyncio.ensure_future(
                 self._housekeeping_loop()
             )
         self._started = True
+
+    async def _register_self(self) -> None:
+        """Announce this node's endpoints through the controller log
+        (cluster_discovery.cc startup registration). For a node not in
+        the seed set this IS the join: the leader adds it to raft0."""
+        rpc_addr = (
+            self.config.advertised_host or self.config.rpc_host,
+            self._rpc_server.port if self._rpc_server is not None else 0,
+        )
+        try:
+            await self.controller.join_cluster(
+                rpc_addr, self.kafka_advertised, timeout=30.0
+            )
+        except Exception:
+            logging.getLogger("app").exception(
+                "node %d: cluster registration failed", self.node_id
+            )
 
     async def _housekeeping_loop(self) -> None:
         """Periodic retention + compaction sweep (log_manager.h:228-244
@@ -167,6 +216,14 @@ class Broker:
         if not self._started:
             return
         self._started = False
+        if self._join_task is not None:
+            self._join_task.cancel()
+            try:
+                await self._join_task
+            except asyncio.CancelledError:
+                pass
+            self._join_task = None
+        await self.node_status.stop()
         if self._housekeeping_task is not None:
             self._housekeeping_task.cancel()
             try:
@@ -199,6 +256,9 @@ class Broker:
     def kafka_address_of(self, node_id: int) -> Optional[tuple[str, int]]:
         if node_id == self.node_id:
             return self.kafka_advertised
+        addr = self.controller.members_table.kafka_addr(node_id)
+        if addr is not None:
+            return addr
         peers = self.config.peer_kafka_addresses
         if peers is not None:
             return peers.get(node_id)

@@ -35,13 +35,34 @@ class PartitionAllocator:
             if r in self._counts:
                 self._counts[r] += sign
 
+    def pick_replacement(
+        self, current: list[int], exclude: set[int]
+    ) -> int | None:
+        """Least-loaded registered node not already a replica and not
+        excluded (draining/dead) — the drain loop's per-partition move
+        target (scheduling/constraints.cc distinct_nodes + least_
+        allocated analog)."""
+        candidates = [
+            n
+            for n in sorted(self._counts)
+            if n not in current and n not in exclude
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda n: self._counts[n])
+
     def allocate(
         self,
         partition_count: int,
         replication_factor: int,
         next_group: int,
+        exclude: set[int] | None = None,
     ) -> list[PartitionAssignment]:
-        nodes = sorted(self._counts)
+        """`exclude` removes draining/decommissioning nodes from
+        eligibility — placing new replicas on a node being emptied
+        would fight the drain loop (allocation_state.cc skips
+        non-active members)."""
+        nodes = sorted(n for n in self._counts if not exclude or n not in exclude)
         if replication_factor > len(nodes):
             raise AllocationError(
                 f"replication factor {replication_factor} > {len(nodes)} brokers"

@@ -27,6 +27,11 @@ class CmdType(enum.IntEnum):
     config_set = 8
     allocate_producer_id = 9
     create_partitions = 10
+    register_node = 11
+    decommission_node = 12
+    recommission_node = 13
+    move_replicas = 14
+    finish_move = 15
 
 
 class PartitionAssignmentE(serde.Envelope):
@@ -125,6 +130,60 @@ class DeleteAclsCmd(serde.Envelope):
     ]
 
 
+class RegisterNodeCmd(serde.Envelope):
+    """Node join / address (re)registration (reference:
+    members_manager.cc apply_update of add_node_cmd /
+    update_node_cfg_cmd — one idempotent upsert here)."""
+
+    SERDE_FIELDS = [
+        ("node_id", serde.i32),
+        ("rpc_host", serde.string),
+        ("rpc_port", serde.i32),
+        ("kafka_host", serde.string),
+        ("kafka_port", serde.i32),
+    ]
+
+
+class DecommissionNodeCmd(serde.Envelope):
+    """Mark a node draining (decommission_node_cmd); replica moves off
+    it are driven by the controller leader's drain loop."""
+
+    SERDE_FIELDS = [("node_id", serde.i32)]
+
+
+class RecommissionNodeCmd(serde.Envelope):
+    SERDE_FIELDS = [("node_id", serde.i32)]
+
+
+class MoveReplicasCmd(serde.Envelope):
+    """Reassign one partition's replica set (move_partition_replicas_cmd).
+    Applies to the topic table immediately; the raft group's joint
+    reconfiguration is reconciled by the hosting nodes."""
+
+    SERDE_FIELDS = [
+        ("ns", serde.string),
+        ("topic", serde.string),
+        ("partition", serde.i32),
+        ("replicas", serde.vector(serde.i32)),
+    ]
+
+
+class FinishMoveCmd(serde.Envelope):
+    """Reported by the data group's leader once the raft
+    reconfiguration onto `replicas` is final and committed
+    (finish_moving_partition_replicas_cmd). Only now may losing nodes
+    delete their local replica — removing earlier could destroy a
+    committed entry's last surviving copy if the new set elects a
+    laggard."""
+
+    SERDE_FIELDS = [
+        ("ns", serde.string),
+        ("topic", serde.string),
+        ("partition", serde.i32),
+        ("replicas", serde.vector(serde.i32)),
+    ]
+
+
 CMD_CLASSES = {
     CmdType.create_topic: CreateTopicCmd,
     CmdType.delete_topic: DeleteTopicCmd,
@@ -135,6 +194,11 @@ CMD_CLASSES = {
     CmdType.delete_user: DeleteUserCmd,
     CmdType.create_acls: CreateAclsCmd,
     CmdType.delete_acls: DeleteAclsCmd,
+    CmdType.register_node: RegisterNodeCmd,
+    CmdType.decommission_node: DecommissionNodeCmd,
+    CmdType.recommission_node: RecommissionNodeCmd,
+    CmdType.move_replicas: MoveReplicasCmd,
+    CmdType.finish_move: FinishMoveCmd,
 }
 
 

@@ -38,25 +38,33 @@ from .commands import (
     CreatePartitionsCmd,
     CreateTopicCmd,
     CreateUserCmd,
+    DecommissionNodeCmd,
     DeleteAclsCmd,
     DeleteTopicCmd,
     DeleteUserCmd,
+    FinishMoveCmd,
+    MoveReplicasCmd,
     PartitionAssignmentE,
+    RecommissionNodeCmd,
+    RegisterNodeCmd,
     UpdateTopicConfigCmd,
     decode_commands,
     encode_command,
 )
+from .members import MembersTable, MembershipState
 from .partition_manager import PartitionManager
 from .shard_table import ShardTable
 from .topic_table import TopicTable
 
 logger = logging.getLogger("cluster.controller")
 
-# rpc method ids (raft uses 100-104; dissemination 210; tx 220-221)
+# rpc method ids (raft uses 100-104; dissemination 210; tx 220-221;
+# node_status 230)
 CREATE_TOPIC = 200
 DELETE_TOPIC = 201
 ALLOCATE_PRODUCER_ID = 202
 REPLICATE_CMD = 203  # generic leader-routed controller command
+JOIN_NODE = 204  # node join: register endpoints + add as raft0 voter
 
 
 class TopicError(Exception):
@@ -145,6 +153,29 @@ class ControllerStm(StateMachine):
                 )
             elif cmd_type == CmdType.delete_acls:
                 self._c.acls.remove_matching(_cmd_to_filter(cmd))
+            elif cmd_type == CmdType.register_node:
+                self._c.members_table.apply_register(
+                    int(cmd.node_id),
+                    (cmd.rpc_host, int(cmd.rpc_port)),
+                    (cmd.kafka_host, int(cmd.kafka_port)),
+                )
+                self.allocator.register_node(int(cmd.node_id))
+            elif cmd_type == CmdType.decommission_node:
+                self._c.members_table.apply_state(
+                    int(cmd.node_id), MembershipState.draining
+                )
+            elif cmd_type == CmdType.recommission_node:
+                self._c.members_table.apply_state(
+                    int(cmd.node_id), MembershipState.active
+                )
+            elif cmd_type == CmdType.move_replicas:
+                md = self.topic_table.get(TopicNamespace(cmd.ns, cmd.topic))
+                if md is not None:
+                    a = md.assignments.get(int(cmd.partition))
+                    new = [int(r) for r in cmd.replicas]
+                    if a is not None and a.replicas != new:
+                        self.allocator.account(a.replicas, sign=-1)
+                        self.allocator.account(new)
             # topic_table.apply handles its own families and bumps the
             # applied revision for every command type, which is what
             # wait_revision barriers on
@@ -230,6 +261,21 @@ class ControllerService(Service):
                 code="not_controller", message="", revision=-1
             ).encode()
 
+    @method(JOIN_NODE)
+    async def join_node(self, payload: bytes) -> bytes:
+        cmd = RegisterNodeCmd.decode(payload)
+        try:
+            base = await self._controller.join_node_local(cmd)
+            return _TopicReply(code="", message="", revision=base).encode()
+        except TopicError as e:
+            return _TopicReply(
+                code=e.code, message=e.message, revision=-1
+            ).encode()
+        except NotLeaderError:
+            return _TopicReply(
+                code="not_controller", message="", revision=-1
+            ).encode()
+
     @method(DELETE_TOPIC)
     async def delete_topic(self, payload: bytes) -> bytes:
         req = _TopicReq.decode(payload)
@@ -256,14 +302,16 @@ class Controller:
         self._gm = group_manager
         self._pm = partition_manager
         self._shards = shard_table
-        self.members = list(members)
+        self.seeds = list(members)
         self._send = send
         self.topic_table = TopicTable()
         self.allocator = PartitionAllocator()
         self.credentials = CredentialStore()
         self.acls = AclStore()
         self.authorizer = Authorizer(self.acls)
+        self.members_table = MembersTable()
         for m in members:
+            self.members_table.seed(m)
             self.allocator.register_node(m)
         self.consensus = None
         self.stm: Optional[ControllerStm] = None
@@ -271,12 +319,18 @@ class Controller:
         self._backend_task: Optional[asyncio.Task] = None
         self._create_lock = asyncio.Lock()
         self._local_next_group = 1
+        self._move_tasks: dict = {}
         self._closed = False
+
+    @property
+    def members(self) -> list[int]:
+        """All known cluster members (registered + unregistered seeds)."""
+        return self.members_table.node_ids()
 
     # -- lifecycle ---------------------------------------------------
     async def start(self) -> None:
         self.consensus = await self._gm.create_group(
-            int(CONTROLLER_GROUP), voters=self.members
+            int(CONTROLLER_GROUP), voters=self.seeds
         )
         self.stm = ControllerStm(self.consensus, self)
         await self.stm.start()
@@ -284,6 +338,9 @@ class Controller:
 
     async def stop(self) -> None:
         self._closed = True
+        for t in list(self._move_tasks.values()):
+            t.cancel()
+        self._move_tasks.clear()
         if self._backend_task is not None:
             self._backend_task.cancel()
             try:
@@ -389,7 +446,10 @@ class Controller:
             )
             try:
                 assignments = self.allocator.allocate(
-                    partitions, replication_factor, next_group
+                    partitions,
+                    replication_factor,
+                    next_group,
+                    exclude=self._draining_nodes(),
                 )
             except AllocationError as e:
                 raise TopicError("invalid_replication_factor", str(e)) from None
@@ -487,6 +547,108 @@ class Controller:
                 continue
             raise TopicError(reply.code, reply.message)
 
+    # -- membership frontends ------------------------------------------
+    async def join_node_local(self, cmd: RegisterNodeCmd) -> int:
+        """Leader side of a node join (members_manager.cc
+        handle_join_request): replicate the registration, then add the
+        node to raft group 0's voter set if it isn't one yet."""
+        if self.consensus is None or not self.is_leader:
+            raise NotLeaderError(self.leader_id)
+        base = await self.replicate_cmd_local(CmdType.register_node, cmd)
+        nid = int(cmd.node_id)
+        voters = list(self.consensus.config.voters)
+        if nid not in voters:
+            await self.consensus.change_configuration(voters + [nid])
+        return base
+
+    async def join_cluster(
+        self,
+        rpc_addr: tuple[str, int],
+        kafka_addr: tuple[str, int],
+        timeout: float = 15.0,
+    ) -> None:
+        """Joiner side (cluster_discovery.cc): announce this node's
+        endpoints to the cluster through any seed, retrying around
+        leadership placement. Seeds also call this to register their
+        own addresses (idempotent upsert)."""
+        cmd = RegisterNodeCmd(
+            node_id=self.node_id,
+            rpc_host=rpc_addr[0],
+            rpc_port=int(rpc_addr[1]),
+            kafka_host=kafka_addr[0],
+            kafka_port=int(kafka_addr[1]),
+        )
+        deadline = asyncio.get_event_loop().time() + timeout
+        payload = cmd.encode()
+        while True:
+            if self.is_leader:
+                await self.join_node_local(cmd)
+                return
+            last_err = "no seed reachable"
+            for seed in self.seeds:
+                if seed == self.node_id:
+                    continue
+                try:
+                    raw = await self._send(seed, JOIN_NODE, payload, 5.0)
+                except Exception as e:
+                    last_err = f"seed {seed}: {e}"
+                    continue
+                reply = _TopicReply.decode(raw)
+                if reply.code == "":
+                    if reply.revision >= 0:
+                        await self.topic_table.wait_revision(
+                            reply.revision,
+                            max(
+                                0.01,
+                                deadline
+                                - asyncio.get_event_loop().time(),
+                            ),
+                        )
+                    return
+                last_err = reply.code
+            if asyncio.get_event_loop().time() > deadline:
+                raise TopicError("request_timed_out", f"join: {last_err}")
+            await asyncio.sleep(0.1)
+
+    async def decommission_node(self, node_id: int) -> None:
+        """Mark draining; the leader's drain pass then moves every
+        replica off it (members_backend.cc reallocation loop)."""
+        if node_id not in self.members_table:
+            raise TopicError("unknown_server_error", f"no node {node_id}")
+        await self.replicate_cmd(
+            CmdType.decommission_node, DecommissionNodeCmd(node_id=node_id)
+        )
+
+    async def recommission_node(self, node_id: int) -> None:
+        await self.replicate_cmd(
+            CmdType.recommission_node, RecommissionNodeCmd(node_id=node_id)
+        )
+
+    async def move_partition_replicas(
+        self, topic: str, partition: int, replicas: list[int], ns: str = DEFAULT_NS
+    ) -> None:
+        """Reassign one partition's replica set
+        (topics_frontend.cc move_partition_replicas)."""
+        md = self.topic_table.get(TopicNamespace(ns, topic))
+        if md is None:
+            raise TopicError("unknown_topic_or_partition", topic)
+        if partition not in md.assignments:
+            raise TopicError("unknown_topic_or_partition", f"{topic}/{partition}")
+        if not replicas or len(set(replicas)) != len(replicas):
+            raise TopicError(
+                "invalid_replication_factor",
+                f"replica set must be non-empty and distinct: {replicas}",
+            )
+        for r in replicas:
+            if r not in self.members_table:
+                raise TopicError("unknown_server_error", f"no node {r}")
+        await self.replicate_cmd(
+            CmdType.move_replicas,
+            MoveReplicasCmd(
+                ns=ns, topic=topic, partition=partition, replicas=replicas
+            ),
+        )
+
     # -- security frontends -------------------------------------------
     async def create_user(self, user: str, credential_raw: bytes) -> None:
         await self.replicate_cmd(
@@ -578,7 +740,10 @@ class Controller:
             )
             try:
                 assignments = self.allocator.allocate(
-                    add, md.replication_factor, next_group
+                    add,
+                    md.replication_factor,
+                    next_group,
+                    exclude=self._draining_nodes(),
                 )
             except AllocationError as e:
                 raise TopicError("invalid_replication_factor", str(e)) from None
@@ -675,7 +840,8 @@ class Controller:
     # -- backend reconciliation --------------------------------------
     async def _backend_loop(self) -> None:
         """Turn topic_table deltas into local partition create/remove
-        (reference: cluster/controller_backend.{h,cc})."""
+        (reference: cluster/controller_backend.{h,cc}); periodically
+        runs the leader-only drain pass for decommissioning nodes."""
         while not self._closed:
             deltas = self.topic_table.drain_deltas()
             if not deltas:
@@ -683,6 +849,9 @@ class Controller:
                     await self.topic_table.wait_change(timeout=1.0)
                 except Exception:
                     pass
+                self._move_repair_pass()
+                if self.is_leader:
+                    await self._drain_pass()
                 continue
             for d in deltas:
                 try:
@@ -701,10 +870,187 @@ class Controller:
                         p = self._pm.get(d.ntp)
                         if p is not None:
                             p.log.config = self._log_config_for(d.ntp)
+                    elif d.kind == "move":
+                        await self._reconcile_move(d)
+                    elif d.kind == "purge":
+                        # reconfiguration is final (finish_move
+                        # committed): losers drop their local replica
+                        if (
+                            self.node_id not in d.replicas
+                            and self._pm.get(d.ntp) is not None
+                        ):
+                            t = self._move_tasks.pop(d.ntp, None)
+                            if t is not None:
+                                t.cancel()
+                            self._shards.erase(d.ntp, d.group)
+                            await self._pm.remove(d.ntp)
                 except Exception:
                     logger.exception(
                         "node %d: reconciliation failed for %s", self.node_id, d.ntp
                     )
+
+    async def _reconcile_move(self, d) -> None:
+        """One node's share of a replica move. Gaining nodes create the
+        raft instance against the OLD replica set (they are not voters
+        yet — the group leader's joint reconfiguration adds them); every
+        hosting node then runs a convergence task that (a) retries
+        change_configuration whenever it is the leader and the config
+        is stale, and (b) removes the local replica once the final
+        config excludes this node. Reference: controller_backend.cc
+        update stages + raft change_configuration."""
+        if self.node_id in d.replicas:
+            if self._pm.get(d.ntp) is None:
+                await self._pm.manage(
+                    d.ntp,
+                    d.group,
+                    d.old_replicas,
+                    log_config=self._log_config_for(d.ntp),
+                )
+                self._shards.insert(d.ntp, d.group)
+        if self._pm.get(d.ntp) is None:
+            return  # not hosting; nothing to converge
+        prev = self._move_tasks.pop(d.ntp, None)
+        if prev is not None:
+            prev.cancel()
+        self._move_tasks[d.ntp] = asyncio.ensure_future(
+            self._converge_move(d.ntp, d.group, list(d.replicas))
+        )
+
+    async def _converge_move(
+        self, ntp, group: int, target: list[int], timeout: float = 30.0
+    ) -> None:
+        """Drive the data group's raft config to `target`, then report
+        completion through the controller log (finish_move) so losing
+        nodes purge safely. A node being REMOVED may never see the
+        final config batch (the leader drops it from the replication
+        set at append time) — it simply waits here until the purge
+        delta deletes its partition and the task with it."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        want = set(target)
+        while not self._closed:
+            p = self._pm.get(ntp)
+            if p is None:
+                self._move_tasks.pop(ntp, None)
+                return
+            c = p.consensus
+            last_cfg_offset = (
+                c._config_history[-1][0] if c._config_history else -1
+            )
+            done = (
+                not c.config.is_joint()
+                and set(c.config.voters) == want
+                and c.commit_index >= last_cfg_offset
+            )
+            if done:
+                if c.is_leader():
+                    # only the group leader reports: it KNOWS the final
+                    # config committed (its own commit_index covers it)
+                    try:
+                        await self.replicate_cmd(
+                            CmdType.finish_move,
+                            FinishMoveCmd(
+                                ns=ntp.ns,
+                                topic=ntp.topic,
+                                partition=ntp.partition,
+                                replicas=target,
+                            ),
+                        )
+                        self._move_tasks.pop(ntp, None)
+                        return
+                    except Exception as e:
+                        logger.info(
+                            "g%d move: finish report failed: %s", group, e
+                        )
+                elif self.node_id not in want:
+                    # safe self-removal: our own commit_index covers the
+                    # final config batch, so the new replica set has
+                    # committed it — unlike the stuck-joint case (which
+                    # waits for the leader's finish_move → purge), no
+                    # committed entry can depend on this copy anymore
+                    self._move_tasks.pop(ntp, None)
+                    self._shards.erase(ntp, group)
+                    await self._pm.remove(ntp)
+                    return
+                else:
+                    self._move_tasks.pop(ntp, None)
+                    return
+            elif c.is_leader():
+                try:
+                    await c.change_configuration(target)
+                except Exception as e:
+                    logger.info(
+                        "g%d move: reconfig attempt failed: %s", group, e
+                    )
+            if asyncio.get_event_loop().time() > deadline:
+                logger.warning("g%d move to %s: convergence timed out", group, target)
+                self._move_tasks.pop(ntp, None)
+                return
+            await asyncio.sleep(0.1)
+
+    def _draining_nodes(self) -> set[int]:
+        return {
+            nid
+            for nid in self.members_table.node_ids()
+            if self.members_table.is_draining(nid)
+        }
+
+    def _move_repair_pass(self) -> None:
+        """Level-triggered repair (controller_backend reconciliation
+        fibers): any hosted partition whose raft config disagrees with
+        the topic-table assignment gets a (re)spawned convergence task.
+        Heals moves whose delta-driven task timed out or died with the
+        process — the assignment in raft0 is the durable intent."""
+        for ntp, p in list(self._pm.partitions().items()):
+            md = self.topic_table.get(ntp.tp_ns)
+            if md is None:
+                continue
+            a = md.assignments.get(ntp.partition)
+            if a is None:
+                continue
+            want = set(a.replicas)
+            c = p.consensus
+            converged = not c.config.is_joint() and set(c.config.voters) == want
+            stale_local = converged and self.node_id not in want
+            if (not converged or stale_local) and ntp not in self._move_tasks:
+                self._move_tasks[ntp] = asyncio.ensure_future(
+                    self._converge_move(ntp, a.group, list(a.replicas))
+                )
+
+    async def _drain_pass(self) -> None:
+        """Leader-only: move replicas off draining nodes, one partition
+        per draining node per pass (members_backend.cc incremental
+        reallocation)."""
+        draining = [
+            nid
+            for nid in self.members_table.node_ids()
+            if self.members_table.is_draining(nid)
+        ]
+        if not draining:
+            return
+        for nid in draining:
+            moved = False
+            for tp_ns, md in list(self.topic_table.topics().items()):
+                if moved:
+                    break
+                for a in md.assignments.values():
+                    if nid not in a.replicas:
+                        continue
+                    repl = self.allocator.pick_replacement(
+                        a.replicas, exclude=set(draining)
+                    )
+                    if repl is None:
+                        continue  # this partition is stuck; try others
+                    new = [repl if r == nid else r for r in a.replicas]
+                    try:
+                        await self.move_partition_replicas(
+                            tp_ns.topic, a.partition, new, ns=tp_ns.ns
+                        )
+                    except Exception:
+                        logger.exception(
+                            "drain: move %s/%d failed", tp_ns.topic, a.partition
+                        )
+                    moved = True  # one move per node per pass
+                    break
 
     def _log_config_for(self, ntp: NTP):
         from ..storage.log import LogConfig

@@ -36,10 +36,13 @@ class TopicMetadata:
 class Delta:
     """One reconciliation unit emitted to controller_backend."""
 
-    kind: str  # "add" | "del" | "cfg"
+    kind: str  # "add" | "del" | "cfg" | "move" | "purge"
     ntp: NTP
     group: int
     replicas: list[int]
+    # "move" only: the replica set being replaced (new nodes bootstrap
+    # their raft instance from it; the group leader reconfigures old→new)
+    old_replicas: list[int] = dataclasses.field(default_factory=list)
 
 
 class TopicTable:
@@ -77,8 +80,56 @@ class TopicTable:
             self._apply_update_config(cmd)
         elif cmd_type == CmdType.create_partitions:
             self._apply_create_partitions(cmd)
+        elif cmd_type == CmdType.move_replicas:
+            self._apply_move(cmd)
+        elif cmd_type == CmdType.finish_move:
+            self._apply_finish_move(cmd)
         self.revision = revision
         self._notify()
+
+    def _apply_finish_move(self, cmd) -> None:
+        """The data group's reconfiguration is final: losers may purge
+        their local replica (finish_moving_partition_replicas apply)."""
+        md = self._topics.get(TopicNamespace(cmd.ns, cmd.topic))
+        if md is None:
+            return
+        a = md.assignments.get(int(cmd.partition))
+        if a is None:
+            return
+        if [int(r) for r in cmd.replicas] != a.replicas:
+            # stale report from a superseded move: purging against it
+            # would delete replicas the CURRENT assignment owns
+            return
+        self._pending_deltas.append(
+            Delta(
+                "purge",
+                NTP(cmd.ns, cmd.topic, a.partition),
+                a.group,
+                [int(r) for r in cmd.replicas],
+            )
+        )
+
+    def _apply_move(self, cmd) -> None:
+        md = self._topics.get(TopicNamespace(cmd.ns, cmd.topic))
+        if md is None:
+            return
+        a = md.assignments.get(int(cmd.partition))
+        if a is None:
+            return
+        new = [int(r) for r in cmd.replicas]
+        if new == a.replicas:
+            return  # idempotent re-apply
+        old = list(a.replicas)
+        a.replicas = new
+        self._pending_deltas.append(
+            Delta(
+                "move",
+                NTP(cmd.ns, cmd.topic, a.partition),
+                a.group,
+                new,
+                old_replicas=old,
+            )
+        )
 
     def _apply_update_config(self, cmd) -> None:
         md = self._topics.get(TopicNamespace(cmd.ns, cmd.topic))

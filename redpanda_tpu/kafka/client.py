@@ -454,7 +454,11 @@ class KafkaClient:
         for key, value in records:
             builder.add(value, key=key)
         wire = builder.build().to_kafka_wire()
-        for attempt in range(2):
+        # leadership can be mid-flight (fresh topic, election, replica
+        # move): retry with metadata refresh like real clients do
+        for attempt in range(8):
+            if attempt:
+                await asyncio.sleep(0.1)
             conn = await self.leader_conn(topic, partition, refresh=attempt > 0)
             v = conn.pick_version(PRODUCE, 7)
             req = Msg(
@@ -505,7 +509,9 @@ class KafkaClient:
         read_committed: bool = False,
     ) -> list[tuple[int, bytes | None, bytes | None]]:
         """Returns [(offset, key, value)] at-or-after `offset`."""
-        for attempt in range(2):
+        for attempt in range(8):
+            if attempt:
+                await asyncio.sleep(0.1)
             conn = await self.leader_conn(topic, partition, refresh=attempt > 0)
             v = conn.pick_version(FETCH, 11)
             req = Msg(

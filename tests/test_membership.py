@@ -1,0 +1,225 @@
+"""Dynamic membership, node liveness, and replica movement.
+
+Reference test model: cluster/tests/members_manager_test.cc,
+rptest/tests/nodes_decommissioning_test.py, node_status tests —
+start a cluster, join a node through the controller, move replicas
+onto it, kill a node, observe health.
+"""
+
+import asyncio
+import contextlib
+
+import pytest
+
+from redpanda_tpu.app import Broker, BrokerConfig
+from redpanda_tpu.kafka.client import KafkaClient
+from redpanda_tpu.models.fundamental import kafka_ntp
+from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+
+@contextlib.asynccontextmanager
+async def seed_cluster(tmp_path, n=3, **cfg_kw):
+    net = LoopbackNetwork()
+    members = list(range(n))
+    brokers = [
+        Broker(
+            BrokerConfig(
+                node_id=i,
+                data_dir=str(tmp_path / f"node{i}"),
+                members=members,
+                election_timeout_s=0.15,
+                heartbeat_interval_s=0.03,
+                node_status_interval_s=0.1,
+                **cfg_kw,
+            ),
+            loopback=net,
+        )
+        for i in members
+    ]
+    for b in brokers:
+        await b.start()
+    try:
+        await brokers[0].wait_controller_leader()
+        yield net, brokers
+    finally:
+        for b in brokers:
+            await b.stop()
+
+
+async def wait_until(pred, timeout=8.0, interval=0.05, msg="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        if pred():
+            return
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError(f"timed out waiting for {msg}")
+        await asyncio.sleep(interval)
+
+
+async def _join_move_kill_health(tmp_path):
+    async with seed_cluster(tmp_path, n=3) as (net, brokers):
+        # every seed registers its endpoints through the controller log
+        ctrl = brokers[0].controller
+        await wait_until(
+            lambda: len(ctrl.members_table.registered()) == 3,
+            msg="seed registration",
+        )
+
+        # a topic on the seeds
+        client = KafkaClient([brokers[0].kafka_advertised])
+        await client.create_topic("mt", partitions=1, replication_factor=3)
+        await client.produce("mt", 0, [(b"k", b"v0")])
+
+        # ---- join a 4th broker (not in the seed set) ----
+        joiner = Broker(
+            BrokerConfig(
+                node_id=3,
+                data_dir=str(tmp_path / "node3"),
+                members=[0, 1, 2],  # seeds only; self not included
+                election_timeout_s=0.15,
+                heartbeat_interval_s=0.03,
+                node_status_interval_s=0.1,
+            ),
+            loopback=net,
+        )
+        await joiner.start()
+        try:
+            # registration replicates + raft0 voter set grows to 4
+            await wait_until(
+                lambda: 3 in ctrl.members_table.registered(),
+                msg="joiner registered",
+            )
+            await wait_until(
+                lambda: set(ctrl.consensus.config.voters) == {0, 1, 2, 3}
+                and not ctrl.consensus.config.is_joint(),
+                msg="joiner voted into raft0",
+            )
+            # the joiner converges the controller state (sees the topic)
+            await wait_until(
+                lambda: joiner.controller.topic_table.get(
+                    kafka_ntp("mt", 0).tp_ns
+                )
+                is not None,
+                msg="joiner topic table convergence",
+            )
+
+            # ---- move a replica onto the new node ----
+            await ctrl.move_partition_replicas("mt", 0, [1, 2, 3])
+            await wait_until(
+                lambda: joiner.partition_manager.get(kafka_ntp("mt", 0))
+                is not None,
+                msg="joiner hosts the partition",
+            )
+            p3 = joiner.partition_manager.get(kafka_ntp("mt", 0))
+            await wait_until(
+                lambda: set(p3.consensus.config.voters) == {1, 2, 3}
+                and not p3.consensus.config.is_joint(),
+                msg="group reconfigured onto joiner",
+            )
+            # node 0 gives up its replica
+            await wait_until(
+                lambda: brokers[0].partition_manager.get(kafka_ntp("mt", 0))
+                is None,
+                msg="node 0 dropped the moved replica",
+            )
+            # data followed the move: the joiner catches up the log
+            await wait_until(
+                lambda: p3.high_watermark() >= 1,
+                msg="joiner caught up data",
+            )
+            # produce again through the new replica set
+            await client.produce("mt", 0, [(b"k", b"v1")])
+            got = await client.fetch("mt", 0, 0)
+            assert [v for _o, _k, v in got] == [b"v0", b"v1"]
+
+            # ---- kill a broker; health reports it down ----
+            victim = brokers[2]
+            net.isolate(victim.node_id)
+            await wait_until(
+                lambda: not brokers[0].node_status.is_alive(victim.node_id),
+                msg="liveness detects the dead node",
+            )
+            report = brokers[0].health_monitor.report()
+            assert victim.node_id in report.nodes_down
+            alive_ids = {
+                n.node_id for n in report.nodes if n.is_alive
+            }
+            assert alive_ids == {0, 1, 3}
+            net.heal(victim.node_id)
+            await wait_until(
+                lambda: brokers[0].node_status.is_alive(victim.node_id),
+                msg="liveness recovers after heal",
+            )
+        finally:
+            await joiner.stop()
+        await client.close()
+
+
+def test_join_move_kill_health(tmp_path):
+    asyncio.run(_join_move_kill_health(tmp_path))
+
+
+async def _decommission_drains_replicas(tmp_path):
+    async with seed_cluster(tmp_path, n=3) as (net, brokers):
+        ctrl = brokers[0].controller
+        await wait_until(
+            lambda: len(ctrl.members_table.registered()) == 3,
+            msg="seed registration",
+        )
+        client = KafkaClient([brokers[0].kafka_advertised])
+        await client.create_topic("dt", partitions=2, replication_factor=1)
+        await client.produce("dt", 0, [(b"a", b"1")])
+        await client.produce("dt", 1, [(b"b", b"2")])
+
+        # join a 4th node to receive the drained replicas
+        joiner = Broker(
+            BrokerConfig(
+                node_id=3,
+                data_dir=str(tmp_path / "node3"),
+                members=[0, 1, 2],
+                election_timeout_s=0.15,
+                heartbeat_interval_s=0.03,
+                node_status_interval_s=0.1,
+            ),
+            loopback=net,
+        )
+        await joiner.start()
+        try:
+            await wait_until(
+                lambda: 3 in ctrl.members_table.registered(),
+                msg="joiner registered",
+            )
+            # decommission a node that hosts at least one replica
+            hosted = {
+                nid: [
+                    a
+                    for md in ctrl.topic_table.topics().values()
+                    for a in md.assignments.values()
+                    if nid in a.replicas
+                ]
+                for nid in (0, 1, 2)
+            }
+            victim = next(nid for nid, parts in hosted.items() if parts)
+            await ctrl.decommission_node(victim)
+            assert ctrl.members_table.is_draining(victim)
+
+            def drained():
+                for md in ctrl.topic_table.topics().values():
+                    for a in md.assignments.values():
+                        if victim in a.replicas:
+                            return False
+                return True
+
+            await wait_until(drained, timeout=15.0, msg="drain moves replicas off")
+            # data survived the moves
+            got0 = await client.fetch("dt", 0, 0)
+            got1 = await client.fetch("dt", 1, 0)
+            assert [v for _o, _k, v in got0] == [b"1"]
+            assert [v for _o, _k, v in got1] == [b"2"]
+        finally:
+            await joiner.stop()
+        await client.close()
+
+
+def test_decommission_drains_replicas(tmp_path):
+    asyncio.run(_decommission_drains_replicas(tmp_path))
