@@ -72,6 +72,12 @@ class BrokerConfig:
     # retention + compaction pass interval (log_compaction_interval_ms
     # analog); <= 0 disables the timer (tests drive housekeeping directly)
     housekeeping_interval_s: float = 10.0
+    # tiered storage: directory backing the filesystem object store
+    # (cloud_storage_enabled + bucket analog); None disables tiering
+    # unless an object store is injected on the Broker directly
+    cloud_storage_dir: Optional[str] = None
+    # archival upload pass cadence; <= 0 disables the timer
+    archival_interval_s: float = 1.0
 
 
 class Broker:
@@ -79,12 +85,18 @@ class Broker:
         self,
         config: BrokerConfig,
         loopback: Optional[LoopbackNetwork] = None,
+        object_store=None,
     ):
         self.config = config
         self.node_id = config.node_id
         self._loopback = loopback
 
         self.storage = StorageApi(config.data_dir)
+        if object_store is None and config.cloud_storage_dir is not None:
+            from .cloud import FilesystemObjectStore
+
+            object_store = FilesystemObjectStore(config.cloud_storage_dir)
+        self.object_store = object_store
 
         if loopback is not None:
             self._conn_cache = ConnectionCache(
@@ -137,7 +149,56 @@ class Broker:
         )
         self.node_status_service = NodeStatusService(config.node_id)
         self.health_monitor = HealthMonitor(self)
+        self.archival = None
+        self.remote_reader = None
+        if self.object_store is not None:
+            from .cloud import ArchivalService, RemoteReader
+            from .cloud.object_store import RetryingStore
+
+            self.archival = ArchivalService(
+                self.object_store,
+                partitions=self.partition_manager.partitions,
+                topic_table=self.controller.topic_table,
+                interval_s=config.archival_interval_s,
+            )
+            self.remote_reader = RemoteReader(RetryingStore(self.object_store))
+            self.controller.on_partition_added = self._maybe_recover_partition
         self._started = False
+
+    async def _maybe_recover_partition(self, ntp, partition) -> None:
+        """Backend hook: a partition of a topic created with
+        redpanda.remote.recovery seeds itself from the cloud manifest
+        (cloud_storage topic recovery / partition_downloader analog)."""
+        md = self.controller.topic_table.get(ntp.tp_ns)
+        if md is None or str(
+            md.config.get("redpanda.remote.recovery")
+        ).lower() not in ("true", "1", "yes"):
+            return
+        from .cloud import PartitionManifest
+        from .cloud.object_store import StoreError
+
+        key = (
+            f"{PartitionManifest.prefix(ntp.ns, ntp.topic, ntp.partition)}"
+            "/manifest.bin"
+        )
+        try:
+            # exists() first: a permanent miss must not spin the retry
+            # backoff inside the serial reconciliation loop
+            if not await self.archival.store.exists(key):
+                return
+            raw = await self.archival.store.get(key)
+        except StoreError:
+            return  # store unavailable; archiver heals later
+        manifest = PartitionManifest.decode(raw)
+        # attach the archiver up-front so remote reads work immediately
+        a = self.archival.archiver_for(partition)
+        a.manifest = manifest
+        if partition.recover_from_cloud(manifest):
+            logging.getLogger("app").info(
+                "%s: recovered from cloud upto offset %d",
+                ntp,
+                manifest.archived_upto,
+            )
 
     def _rpc_addr_of(self, node_id: int) -> tuple[str, int]:
         """Peer RPC address: replicated members table first (dynamic
@@ -173,6 +234,8 @@ class Broker:
         await self.kafka_server.start()
         if self.config.node_status_interval_s > 0:
             await self.node_status.start()
+        if self.archival is not None and self.config.archival_interval_s > 0:
+            await self.archival.start()
         self._join_task = None
         if self.config.auto_join:
             self._join_task = asyncio.ensure_future(self._register_self())
@@ -224,6 +287,8 @@ class Broker:
                 pass
             self._join_task = None
         await self.node_status.stop()
+        if self.archival is not None:
+            await self.archival.stop()
         if self._housekeeping_task is not None:
             self._housekeeping_task.cancel()
             try:
@@ -266,3 +331,29 @@ class Broker:
 
     async def wait_controller_leader(self, timeout: float = 10.0) -> int:
         return await self.controller.wait_leader(timeout)
+
+    async def recover_topic_from_cloud(
+        self, topic: str, ns: str = "kafka", timeout: float = 10.0
+    ) -> None:
+        """Disaster recovery: recreate a topic from its uploaded
+        manifests (cloud_storage topic recovery). The topic is created
+        with its archived config plus redpanda.remote.recovery=true;
+        each replica then seeds itself from the partition manifest via
+        the backend hook, so the archived range serves reads and new
+        appends continue at archived_upto + 1."""
+        from .cloud import TopicManifest
+
+        if self.archival is None:
+            raise RuntimeError("tiered storage is not configured")
+        raw = await self.archival.store.get(TopicManifest.key_for(ns, topic))
+        tm = TopicManifest.decode(raw)
+        config = dict(tm.config)
+        config["redpanda.remote.recovery"] = "true"
+        await self.controller.create_topic(
+            topic,
+            partitions=int(tm.partition_count),
+            replication_factor=int(tm.replication_factor),
+            config=config,
+            ns=ns,
+            timeout=timeout,
+        )

@@ -1,0 +1,224 @@
+"""Per-partition archival to the object store.
+
+Reference: src/v/archival/ntp_archiver_service.h:140 (upload loop:
+closed, committed segments upload in offset order; the manifest is
+rewritten after each batch of uploads) and archival_policy.cc
+(upload_candidate selection).
+
+Upload ordering invariant: segment objects are put BEFORE the manifest
+that references them, so a crashed archiver never publishes a manifest
+pointing at missing objects — at worst it re-uploads an orphan.
+Compaction note: segments are archived as-is at upload time; a later
+compaction rewrite of a local segment is NOT re-uploaded (the cloud
+copy keeps the uncompacted records; offsets are identical either way).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import TYPE_CHECKING, Callable, Optional
+
+from .manifest import PartitionManifest, SegmentMeta
+from .object_store import ObjectStore, RetryingStore, StoreError
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..cluster.partition import Partition
+
+logger = logging.getLogger("cloud.archiver")
+
+
+class NtpArchiver:
+    def __init__(self, partition: "Partition", store: ObjectStore):
+        self.partition = partition
+        self.store = store
+        self.manifest: Optional[PartitionManifest] = None
+
+    async def _load_manifest(self, refresh: bool = False) -> PartitionManifest:
+        if self.manifest is not None and not refresh:
+            return self.manifest
+        ntp = self.partition.ntp
+        key = (
+            f"{PartitionManifest.prefix(ntp.ns, ntp.topic, ntp.partition)}"
+            "/manifest.bin"
+        )
+        if await self.store.exists(key):
+            self.manifest = PartitionManifest.decode(await self.store.get(key))
+        elif self.manifest is None:
+            self.manifest = PartitionManifest(
+                ns=ntp.ns,
+                topic=ntp.topic,
+                partition=ntp.partition,
+                revision=0,
+                segments=[],
+            )
+        return self.manifest
+
+    @property
+    def archived_upto(self) -> int:
+        """Last archived raft offset (-1 until the manifest is loaded —
+        retention treats unknown as nothing-archived)."""
+        return self.manifest.archived_upto if self.manifest is not None else -1
+
+    async def upload_pass(self) -> int:
+        """One archival round: upload every closed segment whose range
+        is fully committed+flushed and above the archived boundary, in
+        offset order. Returns the number of segments uploaded."""
+        p = self.partition
+        if not p.consensus.is_leader():
+            # followers track the leader's manifest so their retention
+            # stays gated on the true archived boundary
+            await self._load_manifest(refresh=True)
+            return 0
+        manifest = await self._load_manifest()
+        log = p.log
+        boundary = min(p.consensus.commit_index, log.offsets().committed_offset)
+        uploaded = 0
+        for seg in list(log._segments[:-1]):  # never the active tail
+            if seg.dirty_offset < seg.base_offset:
+                continue
+            if seg.base_offset <= manifest.archived_upto:
+                continue
+            if seg.dirty_offset > boundary:
+                break  # in offset order: later segments are above too
+            try:
+                with open(seg._path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                break
+            base = seg.base_offset
+            # filtered batches strictly below the segment base: lets a
+            # remote reader re-derive every batch's kafka offset by
+            # walking the segment (manifest.py delta_offset contract)
+            delta = (
+                (base - 1) - p.translator.to_kafka(base - 1) if base > 0 else 0
+            )
+            meta = SegmentMeta(
+                base_offset=base,
+                last_offset=seg.dirty_offset,
+                term=seg.term,
+                size_bytes=len(data),
+                base_timestamp=-1,
+                max_timestamp=seg.max_timestamp,
+                delta_offset=delta,
+                delta_offset_end=(
+                    seg.dirty_offset - p.translator.to_kafka(seg.dirty_offset)
+                ),
+            )
+            try:
+                await self.store.put(manifest.segment_key(meta), data)
+                manifest.add(meta)
+                manifest.revision += 1
+                await self.store.put(manifest.key(), manifest.encode())
+            except StoreError as e:
+                logger.warning(
+                    "%s: upload failed at segment %d: %s",
+                    p.ntp,
+                    base,
+                    e,
+                )
+                break
+            uploaded += 1
+        return uploaded
+
+
+class ArchivalService:
+    """Broker-level archival driver (the scheduler around per-NTP
+    archivers; upload_controller analog). Walks local partitions whose
+    topic enables remote writes and runs an upload pass on leaders."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        partitions: Callable[[], dict],  # ntp -> Partition
+        topic_table,  # cluster.topic_table.TopicTable
+        interval_s: float = 1.0,
+    ):
+        self.store = RetryingStore(store)
+        self._partitions = partitions
+        self._topic_table = topic_table
+        self.interval_s = interval_s
+        self._archivers: dict = {}
+        # tp_ns -> uploaded (partition_count, rf, config) shape
+        self._topic_manifests: dict = {}
+        self._task: Optional[asyncio.Task] = None
+
+    def archiver_for(self, partition: "Partition") -> NtpArchiver:
+        a = self._archivers.get(partition.ntp)
+        if a is None or a.partition is not partition:
+            a = NtpArchiver(partition, self.store)
+            self._archivers[partition.ntp] = a
+            partition.archiver = a
+        return a
+
+    @staticmethod
+    def _truthy(v) -> bool:
+        return str(v).lower() in ("true", "1", "yes")
+
+    def remote_write_enabled(self, tp_ns) -> bool:
+        md = self._topic_table.get(tp_ns)
+        return md is not None and self._truthy(
+            md.config.get("redpanda.remote.write")
+        )
+
+    async def start(self) -> None:
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await self.run_once()
+            except Exception:
+                logger.exception("archival pass failed")
+
+    async def run_once(self) -> int:
+        total = 0
+        for ntp, p in list(self._partitions().items()):
+            if not self.remote_write_enabled(ntp.tp_ns):
+                continue
+            await self._ensure_topic_manifest(ntp.tp_ns)
+            total += await self.archiver_for(p).upload_pass()
+        # drop archivers for partitions no longer hosted
+        live = self._partitions()
+        for ntp in list(self._archivers):
+            if ntp not in live:
+                del self._archivers[ntp]
+        return total
+
+    async def _ensure_topic_manifest(self, tp_ns) -> None:
+        """Topic config/shape for disaster recovery
+        (topic_manifest.h): uploaded once, rewritten when it changes."""
+        from .manifest import TopicManifest
+
+        md = self._topic_table.get(tp_ns)
+        if md is None:
+            return
+        shape = (
+            md.partition_count,
+            md.replication_factor,
+            tuple(sorted(md.config.items())),
+        )
+        if self._topic_manifests.get(tp_ns) == shape:
+            return
+        tm = TopicManifest(
+            ns=tp_ns.ns,
+            topic=tp_ns.topic,
+            partition_count=md.partition_count,
+            replication_factor=md.replication_factor,
+            config=dict(md.config),
+        )
+        try:
+            await self.store.put(tm.key(), tm.encode())
+        except StoreError:
+            return
+        self._topic_manifests[tp_ns] = shape

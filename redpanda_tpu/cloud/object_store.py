@@ -1,0 +1,167 @@
+"""Object-store client abstraction.
+
+Reference: src/v/cloud_storage_clients/ (client.h — the S3/ABS client
+interface: put/get/head/list/delete on keys) and src/v/cloud_storage/
+remote.h:117 (the retrying orchestration wrapper).
+
+Zero-egress environments get two backends: a filesystem store (atomic
+rename puts — the durability model of a real bucket) and an in-memory
+store for tests. Both speak the same minimal S3-shaped API, so a real
+boto-style client slots in behind the same surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+from typing import Optional, Protocol
+
+
+class StoreError(Exception):
+    pass
+
+
+class ObjectStore(Protocol):
+    async def put(self, key: str, data: bytes) -> None: ...
+
+    async def get(self, key: str) -> bytes: ...
+
+    async def exists(self, key: str) -> bool: ...
+
+    async def list(self, prefix: str) -> list[str]: ...
+
+    async def delete(self, key: str) -> None: ...
+
+
+class MemoryObjectStore:
+    """In-memory bucket with optional fault injection (the test double
+    the reference builds with s3_imposter)."""
+
+    def __init__(self):
+        self._data: dict[str, bytes] = {}
+        self.fail_next: int = 0  # inject N transient failures
+        self.put_count = 0
+        self.get_count = 0
+
+    def _maybe_fail(self) -> None:
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise StoreError("injected transient failure")
+
+    async def put(self, key: str, data: bytes) -> None:
+        self._maybe_fail()
+        self.put_count += 1
+        self._data[key] = bytes(data)
+
+    async def get(self, key: str) -> bytes:
+        self._maybe_fail()
+        self.get_count += 1
+        if key not in self._data:
+            raise StoreError(f"no such key: {key}")
+        return self._data[key]
+
+    async def exists(self, key: str) -> bool:
+        return key in self._data
+
+    async def list(self, prefix: str) -> list[str]:
+        return sorted(k for k in self._data if k.startswith(prefix))
+
+    async def delete(self, key: str) -> None:
+        self._data.pop(key, None)
+
+
+class FilesystemObjectStore:
+    """Bucket on a directory: keys are relative paths, puts are
+    tmp-write + fsync + atomic rename (objects are all-or-nothing,
+    like S3)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        if key.startswith("/") or ".." in key.split("/"):
+            raise StoreError(f"invalid key: {key}")
+        return os.path.join(self.root, key)
+
+    async def put(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{random.randrange(1 << 30)}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    async def get(self, key: str) -> bytes:
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise StoreError(f"no such key: {key}") from None
+
+    async def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    async def list(self, prefix: str) -> list[str]:
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            for name in files:
+                if name.endswith(".tmp") or ".tmp." in name:
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, name), self.root)
+                rel = rel.replace(os.sep, "/")
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return sorted(out)
+
+    async def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+
+class RetryingStore:
+    """Exp-backoff retry wrapper (cloud_storage/remote.h retry_chain):
+    every operation retries transient StoreErrors with jittered
+    backoff before surfacing the failure."""
+
+    def __init__(
+        self,
+        inner: ObjectStore,
+        attempts: int = 4,
+        base_backoff_s: float = 0.05,
+    ):
+        self._inner = inner
+        self._attempts = attempts
+        self._base = base_backoff_s
+
+    async def _retry(self, op, *args):
+        delay = self._base
+        for attempt in range(self._attempts):
+            try:
+                return await op(*args)
+            except StoreError:
+                if attempt == self._attempts - 1:
+                    raise
+                await asyncio.sleep(delay * (0.5 + random.random()))
+                delay *= 2
+
+    async def put(self, key: str, data: bytes) -> None:
+        await self._retry(self._inner.put, key, data)
+
+    async def get(self, key: str) -> bytes:
+        return await self._retry(self._inner.get, key)
+
+    async def exists(self, key: str) -> bool:
+        return await self._retry(self._inner.exists, key)
+
+    async def list(self, prefix: str) -> list[str]:
+        return await self._retry(self._inner.list, prefix)
+
+    async def delete(self, key: str) -> None:
+        await self._retry(self._inner.delete, key)

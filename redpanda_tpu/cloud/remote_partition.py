@@ -1,0 +1,124 @@
+"""Read path over archived segments.
+
+Reference: src/v/cloud_storage/remote_partition.{h,cc} +
+remote_segment.{h,cc} (hydrate segment → serve reader) and
+materialized_segments.h (bounded cache of hydrated segments).
+
+A fetch below the local log start locates the covering segment via the
+manifest (kafka-space bisect using per-segment delta_offset), downloads
+it through a bytes-bounded LRU, and walks its batches re-deriving each
+batch's kafka offset exactly like the local offset translator would —
+filtered (non-data) batches advance the running delta.
+"""
+
+from __future__ import annotations
+
+import bisect
+from collections import OrderedDict
+from typing import Optional
+
+from ..models.record import HEADER_SIZE, RecordBatch, RecordBatchHeader, RecordBatchType
+from .manifest import PartitionManifest, SegmentMeta
+from .object_store import ObjectStore, StoreError
+
+
+class RemoteReader:
+    def __init__(self, store: ObjectStore, cache_max_bytes: int = 32 << 20):
+        self.store = store
+        self._cache: OrderedDict[str, bytes] = OrderedDict()
+        self._cache_bytes = 0
+        self._cache_max = cache_max_bytes
+        self.hydrations = 0
+
+    # -- segment hydration (remote_segment.cc) ------------------------
+    async def _hydrate(self, key: str) -> bytes:
+        data = self._cache.get(key)
+        if data is not None:
+            self._cache.move_to_end(key)
+            return data
+        data = await self.store.get(key)
+        self.hydrations += 1
+        self._cache[key] = data
+        self._cache_bytes += len(data)
+        while self._cache_bytes > self._cache_max and len(self._cache) > 1:
+            _k, evicted = self._cache.popitem(last=False)
+            self._cache_bytes -= len(evicted)
+        return data
+
+    # -- kafka-space location -----------------------------------------
+    @staticmethod
+    def kafka_start(meta: SegmentMeta) -> int:
+        """First kafka offset at-or-after the segment base."""
+        return int(meta.base_offset) - int(meta.delta_offset)
+
+    def cloud_start_kafka(self, manifest: PartitionManifest) -> Optional[int]:
+        if not manifest.segments:
+            return None
+        return self.kafka_start(manifest.segments[0])
+
+    def find_segment(
+        self, manifest: PartitionManifest, kafka_offset: int
+    ) -> Optional[SegmentMeta]:
+        if not manifest.segments:
+            return None
+        starts = [self.kafka_start(s) for s in manifest.segments]
+        i = bisect.bisect_right(starts, kafka_offset) - 1
+        if i < 0:
+            return None
+        return manifest.segments[i]
+
+    # -- read ---------------------------------------------------------
+    async def read_kafka(
+        self,
+        manifest: PartitionManifest,
+        kafka_offset: int,
+        max_bytes: int = 1 << 20,
+        upto_kafka: Optional[int] = None,
+    ) -> list[tuple[int, RecordBatch]]:
+        """(kafka_base, batch) pairs from archived segments starting at
+        kafka_offset — the same shape Partition.read_kafka returns for
+        local data, so the fetch handler frames them identically."""
+        out: list[tuple[int, RecordBatch]] = []
+        consumed = 0
+        meta = self.find_segment(manifest, kafka_offset)
+        while meta is not None and consumed < max_bytes:
+            try:
+                data = await self._hydrate(manifest.segment_key(meta))
+            except StoreError:
+                break
+            delta = int(meta.delta_offset)
+            pos = 0
+            while pos + HEADER_SIZE <= len(data) and consumed < max_bytes:
+                header = RecordBatchHeader.unpack(data[pos : pos + HEADER_SIZE])
+                if (
+                    header.size_bytes < HEADER_SIZE
+                    or pos + header.size_bytes > len(data)
+                ):
+                    break
+                if header.type != RecordBatchType.raft_data:
+                    delta += header.last_offset_delta + 1
+                    pos += header.size_bytes
+                    continue
+                kbase = header.base_offset - delta
+                klast = kbase + header.last_offset_delta
+                if upto_kafka is not None and kbase >= upto_kafka:
+                    return out
+                if klast >= kafka_offset:
+                    batch = RecordBatch(
+                        header, data[pos + HEADER_SIZE : pos + header.size_bytes]
+                    )
+                    if not batch.verify_crc():
+                        raise StoreError(
+                            f"archived batch CRC mismatch at {header.base_offset}"
+                        )
+                    out.append((kbase, batch))
+                    consumed += header.size_bytes
+                pos += header.size_bytes
+            # next segment in offset order
+            idx = manifest.segments.index(meta)
+            meta = (
+                manifest.segments[idx + 1]
+                if idx + 1 < len(manifest.segments)
+                else None
+            )
+        return out

@@ -320,6 +320,9 @@ class Controller:
         self._create_lock = asyncio.Lock()
         self._local_next_group = 1
         self._move_tasks: dict = {}
+        # async (ntp, partition) hook run after the backend creates a
+        # local partition (Broker wires cloud recovery seeding here)
+        self.on_partition_added = None
         self._closed = False
 
     @property
@@ -856,13 +859,15 @@ class Controller:
             for d in deltas:
                 try:
                     if d.kind == "add" and self.node_id in d.replicas:
-                        await self._pm.manage(
+                        p = await self._pm.manage(
                             d.ntp,
                             d.group,
                             d.replicas,
                             log_config=self._log_config_for(d.ntp),
                         )
                         self._shards.insert(d.ntp, d.group)
+                        if self.on_partition_added is not None:
+                            await self.on_partition_added(d.ntp, p)
                     elif d.kind == "del" and self.node_id in d.replicas:
                         self._shards.erase(d.ntp, d.group)
                         await self._pm.remove(d.ntp)

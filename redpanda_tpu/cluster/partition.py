@@ -62,6 +62,10 @@ class Partition:
         # from one need not replay the discarded prefix
         consensus.register_snapshot_contributor("partition", self)
         self.log.housekeeping_override = self.housekeeping
+        # tiered storage (set by ArchivalService for remote.write
+        # topics): archiver gates local retention on the uploaded
+        # boundary; remote reads serve fetches below the local start
+        self.archiver = None
 
     # -- derived-state maintenance -----------------------------------
     def _replay_from(self, pos: int) -> None:
@@ -182,8 +186,89 @@ class Partition:
         target = self.log.retention_offset(now_ms)
         if target is None:
             return
+        if self.archiver is not None:
+            # tiered topics: local data may only be reclaimed once it
+            # is in the object store (ntp_archiver retention hand-off)
+            target = min(target, self.archiver.archived_upto + 1)
+            if target <= self.log.offsets().start_offset:
+                return
         self.consensus.write_snapshot(target - 1)
         self.log.apply_retention(now_ms, max_offset=self.consensus.snapshot_index)
+
+    # -- tiered storage ------------------------------------------------
+    def cloud_start_kafka(self) -> int | None:
+        """First kafka offset readable from the object store, or None
+        when nothing is archived / tiering is off."""
+        if self.archiver is None or self.archiver.manifest is None:
+            return None
+        m = self.archiver.manifest
+        if not m.segments:
+            return None
+        from ..cloud.remote_partition import RemoteReader
+
+        return RemoteReader.kafka_start(m.segments[0])
+
+    async def read_kafka_remote(
+        self,
+        reader,
+        kafka_offset: int,
+        max_bytes: int = 1 << 20,
+        upto_kafka: int | None = None,
+    ) -> list[tuple[int, RecordBatch]]:
+        """Archived-range read (remote_partition.cc): same (kafka_base,
+        batch) shape as read_kafka, served from uploaded segments."""
+        if self.archiver is None or self.archiver.manifest is None:
+            return []
+        return await reader.read_kafka(
+            self.archiver.manifest, kafka_offset, max_bytes, upto_kafka
+        )
+
+    def recover_from_cloud(self, manifest) -> bool:
+        """Seed a FRESH, empty replica from a partition manifest
+        (cloud_storage topic recovery): synthesize a local raft
+        snapshot at the archived boundary so consensus, the offset
+        translator, and the log all resume at archived_upto + 1, while
+        the archived prefix serves reads remotely. Replicas that miss
+        this seeding heal through normal install_snapshot from one
+        that didn't. Producer idempotence state is NOT recovered (the
+        manifest carries no producer table — reference recovery has
+        the same gap)."""
+        from ..raft.offset_translator import _State
+        from ..raft.snapshot import RaftSnapshotMetadata, SnapshotPayload
+        from ..storage import snapshot as snapfmt
+
+        c = self.consensus
+        last = manifest.archived_upto
+        if (
+            last < 0
+            or self.log.offsets().dirty_offset >= 0
+            or c.snapshot_index >= 0
+        ):
+            return False  # only a fresh, empty replica may be seeded
+        seg = manifest.segments[-1]
+        translator_state = _State(
+            filtered=[],
+            base=last + 1,
+            base_delta=int(seg.delta_offset_end),
+        ).encode()
+        payload = _PartitionSnapshot(
+            translator=translator_state,
+            producers=ProducerStateTable().encode(),
+            tx=TxTracker().encode(),
+        ).encode()
+        meta = RaftSnapshotMetadata(
+            group=c.group_id,
+            last_included_index=last,
+            last_included_term=int(seg.term),
+            config=c.config.encode(),
+        )
+        snapfmt.write_snapshot(
+            c._snapshot_path,
+            meta.encode(),
+            SnapshotPayload(names=["partition"], blobs=[payload]).encode(),
+        )
+        c._load_snapshot()
+        return True
 
     def _record_decided(self, batch, raft_offset: int) -> bool:
         """Compaction participation gate for transactional data: only a

@@ -701,6 +701,16 @@ class KafkaServer:
 
         return finish()
 
+    def _remote_read_enabled(self, topic: str) -> bool:
+        """Per-topic gate for serving archived data
+        (redpanda.remote.read; shadow-indexing fetch config)."""
+        md = self.broker.controller.topic_table.get(
+            TopicNamespace(DEFAULT_NS, topic)
+        )
+        return md is not None and str(
+            md.config.get("redpanda.remote.read")
+        ).lower() in ("true", "1", "yes")
+
     async def handle_fetch(self, hdr: RequestHeader, req: Msg) -> Msg:
         deadline = (
             asyncio.get_event_loop().time() + max(req.max_wait_ms, 0) / 1000.0
@@ -717,6 +727,93 @@ class KafkaServer:
             )
             for t in req.topics
         }
+        # archived-range pre-pass: offsets below the LOCAL log start
+        # that tiered storage still covers are read from the object
+        # store ONCE up front (immutable data — no reason to re-read
+        # in the poll loop). remote_partition.cc read path.
+        remote_rows: dict[tuple[str, int], Msg] = {}
+        reader = self.broker.remote_reader
+        if reader is not None:
+            from ..cloud.object_store import StoreError
+
+            # ONE budget across all remote rows, mirroring the local
+            # read loop's `budget - total` accounting
+            remote_budget = req.max_bytes if req.max_bytes > 0 else 1 << 30
+            for t in req.topics:
+                if not authorized.get(t.topic):
+                    continue
+                if not self._remote_read_enabled(t.topic):
+                    continue
+                for p in t.partitions:
+                    if remote_budget <= 0:
+                        break
+                    partition = self.broker.partition_manager.get(
+                        kafka_ntp(t.topic, p.partition)
+                    )
+                    if partition is None or not partition.is_leader:
+                        continue
+                    start = partition.start_offset()
+                    cstart = partition.cloud_start_kafka()
+                    if (
+                        p.fetch_offset >= start
+                        or cstart is None
+                        or p.fetch_offset < cstart
+                    ):
+                        continue
+                    lso = partition.last_stable_offset()
+                    upto = lso if read_committed else None
+                    budget = min(p.partition_max_bytes, remote_budget)
+                    try:
+                        pairs = await partition.read_kafka_remote(
+                            reader,
+                            p.fetch_offset,
+                            max_bytes=budget,
+                            upto_kafka=upto,
+                        )
+                    except StoreError:
+                        # corrupt/missing object: fail ONE partition
+                        # (out_of_range via the poll loop), not the fetch
+                        continue
+                    # stitch the local tail into the same response when
+                    # the archived range hands over within budget
+                    used = sum(b.size_bytes() for _kb, b in pairs)
+                    next_off = (
+                        pairs[-1][0] + pairs[-1][1].header.last_offset_delta + 1
+                        if pairs
+                        else p.fetch_offset
+                    )
+                    if used < budget and next_off >= partition.start_offset():
+                        pairs += partition.read_kafka(
+                            next_off,
+                            max_bytes=budget - used,
+                            upto_kafka=upto,
+                        )
+                    wire = b"".join(
+                        _frame_kafka(b, kb) for kb, b in pairs
+                    )
+                    remote_budget -= len(wire)
+                    aborted = None
+                    if read_committed and pairs:
+                        fetch_end = (
+                            pairs[-1][0]
+                            + pairs[-1][1].header.last_offset_delta
+                            + 1
+                        )
+                        aborted = [
+                            Msg(producer_id=pid, first_offset=first)
+                            for pid, first in partition.aborted_in(
+                                p.fetch_offset, fetch_end
+                            )
+                        ]
+                    remote_rows[(t.topic, p.partition)] = Msg(
+                        partition_index=p.partition,
+                        error_code=0,
+                        high_watermark=partition.high_watermark(),
+                        last_stable_offset=lso,
+                        log_start_offset=cstart,
+                        aborted_transactions=aborted,
+                        records=wire if wire else None,
+                    )
 
         def read_all() -> tuple[list[Msg], int, bool]:
             total = 0
@@ -786,6 +883,13 @@ class KafkaServer:
                     # position that simply reads empty until the open
                     # tx resolves and the LSO advances past it
                     if p.fetch_offset < start or p.fetch_offset > hw:
+                        remote = remote_rows.get((t.topic, p.partition))
+                        if remote is not None:
+                            # served from the archived range
+                            total += len(remote.records or b"")
+                            parts.append(remote)
+                            continue
+                        cloud_start = partition.cloud_start_kafka()
                         has_error = True
                         parts.append(
                             Msg(
@@ -793,7 +897,12 @@ class KafkaServer:
                                 error_code=int(ErrorCode.offset_out_of_range),
                                 high_watermark=hw,
                                 last_stable_offset=lso,
-                                log_start_offset=start,
+                                log_start_offset=(
+                                    cloud_start
+                                    if cloud_start is not None
+                                    and cloud_start < start
+                                    else start
+                                ),
                                 aborted_transactions=None,
                                 records=None,
                             )

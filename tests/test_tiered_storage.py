@@ -1,0 +1,218 @@
+"""Tiered storage: archival upload, archive-gated retention, remote
+reads below the local log start, and topic recovery from manifests.
+
+Reference test model: cloud_storage/tests/remote_partition_test.cc,
+archival/tests/ntp_archiver_test.cc, rptest shadow-indexing tests.
+"""
+
+import asyncio
+import contextlib
+
+import pytest
+
+from redpanda_tpu.app import Broker, BrokerConfig
+from redpanda_tpu.cloud import (
+    FilesystemObjectStore,
+    MemoryObjectStore,
+    PartitionManifest,
+    RemoteReader,
+    SegmentMeta,
+)
+from redpanda_tpu.cloud.object_store import RetryingStore, StoreError
+from redpanda_tpu.kafka.client import KafkaClient, KafkaClientError
+from redpanda_tpu.models.fundamental import kafka_ntp
+from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+
+# -- object store unit level ------------------------------------------
+def test_filesystem_store_roundtrip(tmp_path):
+    async def main():
+        store = FilesystemObjectStore(str(tmp_path / "bucket"))
+        await store.put("a/b/seg.bin", b"data1")
+        await store.put("a/b/manifest.bin", b"m")
+        assert await store.get("a/b/seg.bin") == b"data1"
+        assert await store.exists("a/b/manifest.bin")
+        assert await store.list("a/b/") == ["a/b/manifest.bin", "a/b/seg.bin"]
+        await store.delete("a/b/seg.bin")
+        assert not await store.exists("a/b/seg.bin")
+        with pytest.raises(StoreError):
+            await store.get("a/b/seg.bin")
+        with pytest.raises(StoreError):
+            await store.get("../escape")
+
+    asyncio.run(main())
+
+
+def test_retrying_store_survives_transient_failures(tmp_path):
+    async def main():
+        inner = MemoryObjectStore()
+        store = RetryingStore(inner, attempts=4, base_backoff_s=0.001)
+        inner.fail_next = 2
+        await store.put("k", b"v")
+        inner.fail_next = 3
+        assert await store.get("k") == b"v"
+        inner.fail_next = 4  # exceeds attempts
+        with pytest.raises(StoreError):
+            await store.get("k")
+
+    asyncio.run(main())
+
+
+# -- broker e2e -------------------------------------------------------
+@contextlib.asynccontextmanager
+async def tiered_broker(tmp_path, store):
+    net = LoopbackNetwork()
+    b = Broker(
+        BrokerConfig(
+            node_id=0,
+            data_dir=str(tmp_path / "n0"),
+            members=[0],
+            election_timeout_s=0.15,
+            heartbeat_interval_s=0.03,
+            housekeeping_interval_s=0,  # drive manually
+            archival_interval_s=0,  # drive manually
+        ),
+        loopback=net,
+        object_store=store,
+    )
+    await b.start()
+    b.config.peer_kafka_addresses = {0: b.kafka_advertised}
+    try:
+        await b.wait_controller_leader()
+        yield b
+    finally:
+        await b.stop()
+
+
+async def _produce_n(client, topic, n, start=0):
+    for i in range(start, start + n):
+        await client.produce(topic, 0, [(b"k%d" % i, b"v%d" % i)])
+
+
+async def _archive_cycle(tmp_path):
+    store = MemoryObjectStore()
+    async with tiered_broker(tmp_path, store) as b:
+        client = KafkaClient([b.kafka_advertised])
+        await client.create_topic(
+            "tt",
+            partitions=1,
+            replication_factor=1,
+            configs={
+                "redpanda.remote.write": "true",
+                "redpanda.remote.read": "true",
+                "segment.bytes": "400",
+                "retention.bytes": "400",
+            },
+        )
+        await _produce_n(client, "tt", 12)
+        p = b.partition_manager.get(kafka_ntp("tt", 0))
+        p.log.flush()
+        n_segs = p.log.segment_count()
+        assert n_segs > 2
+
+        # archival uploads every closed, committed segment
+        uploaded = await b.archival.run_once()
+        assert uploaded == n_segs - 1
+        manifest = p.archiver.manifest
+        assert manifest.archived_upto >= 0
+        # segments land before the manifest that references them
+        for meta in manifest.segments:
+            assert await store.exists(manifest.segment_key(meta))
+
+        # retention trims the local log only within the archived range
+        b.storage.log_mgr.housekeeping()
+        start_after = p.log.offsets().start_offset
+        assert start_after > 0, "retention should trim archived prefix"
+        assert start_after <= manifest.archived_upto + 1
+
+        # fetch from offset 0: served from the object store (below the
+        # local start), stitched seamlessly with local data
+        got = await client.fetch("tt", 0, 0, max_bytes=1 << 22)
+        assert [(k, v) for _o, k, v in got] == [
+            (b"k%d" % i, b"v%d" % i) for i in range(12)
+        ]
+        offsets = [o for o, _k, _v in got]
+        assert offsets == list(range(12))
+        assert b.remote_reader.hydrations > 0
+
+        # an offset below the cloud start is a genuine out-of-range
+        # (nothing is below cloud start here, so probe metadata only)
+        cstart = p.cloud_start_kafka()
+        assert cstart == 0
+        await client.close()
+        return store
+
+
+def test_archive_retention_remote_read(tmp_path):
+    asyncio.run(_archive_cycle(tmp_path))
+
+
+async def _recovery(tmp_path):
+    # phase 1: produce + archive, then destroy the broker's data dir
+    store = MemoryObjectStore()
+    async with tiered_broker(tmp_path, store) as b:
+        client = KafkaClient([b.kafka_advertised])
+        await client.create_topic(
+            "rt",
+            partitions=1,
+            replication_factor=1,
+            configs={
+                "redpanda.remote.write": "true",
+                "redpanda.remote.read": "true",
+                "segment.bytes": "400",
+            },
+        )
+        await _produce_n(client, "rt", 10)
+        p = b.partition_manager.get(kafka_ntp("rt", 0))
+        p.log.flush()
+        await b.archival.run_once()
+        archived = p.archiver.manifest.archived_upto
+        assert archived >= 0
+        await client.close()
+
+    # phase 2: a FRESH broker (new data dir) recovers the topic from
+    # the object store
+    async with tiered_broker(tmp_path / "fresh", store) as b2:
+        await b2.recover_topic_from_cloud("rt")
+        p2 = b2.partition_manager.get(kafka_ntp("rt", 0))
+        assert p2 is not None
+
+        client = KafkaClient([b2.kafka_advertised])
+        # archived data serves from the cloud
+        got = await client.fetch("rt", 0, 0, max_bytes=1 << 22)
+        kvs = [(k, v) for _o, k, v in got]
+        # everything the manifest covered is readable
+        assert (b"k0", b"v0") in kvs
+        assert len(kvs) >= 8
+        # new appends continue AFTER the archived range (offsets never
+        # regress or collide)
+        first_new = await client.produce("rt", 0, [(b"post", b"recovery")])
+        assert first_new > max(o for o, _k, _v in got)
+        got2 = await client.fetch("rt", 0, first_new)
+        assert [(k, v) for _o, k, v in got2] == [(b"post", b"recovery")]
+        await client.close()
+
+
+def test_topic_recovery_from_cloud(tmp_path):
+    asyncio.run(_recovery(tmp_path))
+
+
+def test_remote_reader_segment_location():
+    m = PartitionManifest(ns="kafka", topic="t", partition=0, revision=1, segments=[])
+    m.add(SegmentMeta(base_offset=0, last_offset=9, term=1, size_bytes=100,
+                      base_timestamp=-1, max_timestamp=-1, delta_offset=0,
+                      delta_offset_end=1))
+    m.add(SegmentMeta(base_offset=10, last_offset=25, term=2, size_bytes=100,
+                      base_timestamp=-1, max_timestamp=-1, delta_offset=1,
+                      delta_offset_end=2))
+    r = RemoteReader(MemoryObjectStore())
+    assert r.cloud_start_kafka(m) == 0
+    # kafka 8 is still in segment 1 (raft 0..9, delta 0 → kafka 0..8ish)
+    assert r.find_segment(m, 8).base_offset == 0
+    # kafka 9 = raft 10 - delta 1 → segment 2's first kafka offset
+    assert r.find_segment(m, 9).base_offset == 10
+    # overlap rejected
+    with pytest.raises(ValueError):
+        m.add(SegmentMeta(base_offset=20, last_offset=30, term=2, size_bytes=1,
+                          base_timestamp=-1, max_timestamp=-1, delta_offset=0,
+                          delta_offset_end=0))
