@@ -1,0 +1,512 @@
+"""Admin HTTP server.
+
+Reference: src/v/redpanda/admin_server.cc (71 routes over seastar
+httpd). This is a dependency-free asyncio HTTP/1.1 server exposing the
+operational surface the implemented subsystems have: cluster health,
+brokers, topics/partitions, leadership transfer, membership
+(decommission/recommission), SCRAM users, replicated cluster config,
+fault injection (hbadger), and the Prometheus /metrics endpoint.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import re
+from typing import TYPE_CHECKING, Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..app import Broker
+
+logger = logging.getLogger("admin")
+
+_MAX_BODY = 4 << 20
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+_REASONS = {
+    200: "OK",
+    204: "No Content",
+    400: "Bad Request",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    409: "Conflict",
+    500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+
+class AdminServer:
+    def __init__(self, broker: "Broker", host: str = "127.0.0.1", port: int = 0):
+        self.broker = broker
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        # (method, compiled-pattern) -> handler(match, query, body)
+        self._routes: list[tuple[str, re.Pattern, Callable]] = []
+        self._install_routes()
+
+    def route(self, method: str, pattern: str, handler: Callable) -> None:
+        self._routes.append((method, re.compile(f"^{pattern}$"), handler))
+
+    async def start(self) -> None:
+        if self.host not in ("127.0.0.1", "localhost", "::1"):
+            # the admin surface is UNAUTHENTICATED (user creation,
+            # decommission, fault injection): widening the bind beyond
+            # loopback hands those to the network even when the Kafka
+            # listener enforces SASL
+            logger.warning(
+                "admin API bound to %s WITHOUT authentication — "
+                "anyone reaching it can mint SCRAM users and "
+                "decommission nodes",
+                self.host,
+            )
+        self._server = await asyncio.start_server(
+            self._on_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    # -- http plumbing -------------------------------------------------
+    async def _on_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    method, target, _version = line.decode().split(" ", 2)
+                except ValueError:
+                    return
+                headers: dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                try:
+                    length = int(headers.get("content-length", "0") or 0)
+                except ValueError:
+                    length = -1
+                if length < 0 or length > _MAX_BODY:
+                    bad = b'{"message": "invalid content-length"}'
+                    writer.write(
+                        b"HTTP/1.1 400 Bad Request\r\n"
+                        b"Content-Type: application/json\r\n"
+                        b"Content-Length: %d\r\n"
+                        b"Connection: close\r\n\r\n%s" % (len(bad), bad)
+                    )
+                    await writer.drain()
+                    return
+                body = await reader.readexactly(length) if length else b""
+                status, ctype, payload = await self._dispatch(
+                    method.upper(), target, body
+                )
+                reason = _REASONS.get(status, "Unknown")
+                head = (
+                    f"HTTP/1.1 {status} {reason}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    "Connection: keep-alive\r\n\r\n"
+                )
+                writer.write(head.encode() + payload)
+                await writer.drain()
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            asyncio.CancelledError,
+        ):
+            pass
+        finally:
+            writer.close()
+
+    async def _dispatch(
+        self, method: str, target: str, body: bytes
+    ) -> tuple[int, str, bytes]:
+        url = urlparse(target)
+        path = url.path
+        query = {k: v[-1] for k, v in parse_qs(url.query).items()}
+        path_seen = False
+        for m, pattern, handler in self._routes:
+            match = pattern.match(path)
+            if match is None:
+                continue
+            path_seen = True
+            if m != method:
+                continue
+            try:
+                result = await handler(match, query, body)
+            except HttpError as e:
+                return (
+                    e.status,
+                    "application/json",
+                    json.dumps({"message": e.message, "code": e.status}).encode(),
+                )
+            except Exception as e:
+                logger.exception("admin: %s %s failed", method, path)
+                return (
+                    500,
+                    "application/json",
+                    json.dumps({"message": str(e), "code": 500}).encode(),
+                )
+            if result is None:
+                return 204, "application/json", b""
+            if isinstance(result, (bytes, str)):
+                data = result.encode() if isinstance(result, str) else result
+                return 200, "text/plain; version=0.0.4", data
+            return 200, "application/json", json.dumps(result).encode()
+        if path_seen:
+            return 405, "application/json", b'{"message": "method not allowed"}'
+        return 404, "application/json", b'{"message": "not found"}'
+
+    @staticmethod
+    def _json_body(body: bytes) -> dict:
+        if not body:
+            return {}
+        try:
+            out = json.loads(body)
+        except json.JSONDecodeError as e:
+            raise HttpError(400, f"invalid json: {e}") from None
+        if not isinstance(out, dict):
+            raise HttpError(400, "body must be a json object")
+        return out
+
+    # -- routes --------------------------------------------------------
+    def _install_routes(self) -> None:
+        r = self.route
+        r("GET", r"/v1/status/ready", self._ready)
+        r("GET", r"/v1/brokers", self._brokers)
+        r("POST", r"/v1/brokers/(\d+)/decommission", self._decommission)
+        r("POST", r"/v1/brokers/(\d+)/recommission", self._recommission)
+        r("GET", r"/v1/cluster/health_overview", self._health)
+        r("GET", r"/v1/cluster_config", self._get_config)
+        r("PUT", r"/v1/cluster_config", self._put_config)
+        r("GET", r"/v1/cluster_config/schema", self._config_schema)
+        r("GET", r"/v1/topics", self._list_topics)
+        r("POST", r"/v1/topics", self._create_topic)
+        r("GET", r"/v1/topics/([^/]+)", self._get_topic)
+        r("DELETE", r"/v1/topics/([^/]+)", self._delete_topic)
+        r(
+            "GET",
+            r"/v1/partitions/([^/]+)/([^/]+)/(\d+)",
+            self._get_partition,
+        )
+        r(
+            "POST",
+            r"/v1/partitions/([^/]+)/([^/]+)/(\d+)/transfer_leadership",
+            self._transfer_leadership,
+        )
+        r(
+            "POST",
+            r"/v1/partitions/([^/]+)/([^/]+)/(\d+)/move_replicas",
+            self._move_replicas,
+        )
+        r("PUT", r"/v1/security/users", self._create_user)
+        r("DELETE", r"/v1/security/users/([^/]+)", self._delete_user)
+        r("POST", r"/v1/debug/fault_injection", self._fault_injection)
+        r("DELETE", r"/v1/debug/fault_injection", self._fault_clear)
+        r("GET", r"/metrics", self._metrics)
+
+    async def _ready(self, _m, _q, _b):
+        return {"status": "ready" if self.broker._started else "booting"}
+
+    async def _brokers(self, _m, _q, _b):
+        ctrl = self.broker.controller
+        out = []
+        for nid in ctrl.members_table.node_ids():
+            ep = ctrl.members_table.get(nid)
+            out.append(
+                {
+                    "node_id": nid,
+                    "membership_status": (
+                        ep.state.value if ep is not None else "unregistered"
+                    ),
+                    "is_alive": self.broker.node_status.is_alive(nid),
+                    "internal_rpc": list(ep.rpc_addr) if ep else None,
+                    "kafka_api": list(ep.kafka_addr) if ep else None,
+                }
+            )
+        return {"brokers": out, "controller_id": ctrl.leader_id}
+
+    async def _decommission(self, m, _q, _b):
+        from ..cluster.controller import TopicError
+
+        try:
+            await self.broker.controller.decommission_node(int(m.group(1)))
+        except TopicError as e:
+            raise HttpError(400, e.message) from None
+        return None
+
+    async def _recommission(self, m, _q, _b):
+        await self.broker.controller.recommission_node(int(m.group(1)))
+        return None
+
+    async def _health(self, _m, _q, _b):
+        rep = self.broker.health_monitor.report()
+        return {
+            "controller_id": rep.controller_id,
+            "all_nodes": [n.node_id for n in rep.nodes],
+            "nodes_down": rep.nodes_down,
+            "leaderless_partitions": rep.leaderless_partitions,
+            "nodes": [
+                {
+                    "node_id": n.node_id,
+                    "is_alive": n.is_alive,
+                    "membership": n.membership,
+                }
+                for n in rep.nodes
+            ],
+        }
+
+    async def _get_config(self, _m, _q, _b):
+        cfg = self.broker.controller.cluster_config
+        return {
+            "version": cfg.version,
+            "values": cfg.snapshot(),
+        }
+
+    async def _config_schema(self, _m, _q, _b):
+        cfg = self.broker.controller.cluster_config
+        return {
+            name: {
+                "type": p.type,
+                "default": p.default,
+                "description": p.description,
+                "needs_restart": p.needs_restart,
+            }
+            for name, p in cfg.properties().items()
+        }
+
+    async def _put_config(self, _m, _q, body):
+        from ..cluster.controller import TopicError
+
+        payload = self._json_body(body)
+        upserts = {
+            str(k): str(v) for k, v in (payload.get("upsert") or {}).items()
+        }
+        removes = [str(k) for k in (payload.get("remove") or [])]
+        try:
+            await self.broker.controller.set_cluster_config(upserts, removes)
+        except TopicError as e:
+            raise HttpError(400, e.message) from None
+        return {"version": self.broker.controller.cluster_config.version}
+
+    async def _list_topics(self, _m, _q, _b):
+        table = self.broker.controller.topic_table
+        return {
+            "topics": [
+                {
+                    "ns": tp.ns,
+                    "topic": tp.topic,
+                    "partition_count": md.partition_count,
+                    "replication_factor": md.replication_factor,
+                }
+                for tp, md in table.topics().items()
+            ]
+        }
+
+    async def _create_topic(self, _m, _q, body):
+        from ..cluster.controller import TopicError
+
+        payload = self._json_body(body)
+        name = payload.get("name")
+        if not name:
+            raise HttpError(400, "missing topic name")
+        try:
+            await self.broker.controller.create_topic(
+                str(name),
+                partitions=int(payload.get("partitions", 1)),
+                replication_factor=int(payload.get("replication_factor", 1)),
+                config={
+                    str(k): (None if v is None else str(v))
+                    for k, v in (payload.get("configs") or {}).items()
+                },
+            )
+        except TopicError as e:
+            raise HttpError(400, f"{e.code}: {e.message}") from None
+        return {"name": name}
+
+    def _topic_md(self, topic: str):
+        from ..models.fundamental import DEFAULT_NS, TopicNamespace
+
+        md = self.broker.controller.topic_table.get(
+            TopicNamespace(DEFAULT_NS, topic)
+        )
+        if md is None:
+            raise HttpError(404, f"no such topic {topic}")
+        return md
+
+    async def _get_topic(self, m, _q, _b):
+        md = self._topic_md(m.group(1))
+        return {
+            "topic": m.group(1),
+            "partition_count": md.partition_count,
+            "replication_factor": md.replication_factor,
+            "config": md.config,
+            "partitions": [
+                {
+                    "partition": a.partition,
+                    "group": a.group,
+                    "replicas": a.replicas,
+                }
+                for a in md.assignments.values()
+            ],
+        }
+
+    async def _delete_topic(self, m, _q, _b):
+        from ..cluster.controller import TopicError
+
+        try:
+            await self.broker.controller.delete_topic(m.group(1))
+        except TopicError as e:
+            status = 404 if e.code == "unknown_topic_or_partition" else 400
+            raise HttpError(status, e.message) from None
+        return None
+
+    def _partition(self, ns: str, topic: str, pid: int):
+        from ..models.fundamental import NTP
+
+        p = self.broker.partition_manager.get(NTP(ns, topic, pid))
+        if p is None:
+            raise HttpError(404, f"{ns}/{topic}/{pid} not hosted here")
+        return p
+
+    async def _get_partition(self, m, _q, _b):
+        ns, topic, pid = m.group(1), m.group(2), int(m.group(3))
+        from ..models.fundamental import NTP, TopicNamespace
+
+        md = self.broker.controller.topic_table.get(TopicNamespace(ns, topic))
+        if md is None or pid not in md.assignments:
+            raise HttpError(404, f"no such partition {ns}/{topic}/{pid}")
+        a = md.assignments[pid]
+        ntp = NTP(ns, topic, pid)
+        local = self.broker.partition_manager.get(ntp)
+        out = {
+            "ns": ns,
+            "topic": topic,
+            "partition": pid,
+            "group": a.group,
+            "replicas": a.replicas,
+            "leader": self.broker.metadata_cache.leader_of(ntp),
+        }
+        if local is not None:
+            out.update(
+                {
+                    "high_watermark": local.high_watermark(),
+                    "last_stable_offset": local.last_stable_offset(),
+                    "start_offset": local.start_offset(),
+                    "term": local.consensus.term,
+                    "is_leader": local.is_leader,
+                }
+            )
+        return out
+
+    async def _transfer_leadership(self, m, q, _b):
+        ns, topic, pid = m.group(1), m.group(2), int(m.group(3))
+        p = self._partition(ns, topic, pid)
+        if not p.consensus.is_leader():
+            raise HttpError(
+                409, f"this node is not the leader (try {p.consensus.leader_id})"
+            )
+        target = q.get("target")
+        if target is None:
+            peers = p.consensus.peers()
+            if not peers:
+                raise HttpError(400, "no peer to transfer to")
+            target = peers[0]
+        try:
+            await p.consensus.transfer_leadership(int(target))
+        except Exception as e:
+            raise HttpError(400, str(e)) from None
+        return None
+
+    async def _move_replicas(self, m, _q, body):
+        from ..cluster.controller import TopicError
+
+        ns, topic, pid = m.group(1), m.group(2), int(m.group(3))
+        payload = self._json_body(body)
+        replicas = payload.get("replicas")
+        if not isinstance(replicas, list):
+            raise HttpError(400, "body must carry a replicas list")
+        try:
+            await self.broker.controller.move_partition_replicas(
+                topic, pid, [int(r) for r in replicas], ns=ns
+            )
+        except TopicError as e:
+            raise HttpError(400, f"{e.code}: {e.message}") from None
+        return None
+
+    async def _create_user(self, _m, _q, body):
+        from ..security.scram import encode_credential, make_credential
+
+        payload = self._json_body(body)
+        user = payload.get("username")
+        password = payload.get("password")
+        if not user or not password:
+            raise HttpError(400, "username and password required")
+        mech = payload.get("algorithm", "SCRAM-SHA-256")
+        await self.broker.controller.create_user(
+            str(user), encode_credential(make_credential(str(password), mech))
+        )
+        return None
+
+    async def _delete_user(self, m, _q, _b):
+        from ..cluster.controller import TopicError
+
+        try:
+            await self.broker.controller.delete_user(m.group(1))
+        except TopicError as e:
+            raise HttpError(404, e.message) from None
+        return None
+
+    async def _fault_injection(self, _m, _q, body):
+        from ..utils.hbadger import Probe, honey_badger
+
+        payload = self._json_body(body)
+        module = payload.get("module")
+        point = payload.get("point", "")
+        if not module:
+            raise HttpError(400, "module required")
+        exc = None
+        if payload.get("fail"):
+            exc = ConnectionError("hbadger injected failure")
+        count = payload.get("count")
+        honey_badger.arm(
+            str(module),
+            str(point),
+            Probe(
+                delay_s=float(payload.get("delay_s", 0.0)),
+                exception=exc,
+                count=int(count) if count is not None else None,
+            ),
+        )
+        return None
+
+    async def _fault_clear(self, _m, _q, _b):
+        from ..utils.hbadger import honey_badger
+
+        honey_badger.clear()
+        return None
+
+    async def _metrics(self, _m, _q, _b):
+        return self.broker.metrics.render()

@@ -26,10 +26,12 @@ from .cluster import (
     PartitionManager,
     ShardTable,
 )
+from .admin import AdminServer
 from .cluster.health_monitor import HealthMonitor
 from .cluster.metadata_dissemination import MetadataDissemination
 from .cluster.node_status import NodeStatusBackend, NodeStatusService
 from .cluster.tx_coordinator import TxCoordinator
+from .metrics import MetricsRegistry
 from .kafka.coordinator import GroupCoordinator
 from .kafka.server import KafkaServer
 from .raft.group_manager import GroupManager
@@ -78,6 +80,10 @@ class BrokerConfig:
     cloud_storage_dir: Optional[str] = None
     # archival upload pass cadence; <= 0 disables the timer
     archival_interval_s: float = 1.0
+    # admin HTTP listener (admin_server.cc); port 0 = ephemeral
+    admin_host: str = "127.0.0.1"
+    admin_port: int = 0
+    enable_admin: bool = True
 
 
 class Broker:
@@ -92,6 +98,7 @@ class Broker:
         self._loopback = loopback
 
         self.storage = StorageApi(config.data_dir)
+        self.metrics = MetricsRegistry()
         if object_store is None and config.cloud_storage_dir is not None:
             from .cloud import FilesystemObjectStore
 
@@ -149,6 +156,10 @@ class Broker:
         )
         self.node_status_service = NodeStatusService(config.node_id)
         self.health_monitor = HealthMonitor(self)
+        self._register_probes()
+        self.admin = AdminServer(
+            self, config.admin_host, config.admin_port
+        ) if config.enable_admin else None
         self.archival = None
         self.remote_reader = None
         if self.object_store is not None:
@@ -163,7 +174,106 @@ class Broker:
             )
             self.remote_reader = RemoteReader(RetryingStore(self.object_store))
             self.controller.on_partition_added = self._maybe_recover_partition
+        self._bind_cluster_config()
         self._started = False
+
+    def _bind_cluster_config(self) -> None:
+        """Live bindings from replicated cluster config onto running
+        subsystems (config/property.h:280 binding<T>). Only explicitly
+        SET values override BrokerConfig — defaults never clobber what
+        the operator passed at construction."""
+        cfg = self.controller.cluster_config
+
+        def bind_override(name: str, fn, original) -> None:
+            """Apply SET values; restore the constructed BrokerConfig
+            value when the override is removed (never let the registry
+            default clobber what the operator passed at boot)."""
+
+            def wrapper(value):
+                fn(value if not cfg.is_default(name) else original)
+
+            cfg.bind(name, wrapper)
+
+        bind_override(
+            "log_compaction_interval_s",
+            lambda v: setattr(self.config, "housekeeping_interval_s", v),
+            self.config.housekeeping_interval_s,
+        )
+
+        def set_archival(v):
+            self.config.archival_interval_s = v
+            if self.archival is not None:
+                self.archival.interval_s = v
+
+        bind_override(
+            "archival_interval_s", set_archival, self.config.archival_interval_s
+        )
+
+    def _register_probes(self) -> None:
+        """Scrape-time gauges over live subsystem state (the probe
+        objects of raft/probe.cc and kafka server probes, pull-based)."""
+        m = self.metrics
+        m.gauge(
+            "partitions_total",
+            lambda: len(self.partition_manager.partitions()),
+            "Locally hosted partitions",
+        )
+        m.gauge(
+            "partition_leaders_total",
+            lambda: sum(
+                1
+                for p in self.partition_manager.partitions().values()
+                if p.is_leader
+            ),
+            "Locally led partitions",
+        )
+        m.gauge(
+            "raft_groups_total",
+            lambda: len(self.group_manager.groups()),
+            "Raft groups on this node",
+        )
+        m.gauge(
+            "controller_is_leader",
+            lambda: 1 if self.controller.is_leader else 0,
+            "1 when this node leads raft group 0",
+        )
+        m.gauge(
+            "cluster_members_total",
+            lambda: len(self.controller.members),
+            "Known cluster members",
+        )
+        m.gauge(
+            "batch_cache_hits_total",
+            lambda: self.storage.cache.hits,
+            "Batch cache hits",
+        )
+        m.gauge(
+            "batch_cache_misses_total",
+            lambda: self.storage.cache.misses,
+            "Batch cache misses",
+        )
+        m.gauge(
+            "batch_cache_bytes",
+            lambda: self.storage.cache.size_bytes,
+            "Batch cache resident bytes",
+        )
+        m.gauge(
+            "log_segments_total",
+            lambda: sum(
+                log.segment_count()
+                for log in self.storage.log_mgr.logs().values()
+            ),
+            "Open log segments across all local logs",
+        )
+        m.gauge(
+            "nodes_alive_total",
+            lambda: sum(
+                1
+                for nid in self.controller.members
+                if self.node_status.is_alive(nid)
+            ),
+            "Members answering liveness pings",
+        )
 
     async def _maybe_recover_partition(self, ntp, partition) -> None:
         """Backend hook: a partition of a topic created with
@@ -236,6 +346,8 @@ class Broker:
             await self.node_status.start()
         if self.archival is not None and self.config.archival_interval_s > 0:
             await self.archival.start()
+        if self.admin is not None:
+            await self.admin.start()
         self._join_task = None
         if self.config.auto_join:
             self._join_task = asyncio.ensure_future(self._register_self())
@@ -287,6 +399,8 @@ class Broker:
                 pass
             self._join_task = None
         await self.node_status.stop()
+        if self.admin is not None:
+            await self.admin.stop()
         if self.archival is not None:
             await self.archival.stop()
         if self._housekeeping_task is not None:

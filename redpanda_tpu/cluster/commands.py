@@ -130,6 +130,16 @@ class DeleteAclsCmd(serde.Envelope):
     ]
 
 
+class ConfigSetCmd(serde.Envelope):
+    """Cluster-config mutation (cluster_config_delta_cmd): string
+    key/values validated at the frontend, applied by every node's stm."""
+
+    SERDE_FIELDS = [
+        ("upserts", serde.mapping(serde.string, serde.string)),
+        ("removes", serde.vector(serde.string)),
+    ]
+
+
 class RegisterNodeCmd(serde.Envelope):
     """Node join / address (re)registration (reference:
     members_manager.cc apply_update of add_node_cmd /
@@ -194,6 +204,7 @@ CMD_CLASSES = {
     CmdType.delete_user: DeleteUserCmd,
     CmdType.create_acls: CreateAclsCmd,
     CmdType.delete_acls: DeleteAclsCmd,
+    CmdType.config_set: ConfigSetCmd,
     CmdType.register_node: RegisterNodeCmd,
     CmdType.decommission_node: DecommissionNodeCmd,
     CmdType.recommission_node: RecommissionNodeCmd,

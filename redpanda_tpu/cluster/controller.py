@@ -34,6 +34,7 @@ from ..security.scram import decode_credential
 from .commands import (
     AllocateProducerIdCmd,
     CmdType,
+    ConfigSetCmd,
     CreateAclsCmd,
     CreatePartitionsCmd,
     CreateTopicCmd,
@@ -153,6 +154,10 @@ class ControllerStm(StateMachine):
                 )
             elif cmd_type == CmdType.delete_acls:
                 self._c.acls.remove_matching(_cmd_to_filter(cmd))
+            elif cmd_type == CmdType.config_set:
+                self._c.cluster_config.apply(
+                    dict(cmd.upserts), list(cmd.removes)
+                )
             elif cmd_type == CmdType.register_node:
                 self._c.members_table.apply_register(
                     int(cmd.node_id),
@@ -310,6 +315,9 @@ class Controller:
         self.acls = AclStore()
         self.authorizer = Authorizer(self.acls)
         self.members_table = MembersTable()
+        from ..config import ClusterConfig
+
+        self.cluster_config = ClusterConfig()
         for m in members:
             self.members_table.seed(m)
             self.allocator.register_node(m)
@@ -650,6 +658,28 @@ class Controller:
             MoveReplicasCmd(
                 ns=ns, topic=topic, partition=partition, replicas=replicas
             ),
+        )
+
+    # -- cluster config frontend ---------------------------------------
+    async def set_cluster_config(
+        self, upserts: dict[str, str], removes: list[str] | None = None
+    ) -> None:
+        """Validate then replicate a config delta; every node's stm
+        applies it and fires local bindings (config_frontend.cc)."""
+        from ..config import ConfigError
+
+        removes = list(removes or [])
+        for name, raw in upserts.items():
+            try:
+                self.cluster_config.validate(name, raw)
+            except ConfigError as e:
+                raise TopicError("invalid_config", str(e)) from None
+        for name in removes:
+            if name not in self.cluster_config.properties():
+                raise TopicError("invalid_config", f"unknown property {name}")
+        await self.replicate_cmd(
+            CmdType.config_set,
+            ConfigSetCmd(upserts=dict(upserts), removes=removes),
         )
 
     # -- security frontends -------------------------------------------
@@ -1061,4 +1091,14 @@ class Controller:
         from ..storage.log import LogConfig
 
         md = self.topic_table.get(ntp.tp_ns)
-        return LogConfig.from_topic_config(md.config if md else {})
+        out = LogConfig.from_topic_config(md.config if md else {})
+        # cluster-level default applies when the topic sets nothing
+        # (configuration.cc delete_retention_ms default)
+        if out.retention_ms is None and (
+            md is None or "retention.ms" not in md.config
+        ):
+            if out.deletion_enabled:
+                out.retention_ms = int(
+                    self.cluster_config.get("default_topic_retention_ms")
+                )
+        return out

@@ -127,6 +127,13 @@ class KafkaServer:
         server_groups.install(self)
         server_tx.install(self)
         server_admin.install(self)
+        # resolved once: the request hot path only pays .inc/.observe
+        self._req_counter = broker.metrics.counter(
+            "kafka_requests_total", "Kafka requests by api"
+        )
+        self._latency_hist = broker.metrics.histogram(
+            "kafka_handler_seconds", "Kafka handler latency"
+        )
 
     # -- authorization -------------------------------------------------
     @property
@@ -218,7 +225,10 @@ class KafkaServer:
                 except (asyncio.IncompleteReadError, ConnectionError):
                     return
                 (size,) = _SIZE.unpack(raw_size)
-                if size <= 0 or size > 128 * 1024 * 1024:
+                max_frame = self.broker.controller.cluster_config.get(
+                    "kafka_max_request_bytes"
+                )
+                if size <= 0 or size > max_frame:
                     return
                 frame = await reader.readexactly(size)
                 try:
@@ -302,6 +312,7 @@ class KafkaServer:
             if handler is None:
                 raise _CloseConnection(b"")
             token = CURRENT_PRINCIPAL.set(ctx.principal)
+            t0 = asyncio.get_event_loop().time()
             try:
                 resp = await handler(hdr, req)
             except Exception:
@@ -311,6 +322,10 @@ class KafkaServer:
                 raise
             finally:
                 CURRENT_PRINCIPAL.reset(token)
+                self._req_counter.inc(api=api.name)
+                self._latency_hist.observe(
+                    asyncio.get_event_loop().time() - t0
+                )
         if asyncio.iscoroutine(resp):
             # staged handler (produce): dispatch done, response later —
             # encode when it settles, off the reader path
@@ -712,8 +727,12 @@ class KafkaServer:
         ).lower() in ("true", "1", "yes")
 
     async def handle_fetch(self, hdr: RequestHeader, req: Msg) -> Msg:
+        wait_cap = self.broker.controller.cluster_config.get(
+            "fetch_max_wait_cap_ms"
+        )
         deadline = (
-            asyncio.get_event_loop().time() + max(req.max_wait_ms, 0) / 1000.0
+            asyncio.get_event_loop().time()
+            + min(max(req.max_wait_ms, 0), wait_cap) / 1000.0
         )
         min_bytes = max(req.min_bytes, 0)
         # isolation 1 = READ_COMMITTED: serve only below the LSO and

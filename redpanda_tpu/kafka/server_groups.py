@@ -120,6 +120,11 @@ class GroupHandlers:
 
         if not self._group_ok(req.group_id):
             return err(int(ErrorCode.group_authorization_failed))
+        max_session = self.server.broker.controller.cluster_config.get(
+            "group_session_timeout_max_ms"
+        )
+        if req.session_timeout_ms > max_session:
+            return err(int(ErrorCode.invalid_session_timeout))
         g, code = await self.coordinator.get_group(req.group_id, create=True)
         if code:
             return err(code)

@@ -1,0 +1,142 @@
+"""Prometheus-format metrics registry.
+
+Reference: the reference exposes two Prometheus endpoints
+(redpanda/application.cc:460-520, /metrics + /public_metrics) fed by
+per-subsystem probes (raft/probe.cc:47-101, kafka probes,
+storage probes). Here one registry holds counters (incremented on hot
+paths — a dict bump, no locks needed on one event loop) and gauges
+(callables sampled at scrape time, so idle brokers pay nothing).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Callable
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    __slots__ = ("name", "help", "_values")
+
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = defaultdict(float)
+
+    def inc(self, value: float = 1.0, **labels: str) -> None:
+        self._values[tuple(sorted(labels.items()))] += value
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        if not self._values:
+            out.append(f"{self.name} 0")
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v:g}")
+        return out
+
+
+class Gauge:
+    """Sampled at scrape time: `fn` returns either a number or a
+    list[(labels_dict, value)] for labeled families."""
+
+    __slots__ = ("name", "help", "fn")
+
+    def __init__(self, name: str, help_: str, fn: Callable):
+        self.name = name
+        self.help = help_
+        self.fn = fn
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        try:
+            v = self.fn()
+        except Exception:
+            return out
+        if isinstance(v, (int, float)):
+            out.append(f"{self.name} {v:g}")
+        else:
+            for labels, value in v:
+                out.append(f"{self.name}{_fmt_labels(labels)} {value:g}")
+        return out
+
+
+class Histogram:
+    """Fixed log2 buckets (the reference's hdr_hist, coarsened):
+    observations in seconds."""
+
+    __slots__ = ("name", "help", "_buckets", "_sum", "_count", "_bounds")
+
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._bounds = [
+            0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+            0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+        ]
+        self._buckets = [0] * (len(self._bounds) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, seconds: float) -> None:
+        self._sum += seconds
+        self._count += 1
+        for i, b in enumerate(self._bounds):
+            if seconds <= b:
+                self._buckets[i] += 1
+                return
+        self._buckets[-1] += 1
+
+    def render(self) -> list[str]:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        cum = 0
+        for i, b in enumerate(self._bounds):
+            cum += self._buckets[i]
+            out.append(f'{self.name}_bucket{{le="{b:g}"}} {cum}')
+        cum += self._buckets[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {self._sum:g}")
+        out.append(f"{self.name}_count {self._count}")
+        return out
+
+
+class MetricsRegistry:
+    def __init__(self, prefix: str = "redpanda_tpu"):
+        self.prefix = prefix
+        self._metrics: dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        full = f"{self.prefix}_{name}"
+        m = self._metrics.get(full)
+        if m is None:
+            m = Counter(full, help_)
+            self._metrics[full] = m
+        return m
+
+    def gauge(self, name: str, fn: Callable, help_: str = "") -> Gauge:
+        full = f"{self.prefix}_{name}"
+        m = Gauge(full, help_, fn)
+        self._metrics[full] = m
+        return m
+
+    def histogram(self, name: str, help_: str = "") -> Histogram:
+        full = f"{self.prefix}_{name}"
+        m = self._metrics.get(full)
+        if m is None:
+            m = Histogram(full, help_)
+            self._metrics[full] = m
+        return m
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].render())
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,275 @@
+"""Admin HTTP API, cluster config system, and Prometheus metrics.
+
+Reference test model: redpanda/tests/admin_server_test, rptest
+admin-API tests (cluster config, users, leadership transfer), and the
+/metrics endpoints of application.cc:460-520.
+"""
+
+import asyncio
+import contextlib
+import json
+
+import pytest
+
+from redpanda_tpu.app import Broker, BrokerConfig
+from redpanda_tpu.kafka.client import KafkaClient
+from redpanda_tpu.models.fundamental import kafka_ntp
+from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+
+async def http(addr, method, path, body=None):
+    """Minimal HTTP/1.1 client over asyncio streams."""
+    reader, writer = await asyncio.open_connection(*addr)
+    payload = b"" if body is None else json.dumps(body).encode()
+    req = (
+        f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+        f"Content-Length: {len(payload)}\r\n\r\n"
+    ).encode() + payload
+    writer.write(req)
+    await writer.drain()
+    status_line = await reader.readline()
+    status = int(status_line.split()[1])
+    headers = {}
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        k, _, v = line.decode().partition(":")
+        headers[k.strip().lower()] = v.strip()
+    length = int(headers.get("content-length", "0") or 0)
+    data = await reader.readexactly(length) if length else b""
+    writer.close()
+    if headers.get("content-type", "").startswith("application/json") and data:
+        return status, json.loads(data)
+    return status, data
+
+
+@contextlib.asynccontextmanager
+async def cluster(tmp_path, n=3):
+    net = LoopbackNetwork()
+    members = list(range(n))
+    brokers = [
+        Broker(
+            BrokerConfig(
+                node_id=i,
+                data_dir=str(tmp_path / f"n{i}"),
+                members=members,
+                election_timeout_s=0.15,
+                heartbeat_interval_s=0.03,
+                node_status_interval_s=0.1,
+            ),
+            loopback=net,
+        )
+        for i in members
+    ]
+    for b in brokers:
+        await b.start()
+    addrs = {b.node_id: b.kafka_advertised for b in brokers}
+    for b in brokers:
+        b.config.peer_kafka_addresses = addrs
+    try:
+        await brokers[0].wait_controller_leader()
+        yield brokers
+    finally:
+        for b in brokers:
+            await b.stop()
+
+
+async def _admin_surface(tmp_path):
+    async with cluster(tmp_path) as brokers:
+        b = brokers[0]
+        addr = b.admin.address
+
+        # readiness + brokers + health
+        st, body = await http(addr, "GET", "/v1/status/ready")
+        assert st == 200 and body["status"] == "ready"
+        st, body = await http(addr, "GET", "/v1/brokers")
+        assert st == 200 and len(body["brokers"]) == 3
+        st, body = await http(addr, "GET", "/v1/cluster/health_overview")
+        assert st == 200 and body["nodes_down"] == []
+
+        # topic lifecycle over HTTP
+        st, body = await http(
+            addr,
+            "POST",
+            "/v1/topics",
+            {"name": "ht", "partitions": 2, "replication_factor": 3,
+             "configs": {"retention.ms": "1000000"}},
+        )
+        assert st == 200, body
+        st, body = await http(addr, "GET", "/v1/topics/ht")
+        assert st == 200
+        assert body["partition_count"] == 2
+        assert body["config"]["retention.ms"] == "1000000"
+
+        # partition detail + leadership transfer (leader election for
+        # the fresh group may be in flight: poll)
+        deadline = asyncio.get_event_loop().time() + 5
+        leader = None
+        while asyncio.get_event_loop().time() < deadline:
+            st, body = await http(addr, "GET", "/v1/partitions/kafka/ht/0")
+            assert st == 200 and sorted(body["replicas"]) == [0, 1, 2]
+            leader = body["leader"]
+            if leader is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert leader is not None
+        ldr_broker = next(x for x in brokers if x.node_id == leader)
+        target = next(i for i in (0, 1, 2) if i != leader)
+        st, _ = await http(
+            ldr_broker.admin.address,
+            "POST",
+            f"/v1/partitions/kafka/ht/0/transfer_leadership?target={target}",
+        )
+        assert st == 204
+        deadline = asyncio.get_event_loop().time() + 5
+        while asyncio.get_event_loop().time() < deadline:
+            p = ldr_broker.partition_manager.get(kafka_ntp("ht", 0))
+            if p is not None and not p.is_leader:
+                break
+            await asyncio.sleep(0.05)
+        st, body = await http(addr, "GET", "/v1/partitions/kafka/ht/0")
+        assert body["leader"] != leader or body["leader"] is None
+
+        # SCRAM user management
+        st, _ = await http(
+            addr, "PUT", "/v1/security/users",
+            {"username": "op", "password": "pw"},
+        )
+        assert st == 204
+        assert brokers[2].controller.credentials.contains("op")
+        st, _ = await http(addr, "DELETE", "/v1/security/users/op")
+        assert st == 204
+
+        # 404s + validation errors
+        st, _ = await http(addr, "GET", "/v1/topics/nope")
+        assert st == 404
+        st, _ = await http(addr, "POST", "/v1/topics", {"partitions": 3})
+        assert st == 400
+        st, _ = await http(addr, "GET", "/v1/nonsense")
+        assert st == 404
+
+        # topic deletion
+        st, _ = await http(addr, "DELETE", "/v1/topics/ht")
+        assert st == 204
+
+
+def test_admin_surface(tmp_path):
+    asyncio.run(_admin_surface(tmp_path))
+
+
+async def _cluster_config(tmp_path):
+    async with cluster(tmp_path) as brokers:
+        addr = brokers[0].admin.address
+        st, schema = await http(addr, "GET", "/v1/cluster_config/schema")
+        assert st == 200 and "log_compaction_interval_s" in schema
+
+        # set through node 0; visible on ALL nodes (replicated)
+        st, body = await http(
+            addr, "PUT", "/v1/cluster_config",
+            {"upsert": {"log_compaction_interval_s": "3.5",
+                        "kafka_max_request_bytes": "1048576"}},
+        )
+        assert st == 200, body
+        for b in brokers:
+            deadline = asyncio.get_event_loop().time() + 5
+            while asyncio.get_event_loop().time() < deadline:
+                if b.controller.cluster_config.get(
+                    "log_compaction_interval_s"
+                ) == 3.5:
+                    break
+                await asyncio.sleep(0.05)
+            assert b.controller.cluster_config.get(
+                "log_compaction_interval_s"
+            ) == 3.5
+            # live binding fired into the running broker
+            assert b.config.housekeeping_interval_s == 3.5
+
+        # follower-routed write converges too (read-your-writes)
+        st, _ = await http(
+            brokers[2].admin.address, "PUT", "/v1/cluster_config",
+            {"upsert": {"fetch_max_wait_cap_ms": "2500"}},
+        )
+        assert st == 200
+        assert brokers[2].controller.cluster_config.get(
+            "fetch_max_wait_cap_ms"
+        ) == 2500
+
+        # validation: bad type and unknown key rejected
+        st, _ = await http(
+            addr, "PUT", "/v1/cluster_config",
+            {"upsert": {"log_compaction_interval_s": "banana"}},
+        )
+        assert st == 400
+        st, _ = await http(
+            addr, "PUT", "/v1/cluster_config", {"upsert": {"no_such_knob": "1"}}
+        )
+        assert st == 400
+
+        # remove reverts to default AND the live binding restores the
+        # broker's constructed value (not the registry default)
+        st, _ = await http(
+            addr, "PUT", "/v1/cluster_config",
+            {"remove": ["kafka_max_request_bytes", "log_compaction_interval_s"]},
+        )
+        assert st == 200
+        assert brokers[0].controller.cluster_config.is_default(
+            "kafka_max_request_bytes"
+        )
+        for b in brokers:
+            deadline = asyncio.get_event_loop().time() + 5
+            while asyncio.get_event_loop().time() < deadline:
+                if b.config.housekeeping_interval_s == 10.0:
+                    break
+                await asyncio.sleep(0.05)
+            # constructed value was the default 10.0 in this fixture
+            assert b.config.housekeeping_interval_s == 10.0
+
+
+def test_cluster_config(tmp_path):
+    asyncio.run(_cluster_config(tmp_path))
+
+
+async def _metrics_endpoint(tmp_path):
+    async with cluster(tmp_path, n=1) as brokers:
+        b = brokers[0]
+        client = KafkaClient([b.kafka_advertised])
+        await client.create_topic("mt", partitions=1, replication_factor=1)
+        await client.produce("mt", 0, [(b"k", b"v")])
+        await client.fetch("mt", 0, 0)
+        await client.close()
+
+        st, text = await http(b.admin.address, "GET", "/metrics")
+        assert st == 200
+        text = text.decode()
+        assert "redpanda_tpu_partitions_total 1" in text
+        assert "redpanda_tpu_controller_is_leader 1" in text
+        assert 'redpanda_tpu_kafka_requests_total{api="produce"} 1' in text
+        assert 'api="fetch"' in text
+        assert "redpanda_tpu_kafka_handler_seconds_count" in text
+        assert "redpanda_tpu_log_segments_total" in text
+
+
+def test_metrics_endpoint(tmp_path):
+    asyncio.run(_metrics_endpoint(tmp_path))
+
+
+async def _fault_injection(tmp_path):
+    from redpanda_tpu.utils.hbadger import honey_badger
+
+    async with cluster(tmp_path, n=1) as brokers:
+        b = brokers[0]
+        st, _ = await http(
+            b.admin.address, "POST", "/v1/debug/fault_injection",
+            {"module": "raft", "point": "append_entries", "delay_s": 0.0,
+             "count": 1},
+        )
+        assert st == 204
+        assert honey_badger._probes, "probe should be armed"
+        st, _ = await http(b.admin.address, "DELETE", "/v1/debug/fault_injection")
+        assert st == 204
+        assert not honey_badger._probes
+
+
+def test_fault_injection_endpoint(tmp_path):
+    asyncio.run(_fault_injection(tmp_path))
