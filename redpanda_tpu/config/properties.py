@@ -117,6 +117,20 @@ def default_properties() -> list[Property]:
             "Server-side cap on fetch max_wait_ms",
             _non_negative,
         ),
+        Property(
+            "quota_produce_bytes_per_s",
+            "int",
+            0,
+            "Per-client produce throughput quota (0 = unlimited)",
+            _non_negative,
+        ),
+        Property(
+            "quota_fetch_bytes_per_s",
+            "int",
+            0,
+            "Per-client fetch throughput quota (0 = unlimited)",
+            _non_negative,
+        ),
     ]
 
 

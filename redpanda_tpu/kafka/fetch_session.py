@@ -1,0 +1,122 @@
+"""Incremental fetch sessions (KIP-227).
+
+Reference: src/v/kafka/server/fetch_session_cache.{h,cc} and
+fetch_session.h. A session remembers the client's partition set and
+the last (high watermark, LSO, log start) each partition was answered
+with, so steady-state polls send no partition list and receive only
+partitions with news — the dominant traffic saver for consumers over
+many partitions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import random
+
+from .protocol import ErrorCode
+
+_MAX_SESSIONS = 1000
+_EVICT_IDLE_S = 120.0
+
+
+@dataclasses.dataclass(slots=True)
+class SessionPartition:
+    fetch_offset: int
+    max_bytes: int
+    # last values answered to the client (None = never answered):
+    # a partition re-enters a response when any of them move
+    last_hw: int | None = None
+    last_lso: int | None = None
+    last_start: int | None = None
+
+
+class FetchSession:
+    def __init__(self, session_id: int):
+        self.id = session_id
+        self.epoch = 1
+        # insertion-ordered (topic, partition) -> SessionPartition
+        self.partitions: dict[tuple[str, int], SessionPartition] = {}
+        self.last_used = 0.0
+
+    def apply_request(self, topics, forgotten) -> None:
+        """Merge an incremental request: named partitions upsert their
+        position; forgotten ones leave the session."""
+        for t in topics or []:
+            for p in t.partitions:
+                cur = self.partitions.get((t.topic, p.partition))
+                if cur is not None:
+                    # position update: the answered-state cache stays —
+                    # wiping it would force the partition back into the
+                    # next response with no news
+                    cur.fetch_offset = p.fetch_offset
+                    cur.max_bytes = p.partition_max_bytes
+                else:
+                    self.partitions[(t.topic, p.partition)] = SessionPartition(
+                        fetch_offset=p.fetch_offset,
+                        max_bytes=p.partition_max_bytes,
+                    )
+        for f in forgotten or []:
+            for pid in f.partitions:
+                self.partitions.pop((f.topic, pid), None)
+
+
+class FetchSessionCache:
+    def __init__(self):
+        self._sessions: dict[int, FetchSession] = {}
+
+    def _now(self) -> float:
+        return asyncio.get_event_loop().time()
+
+    def create(self) -> FetchSession | None:
+        """New session, or None when the cache is full of ACTIVE
+        sessions — the caller then answers sessionless (session_id 0),
+        exactly how fetch_session_cache.cc declines rather than
+        evicting a live consumer's session (evicting would cascade:
+        every new session kills an active one, whose owner then
+        recreates, killing another)."""
+        if len(self._sessions) >= _MAX_SESSIONS:
+            self._evict_idle()
+            if len(self._sessions) >= _MAX_SESSIONS:
+                return None
+        # randomized ids (Kafka does the same): sequential ids let any
+        # client guess and close another client's session
+        while True:
+            sid = random.randrange(1, 1 << 31)
+            if sid not in self._sessions:
+                break
+        s = FetchSession(sid)
+        s.last_used = self._now()
+        self._sessions[sid] = s
+        return s
+
+    def use(
+        self, session_id: int, epoch: int
+    ) -> tuple[FetchSession | None, int]:
+        """Resolve an established session; returns (session, error)."""
+        s = self._sessions.get(session_id)
+        if s is None:
+            return None, int(ErrorCode.fetch_session_id_not_found)
+        if epoch != s.epoch:
+            return None, int(ErrorCode.invalid_fetch_session_epoch)
+        s.epoch += 1
+        s.last_used = self._now()
+        return s, 0
+
+    def remove(self, session_id: int) -> None:
+        self._sessions.pop(session_id, None)
+
+    def _evict_idle(self) -> None:
+        """Drop sessions idle past the threshold — crashed/disconnected
+        consumers never send the closing epoch=-1, so idle expiry is
+        what actually reclaims their slots."""
+        now = self._now()
+        for sid in [
+            sid
+            for sid, s in self._sessions.items()
+            if now - s.last_used > _EVICT_IDLE_S
+        ]:
+            del self._sessions[sid]
+
+    def __len__(self) -> int:
+        return len(self._sessions)

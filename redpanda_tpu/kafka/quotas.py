@@ -1,0 +1,75 @@
+"""Per-client throughput quotas.
+
+Reference: src/v/kafka/server/quota_manager.{h,cc}
+(record_produce_tp_and_throttle / record_fetch_tp, per-client-id token
+buckets, throttle_time_ms surfaced in responses). Rates come from the
+replicated cluster config and apply live; rate 0 means unlimited.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..utils.token_bucket import TokenBucket
+
+# forget a client's bucket after this long idle (client_quotas gc)
+_GC_AFTER_S = 60.0
+_MAX_THROTTLE_MS = 30_000
+
+
+class QuotaManager:
+    def __init__(self, cluster_config):
+        self._cfg = cluster_config
+        # (kind, client_id) -> (bucket, last_used)
+        self._buckets: dict[tuple[str, str], tuple[TokenBucket, float]] = {}
+
+    def _rate(self, kind: str) -> float:
+        key = (
+            "quota_produce_bytes_per_s"
+            if kind == "produce"
+            else "quota_fetch_bytes_per_s"
+        )
+        try:
+            return float(self._cfg.get(key))
+        except Exception:
+            return 0.0
+
+    def _bucket(self, kind: str, client_id: str, rate: float, now: float) -> TokenBucket:
+        key = (kind, client_id)
+        entry = self._buckets.get(key)
+        if entry is None:
+            # burst of one second's allowance, like the reference's
+            # default window
+            b = TokenBucket(rate, burst=rate, now=now)
+            self._buckets[key] = (b, now)
+            return b
+        b, _ = entry
+        b.rate = rate  # live config rebind
+        b.burst = rate
+        self._buckets[key] = (b, now)
+        return b
+
+    def record_and_throttle(
+        self, kind: str, client_id: Optional[str], nbytes: int
+    ) -> int:
+        """Account traffic; returns throttle_time_ms for the response
+        (0 when unlimited or within quota)."""
+        rate = self._rate(kind)
+        if rate <= 0:
+            return 0
+        now = asyncio.get_event_loop().time()
+        b = self._bucket(kind, client_id or "", rate, now)
+        b.record(nbytes, now)
+        delay = b.throttle_delay_s(now)
+        if len(self._buckets) > 10_000:
+            self._gc(now)
+        return min(int(delay * 1000), _MAX_THROTTLE_MS)
+
+    def _gc(self, now: float) -> None:
+        stale = [
+            k for k, (_b, last) in self._buckets.items()
+            if now - last > _GC_AFTER_S
+        ]
+        for k in stale:
+            del self._buckets[k]

@@ -134,6 +134,11 @@ class KafkaServer:
         self._latency_hist = broker.metrics.histogram(
             "kafka_handler_seconds", "Kafka handler latency"
         )
+        from .fetch_session import FetchSessionCache
+        from .quotas import QuotaManager
+
+        self.quotas = QuotaManager(broker.controller.cluster_config)
+        self.fetch_sessions = FetchSessionCache()
 
     # -- authorization -------------------------------------------------
     @property
@@ -695,11 +700,17 @@ class KafkaServer:
         # stage 1 runs before this handler returns: per-connection
         # order is fixed by enqueue order
         work = []
+        produced_bytes = 0
         for t in req.topics:
+            for p in t.partitions:
+                produced_bytes += len(p.records or b"")
             partition_work = [
                 await dispatch_partition(t.name, p) for p in t.partitions
             ]
             work.append((t.name, partition_work))
+        throttle = self.quotas.record_and_throttle(
+            "produce", hdr.client_id, produced_bytes
+        )
 
         async def finish():
             responses = []
@@ -712,7 +723,11 @@ class KafkaServer:
                 )
             if acks == 0:
                 return None
-            return Msg(responses=responses, throttle_time_ms=0)
+            if throttle:
+                # enforced delay on the ordered response stream (see
+                # handle_fetch) — a quota a client can ignore is no quota
+                await asyncio.sleep(min(throttle, 1000) / 1000.0)
+            return Msg(responses=responses, throttle_time_ms=throttle)
 
         return finish()
 
@@ -738,13 +753,66 @@ class KafkaServer:
         # isolation 1 = READ_COMMITTED: serve only below the LSO and
         # report aborted ranges (fetch.cc read_result + rm_stm LSO)
         read_committed = getattr(req, "isolation_level", 0) == 1
+
+        # -- fetch sessions (KIP-227, fetch_session_cache.h) ----------
+        # epoch -1: sessionless full fetch. id 0 + epoch 0: create a
+        # session from this request. Otherwise: incremental — merge the
+        # request into the session and serve ITS partition set.
+        session = None
+        incremental = False
+        if hdr.api_version >= 7:
+            sid = getattr(req, "session_id", 0) or 0
+            epoch = getattr(req, "session_epoch", -1)
+            if epoch == -1:
+                if sid:
+                    self.fetch_sessions.remove(sid)
+            elif epoch == 0:
+                # KIP-227: epoch 0 creates a NEW session regardless of
+                # the id field (a client re-establishing after an error
+                # may still carry its stale id)
+                if sid:
+                    self.fetch_sessions.remove(sid)
+                session = self.fetch_sessions.create()
+                if session is not None:
+                    session.apply_request(req.topics, None)
+                # cache full of active sessions: answer sessionless
+            else:
+                session, err = self.fetch_sessions.use(sid, epoch)
+                if session is None:
+                    return Msg(
+                        throttle_time_ms=0,
+                        error_code=err,
+                        session_id=0,
+                        responses=[],
+                    )
+                incremental = True
+                session.apply_request(
+                    req.topics, getattr(req, "forgotten_topics_data", None)
+                )
+        if session is not None:
+            by_topic: dict[str, list[Msg]] = {}
+            for (topic, pid), sp in session.partitions.items():
+                by_topic.setdefault(topic, []).append(
+                    Msg(
+                        partition=pid,
+                        fetch_offset=sp.fetch_offset,
+                        partition_max_bytes=sp.max_bytes,
+                    )
+                )
+            plan_topics = [
+                Msg(topic=topic, partitions=parts)
+                for topic, parts in by_topic.items()
+            ]
+        else:
+            plan_topics = list(req.topics)
+
         # authorize once per request, not once per ~5ms poll iteration
         # (fetch.cc authorizes at plan time)
         authorized = {
             t.topic: self.authorize(
                 AclOperation.read, AclResourceType.topic, t.topic
             )
-            for t in req.topics
+            for t in plan_topics
         }
         # archived-range pre-pass: offsets below the LOCAL log start
         # that tiered storage still covers are read from the object
@@ -758,7 +826,7 @@ class KafkaServer:
             # ONE budget across all remote rows, mirroring the local
             # read loop's `budget - total` accounting
             remote_budget = req.max_bytes if req.max_bytes > 0 else 1 << 30
-            for t in req.topics:
+            for t in plan_topics:
                 if not authorized.get(t.topic):
                     continue
                 if not self._remote_read_enabled(t.topic):
@@ -839,7 +907,7 @@ class KafkaServer:
             has_error = False
             out = []
             budget = req.max_bytes if req.max_bytes > 0 else 1 << 30
-            for t in req.topics:
+            for t in plan_topics:
                 parts = []
                 topic_ok = authorized[t.topic]
                 for p in t.partitions:
@@ -977,12 +1045,61 @@ class KafkaServer:
             if now >= deadline:
                 break
             await asyncio.sleep(min(0.005, deadline - now))
+
+        if session is not None:
+            responses = self._finish_session_fetch(
+                session, responses, incremental
+            )
+        throttle = self.quotas.record_and_throttle(
+            "fetch",
+            hdr.client_id,
+            sum(
+                len(p.records or b"")
+                for t in responses
+                for p in t.partitions
+            ),
+        )
+        if throttle:
+            # ENFORCE, don't just advise: the connection's ordered
+            # response stream stalls for the throttle window, bounding
+            # a client that ignores throttle_time_ms
+            # (quota_manager.cc throttling via response delay)
+            await asyncio.sleep(min(throttle, 1000) / 1000.0)
         return Msg(
-            throttle_time_ms=0,
+            throttle_time_ms=throttle,
             error_code=0,
-            session_id=0,
+            session_id=session.id if session is not None else 0,
             responses=responses,
         )
+
+    @staticmethod
+    def _finish_session_fetch(session, responses, incremental):
+        """Record what each partition was answered with; incremental
+        responses then carry only partitions with NEWS — records, an
+        error, or hw/lso/log-start movement (fetch_session.h
+        fetch_partition cached-state comparison)."""
+        out = []
+        for t in responses:
+            keep = []
+            for p in t.partitions:
+                sp = session.partitions.get((t.topic, p.partition_index))
+                changed = (
+                    sp is None
+                    or p.records
+                    or p.error_code != 0
+                    or sp.last_hw != p.high_watermark
+                    or sp.last_lso != p.last_stable_offset
+                    or sp.last_start != p.log_start_offset
+                )
+                if sp is not None:
+                    sp.last_hw = p.high_watermark
+                    sp.last_lso = p.last_stable_offset
+                    sp.last_start = p.log_start_offset
+                if changed or not incremental:
+                    keep.append(p)
+            if keep:
+                out.append(Msg(topic=t.topic, partitions=keep))
+        return out
 
     async def handle_list_offsets(self, hdr: RequestHeader, req: Msg) -> Msg:
         out = []

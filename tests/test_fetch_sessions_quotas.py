@@ -1,0 +1,243 @@
+"""Incremental fetch sessions (KIP-227) and per-client quotas.
+
+Reference test model: kafka/server/tests/fetch_session_test.cc and
+quota_manager tests; rptest fetch-session and client-quota coverage.
+"""
+
+import asyncio
+import contextlib
+
+import pytest
+
+from redpanda_tpu.app import Broker, BrokerConfig
+from redpanda_tpu.kafka.client import KafkaClient
+from redpanda_tpu.kafka.protocol import FETCH, ErrorCode, Msg
+from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+
+@contextlib.asynccontextmanager
+async def broker(tmp_path):
+    net = LoopbackNetwork()
+    b = Broker(
+        BrokerConfig(
+            node_id=0,
+            data_dir=str(tmp_path / "n0"),
+            members=[0],
+            election_timeout_s=0.15,
+            heartbeat_interval_s=0.03,
+        ),
+        loopback=net,
+    )
+    await b.start()
+    b.config.peer_kafka_addresses = {0: b.kafka_advertised}
+    try:
+        await b.wait_controller_leader()
+        yield b
+    finally:
+        await b.stop()
+
+
+def _fetch_req(topics, session_id=0, epoch=-1, forgotten=(), max_wait=0):
+    return Msg(
+        replica_id=-1,
+        max_wait_ms=max_wait,
+        min_bytes=0,
+        max_bytes=1 << 20,
+        isolation_level=0,
+        session_id=session_id,
+        session_epoch=epoch,
+        topics=[
+            Msg(
+                topic=t,
+                partitions=[
+                    Msg(
+                        partition=p,
+                        current_leader_epoch=-1,
+                        fetch_offset=off,
+                        log_start_offset=-1,
+                        partition_max_bytes=1 << 20,
+                    )
+                    for p, off in parts
+                ],
+            )
+            for t, parts in topics
+        ],
+        forgotten_topics_data=[
+            Msg(topic=t, partitions=list(ps)) for t, ps in forgotten
+        ],
+        rack_id="",
+    )
+
+
+async def _incremental_sessions(tmp_path):
+    async with broker(tmp_path) as b:
+        client = KafkaClient([b.kafka_advertised])
+        await client.create_topic("fs", partitions=2, replication_factor=1)
+        await client.produce("fs", 0, [(b"a", b"1")])
+        await client.produce("fs", 1, [(b"b", b"2")])
+        conn = await client.leader_conn("fs", 0)
+
+        # establish a session over both partitions (id 0, epoch 0)
+        resp = await conn.request(
+            FETCH,
+            _fetch_req([("fs", [(0, 0), (1, 0)])], session_id=0, epoch=0),
+            11,
+        )
+        assert resp.error_code == 0
+        sid = resp.session_id
+        assert sid > 0
+        got = {
+            p.partition_index: p.records
+            for t in resp.responses
+            for p in t.partitions
+        }
+        assert got[0] is not None and got[1] is not None
+
+        # consumer advanced both positions; nothing new: EMPTY response
+        # (the steady-state saving sessions exist for)
+        resp = await conn.request(
+            FETCH,
+            _fetch_req([("fs", [(0, 1), (1, 1)])], session_id=sid, epoch=1),
+            11,
+        )
+        assert resp.error_code == 0 and resp.session_id == sid
+        assert resp.responses == []
+
+        # produce to one partition; a NO-TOPICS incremental poll now
+        # carries ONLY that partition (the other is omitted)
+        await client.produce("fs", 1, [(b"c", b"3")])
+        resp = await conn.request(
+            FETCH, _fetch_req([], session_id=sid, epoch=2), 11
+        )
+        rows = [
+            (t.topic, p.partition_index)
+            for t in resp.responses
+            for p in t.partitions
+        ]
+        assert rows == [("fs", 1)]
+        p1 = resp.responses[0].partitions[0]
+        assert p1.records
+
+        # client advances partition 1 past the new record: empty again
+        resp = await conn.request(
+            FETCH,
+            _fetch_req([("fs", [(1, 2)])], session_id=sid, epoch=3),
+            11,
+        )
+        rows = {
+            p.partition_index: p.records
+            for t in resp.responses
+            for p in t.partitions
+        }
+        assert 1 not in rows or not rows[1]
+
+        # forget partition 0; produce to it; incremental poll stays empty
+        resp = await conn.request(
+            FETCH,
+            _fetch_req(
+                [], session_id=sid, epoch=4, forgotten=[("fs", [0])]
+            ),
+            11,
+        )
+        assert resp.error_code == 0
+        await client.produce("fs", 0, [(b"d", b"4")])
+        resp = await conn.request(
+            FETCH, _fetch_req([], session_id=sid, epoch=5), 11
+        )
+        assert all(
+            p.partition_index != 0
+            for t in resp.responses
+            for p in t.partitions
+        )
+
+        # wrong epoch → INVALID_FETCH_SESSION_EPOCH
+        resp = await conn.request(
+            FETCH, _fetch_req([], session_id=sid, epoch=99), 11
+        )
+        assert resp.error_code == int(ErrorCode.invalid_fetch_session_epoch)
+        # unknown session id → FETCH_SESSION_ID_NOT_FOUND
+        resp = await conn.request(
+            FETCH, _fetch_req([], session_id=777777, epoch=1), 11
+        )
+        assert resp.error_code == int(ErrorCode.fetch_session_id_not_found)
+
+        # epoch -1 closes the session; the id no longer resolves
+        resp = await conn.request(
+            FETCH,
+            _fetch_req([("fs", [(0, 0)])], session_id=sid, epoch=-1),
+            11,
+        )
+        assert resp.error_code == 0 and resp.session_id == 0
+        resp = await conn.request(
+            FETCH, _fetch_req([], session_id=sid, epoch=6), 11
+        )
+        assert resp.error_code == int(ErrorCode.fetch_session_id_not_found)
+        await client.close()
+
+
+def test_incremental_fetch_sessions(tmp_path):
+    asyncio.run(_incremental_sessions(tmp_path))
+
+
+async def _quotas(tmp_path):
+    async with broker(tmp_path) as b:
+        client = KafkaClient([b.kafka_advertised])
+        await client.create_topic("qt", partitions=1, replication_factor=1)
+        # unlimited by default: no throttle
+        conn = await client.leader_conn("qt", 0)
+        await client.produce("qt", 0, [(b"k", b"v" * 1000)])
+
+        # set a tiny produce quota through replicated cluster config
+        await b.controller.set_cluster_config(
+            {"quota_produce_bytes_per_s": "1024"}
+        )
+        from redpanda_tpu.kafka.protocol import PRODUCE
+        from redpanda_tpu.models.record import RecordBatchBuilder
+
+        throttles = []
+        for i in range(4):
+            builder = RecordBatchBuilder()
+            builder.add(b"x" * 2000, key=b"k")
+            resp = await conn.request(
+                PRODUCE,
+                Msg(
+                    transactional_id=None,
+                    acks=-1,
+                    timeout_ms=5000,
+                    topics=[
+                        Msg(
+                            name="qt",
+                            partitions=[
+                                Msg(
+                                    index=0,
+                                    records=builder.build().to_kafka_wire(),
+                                )
+                            ],
+                        )
+                    ],
+                ),
+                7,
+            )
+            assert resp.responses[0].partition_responses[0].error_code == 0
+            throttles.append(resp.throttle_time_ms)
+        # overshooting 1 KiB/s with ~2 KiB batches must throttle, and
+        # the deficit (hence delay) grows with each batch
+        assert throttles[-1] > 0
+        assert throttles[-1] >= throttles[1]
+
+        # removing the quota stops throttling
+        await b.controller.set_cluster_config(
+            {}, removes=["quota_produce_bytes_per_s"]
+        )
+        resp = await client.produce("qt", 0, [(b"k", b"v" * 2000)])
+        # fetch quota: tiny limit throttles a large read
+        await b.controller.set_cluster_config(
+            {"quota_fetch_bytes_per_s": "512"}
+        )
+        got = await client.fetch("qt", 0, 0, max_bytes=1 << 20)
+        assert got  # data still served; throttle is advisory
+        await client.close()
+
+
+def test_quotas(tmp_path):
+    asyncio.run(_quotas(tmp_path))
