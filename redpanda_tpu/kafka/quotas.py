@@ -23,6 +23,7 @@ class QuotaManager:
         self._cfg = cluster_config
         # (kind, client_id) -> (bucket, last_used)
         self._buckets: dict[tuple[str, str], tuple[TokenBucket, float]] = {}
+        self._last_gc = 0.0
 
     def _rate(self, kind: str) -> float:
         key = (
@@ -67,6 +68,11 @@ class QuotaManager:
         return min(int(delay * 1000), _MAX_THROTTLE_MS)
 
     def _gc(self, now: float) -> None:
+        # client_id cardinality is client-controlled: rate-limit the
+        # O(n) sweep so it cannot ride every hot-path request
+        if now - self._last_gc < 10.0:
+            return
+        self._last_gc = now
         stale = [
             k for k, (_b, last) in self._buckets.items()
             if now - last > _GC_AFTER_S

@@ -711,6 +711,11 @@ class KafkaServer:
         throttle = self.quotas.record_and_throttle(
             "produce", hdr.client_id, produced_bytes
         )
+        if throttle and acks == 0:
+            # no response exists to carry throttle_time_ms for acks=0 —
+            # stall the reader loop itself so the firehose cannot
+            # bypass the quota by never waiting for responses
+            await asyncio.sleep(min(throttle, 1000) / 1000.0)
 
         async def finish():
             responses = []

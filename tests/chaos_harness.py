@@ -1,0 +1,251 @@
+"""Chaos harness: faults injected into a live cluster under load, with
+cluster-wide invariant checks.
+
+Reference: tests/rptest/services/failure_injector.py:142-214 (kill /
+suspend / isolate a broker during traffic) and the consistency
+validations of rptest's produce-consume-validator workloads. In-process
+analog: network partitions via LoopbackNetwork.isolate, crashes via
+Broker.stop + a fresh Broker over the SAME data dir (kill -9 then
+restart), leadership churn via raft transfer.
+
+Invariants checked:
+  I1  every ACKED record is readable at its acked offset (committed
+      data survives every fault)
+  I2  a partition's high watermark never regresses below an acked
+      offset (no un-commit)
+  I3  offsets are served in order with no duplicates at distinct
+      offsets per fetch
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import random
+
+from redpanda_tpu.app import Broker, BrokerConfig
+from redpanda_tpu.kafka.client import KafkaClient, KafkaClientError
+from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+
+class ChaosCluster:
+    def __init__(self, tmp_path, n: int = 3):
+        self.tmp = tmp_path
+        self.n = n
+        self.net = LoopbackNetwork()
+        self.brokers: dict[int, Broker] = {}
+
+    def _config(self, nid: int) -> BrokerConfig:
+        return BrokerConfig(
+            node_id=nid,
+            data_dir=str(self.tmp / f"n{nid}"),
+            members=list(range(self.n)),
+            election_timeout_s=0.15,
+            heartbeat_interval_s=0.03,
+            node_status_interval_s=0.2,
+            enable_admin=False,
+        )
+
+    async def start(self) -> None:
+        for nid in range(self.n):
+            b = Broker(self._config(nid), loopback=self.net)
+            self.brokers[nid] = b
+            await b.start()
+        addrs = {b.node_id: b.kafka_advertised for b in self.brokers.values()}
+        for b in self.brokers.values():
+            b.config.peer_kafka_addresses = dict(addrs)
+        await self.brokers[0].wait_controller_leader()
+
+    async def stop(self) -> None:
+        for b in self.brokers.values():
+            await b.stop()
+
+    async def crash(self, nid: int) -> None:
+        """kill -9: stop serving immediately; data stays on disk."""
+        await self.brokers[nid].stop()
+
+    async def restart(self, nid: int) -> None:
+        """Boot a fresh broker process over the surviving data dir."""
+        b = Broker(self._config(nid), loopback=self.net)
+        self.brokers[nid] = b
+        await b.start()
+        addrs = {
+            x.node_id: x.kafka_advertised for x in self.brokers.values()
+        }
+        for x in self.brokers.values():
+            x.config.peer_kafka_addresses = dict(addrs)
+
+    def partition_network(self, nid: int) -> None:
+        self.net.isolate(nid)
+
+    def heal_network(self) -> None:
+        self.net.heal()
+
+    def addresses(self) -> list[tuple[str, int]]:
+        return [b.kafka_advertised for b in self.brokers.values()]
+
+
+class SeqProducer:
+    """Producer of sequenced records; remembers every ACK as
+    (partition, offset, seq) — the ground truth the validator holds
+    the cluster to."""
+
+    def __init__(self, cluster: ChaosCluster, topic: str, partitions: int):
+        self.cluster = cluster
+        self.topic = topic
+        self.partitions = partitions
+        self.acked: list[tuple[int, int, int]] = []
+        self.attempts = 0
+        self._seq = 0
+        self._stop = False
+
+    async def run(self) -> None:
+        client = KafkaClient(self.cluster.addresses())
+        try:
+            while not self._stop:
+                seq = self._seq
+                self._seq += 1
+                pid = seq % self.partitions
+                self.attempts += 1
+                try:
+                    off = await asyncio.wait_for(
+                        client.produce(
+                            self.topic,
+                            pid,
+                            [(b"seq-%d" % seq, b"payload-%d" % seq)],
+                            acks=-1,
+                        ),
+                        timeout=3.0,
+                    )
+                    self.acked.append((pid, off, seq))
+                except (KafkaClientError, asyncio.TimeoutError, OSError):
+                    # unacked: may or may not be committed — the
+                    # validator makes no claim about it
+                    with contextlib.suppress(Exception):
+                        await client.close()
+                    client = KafkaClient(self.cluster.addresses())
+                await asyncio.sleep(0.01)
+        finally:
+            with contextlib.suppress(Exception):
+                await client.close()
+
+    def stop(self) -> None:
+        self._stop = True
+
+
+async def validate(
+    cluster: ChaosCluster, topic: str, partitions: int, producer: SeqProducer
+) -> dict:
+    """Post-chaos invariant sweep (see module docstring)."""
+    client = KafkaClient(cluster.addresses())
+    by_partition: dict[int, dict[int, int]] = {}
+    for pid, off, seq in producer.acked:
+        by_partition.setdefault(pid, {})[off] = seq
+    stats = {"acked": len(producer.acked), "attempts": producer.attempts}
+    try:
+        for pid in range(partitions):
+            got = await client.fetch(
+                topic, pid, 0, max_bytes=1 << 24, max_wait_ms=100
+            )
+            offsets = [o for o, _k, _v in got]
+            # I3: order + uniqueness
+            assert offsets == sorted(set(offsets)), (
+                f"p{pid}: unordered or duplicated offsets"
+            )
+            seen = {o: (k, v) for o, k, v in got}
+            hw_top = max(offsets) if offsets else -1
+            for off, seq in by_partition.get(pid, {}).items():
+                # I2: no acked offset above the final high watermark
+                assert off <= hw_top, (
+                    f"p{pid}: acked offset {off} (seq {seq}) beyond "
+                    f"final watermark {hw_top} — committed data lost"
+                )
+                # I1: the acked record is THE record at that offset
+                entry = seen.get(off)
+                assert entry is not None, (
+                    f"p{pid}@{off}: acked seq {seq} missing from fetch "
+                    f"below watermark {hw_top} — committed data lost"
+                )
+                k, v = entry
+                assert k == b"seq-%d" % seq and v == b"payload-%d" % seq, (
+                    f"p{pid}@{off}: expected seq {seq}, found {k!r}"
+                )
+    finally:
+        await client.close()
+    return stats
+
+
+async def run_chaos(
+    tmp_path,
+    seed: int,
+    duration_s: float = 6.0,
+    partitions: int = 2,
+    faults=("partition", "crash", "transfer"),
+) -> dict:
+    rng = random.Random(seed)
+    cluster = ChaosCluster(tmp_path, n=3)
+    await cluster.start()
+    try:
+        boot = KafkaClient(cluster.addresses())
+        await boot.create_topic(
+            "chaos", partitions=partitions, replication_factor=3
+        )
+        await boot.close()
+        producer = SeqProducer(cluster, "chaos", partitions)
+        ptask = asyncio.ensure_future(producer.run())
+
+        deadline = asyncio.get_event_loop().time() + duration_s
+        down: int | None = None
+        events = []
+        while asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(rng.uniform(0.4, 0.9))
+            action = rng.choice(faults)
+            if down is not None:
+                # one fault at a time: heal/restart before the next
+                # (a 3-node RF3 cluster tolerates exactly one failure)
+                if events and events[-1][0] == "crash":
+                    await cluster.restart(down)
+                else:
+                    cluster.heal_network()
+                events.append(("recover", down))
+                down = None
+                continue
+            victim = rng.randrange(cluster.n)
+            if action == "partition":
+                cluster.partition_network(victim)
+                events.append(("partition", victim))
+                down = victim
+            elif action == "crash":
+                await cluster.crash(victim)
+                events.append(("crash", victim))
+                down = victim
+            elif action == "transfer":
+                for b in cluster.brokers.values():
+                    for p in b.partition_manager.partitions().values():
+                        if p.is_leader and p.ntp.topic == "chaos":
+                            peers = p.consensus.peers()
+                            if peers:
+                                with contextlib.suppress(Exception):
+                                    await p.consensus.transfer_leadership(
+                                        rng.choice(peers)
+                                    )
+                            break
+                events.append(("transfer", -1))
+
+        # heal everything, let the cluster settle, then validate
+        if down is not None:
+            if events and events[-1][0] == "crash":
+                await cluster.restart(down)
+            else:
+                cluster.heal_network()
+        cluster.heal_network()
+        await asyncio.sleep(1.0)
+        producer.stop()
+        with contextlib.suppress(Exception):
+            await asyncio.wait_for(ptask, timeout=5.0)
+        await asyncio.sleep(0.5)
+        stats = await validate(cluster, "chaos", partitions, producer)
+        stats["events"] = events
+        return stats
+    finally:
+        await cluster.stop()
