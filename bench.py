@@ -274,11 +274,131 @@ def bench_codec() -> dict:
     }
 
 
+# ---------------------------------------------------------------- broker
+async def _broker_async() -> dict:
+    """OMB-lite system bench (BASELINE.md release-smoke shape, scaled
+    to one in-process broker): 1 KB records in 128-record batches,
+    concurrent pipelined producers with acks=all onto a real TCP kafka
+    listener, then a full consumer sweep. Measures the WHOLE stack:
+    wire protocol, CRC verify, idempotence checks, replicate batcher,
+    segment append+fsync, fetch read path."""
+    from redpanda_tpu.app import Broker, BrokerConfig
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = tempfile.mkdtemp(prefix="rp_bench_", dir=shm)
+    n_partitions = 4
+    n_producers = 8
+    batch_records = 128
+    record_bytes = 1024
+    duration_s = 4.0
+
+    b = Broker(
+        BrokerConfig(
+            node_id=0,
+            data_dir=tmp,
+            members=[0],
+            enable_admin=False,
+            node_status_interval_s=0,
+            housekeeping_interval_s=0,
+        ),
+        loopback=LoopbackNetwork(),
+    )
+    await b.start()
+    b.config.peer_kafka_addresses = {0: b.kafka_advertised}
+    boot = None
+    try:
+        await b.wait_controller_leader()
+        boot = KafkaClient([b.kafka_advertised])
+        await boot.create_topic(
+            "bench", partitions=n_partitions, replication_factor=1
+        )
+        payload = os.urandom(record_bytes - 16)
+        records = [(b"k%012d" % i, payload) for i in range(batch_records)]
+        # encode ONCE: the bench measures the broker, and real producers
+        # encode on separate client machines anyway
+        from redpanda_tpu.models.record import RecordBatchBuilder
+
+        builder = RecordBatchBuilder()
+        for k, v in records:
+            builder.add(v, key=k)
+        wire = builder.build().to_kafka_wire()
+        lat_ms: list[float] = []
+        sent_bytes = 0
+
+        async def producer(idx: int) -> None:
+            nonlocal sent_bytes
+            client = KafkaClient([b.kafka_advertised])
+            pid = idx % n_partitions
+            try:
+                while time.perf_counter() < t_end:
+                    t0 = time.perf_counter()
+                    await client.produce_wire("bench", pid, wire, acks=-1)
+                    lat_ms.append((time.perf_counter() - t0) * 1e3)
+                    sent_bytes += batch_records * record_bytes
+            finally:
+                await client.close()
+
+        # warmup (connection setup + first segment)
+        await boot.produce("bench", 0, records[:8], acks=-1)
+        t_start = time.perf_counter()
+        t_end = t_start + duration_s
+        await asyncio.gather(*(producer(i) for i in range(n_producers)))
+        produce_s = time.perf_counter() - t_start
+        produce_mbps = sent_bytes / produce_s / 1e6
+
+        # consumer sweep: read everything back through the fetch path
+        # (raw wire — per-record decode is client-machine work)
+        read_bytes = 0
+        t0 = time.perf_counter()
+        for pid in range(n_partitions):
+            pos = 0
+            while True:
+                chunk, nxt = await boot.fetch_raw(
+                    "bench", pid, pos, max_bytes=4 << 20
+                )
+                if nxt == pos:
+                    break
+                read_bytes += len(chunk)
+                pos = nxt
+        consume_s = time.perf_counter() - t0
+        consume_mbps = read_bytes / consume_s / 1e6
+        return {
+            "metric": "broker_produce_mbps",
+            "value": round(produce_mbps, 1),
+            "unit": "MB/s",
+            # release-smoke floor is 600 MB/s on a 3-node EC2 cluster;
+            # single in-process broker measured against the same bar
+            "vs_baseline": round(produce_mbps / 600.0, 3),
+            "produce_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+            "produce_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+            "consume_mbps": round(consume_mbps, 1),
+            "batches": len(lat_ms),
+        }
+    finally:
+        if boot is not None:
+            try:
+                await boot.close()
+            except Exception:
+                pass
+        try:
+            await b.stop()
+        except Exception:
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_broker() -> dict:
+    return asyncio.run(_broker_async())
+
+
 BENCHES = {
     "quorum": bench_quorum,
     "live_tick": bench_live_tick,
     "crc": bench_crc,
     "codec": bench_codec,
+    "broker": bench_broker,
 }
 
 
@@ -303,7 +423,7 @@ def main() -> None:
         import subprocess
 
         extra = {}
-        for name in ("crc", "codec", "live_tick"):
+        for name in ("crc", "codec", "live_tick", "broker"):
             try:
                 proc = subprocess.run(
                     [sys.executable, __file__, "--only", name],

@@ -454,6 +454,22 @@ class KafkaClient:
         for key, value in records:
             builder.add(value, key=key)
         wire = builder.build().to_kafka_wire()
+        return await self.produce_wire(
+            topic, partition, wire, acks=acks, timeout_ms=timeout_ms
+        )
+
+    async def produce_wire(
+        self,
+        topic: str,
+        partition: int,
+        wire: bytes,
+        acks: int = -1,
+        timeout_ms: int = 10000,
+    ) -> int:
+        """Produce a pre-built kafka-wire record batch. Real producers
+        encode once on the client machine; benchmarks measuring broker
+        throughput reuse one encoded batch so client-side record
+        encoding doesn't pollute the server number."""
         # leadership can be mid-flight (fresh topic, election, replica
         # move): retry with metadata refresh like real clients do
         for attempt in range(8):
@@ -498,6 +514,44 @@ class KafkaClient:
         )
 
     # -- fetch -------------------------------------------------------
+    @staticmethod
+    def _fetch_request(
+        topic: str,
+        partition: int,
+        offset: int,
+        max_bytes: int,
+        max_wait_ms: int,
+        min_bytes: int,
+        read_committed: bool,
+    ) -> Msg:
+        """One sessionless single-partition FETCH request (shared by
+        fetch/fetch_raw so the wire shape can't diverge)."""
+        return Msg(
+            replica_id=-1,
+            max_wait_ms=max_wait_ms,
+            min_bytes=min_bytes,
+            max_bytes=max_bytes,
+            isolation_level=1 if read_committed else 0,
+            session_id=0,
+            session_epoch=-1,
+            topics=[
+                Msg(
+                    topic=topic,
+                    partitions=[
+                        Msg(
+                            partition=partition,
+                            current_leader_epoch=-1,
+                            fetch_offset=offset,
+                            log_start_offset=0,
+                            partition_max_bytes=max_bytes,
+                        )
+                    ],
+                )
+            ],
+            forgotten_topics_data=[],
+            rack_id="",
+        )
+
     async def fetch(
         self,
         topic: str,
@@ -514,30 +568,9 @@ class KafkaClient:
                 await asyncio.sleep(0.1)
             conn = await self.leader_conn(topic, partition, refresh=attempt > 0)
             v = conn.pick_version(FETCH, 11)
-            req = Msg(
-                replica_id=-1,
-                max_wait_ms=max_wait_ms,
-                min_bytes=min_bytes,
-                max_bytes=max_bytes,
-                isolation_level=1 if read_committed else 0,
-                session_id=0,
-                session_epoch=-1,
-                topics=[
-                    Msg(
-                        topic=topic,
-                        partitions=[
-                            Msg(
-                                partition=partition,
-                                current_leader_epoch=-1,
-                                fetch_offset=offset,
-                                log_start_offset=0,
-                                partition_max_bytes=max_bytes,
-                            )
-                        ],
-                    )
-                ],
-                forgotten_topics_data=[],
-                rack_id="",
+            req = self._fetch_request(
+                topic, partition, offset, max_bytes, max_wait_ms,
+                min_bytes, read_committed,
             )
             resp = await conn.request(FETCH, req, v)
             pr = resp.responses[0].partitions[0]
@@ -559,6 +592,52 @@ class KafkaClient:
         raise KafkaClientError(
             int(ErrorCode.not_leader_for_partition), f"fetch {topic}/{partition}"
         )
+
+    async def fetch_raw(
+        self,
+        topic: str,
+        partition: int,
+        offset: int,
+        max_bytes: int = 1 << 20,
+        max_wait_ms: int = 0,
+    ) -> tuple[bytes, int]:
+        """One fetch round returning (raw records wire, next_offset)
+        without per-record decode — broker-throughput measurement and
+        mirroring consumers that hand wire bytes onward."""
+        pr = None
+        for attempt in range(8):
+            if attempt:
+                await asyncio.sleep(0.1)
+            conn = await self.leader_conn(topic, partition, refresh=attempt > 0)
+            v = conn.pick_version(FETCH, 11)
+            req = self._fetch_request(
+                topic, partition, offset, max_bytes, max_wait_ms, 0, False
+            )
+            resp = await conn.request(FETCH, req, v)
+            pr = resp.responses[0].partitions[0]
+            if pr.error_code == int(ErrorCode.not_leader_for_partition):
+                continue
+            break
+        if pr is None or pr.error_code != 0:
+            raise KafkaClientError(
+                pr.error_code if pr is not None else -1,
+                f"fetch {topic}/{partition}",
+            )
+        wire = bytes(pr.records or b"")
+        # next position: walk only the fixed batch headers (cheap)
+        next_off = offset
+        pos = 0
+        while pos + 12 <= len(wire):
+            base = int.from_bytes(wire[pos : pos + 8], "big", signed=True)
+            blen = int.from_bytes(wire[pos + 8 : pos + 12], "big", signed=True)
+            if pos + 12 + blen > len(wire) or blen <= 0:
+                break
+            # kafka batch layout: base(8) len(4) epoch(4) magic(1)
+            # crc(4) attrs(2) last_offset_delta(4) → delta at +23
+            lod = int.from_bytes(wire[pos + 23 : pos + 27], "big", signed=True)
+            next_off = max(next_off, base + lod + 1)
+            pos += 12 + blen
+        return wire, next_off
 
     async def list_offset(
         self, topic: str, partition: int, timestamp: int
