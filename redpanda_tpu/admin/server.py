@@ -1,61 +1,30 @@
 """Admin HTTP server.
 
 Reference: src/v/redpanda/admin_server.cc (71 routes over seastar
-httpd). This is a dependency-free asyncio HTTP/1.1 server exposing the
-operational surface the implemented subsystems have: cluster health,
-brokers, topics/partitions, leadership transfer, membership
-(decommission/recommission), SCRAM users, replicated cluster config,
-fault injection (hbadger), and the Prometheus /metrics endpoint.
+httpd). Sits on the shared asyncio HTTP base (redpanda_tpu.httpd),
+exposing the operational surface the implemented subsystems have:
+cluster health, brokers, topics/partitions, leadership transfer,
+membership (decommission/recommission), SCRAM users, replicated
+cluster config, fault injection (hbadger), and Prometheus /metrics.
 """
 
 from __future__ import annotations
 
-import asyncio
-import json
 import logging
-import re
-from typing import TYPE_CHECKING, Callable, Optional
-from urllib.parse import parse_qs, urlparse
+from typing import TYPE_CHECKING
+
+from ..httpd import HttpError, HttpServer
 
 if TYPE_CHECKING:  # pragma: no cover
     from ..app import Broker
 
 logger = logging.getLogger("admin")
 
-_MAX_BODY = 4 << 20
 
-
-class HttpError(Exception):
-    def __init__(self, status: int, message: str):
-        super().__init__(message)
-        self.status = status
-        self.message = message
-
-
-_REASONS = {
-    200: "OK",
-    204: "No Content",
-    400: "Bad Request",
-    404: "Not Found",
-    405: "Method Not Allowed",
-    409: "Conflict",
-    500: "Internal Server Error",
-    503: "Service Unavailable",
-}
-
-
-class AdminServer:
+class AdminServer(HttpServer):
     def __init__(self, broker: "Broker", host: str = "127.0.0.1", port: int = 0):
         self.broker = broker
-        self.host = host
-        self.port = port
-        self._server: Optional[asyncio.AbstractServer] = None
-        # (method, compiled-pattern) -> handler(match, query, body)
-        self._routes: list[tuple[str, re.Pattern, Callable]] = []
-        self._install_routes()
-
-    def route(self, method: str, pattern: str, handler: Callable) -> None:
-        self._routes.append((method, re.compile(f"^{pattern}$"), handler))
+        super().__init__(host, port)
 
     async def start(self) -> None:
         if self.host not in ("127.0.0.1", "localhost", "::1"):
@@ -69,127 +38,9 @@ class AdminServer:
                 "decommission nodes",
                 self.host,
             )
-        self._server = await asyncio.start_server(
-            self._on_conn, self.host, self.port
-        )
-        self.port = self._server.sockets[0].getsockname()[1]
+        await super().start()
 
-    async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
-
-    @property
-    def address(self) -> tuple[str, int]:
-        return (self.host, self.port)
-
-    # -- http plumbing -------------------------------------------------
-    async def _on_conn(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
-    ) -> None:
-        try:
-            while True:
-                line = await reader.readline()
-                if not line:
-                    return
-                try:
-                    method, target, _version = line.decode().split(" ", 2)
-                except ValueError:
-                    return
-                headers: dict[str, str] = {}
-                while True:
-                    h = await reader.readline()
-                    if h in (b"\r\n", b"\n", b""):
-                        break
-                    k, _, v = h.decode().partition(":")
-                    headers[k.strip().lower()] = v.strip()
-                try:
-                    length = int(headers.get("content-length", "0") or 0)
-                except ValueError:
-                    length = -1
-                if length < 0 or length > _MAX_BODY:
-                    bad = b'{"message": "invalid content-length"}'
-                    writer.write(
-                        b"HTTP/1.1 400 Bad Request\r\n"
-                        b"Content-Type: application/json\r\n"
-                        b"Content-Length: %d\r\n"
-                        b"Connection: close\r\n\r\n%s" % (len(bad), bad)
-                    )
-                    await writer.drain()
-                    return
-                body = await reader.readexactly(length) if length else b""
-                status, ctype, payload = await self._dispatch(
-                    method.upper(), target, body
-                )
-                reason = _REASONS.get(status, "Unknown")
-                head = (
-                    f"HTTP/1.1 {status} {reason}\r\n"
-                    f"Content-Type: {ctype}\r\n"
-                    f"Content-Length: {len(payload)}\r\n"
-                    "Connection: keep-alive\r\n\r\n"
-                )
-                writer.write(head.encode() + payload)
-                await writer.drain()
-        except (
-            asyncio.IncompleteReadError,
-            ConnectionError,
-            asyncio.CancelledError,
-        ):
-            pass
-        finally:
-            writer.close()
-
-    async def _dispatch(
-        self, method: str, target: str, body: bytes
-    ) -> tuple[int, str, bytes]:
-        url = urlparse(target)
-        path = url.path
-        query = {k: v[-1] for k, v in parse_qs(url.query).items()}
-        path_seen = False
-        for m, pattern, handler in self._routes:
-            match = pattern.match(path)
-            if match is None:
-                continue
-            path_seen = True
-            if m != method:
-                continue
-            try:
-                result = await handler(match, query, body)
-            except HttpError as e:
-                return (
-                    e.status,
-                    "application/json",
-                    json.dumps({"message": e.message, "code": e.status}).encode(),
-                )
-            except Exception as e:
-                logger.exception("admin: %s %s failed", method, path)
-                return (
-                    500,
-                    "application/json",
-                    json.dumps({"message": str(e), "code": 500}).encode(),
-                )
-            if result is None:
-                return 204, "application/json", b""
-            if isinstance(result, (bytes, str)):
-                data = result.encode() if isinstance(result, str) else result
-                return 200, "text/plain; version=0.0.4", data
-            return 200, "application/json", json.dumps(result).encode()
-        if path_seen:
-            return 405, "application/json", b'{"message": "method not allowed"}'
-        return 404, "application/json", b'{"message": "not found"}'
-
-    @staticmethod
-    def _json_body(body: bytes) -> dict:
-        if not body:
-            return {}
-        try:
-            out = json.loads(body)
-        except json.JSONDecodeError as e:
-            raise HttpError(400, f"invalid json: {e}") from None
-        if not isinstance(out, dict):
-            raise HttpError(400, "body must be a json object")
-        return out
+    _json_body = staticmethod(HttpServer.json_body)
 
     # -- routes --------------------------------------------------------
     def _install_routes(self) -> None:

@@ -84,6 +84,11 @@ class BrokerConfig:
     admin_host: str = "127.0.0.1"
     admin_port: int = 0
     enable_admin: bool = True
+    # HTTP ecosystem services (src/v/pandaproxy): opt-in per broker
+    enable_pandaproxy: bool = False
+    pandaproxy_port: int = 0
+    enable_schema_registry: bool = False
+    schema_registry_port: int = 0
 
 
 class Broker:
@@ -175,6 +180,8 @@ class Broker:
             self.remote_reader = RemoteReader(RetryingStore(self.object_store))
             self.controller.on_partition_added = self._maybe_recover_partition
         self._bind_cluster_config()
+        self.pandaproxy = None
+        self.schema_registry = None
         self._started = False
 
     def _bind_cluster_config(self) -> None:
@@ -348,6 +355,22 @@ class Broker:
             await self.archival.start()
         if self.admin is not None:
             await self.admin.start()
+        self.pandaproxy = None
+        self.schema_registry = None
+        if self.config.enable_pandaproxy:
+            from .proxy import PandaproxyServer
+
+            self.pandaproxy = PandaproxyServer(
+                self, port=self.config.pandaproxy_port
+            )
+            await self.pandaproxy.start()
+        if self.config.enable_schema_registry:
+            from .proxy import SchemaRegistryServer
+
+            self.schema_registry = SchemaRegistryServer(
+                self, port=self.config.schema_registry_port
+            )
+            await self.schema_registry.start()
         self._join_task = None
         if self.config.auto_join:
             self._join_task = asyncio.ensure_future(self._register_self())
@@ -399,6 +422,12 @@ class Broker:
                 pass
             self._join_task = None
         await self.node_status.stop()
+        if self.pandaproxy is not None:
+            await self.pandaproxy.stop()
+            self.pandaproxy = None
+        if self.schema_registry is not None:
+            await self.schema_registry.stop()
+            self.schema_registry = None
         if self.admin is not None:
             await self.admin.stop()
         if self.archival is not None:

@@ -188,13 +188,18 @@ def test_install_snapshot_discards_divergent_follower_suffix(tmp_path):
         assert snap >= 0
 
         cluster.net.heal(old_id)
-        deadline = asyncio.get_event_loop().time() + 10.0
+        # generous deadline: under full-suite CPU load the heal →
+        # step-down → install_snapshot → catch-up chain can take a while
+        deadline = asyncio.get_event_loop().time() + 25.0
         while asyncio.get_event_loop().time() < deadline:
             if old_leader.commit_index >= new_leader.commit_index and \
                old_leader.role == Role.FOLLOWER:
                 break
             await asyncio.sleep(0.05)
-        assert old_leader.commit_index >= snap
+        assert old_leader.commit_index >= snap, (
+            f"stale leader never converged: commit {old_leader.commit_index} "
+            f"< snapshot {snap} (role {old_leader.role})"
+        )
         # divergent suffix gone: its log agrees with the new leader's
         for off in range(
             old_leader.log.offsets().start_offset,
