@@ -54,6 +54,12 @@ class Partition:
         # pid → (epoch, last dispatched seq): the sequencing horizon
         # ahead of the table while appends sit in the batcher
         self._inflight_seq: dict[int, tuple[int, int]] = {}
+        # DeleteRecords floors: (marker raft offset, kafka floor).
+        # A floor takes effect only once ITS OWN marker commits —
+        # honoring an uncommitted marker that later gets truncated
+        # would prefix-truncate one replica while the cluster never
+        # agreed to delete. Set BEFORE replay.
+        self._dr_markers: list[tuple[int, int]] = []
         self._rebuild_state()
         self.log.on_append.append(self._on_append)
         self.log.on_truncate.append(self._on_truncate)
@@ -91,6 +97,22 @@ class Partition:
     def _observe(self, batch: RecordBatch) -> None:
         h = batch.header
         self.translator.track(h.type, h.base_offset, h.last_offset)
+        if h.type == RecordBatchType.checkpoint:
+            # replicated DeleteRecords marker: every replica moves its
+            # log start identically once the marker commits (the
+            # reference's prefix_truncate batch; kafka DeleteRecords)
+            try:
+                rec = batch.records()[0]
+                if rec.key == b"delete_records" and rec.value:
+                    self._dr_markers.append(
+                        (
+                            h.base_offset,
+                            int.from_bytes(rec.value, "little", signed=True),
+                        )
+                    )
+            except Exception:
+                pass
+            return
         if h.type != RecordBatchType.raft_data or h.producer_id < 0:
             return
         kbase = self.translator.to_kafka(h.base_offset)
@@ -128,6 +150,11 @@ class Partition:
 
     def _on_truncate(self, offset: int) -> None:
         self.translator.truncate(offset)
+        # a truncated (never-committed) DeleteRecords marker must not
+        # leave its floor behind
+        self._dr_markers = [
+            (moff, floor) for moff, floor in self._dr_markers if moff < offset
+        ]
         # sequence/tx state may reference truncated batches: rebuild
         # from the surviving log (rare path — divergent-leader healing)
         self.producers.truncate()
@@ -160,6 +187,46 @@ class Partition:
         self._replay_from(last_included + 1)
         self.translator.checkpoint()
 
+    # -- delete records ------------------------------------------------
+    async def delete_records(self, kafka_offset: int, timeout: float = 10.0) -> int:
+        """Kafka DeleteRecords: move the log start to kafka_offset
+        (-1 = high watermark). Replicates a marker so every replica —
+        and any future replay — applies the same floor, then truncates
+        locally. Returns the new low watermark (kafka space)."""
+        hw = self.high_watermark()
+        target = hw if kafka_offset == -1 else kafka_offset
+        if target < 0 or target > hw:
+            raise ValueError(f"offset {kafka_offset} outside [0, {hw}]")
+        if target <= self.start_offset():
+            return self.start_offset()
+        b = RecordBatchBuilder(batch_type=RecordBatchType.checkpoint)
+        b.add(
+            value=int(target).to_bytes(8, "little", signed=True),
+            key=b"delete_records",
+        )
+        await self.replicate(b.build(), acks=-1, timeout=timeout)
+        self.apply_delete_records()
+        return self.start_offset()
+
+    def apply_delete_records(self) -> None:
+        """Apply floors whose MARKER has committed (leader on the
+        request path; followers via housekeeping/replay)."""
+        commit = self.consensus.commit_index
+        floor = -1
+        pending = []
+        for moff, f in self._dr_markers:
+            if moff <= commit:
+                floor = max(floor, f)
+            else:
+                pending.append((moff, f))
+        self._dr_markers = pending
+        if floor < 0 or floor <= self.start_offset():
+            return
+        raft_target = self.translator.from_kafka(floor)
+        bound = min(raft_target - 1, commit)
+        if bound >= 0:
+            self.consensus.write_snapshot(bound)
+
     # -- housekeeping -------------------------------------------------
     def housekeeping(self, now_ms: int | None = None) -> None:
         """Retention + compaction for a raft-replicated log
@@ -171,10 +238,14 @@ class Partition:
         but uncommitted suffixes may still be truncated by a new leader
         and must stay byte-identical.
 
+        Also applies any replicated DeleteRecords floor (followers pick
+        it up here; the leader applies on the request path).
+
         Retention takes a snapshot covering the reclaimable prefix
         first, then drops only segments the snapshot covers — a stopped
         follower recovers via install_snapshot instead of being
         stranded."""
+        self.apply_delete_records()
         if self.log.config.compaction_enabled:
             boundary = min(
                 self.consensus.commit_index, self.log.offsets().committed_offset
@@ -329,12 +400,18 @@ class Partition:
         return self.tx.aborted_in(start, end)
 
     def start_offset(self) -> int:
-        """First kafka offset = count of data offsets below the raft
-        log start (config batches at the head don't shift it past 0)."""
+        """First VISIBLE kafka offset. The raft snapshot boundary is
+        the logical log start — physical segment layout may lag behind
+        it (a single open segment can't be prefix-dropped, and
+        DeleteRecords moves the boundary without waiting for physical
+        reclaim, exactly like Kafka's logStartOffset)."""
         offs = self.log.offsets()
-        if offs.dirty_offset < 0:
+        if offs.dirty_offset < 0 and self.consensus.snapshot_index < 0:
             return 0
-        return self.translator.to_kafka(max(offs.start_offset, 0) - 1) + 1
+        raft_start = max(
+            offs.start_offset, self.consensus.snapshot_index + 1, 0
+        )
+        return self.translator.to_kafka(raft_start - 1) + 1
 
     # -- write -------------------------------------------------------
     async def replicate_in_stages(self, batch: RecordBatch, acks: int = -1):

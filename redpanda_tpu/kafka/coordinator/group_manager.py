@@ -470,6 +470,53 @@ class GroupCoordinator:
             g.offsets[(topic, part)] = (off, md, now)
         return 0
 
+    async def delete_offsets(
+        self, g: Group, items: list[tuple[str, int]]
+    ) -> dict[tuple[str, int], int]:
+        """OffsetDelete: tombstone committed offsets (group_manager.cc
+        offset deletion — the same keyed records with null values, so
+        compaction reclaims them). Per-partition error codes returned."""
+        p = self._local_partition(g.group_id)
+        out: dict[tuple[str, int], int] = {}
+        if p is None:
+            return {tp: int(ErrorCode.not_coordinator) for tp in items}
+        if g.members:
+            # a live group's committed positions must not vanish under
+            # it (offset_delete.cc GROUP_SUBSCRIBED_TO_TOPIC). Client
+            # subscription metadata is opaque to the broker, so a
+            # non-empty group conservatively protects every topic.
+            return {
+                tp: int(ErrorCode.group_subscribed_to_topic) for tp in items
+            }
+        to_delete = []
+        for tp in items:
+            if tp in g.offsets:
+                to_delete.append(tp)
+                out[tp] = 0
+            else:
+                out[tp] = 0  # deleting a non-existent offset is a no-op
+        if to_delete:
+            b = RecordBatchBuilder()
+            for topic, part in to_delete:
+                b.add(
+                    value=None,
+                    key=_Key(
+                        kind=_KIND_OFFSET,
+                        group=g.group_id,
+                        topic=topic,
+                        partition=part,
+                    ).encode(),
+                )
+            try:
+                await p.replicate(b.build(), acks=-1)
+            except NotLeaderError:
+                return {tp: int(ErrorCode.not_coordinator) for tp in items}
+            except ReplicateTimeout:
+                return {tp: int(ErrorCode.request_timed_out) for tp in items}
+            for tp in to_delete:
+                g.offsets.pop(tp, None)
+        return out
+
     async def txn_commit_offsets(
         self,
         g: Group,

@@ -327,6 +327,101 @@ INCREMENTAL_ALTER_CONFIGS = register(
     )
 )
 
+DELETE_RECORDS = register(
+    Api(
+        key=21,
+        name="delete_records",
+        versions=(0, 1),
+        flex_since=None,  # flex at v2
+        request=[
+            F(
+                "topics",
+                Array(
+                    [
+                        F("name", "string"),
+                        F(
+                            "partitions",
+                            Array(
+                                [
+                                    F("partition_index", "int32"),
+                                    F("offset", "int64"),
+                                ]
+                            ),
+                        ),
+                    ]
+                ),
+            ),
+            F("timeout_ms", "int32"),
+        ],
+        response=[
+            F("throttle_time_ms", "int32"),
+            F(
+                "topics",
+                Array(
+                    [
+                        F("name", "string"),
+                        F(
+                            "partitions",
+                            Array(
+                                [
+                                    F("partition_index", "int32"),
+                                    F("low_watermark", "int64"),
+                                    F("error_code", "int16"),
+                                ]
+                            ),
+                        ),
+                    ]
+                ),
+            ),
+        ],
+    )
+)
+
+OFFSET_DELETE = register(
+    Api(
+        key=47,
+        name="offset_delete",
+        versions=(0, 0),
+        flex_since=None,
+        request=[
+            F("group_id", "string"),
+            F(
+                "topics",
+                Array(
+                    [
+                        F("name", "string"),
+                        F(
+                            "partitions",
+                            Array([F("partition_index", "int32")]),
+                        ),
+                    ]
+                ),
+            ),
+        ],
+        response=[
+            F("error_code", "int16"),
+            F("throttle_time_ms", "int32"),
+            F(
+                "topics",
+                Array(
+                    [
+                        F("name", "string"),
+                        F(
+                            "partitions",
+                            Array(
+                                [
+                                    F("partition_index", "int32"),
+                                    F("error_code", "int16"),
+                                ]
+                            ),
+                        ),
+                    ]
+                ),
+            ),
+        ],
+    )
+)
+
 OFFSET_FOR_LEADER_EPOCH = register(
     Api(
         key=23,

@@ -84,6 +84,7 @@ class ErrorCode(enum.IntEnum):
     member_id_required = 79
     preferred_leader_not_available = 80
     group_max_size_reached = 81
+    group_subscribed_to_topic = 86
     unstable_offset_commit = 88
     sasl_authentication_failed = 58
     producer_fenced = 90

@@ -24,9 +24,11 @@ from .protocol.admin_apis import (
     CREATE_ACLS,
     CREATE_PARTITIONS,
     DELETE_ACLS,
+    DELETE_RECORDS,
     DESCRIBE_ACLS,
     DESCRIBE_CONFIGS,
     INCREMENTAL_ALTER_CONFIGS,
+    OFFSET_DELETE,
     OFFSET_FOR_LEADER_EPOCH,
 )
 
@@ -67,6 +69,8 @@ def install(server: "KafkaServer") -> None:
             INCREMENTAL_ALTER_CONFIGS.key: h.incremental_alter_configs,
             OFFSET_FOR_LEADER_EPOCH.key: h.offset_for_leader_epoch,
             CREATE_PARTITIONS.key: h.create_partitions,
+            DELETE_RECORDS.key: h.delete_records,
+            OFFSET_DELETE.key: h.offset_delete,
         }
     )
 
@@ -551,3 +555,142 @@ class AdminHandlers:
                     code = int(ErrorCode.request_timed_out)
             out.append(Msg(name=t.name, error_code=code, error_message=message))
         return Msg(throttle_time_ms=0, results=out)
+
+    async def delete_records(self, hdr, req) -> Msg:
+        """Kafka DeleteRecords (handlers/delete_records.cc): advance a
+        partition's log start; a replicated marker carries the floor to
+        every replica."""
+        topics = []
+        for t in req.topics:
+            parts = []
+            authorized = self.server.authorize(
+                AclOperation.remove, AclResourceType.topic, t.name
+            )
+            for p in t.partitions:
+                if not authorized:
+                    parts.append(
+                        Msg(
+                            partition_index=p.partition_index,
+                            low_watermark=-1,
+                            error_code=int(
+                                ErrorCode.topic_authorization_failed
+                            ),
+                        )
+                    )
+                    continue
+                partition = self.server.broker.partition_manager.get(
+                    kafka_ntp(t.name, p.partition_index)
+                )
+                if partition is None:
+                    parts.append(
+                        Msg(
+                            partition_index=p.partition_index,
+                            low_watermark=-1,
+                            error_code=int(
+                                ErrorCode.unknown_topic_or_partition
+                            ),
+                        )
+                    )
+                    continue
+                if (
+                    partition.log.config.compaction_enabled
+                    or t.name.startswith("__")
+                ):
+                    # compacted/internal topics protect key history and
+                    # coordinator state (delete_records.cc POLICY_VIOLATION)
+                    parts.append(
+                        Msg(
+                            partition_index=p.partition_index,
+                            low_watermark=-1,
+                            error_code=int(ErrorCode.policy_violation),
+                        )
+                    )
+                    continue
+                if not partition.is_leader:
+                    parts.append(
+                        Msg(
+                            partition_index=p.partition_index,
+                            low_watermark=-1,
+                            error_code=int(
+                                ErrorCode.not_leader_for_partition
+                            ),
+                        )
+                    )
+                    continue
+                try:
+                    low = await partition.delete_records(
+                        int(p.offset),
+                        timeout=max(req.timeout_ms / 1000.0, 1.0),
+                    )
+                    parts.append(
+                        Msg(
+                            partition_index=p.partition_index,
+                            low_watermark=low,
+                            error_code=0,
+                        )
+                    )
+                except ValueError:
+                    parts.append(
+                        Msg(
+                            partition_index=p.partition_index,
+                            low_watermark=-1,
+                            error_code=int(ErrorCode.offset_out_of_range),
+                        )
+                    )
+                except Exception as e:
+                    from ..raft.consensus import NotLeaderError
+
+                    code = (
+                        ErrorCode.not_leader_for_partition
+                        if isinstance(e, NotLeaderError)
+                        else ErrorCode.request_timed_out
+                    )
+                    parts.append(
+                        Msg(
+                            partition_index=p.partition_index,
+                            low_watermark=-1,
+                            error_code=int(code),
+                        )
+                    )
+            topics.append(Msg(name=t.name, partitions=parts))
+        return Msg(throttle_time_ms=0, topics=topics)
+
+    async def offset_delete(self, hdr, req) -> Msg:
+        """OffsetDelete (handlers/offset_delete.cc): drop committed
+        group offsets for specific partitions."""
+        from ..security.acl import AclOperation as Op
+
+        def all_err(code: int) -> Msg:
+            return Msg(
+                error_code=code,
+                throttle_time_ms=0,
+                topics=[],
+            )
+
+        if not self.server.authorize(
+            Op.remove, AclResourceType.group, req.group_id
+        ):
+            return all_err(int(ErrorCode.group_authorization_failed))
+        coordinator = self.server.broker.group_coordinator
+        g, code = await coordinator.get_group(req.group_id)
+        if code:
+            return all_err(code)
+        items = [
+            (t.name, p.partition_index)
+            for t in req.topics
+            for p in t.partitions
+        ]
+        per_part = await coordinator.delete_offsets(g, items)
+        by_topic: dict[str, list[Msg]] = {}
+        for (topic, pid), ecode in per_part.items():
+            by_topic.setdefault(topic, []).append(
+                Msg(partition_index=pid, error_code=ecode)
+            )
+        return Msg(
+            error_code=0,
+            throttle_time_ms=0,
+            topics=[
+                Msg(name=topic, partitions=parts)
+                for topic, parts in by_topic.items()
+            ],
+        )
