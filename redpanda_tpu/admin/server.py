@@ -95,6 +95,7 @@ class AdminServer(HttpServer):
                     "is_alive": self.broker.node_status.is_alive(nid),
                     "internal_rpc": list(ep.rpc_addr) if ep else None,
                     "kafka_api": list(ep.kafka_addr) if ep else None,
+                    "rack": (ep.rack or None) if ep else None,
                 }
             )
         return {"brokers": out, "controller_id": ctrl.leader_id}

@@ -54,6 +54,8 @@ class BrokerConfig:
     rpc_host: str = "127.0.0.1"
     rpc_port: int = 0
     advertised_host: Optional[str] = None
+    # rack/failure-domain label for rack-aware replica placement
+    rack: Optional[str] = None
     # node_id → advertised (host, kafka_port) of peers; bootstrap
     # fallback only — the replicated members table takes precedence
     # once nodes register
@@ -391,7 +393,10 @@ class Broker:
         )
         try:
             await self.controller.join_cluster(
-                rpc_addr, self.kafka_advertised, timeout=30.0
+                rpc_addr,
+                self.kafka_advertised,
+                rack=self.config.rack or "",
+                timeout=30.0,
             )
         except Exception:
             logging.getLogger("app").exception(

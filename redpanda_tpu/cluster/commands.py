@@ -145,13 +145,16 @@ class RegisterNodeCmd(serde.Envelope):
     members_manager.cc apply_update of add_node_cmd /
     update_node_cfg_cmd — one idempotent upsert here)."""
 
+    SERDE_VERSION = 2  # v2 appended rack
     SERDE_FIELDS = [
         ("node_id", serde.i32),
         ("rpc_host", serde.string),
         ("rpc_port", serde.i32),
         ("kafka_host", serde.string),
         ("kafka_port", serde.i32),
+        ("rack", serde.string),  # "" = unlabeled
     ]
+    SERDE_DEFAULTS = {"rack": ""}
 
 
 class DecommissionNodeCmd(serde.Envelope):

@@ -163,8 +163,11 @@ class ControllerStm(StateMachine):
                     int(cmd.node_id),
                     (cmd.rpc_host, int(cmd.rpc_port)),
                     (cmd.kafka_host, int(cmd.kafka_port)),
+                    rack=str(cmd.rack or ""),
                 )
-                self.allocator.register_node(int(cmd.node_id))
+                self.allocator.register_node(
+                    int(cmd.node_id), rack=str(cmd.rack or "")
+                )
             elif cmd_type == CmdType.decommission_node:
                 self._c.members_table.apply_state(
                     int(cmd.node_id), MembershipState.draining
@@ -576,6 +579,7 @@ class Controller:
         self,
         rpc_addr: tuple[str, int],
         kafka_addr: tuple[str, int],
+        rack: str = "",
         timeout: float = 15.0,
     ) -> None:
         """Joiner side (cluster_discovery.cc): announce this node's
@@ -588,6 +592,7 @@ class Controller:
             rpc_port=int(rpc_addr[1]),
             kafka_host=kafka_addr[0],
             kafka_port=int(kafka_addr[1]),
+            rack=rack,
         )
         deadline = asyncio.get_event_loop().time() + timeout
         payload = cmd.encode()

@@ -25,6 +25,7 @@ class BrokerEndpoint:
     rpc_addr: tuple[str, int]
     kafka_addr: tuple[str, int]
     state: MembershipState = MembershipState.active
+    rack: str = ""  # failure-domain label; "" = unlabeled
 
 
 class MembersTable:
@@ -45,11 +46,12 @@ class MembersTable:
         node_id: int,
         rpc_addr: tuple[str, int],
         kafka_addr: tuple[str, int],
+        rack: str = "",
     ) -> None:
         cur = self._nodes.get(node_id)
         state = cur.state if cur is not None else MembershipState.active
         self._nodes[node_id] = BrokerEndpoint(
-            node_id, rpc_addr, kafka_addr, state
+            node_id, rpc_addr, kafka_addr, state, rack
         )
 
     def apply_state(self, node_id: int, state: MembershipState) -> None:

@@ -525,8 +525,14 @@ class KafkaServer:
         for nid in b.controller.members:
             addr = b.kafka_address_of(nid)
             if addr is not None:
+                ep = b.controller.members_table.get(nid)
                 brokers.append(
-                    Msg(node_id=nid, host=addr[0], port=addr[1], rack=None)
+                    Msg(
+                        node_id=nid,
+                        host=addr[0],
+                        port=addr[1],
+                        rack=(ep.rack or None) if ep is not None else None,
+                    )
                 )
         controller_id = b.controller.leader_id
         return Msg(

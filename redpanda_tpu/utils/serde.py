@@ -172,6 +172,9 @@ class Envelope:
     SERDE_VERSION: int = 1
     SERDE_COMPAT_VERSION: int = 1
     SERDE_FIELDS: list[tuple[str, SerdeType]] = []
+    # defaults for trailing fields absent in envelopes written by older
+    # versions (property of appended-field evolution)
+    SERDE_DEFAULTS: dict = {}
 
     def __init__(self, **kwargs: Any):
         names = [n for n, _ in self.SERDE_FIELDS]
@@ -202,7 +205,11 @@ class Envelope:
         obj = cls.__new__(cls)
         for name, t in cls.SERDE_FIELDS:
             if p.pos() >= end:
-                # older peer: fields added after its version are absent
+                # older peer/log entry: fields added after its version
+                # are absent — fill declared defaults, else fail
+                if name in cls.SERDE_DEFAULTS:
+                    setattr(obj, name, cls.SERDE_DEFAULTS[name])
+                    continue
                 raise SerdeError(
                     f"{cls.__name__}: truncated envelope (missing {name})"
                 )

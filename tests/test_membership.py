@@ -223,3 +223,24 @@ async def _decommission_drains_replicas(tmp_path):
 
 def test_decommission_drains_replicas(tmp_path):
     asyncio.run(_decommission_drains_replicas(tmp_path))
+
+
+def test_rack_aware_allocation():
+    """Replicas spread across racks when labels exist; capacity still
+    wins when a rack-diverse placement is impossible."""
+    from redpanda_tpu.cluster.allocator import PartitionAllocator
+
+    a = PartitionAllocator()
+    for nid, rack in ((0, "a"), (1, "a"), (2, "b"), (3, "b"), (4, "c")):
+        a.register_node(nid, rack=rack)
+    out = a.allocate(6, 3, next_group=1)
+    racks = {0: "a", 1: "a", 2: "b", 3: "b", 4: "c"}
+    for assign in out:
+        assert len({racks[r] for r in assign.replicas}) == 3, assign.replicas
+    # RF larger than rack count: still allocates (soft constraint)
+    b = PartitionAllocator()
+    for nid, rack in ((0, "a"), (1, "a"), (2, "b")):
+        b.register_node(nid, rack=rack)
+    out = b.allocate(2, 3, next_group=1)
+    for assign in out:
+        assert sorted(assign.replicas) == [0, 1, 2]
