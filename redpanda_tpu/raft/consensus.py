@@ -94,7 +94,8 @@ class Consensus:
         self._election_timeout = election_timeout_s
 
         self.row = arrays.alloc_row()
-        self.role = Role.FOLLOWER
+        self._role = Role.FOLLOWER
+        arrays.is_follower[self.row] = True
         self._voted_for: Optional[int] = None
         self._slot_map: dict[int, int] = {}
         self._next_index: dict[int, int] = {}
@@ -129,6 +130,17 @@ class Consensus:
         from .replicate_batcher import ReplicateBatcher
 
         self._batcher = ReplicateBatcher(self)
+
+    @property
+    def role(self) -> Role:
+        return self._role
+
+    @role.setter
+    def role(self, v: Role) -> None:
+        """Mirror the follower flag into the SoA so the node-batched
+        heartbeat answer needs no per-group Python role check."""
+        self._role = v
+        self.arrays.is_follower[self.row] = v is Role.FOLLOWER
 
     # ---------------------------------------------------------- setup
     def _vote_key(self) -> bytes:

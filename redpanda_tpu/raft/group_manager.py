@@ -43,6 +43,9 @@ class GroupManager:
         )
         self.service = RaftService(self)
         self._groups: dict[int, Consensus] = {}
+        # bumped on every create/remove: lets the heartbeat service
+        # cache group->row resolution across ticks
+        self.registry_epoch = 0
         self._started = False
 
     def get(self, group_id: int) -> Optional[Consensus]:
@@ -88,12 +91,15 @@ class GroupManager:
             election_timeout_s=election_timeout_s or self._election_timeout,
         )
         self._groups[group_id] = c
+        self.registry_epoch += 1
         await c.start()
         self.heartbeat_manager.register(c)
         return c
 
     async def remove_group(self, group_id: int) -> None:
         c = self._groups.pop(group_id, None)
+        self.registry_epoch += 1
+        self.service.invalidate_heartbeat_plans()
         if c is not None:
             self.heartbeat_manager.deregister(group_id)
             await c.stop()

@@ -149,11 +149,11 @@ class HeartbeatManager:
                 node_id=self.node_id,
                 target_node_id=peer,
                 groups=p.gids,
-                terms=terms.tolist(),
-                prev_log_indices=prevs.tolist(),
-                prev_log_terms=prev_terms.tolist(),
-                commit_indices=commits.tolist(),
-                seqs=seqs.tolist(),
+                terms=terms,
+                prev_log_indices=prevs,
+                prev_log_terms=prev_terms,
+                commit_indices=commits,
+                seqs=seqs,
             ).encode()
             sent[peer] = (p, prevs, seqs, msg)
 

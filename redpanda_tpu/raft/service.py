@@ -22,9 +22,36 @@ class RaftService(Service):
 
     def __init__(self, group_manager):
         self._gm = group_manager
+        # heartbeat batches repeat the same group list tick after tick:
+        # cache the group->(consensus, row) resolution PER SENDER (each
+        # peer leads a different group set — one shared slot would
+        # thrash), invalidated by the registry epoch
+        self._hb_plans: dict[int, tuple] = {}
 
     def _consensus(self, group_id: int):
         return self._gm.get(group_id)
+
+    def invalidate_heartbeat_plans(self) -> None:
+        """Called on group removal so stale plans don't pin stopped
+        Consensus objects (and their logs) in memory."""
+        self._hb_plans.clear()
+
+    def _resolve_batch(self, sender: int, groups) -> tuple[list, "object"]:
+        import numpy as np
+
+        key = bytes(np.asarray(groups, np.int64).data)
+        epoch = self._gm.registry_epoch
+        plan = self._hb_plans.get(sender)
+        if plan is not None and plan[0] == epoch and plan[1] == key:
+            return plan[2], plan[3]
+        cons = [self._gm.get(int(g)) for g in groups]
+        rows = np.fromiter(
+            (c.row if c is not None else -1 for c in cons),
+            np.int64,
+            len(cons),
+        )
+        self._hb_plans[sender] = (epoch, key, cons, rows)
+        return cons, rows
 
     @method(rt.VOTE)
     async def vote(self, payload: bytes) -> bytes:
@@ -65,16 +92,12 @@ class RaftService(Service):
         import numpy as np
 
         from ..models.consensus_state import SELF_SLOT
-        from .consensus import Role
 
         req = rt.HeartbeatRequest.decode(payload)
         gm = self._gm
         arrays = gm.arrays
         n = len(req.groups)
-        cons = [gm.get(int(g)) for g in req.groups]
-        rows = np.fromiter(
-            (c.row if c is not None else -1 for c in cons), np.int64, n
-        )
+        cons, rows = self._resolve_batch(int(req.node_id), req.groups)
         avail = rows >= 0
         r = np.where(avail, rows, 0)
         t_req = np.asarray(req.terms, np.int64)
@@ -88,9 +111,7 @@ class RaftService(Service):
         terms_out = np.where(avail, my_term, -1)
         statuses = np.full(n, rt.AppendEntriesReply.GROUP_UNAVAILABLE, np.int64)
 
-        follower = np.fromiter(
-            (c is not None and c.role is Role.FOLLOWER for c in cons), bool, n
-        )
+        follower = avail & arrays.is_follower[r]
         tb_terms, known = arrays.term_at_batch(r, prevs)
         in_log = (prevs >= 0) & (
             (prevs >= arrays.log_start[r]) | (prevs == arrays.snap_index[r])
@@ -150,11 +171,11 @@ class RaftService(Service):
         return rt.HeartbeatReply(
             node_id=gm.node_id,
             groups=list(req.groups),
-            terms=terms_out.tolist(),
-            last_dirty=dirty_out.tolist(),
-            last_flushed=flushed_out.tolist(),
+            terms=terms_out,
+            last_dirty=dirty_out,
+            last_flushed=flushed_out,
             seqs=seqs,
-            statuses=statuses.tolist(),
+            statuses=statuses,
         ).encode()
 
     @method(rt.INSTALL_SNAPSHOT)

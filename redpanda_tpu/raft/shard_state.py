@@ -64,6 +64,9 @@ class ShardGroupArrays:
         self.log_start = np.zeros(g, np.int64)  # log start offset
         self.snap_index = np.full(g, NO_OFFSET, np.int64)
         self.leader_id = np.full(g, -1, np.int64)  # known leader node
+        # role mirror (True only for Role.FOLLOWER — candidates must
+        # drop to the scalar heartbeat path to step down correctly)
+        self.is_follower = np.zeros(g, bool)
 
     # -- row lifecycle ------------------------------------------------
     def alloc_row(self) -> int:
@@ -97,6 +100,7 @@ class ShardGroupArrays:
         self.log_start[row] = 0
         self.snap_index[row] = NO_OFFSET
         self.leader_id[row] = -1
+        self.is_follower[row] = False
 
     def _grow(self) -> None:
         old = self._cap
@@ -119,6 +123,7 @@ class ShardGroupArrays:
             "last_hb",
             "log_start",
             "snap_index",
+            "is_follower",
             "leader_id",
         ):
             arr = getattr(self, name)
