@@ -148,6 +148,7 @@ class Broker:
         )
         self.controller.authorizer.superusers = set(config.superusers or [])
         self.leaders = PartitionLeadersTable()
+        self.controller.leaders_table = self.leaders
         self.metadata_cache = MetadataCache(
             self.controller.topic_table, self.partition_manager, self.leaders
         )

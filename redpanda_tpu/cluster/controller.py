@@ -334,6 +334,11 @@ class Controller:
         # async (ntp, partition) hook run after the backend creates a
         # local partition (Broker wires cloud recovery seeding here)
         self.on_partition_added = None
+        # leadership view for the balancer (Broker assigns its
+        # dissemination-fed PartitionLeadersTable after construction)
+        self.leaders_table = None
+        self._balance_ticks = 0
+        self.leader_balancer_enabled = True
         self._closed = False
 
     @property
@@ -890,6 +895,10 @@ class Controller:
                 self._move_repair_pass()
                 if self.is_leader:
                     await self._drain_pass()
+                    self._balance_ticks += 1
+                    if self._balance_ticks >= 5:  # ~5s of idle ticks
+                        self._balance_ticks = 0
+                        await self._leader_balance_pass()
                 continue
             for d in deltas:
                 try:
@@ -1055,6 +1064,81 @@ class Controller:
                 self._move_tasks[ntp] = asyncio.ensure_future(
                     self._converge_move(ntp, a.group, list(a.replicas))
                 )
+
+    async def _leader_balance_pass(self) -> None:
+        """Leader-only greedy leadership rebalancing
+        (cluster/leader_balancer.cc): when the most-loaded node leads
+        at least 2 more partitions than the least-loaded, ask it to
+        hand one suitable leadership over. One transfer per pass keeps
+        churn bounded; repeated passes converge."""
+        if not self.leader_balancer_enabled or self.leaders_table is None:
+            return
+        alive = set(self.members_table.node_ids())
+        draining = self._draining_nodes()
+        counts: dict[int, int] = {
+            n: 0 for n in alive if n not in draining
+        }
+        led: dict[int, list] = {n: [] for n in counts}
+        for tp_ns, md in self.topic_table.topics().items():
+            for a in md.assignments.values():
+                ntp = NTP(tp_ns.ns, tp_ns.topic, a.partition)
+                # locally-hosted replicas know their leader
+                # authoritatively (heartbeats); the gossip table covers
+                # partitions this node doesn't host
+                local = self._pm.get(ntp)
+                if local is not None and local.consensus.leader_id is not None:
+                    leader = int(local.consensus.leader_id)
+                else:
+                    leader = self.leaders_table.get(ntp)
+                if leader in counts:
+                    counts[leader] += 1
+                    led[leader].append((ntp, a))
+        if len(counts) < 2:
+            return
+        from ..raft import types as rt
+
+        hot = max(counts, key=counts.get)
+        # best candidate: among partitions the hot node leads, the
+        # replica with the FEWEST leaderships that can actually take
+        # this one (the globally-coldest node may host none of them)
+        best = None  # (target_count, ntp, assignment, target)
+        for ntp, a in led[hot]:
+            eligible = [
+                r
+                for r in a.replicas
+                if r != hot and r in counts
+            ]
+            if not eligible:
+                continue
+            target = min(eligible, key=lambda r: counts[r])
+            if best is None or counts[target] < best[0]:
+                best = (counts[target], ntp, a, target)
+        if best is None or counts[hot] - best[0] < 2:
+            return
+        _tc, ntp, a, cold = best
+        try:
+            if hot == self.node_id:
+                p = self._pm.get(ntp)
+                if p is None or not p.consensus.is_leader():
+                    return  # stale view; recount next pass
+                await p.consensus.transfer_leadership(cold)
+            else:
+                req = rt.TransferLeadershipRequest(
+                    group=a.group, target=cold
+                ).encode()
+                raw = await self._send(hot, rt.TRANSFER_LEADERSHIP, req, 5.0)
+                reply = rt.TransferLeadershipReply.decode(raw)
+                if not reply.success:
+                    return
+            logger.info(
+                "leader_balancer: moved %s leadership %d -> %d (counts %s)",
+                ntp,
+                hot,
+                cold,
+                counts,
+            )
+        except Exception:
+            pass
 
     async def _drain_pass(self) -> None:
         """Leader-only: move replicas off draining nodes, one partition

@@ -188,6 +188,34 @@ class RaftService(Service):
             ).encode()
         return (await c.handle_install_snapshot(req)).encode()
 
+    @method(rt.TRANSFER_LEADERSHIP)
+    async def transfer_leadership(self, payload: bytes) -> bytes:
+        """Balancer/operator entry point: this node must currently lead
+        the group; it drives the timeout_now handshake to the target."""
+        req = rt.TransferLeadershipRequest.decode(payload)
+        c = self._consensus(int(req.group))
+        if c is None or not c.is_leader():
+            return rt.TransferLeadershipReply(
+                group=int(req.group), success=False, error="not leader here"
+            ).encode()
+        target = int(req.target)
+        if target < 0:
+            peers = c.peers()
+            if not peers:
+                return rt.TransferLeadershipReply(
+                    group=int(req.group), success=False, error="no peer"
+                ).encode()
+            target = peers[0]
+        try:
+            await c.transfer_leadership(target)
+        except Exception as e:
+            return rt.TransferLeadershipReply(
+                group=int(req.group), success=False, error=str(e)
+            ).encode()
+        return rt.TransferLeadershipReply(
+            group=int(req.group), success=True, error=""
+        ).encode()
+
     @method(rt.TIMEOUT_NOW)
     async def timeout_now(self, payload: bytes) -> bytes:
         req = rt.TimeoutNowRequest.decode(payload)

@@ -17,6 +17,7 @@ APPEND_ENTRIES = 101
 HEARTBEAT = 102
 INSTALL_SNAPSHOT = 103
 TIMEOUT_NOW = 104
+TRANSFER_LEADERSHIP = 105
 
 
 class VoteRequest(serde.Envelope):
@@ -139,4 +140,23 @@ class TimeoutNowReply(serde.Envelope):
     SERDE_FIELDS = [
         ("group", serde.i64),
         ("term", serde.i64),
+    ]
+
+
+class TransferLeadershipRequest(serde.Envelope):
+    """Operator/balancer-initiated transfer routed to whatever node
+    currently LEADS the group (the leader then runs the timeout_now
+    protocol against the target). -1 target = leader's choice."""
+
+    SERDE_FIELDS = [
+        ("group", serde.i64),
+        ("target", serde.i32),
+    ]
+
+
+class TransferLeadershipReply(serde.Envelope):
+    SERDE_FIELDS = [
+        ("group", serde.i64),
+        ("success", serde.boolean),
+        ("error", serde.string),
     ]
