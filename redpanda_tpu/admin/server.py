@@ -76,6 +76,7 @@ class AdminServer(HttpServer):
         r("DELETE", r"/v1/security/users/([^/]+)", self._delete_user)
         r("POST", r"/v1/debug/fault_injection", self._fault_injection)
         r("DELETE", r"/v1/debug/fault_injection", self._fault_clear)
+        r("POST", r"/v1/debug/self_test", self._self_test)
         r("GET", r"/metrics", self._metrics)
 
     async def _ready(self, _m, _q, _b):
@@ -359,6 +360,87 @@ class AdminServer(HttpServer):
 
         honey_badger.clear()
         return None
+
+    async def _self_test(self, _m, _q, body):
+        """Disk + network micro-benchmarks on THIS node (reference:
+        cluster/self_test — diskcheck/netcheck run via the admin API).
+        Sized small so the probe itself doesn't disturb a live broker."""
+        import asyncio
+        import os
+        import time
+
+        import secrets
+
+        payload = self._json_body(body)
+        size_mb = min(int(payload.get("disk_mb", 16)), 256)
+        results: dict = {"node_id": self.broker.node_id}
+
+        # diskcheck: sequential write+fsync then read-back on data_dir
+        # (unique name — concurrent probes must not share a file; the
+        # finally guarantees no orphan even on ENOSPC mid-write)
+        path = os.path.join(
+            self.broker.config.data_dir,
+            f".self_test.{secrets.token_hex(6)}.tmp",
+        )
+        block = os.urandom(1 << 20)
+        loop = asyncio.get_event_loop()
+
+        def disk() -> dict:
+            try:
+                t0 = time.perf_counter()
+                with open(path, "wb") as f:
+                    for _ in range(size_mb):
+                        f.write(block)
+                    f.flush()
+                    os.fsync(f.fileno())
+                w = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                with open(path, "rb") as f:
+                    while f.read(1 << 20):
+                        pass
+                r = time.perf_counter() - t0
+            finally:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            return {
+                "write_mbps": round(size_mb / w, 1),
+                "read_mbps": round(size_mb / r, 1),
+                "size_mb": size_mb,
+            }
+
+        results["disk"] = await loop.run_in_executor(None, disk)
+
+        # netcheck: concurrent per-peer RTT sampling — dead peers cost
+        # ONE timeout for the whole check, not one each
+        from ..cluster.node_status import NODE_PING, _Ping
+
+        req = _Ping(node_id=self.broker.node_id).encode()
+
+        async def probe(peer: int) -> tuple[str, dict]:
+            samples = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                try:
+                    await self.broker.send_rpc(peer, NODE_PING, req, 2.0)
+                except Exception:
+                    return str(peer), {"error": "unreachable"}
+                samples.append((time.perf_counter() - t0) * 1e3)
+            return str(peer), {
+                "rtt_ms_min": round(min(samples), 3),
+                "rtt_ms_avg": round(sum(samples) / len(samples), 3),
+            }
+
+        peers = [
+            p
+            for p in self.broker.controller.members
+            if p != self.broker.node_id
+        ]
+        results["network"] = dict(
+            await asyncio.gather(*(probe(p) for p in peers))
+        )
+        return results
 
     async def _metrics(self, _m, _q, _b):
         return self.broker.metrics.render()

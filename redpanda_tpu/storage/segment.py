@@ -49,6 +49,7 @@ class Segment:
         self.dirty_offset = base_offset - 1  # last appended
         self.stable_offset = base_offset - 1  # last fsynced
         self.max_timestamp = -1
+        self._rfd: int | None = None  # cached pread descriptor
         if os.path.exists(self._path):
             self._recover()
         self._file = open(self._path, "ab")
@@ -131,6 +132,23 @@ class Segment:
         i = bisect.bisect_right(self._idx_offsets, offset) - 1
         return self._idx_positions[i] if i >= 0 else 0
 
+    def _read_fd(self) -> int:
+        """Cached O_RDONLY descriptor (readers_cache analog): reads go
+        through positional os.pread — no seek state, so concurrent
+        readers share one fd and repeated fetches skip the
+        open/close-per-call syscall pair."""
+        if self._rfd is None:
+            self._rfd = os.open(self._path, os.O_RDONLY)
+        return self._rfd
+
+    def _drop_read_fd(self) -> None:
+        if self._rfd is not None:
+            try:
+                os.close(self._rfd)
+            except OSError:
+                pass
+            self._rfd = None
+
     def read_batches(
         self, start_offset: int, max_bytes: int = 1 << 30
     ) -> list[RecordBatch]:
@@ -138,20 +156,21 @@ class Segment:
         self._file.flush()
         out: list[RecordBatch] = []
         consumed = 0
-        with open(self._path, "rb") as f:
-            f.seek(self.lower_bound_pos(start_offset))
-            while consumed < max_bytes:
-                hdr_bytes = f.read(HEADER_SIZE)
-                if len(hdr_bytes) < HEADER_SIZE:
-                    break
-                header = RecordBatchHeader.unpack(hdr_bytes)
-                body = f.read(header.size_bytes - HEADER_SIZE)
-                if len(body) < header.size_bytes - HEADER_SIZE:
-                    break
-                if header.last_offset < start_offset:
-                    continue
-                out.append(RecordBatch(header, body))
-                consumed += header.size_bytes
+        fd = self._read_fd()
+        pos = self.lower_bound_pos(start_offset)
+        while consumed < max_bytes:
+            hdr_bytes = os.pread(fd, HEADER_SIZE, pos)
+            if len(hdr_bytes) < HEADER_SIZE:
+                break
+            header = RecordBatchHeader.unpack(hdr_bytes)
+            body = os.pread(fd, header.size_bytes - HEADER_SIZE, pos + HEADER_SIZE)
+            if len(body) < header.size_bytes - HEADER_SIZE:
+                break
+            pos += header.size_bytes
+            if header.last_offset < start_offset:
+                continue
+            out.append(RecordBatch(header, body))
+            consumed += header.size_bytes
         return out
 
     def timequery(self, ts: int) -> int | None:
@@ -208,9 +227,11 @@ class Segment:
     def close(self) -> None:
         self.flush()
         self.persist_index()
+        self._drop_read_fd()
         self._file.close()
 
     def remove_files(self) -> None:
+        self._drop_read_fd()
         for p in (self._path, self._index_path):
             if os.path.exists(p):
                 os.remove(p)

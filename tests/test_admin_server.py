@@ -273,3 +273,21 @@ async def _fault_injection(tmp_path):
 
 def test_fault_injection_endpoint(tmp_path):
     asyncio.run(_fault_injection(tmp_path))
+
+
+async def _self_test(tmp_path):
+    async with cluster(tmp_path, n=3) as brokers:
+        st, body = await http(
+            brokers[0].admin.address, "POST", "/v1/debug/self_test",
+            {"disk_mb": 4},
+        )
+        assert st == 200, body
+        assert body["disk"]["write_mbps"] > 0
+        assert body["disk"]["read_mbps"] > 0
+        assert set(body["network"]) == {"1", "2"}
+        for peer in ("1", "2"):
+            assert body["network"][peer]["rtt_ms_avg"] >= 0
+
+
+def test_self_test(tmp_path):
+    asyncio.run(_self_test(tmp_path))
