@@ -77,6 +77,7 @@ class AdminServer(HttpServer):
         r("POST", r"/v1/debug/fault_injection", self._fault_injection)
         r("DELETE", r"/v1/debug/fault_injection", self._fault_clear)
         r("POST", r"/v1/debug/self_test", self._self_test)
+        r("GET", r"/v1/features", self._features)
         r("GET", r"/metrics", self._metrics)
 
     async def _ready(self, _m, _q, _b):
@@ -441,6 +442,9 @@ class AdminServer(HttpServer):
             await asyncio.gather(*(probe(p) for p in peers))
         )
         return results
+
+    async def _features(self, _m, _q, _b):
+        return self.broker.controller.features.snapshot()
 
     async def _metrics(self, _m, _q, _b):
         return self.broker.metrics.render()

@@ -32,6 +32,7 @@ class CmdType(enum.IntEnum):
     recommission_node = 13
     move_replicas = 14
     finish_move = 15
+    feature_update = 16
 
 
 class PartitionAssignmentE(serde.Envelope):
@@ -153,8 +154,13 @@ class RegisterNodeCmd(serde.Envelope):
         ("kafka_host", serde.string),
         ("kafka_port", serde.i32),
         ("rack", serde.string),  # "" = unlabeled
+        # highest feature level this build understands (feature_table.h
+        # latest_version): the cluster's active version is the MINIMUM
+        # across members, so features activate only when every node can
+        # serve them
+        ("logical_version", serde.i32),
     ]
-    SERDE_DEFAULTS = {"rack": ""}
+    SERDE_DEFAULTS = {"rack": "", "logical_version": 1}
 
 
 class DecommissionNodeCmd(serde.Envelope):
@@ -197,6 +203,18 @@ class FinishMoveCmd(serde.Envelope):
     ]
 
 
+class FeatureUpdateCmd(serde.Envelope):
+    """Cluster feature activation (feature_update_cmd): replicated by
+    the controller leader once every member's logical version supports
+    the feature."""
+
+    SERDE_FIELDS = [
+        ("name", serde.string),
+        ("state", serde.string),  # "active" | "disabled"
+        ("cluster_version", serde.i32),
+    ]
+
+
 CMD_CLASSES = {
     CmdType.create_topic: CreateTopicCmd,
     CmdType.delete_topic: DeleteTopicCmd,
@@ -213,6 +231,7 @@ CMD_CLASSES = {
     CmdType.recommission_node: RecommissionNodeCmd,
     CmdType.move_replicas: MoveReplicasCmd,
     CmdType.finish_move: FinishMoveCmd,
+    CmdType.feature_update: FeatureUpdateCmd,
 }
 
 

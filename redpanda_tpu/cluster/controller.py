@@ -43,6 +43,7 @@ from .commands import (
     DeleteAclsCmd,
     DeleteTopicCmd,
     DeleteUserCmd,
+    FeatureUpdateCmd,
     FinishMoveCmd,
     MoveReplicasCmd,
     PartitionAssignmentE,
@@ -52,6 +53,7 @@ from .commands import (
     decode_commands,
     encode_command,
 )
+from .features import LATEST_LOGICAL_VERSION, FeatureTable
 from .members import MembersTable, MembershipState
 from .partition_manager import PartitionManager
 from .shard_table import ShardTable
@@ -164,6 +166,7 @@ class ControllerStm(StateMachine):
                     (cmd.rpc_host, int(cmd.rpc_port)),
                     (cmd.kafka_host, int(cmd.kafka_port)),
                     rack=str(cmd.rack or ""),
+                    logical_version=int(cmd.logical_version),
                 )
                 self.allocator.register_node(
                     int(cmd.node_id), rack=str(cmd.rack or "")
@@ -175,6 +178,10 @@ class ControllerStm(StateMachine):
             elif cmd_type == CmdType.recommission_node:
                 self._c.members_table.apply_state(
                     int(cmd.node_id), MembershipState.active
+                )
+            elif cmd_type == CmdType.feature_update:
+                self._c.features.apply(
+                    cmd.name, cmd.state, int(cmd.cluster_version)
                 )
             elif cmd_type == CmdType.move_replicas:
                 md = self.topic_table.get(TopicNamespace(cmd.ns, cmd.topic))
@@ -318,6 +325,7 @@ class Controller:
         self.acls = AclStore()
         self.authorizer = Authorizer(self.acls)
         self.members_table = MembersTable()
+        self.features = FeatureTable()
         from ..config import ClusterConfig
 
         self.cluster_config = ClusterConfig()
@@ -598,6 +606,7 @@ class Controller:
             kafka_host=kafka_addr[0],
             kafka_port=int(kafka_addr[1]),
             rack=rack,
+            logical_version=LATEST_LOGICAL_VERSION,
         )
         deadline = asyncio.get_event_loop().time() + timeout
         payload = cmd.encode()
@@ -894,6 +903,7 @@ class Controller:
                     pass
                 self._move_repair_pass()
                 if self.is_leader:
+                    await self._feature_pass()
                     await self._drain_pass()
                     self._balance_ticks += 1
                     if self._balance_ticks >= 5:  # ~5s of idle ticks
@@ -1064,6 +1074,43 @@ class Controller:
                 self._move_tasks[ntp] = asyncio.ensure_future(
                     self._converge_move(ntp, a.group, list(a.replicas))
                 )
+
+    async def _feature_pass(self) -> None:
+        """Leader-only: activate features the whole membership now
+        supports (feature_manager.cc maybe_update_active_version). The
+        active cluster version is min(member logical versions) over
+        REGISTERED members — unregistered seeds hold activation back
+        since their build level is unknown."""
+        regs = self.members_table.registered()
+        if not regs or len(regs) < len(self.members_table.node_ids()):
+            return
+        versions = [ep.logical_version for ep in regs.values()]
+        pending = self.features.pending_activations(versions)
+        if not pending:
+            return
+        cluster_version = min(versions)
+        for f in pending:
+            try:
+                await self.replicate_cmd_local(
+                    CmdType.feature_update,
+                    FeatureUpdateCmd(
+                        name=f.name,
+                        state="active",
+                        cluster_version=cluster_version,
+                    ),
+                )
+                logger.info(
+                    "feature_manager: activated %s (cluster version %d)",
+                    f.name,
+                    cluster_version,
+                )
+            except Exception:
+                logger.warning(
+                    "feature_manager: activation of %s failed; will retry",
+                    f.name,
+                    exc_info=True,
+                )
+                return
 
     async def _leader_balance_pass(self) -> None:
         """Leader-only greedy leadership rebalancing

@@ -26,6 +26,7 @@ class BrokerEndpoint:
     kafka_addr: tuple[str, int]
     state: MembershipState = MembershipState.active
     rack: str = ""  # failure-domain label; "" = unlabeled
+    logical_version: int = 1  # feature level this build supports
 
 
 class MembersTable:
@@ -47,11 +48,12 @@ class MembersTable:
         rpc_addr: tuple[str, int],
         kafka_addr: tuple[str, int],
         rack: str = "",
+        logical_version: int = 1,
     ) -> None:
         cur = self._nodes.get(node_id)
         state = cur.state if cur is not None else MembershipState.active
         self._nodes[node_id] = BrokerEndpoint(
-            node_id, rpc_addr, kafka_addr, state, rack
+            node_id, rpc_addr, kafka_addr, state, rack, logical_version
         )
 
     def apply_state(self, node_id: int, state: MembershipState) -> None:

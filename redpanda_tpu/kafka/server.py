@@ -771,7 +771,9 @@ class KafkaServer:
         # request into the session and serve ITS partition set.
         session = None
         incremental = False
-        if hdr.api_version >= 7:
+        if hdr.api_version >= 7 and self.broker.controller.features.is_active(
+            "fetch_sessions"
+        ):
             sid = getattr(req, "session_id", 0) or 0
             epoch = getattr(req, "session_epoch", -1)
             if epoch == -1:

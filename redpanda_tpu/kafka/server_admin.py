@@ -559,7 +559,27 @@ class AdminHandlers:
     async def delete_records(self, hdr, req) -> Msg:
         """Kafka DeleteRecords (handlers/delete_records.cc): advance a
         partition's log start; a replicated marker carries the floor to
-        every replica."""
+        every replica. Feature-gated: in a mixed-version cluster an
+        older node would mis-handle the floor marker, so the API stays
+        off until every member's build supports it."""
+        if not self.controller.features.is_active("delete_records"):
+            return Msg(
+                throttle_time_ms=0,
+                topics=[
+                    Msg(
+                        name=t.name,
+                        partitions=[
+                            Msg(
+                                partition_index=p.partition_index,
+                                low_watermark=-1,
+                                error_code=int(ErrorCode.unsupported_version),
+                            )
+                            for p in t.partitions
+                        ],
+                    )
+                    for t in req.topics
+                ],
+            )
         topics = []
         for t in req.topics:
             parts = []

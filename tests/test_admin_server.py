@@ -291,3 +291,25 @@ async def _self_test(tmp_path):
 
 def test_self_test(tmp_path):
     asyncio.run(_self_test(tmp_path))
+
+
+async def _features(tmp_path):
+    async with cluster(tmp_path, n=3) as brokers:
+        # activation needs every member registered + the leader's pass
+        deadline = asyncio.get_event_loop().time() + 10
+        while asyncio.get_event_loop().time() < deadline:
+            st, body = await http(brokers[1].admin.address, "GET", "/v1/features")
+            assert st == 200
+            states = {f["name"]: f["state"] for f in body["features"]}
+            if all(s == "active" for s in states.values()):
+                break
+            await asyncio.sleep(0.1)
+        assert all(s == "active" for s in states.values()), states
+        assert body["cluster_version"] == body["latest_version"]
+        # the table is replicated: every node agrees
+        for b in brokers:
+            assert b.controller.features.is_active("delete_records")
+
+
+def test_features(tmp_path):
+    asyncio.run(_features(tmp_path))
