@@ -347,6 +347,7 @@ class Controller:
         self.leaders_table = None
         self._balance_ticks = 0
         self.leader_balancer_enabled = True
+        self.partition_balancer_enabled = True
         self._closed = False
 
     @property
@@ -909,6 +910,7 @@ class Controller:
                     if self._balance_ticks >= 5:  # ~5s of idle ticks
                         self._balance_ticks = 0
                         await self._leader_balance_pass()
+                        await self._partition_balance_pass()
                 continue
             for d in deltas:
                 try:
@@ -1186,6 +1188,72 @@ class Controller:
             )
         except Exception:
             pass
+
+    async def _partition_balance_pass(self) -> None:
+        """Leader-only: even out REPLICA counts across active members
+        (cluster/partition_balancer_backend.cc, count-based subset).
+        When the most-loaded node holds 2+ more replicas than the
+        least-loaded, move ONE replica of one partition — the move
+        machinery (joint reconfiguration + finish_move purge) does the
+        rest. Joins therefore pull existing data onto new nodes without
+        an operator issuing moves."""
+        if not self.partition_balancer_enabled:
+            return
+        if self.topic_table.updates_in_progress:
+            # cluster-wide in-flight bound (replicated via move/finish
+            # commands, so EVERY controller leader sees it — the local
+            # converge-task dict only exists on hosting nodes)
+            return
+        draining = self._draining_nodes()
+        active = [
+            n
+            for n in self.members_table.node_ids()
+            if n not in draining and self.members_table.get(n) is not None
+        ]
+        if len(active) < 2:
+            return
+        counts = {n: 0 for n in active}
+        assignments = []
+        for tp_ns, md in self.topic_table.topics().items():
+            for a in md.assignments.values():
+                assignments.append((tp_ns, a))
+                for r in a.replicas:
+                    if r in counts:
+                        counts[r] += 1
+        hot = max(counts, key=counts.get)
+        if counts[hot] - min(counts.values()) < 2:
+            return
+        for tp_ns, a in assignments:
+            if hot not in a.replicas:
+                continue
+            # rack-aware target via the same constraint logic the
+            # drain path uses — never trade balance for rack diversity
+            target = self.allocator.pick_replacement(
+                a.replicas, exclude=draining
+            )
+            if target is None or counts[hot] - counts.get(target, 0) < 2:
+                continue
+            new = [target if r == hot else r for r in a.replicas]
+            try:
+                await self.move_partition_replicas(
+                    tp_ns.topic, a.partition, new, ns=tp_ns.ns
+                )
+                logger.info(
+                    "partition_balancer: moving %s/%d replica %d -> %d "
+                    "(counts %s)",
+                    tp_ns.topic,
+                    a.partition,
+                    hot,
+                    target,
+                    counts,
+                )
+            except Exception:
+                logger.exception(
+                    "partition_balancer: move %s/%d failed",
+                    tp_ns.topic,
+                    a.partition,
+                )
+            return
 
     async def _drain_pass(self) -> None:
         """Leader-only: move replicas off draining nodes, one partition

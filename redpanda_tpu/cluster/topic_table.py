@@ -52,6 +52,10 @@ class TopicTable:
         self.revision = 0  # last applied controller revision (offset)
         self._pending_deltas: list[Delta] = []
         self._waiters: list[asyncio.Event] = []
+        # replicated view of replica moves not yet finished (applied on
+        # move_replicas, cleared on finish_move) — every node agrees,
+        # so balancers can bound cluster-wide move concurrency
+        self.updates_in_progress: set[NTP] = set()
 
     # -- queries -----------------------------------------------------
     def topics(self) -> dict[TopicNamespace, TopicMetadata]:
@@ -100,6 +104,9 @@ class TopicTable:
             # stale report from a superseded move: purging against it
             # would delete replicas the CURRENT assignment owns
             return
+        self.updates_in_progress.discard(
+            NTP(cmd.ns, cmd.topic, a.partition)
+        )
         self._pending_deltas.append(
             Delta(
                 "purge",
@@ -121,14 +128,10 @@ class TopicTable:
             return  # idempotent re-apply
         old = list(a.replicas)
         a.replicas = new
+        ntp = NTP(cmd.ns, cmd.topic, a.partition)
+        self.updates_in_progress.add(ntp)
         self._pending_deltas.append(
-            Delta(
-                "move",
-                NTP(cmd.ns, cmd.topic, a.partition),
-                a.group,
-                new,
-                old_replicas=old,
-            )
+            Delta("move", ntp, a.group, new, old_replicas=old)
         )
 
     def _apply_update_config(self, cmd) -> None:
@@ -206,6 +209,10 @@ class TopicTable:
         md = self._topics.pop(tp_ns, None)
         if md is None:
             return
+        # a topic deleted mid-move must not pin the in-progress set
+        self.updates_in_progress = {
+            ntp for ntp in self.updates_in_progress if ntp.tp_ns != tp_ns
+        }
         for a in md.assignments.values():
             self._pending_deltas.append(
                 Delta(

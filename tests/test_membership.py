@@ -316,3 +316,81 @@ async def _leader_balancer(tmp_path):
 
 def test_leader_balancer(tmp_path):
     asyncio.run(_leader_balancer(tmp_path))
+
+
+async def _partition_balancer(tmp_path):
+    """A freshly joined empty node pulls replicas automatically
+    (partition_balancer_backend.cc count-based rebalancing)."""
+    async with seed_cluster(tmp_path, n=3) as (net, brokers):
+        ctrl = brokers[0].controller
+        await wait_until(
+            lambda: len(ctrl.members_table.registered()) == 3,
+            msg="seed registration",
+        )
+        client = KafkaClient([brokers[0].kafka_advertised])
+        await client.create_topic("pb", partitions=6, replication_factor=1)
+        for pid in range(6):
+            await client.produce("pb", pid, [(b"k", b"v%d" % pid)])
+
+        joiner = Broker(
+            BrokerConfig(
+                node_id=3,
+                data_dir=str(tmp_path / "node3"),
+                members=[0, 1, 2],
+                election_timeout_s=0.15,
+                heartbeat_interval_s=0.03,
+                node_status_interval_s=0.1,
+            ),
+            loopback=net,
+        )
+        await joiner.start()
+        try:
+            await wait_until(
+                lambda: 3 in ctrl.members_table.registered(),
+                msg="joiner registered",
+            )
+
+            def replica_counts():
+                counts = {n: 0 for n in (0, 1, 2, 3)}
+                for md in ctrl.topic_table.topics().values():
+                    for a in md.assignments.values():
+                        for r in a.replicas:
+                            counts[r] = counts.get(r, 0) + 1
+                return counts
+
+            # the background balancer (one move per ~5 idle seconds)
+            # pulls replicas onto the empty joiner; drive passes
+            # directly to keep the test fast
+            leader_ctrl = None
+
+            async def converged():
+                nonlocal leader_ctrl
+                for _ in range(40):
+                    leader_ctrl = next(
+                        (
+                            b.controller
+                            for b in brokers + [joiner]
+                            if b.controller.is_leader
+                        ),
+                        None,
+                    )
+                    if leader_ctrl is not None:
+                        await leader_ctrl._partition_balance_pass()
+                    await asyncio.sleep(0.3)
+                    c = replica_counts()
+                    if max(c.values()) - min(c.values()) <= 1:
+                        return True
+                return False
+
+            assert await converged(), replica_counts()
+            # data survived every move
+            for pid in range(6):
+                got = await client.fetch("pb", pid, 0)
+                assert [(k, v) for _o, k, v in got] == [(b"k", b"v%d" % pid)]
+        finally:
+            await joiner.stop()
+        await client.close()
+
+
+def test_partition_balancer(tmp_path):
+    asyncio.run(_partition_balancer(tmp_path))
