@@ -105,6 +105,14 @@ class ProducerStateTable:
         p.batches.append((first_seq, last_seq, kafka_base))
         p.last_seq = max(p.last_seq, last_seq)
 
+    def snapshot(self) -> list[tuple[int, int, int]]:
+        """(producer_id, epoch, last_seq) rows for introspection
+        (DescribeProducers), sorted by producer id."""
+        return [
+            (pid, p.epoch, p.last_seq)
+            for pid, p in sorted(self._pids.items())
+        ]
+
     def truncate(self) -> None:
         """Raft truncation: rebuild from scratch on next replay — rare
         event, and partial rollback of seq state is not worth the

@@ -54,8 +54,11 @@ class TopicTable:
         self._waiters: list[asyncio.Event] = []
         # replicated view of replica moves not yet finished (applied on
         # move_replicas, cleared on finish_move) — every node agrees,
-        # so balancers can bound cluster-wide move concurrency
-        self.updates_in_progress: set[NTP] = set()
+        # so balancers can bound cluster-wide move concurrency. Maps
+        # the moving ntp to the replica set being replaced, which is
+        # what ListPartitionReassignments reports as removing_replicas
+        # and what a reassignment cancel restores.
+        self.updates_in_progress: dict[NTP, list[int]] = {}
 
     # -- queries -----------------------------------------------------
     def topics(self) -> dict[TopicNamespace, TopicMetadata]:
@@ -104,9 +107,7 @@ class TopicTable:
             # stale report from a superseded move: purging against it
             # would delete replicas the CURRENT assignment owns
             return
-        self.updates_in_progress.discard(
-            NTP(cmd.ns, cmd.topic, a.partition)
-        )
+        self.updates_in_progress.pop(NTP(cmd.ns, cmd.topic, a.partition), None)
         self._pending_deltas.append(
             Delta(
                 "purge",
@@ -129,7 +130,13 @@ class TopicTable:
         old = list(a.replicas)
         a.replicas = new
         ntp = NTP(cmd.ns, cmd.topic, a.partition)
-        self.updates_in_progress.add(ntp)
+        # a move issued while another is converging (e.g. a cancel)
+        # keeps the ORIGINAL pre-move set as its rollback target only
+        # if it does not complete a round trip back to it
+        if self.updates_in_progress.get(ntp) == new:
+            self.updates_in_progress.pop(ntp)
+        else:
+            self.updates_in_progress.setdefault(ntp, old)
         self._pending_deltas.append(
             Delta("move", ntp, a.group, new, old_replicas=old)
         )
@@ -211,7 +218,9 @@ class TopicTable:
             return
         # a topic deleted mid-move must not pin the in-progress set
         self.updates_in_progress = {
-            ntp for ntp in self.updates_in_progress if ntp.tp_ns != tp_ns
+            ntp: prev
+            for ntp, prev in self.updates_in_progress.items()
+            if ntp.tp_ns != tp_ns
         }
         for a in md.assignments.values():
             self._pending_deltas.append(

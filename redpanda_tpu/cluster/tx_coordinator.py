@@ -229,34 +229,44 @@ class TxCoordinator:
         return leader, addr[0], addr[1]
 
     def _local_partition(self, tx_id: str):
-        p = self.broker.partition_manager.get(self.ntp_for(tx_id))
-        if p is None or not p.is_leader:
-            return None
-        return p
+        return self._local_partition_pid(self.partition_for(tx_id))
 
     # -- replay (tm_stm hydration with leadership barrier) -----------
     async def _ensure_replayed(self, tx_id: str) -> Optional[int]:
         """Partition id if this broker coordinates tx_id, None if not;
         raises asyncio.TimeoutError while the barrier settles (callers
         map it to CONCURRENT_TRANSACTIONS / coordinator retry)."""
-        p = self._local_partition(tx_id)
         pid = self.partition_for(tx_id)
+        if await self.ensure_replayed_pid(pid):
+            return pid
+        return None
+
+    def _local_partition_pid(self, pid: int):
+        p = self.broker.partition_manager.get(NTP(TX_NS, TX_TOPIC, pid))
+        if p is None or not p.is_leader:
+            return None
+        return p
+
+    async def ensure_replayed_pid(self, pid: int) -> bool:
+        """True if this broker leads coordinator partition `pid` and its
+        tx shard is hydrated for the current term."""
+        p = self._local_partition_pid(pid)
         if p is None:
             self._replayed.pop(pid, None)
-            return None
+            return False
         term = p.consensus.term
         if self._replayed.get(pid) == term:
-            return pid
+            return True
         lock = self._replay_locks.setdefault(pid, asyncio.Lock())
         async with lock:
-            p = self._local_partition(tx_id)
+            p = self._local_partition_pid(pid)
             if p is None:
                 self._replayed.pop(pid, None)
-                return None
+                return False
             c = p.consensus
             term = c.term
             if self._replayed.get(pid) == term:
-                return pid
+                return True
             if c.commit_index < c.term_start:
                 await c.wait_committed(c.term_start, timeout=2.0)
                 if not c.is_leader() or c.term != term:
@@ -289,7 +299,7 @@ class TxCoordinator:
                     t = asyncio.ensure_future(self._resume(meta))
                     self._recovery_tasks.add(t)
                     t.add_done_callback(self._recovery_tasks.discard)
-            return pid
+            return True
 
     def _replay_batch(self, shard: dict[str, TxMeta], batch: RecordBatch) -> None:
         for rec in batch.records():
@@ -472,6 +482,31 @@ class TxCoordinator:
         if pid is None:
             return None
         return self._txs.setdefault(pid, {})
+
+    # -- introspection (DescribeTransactions / ListTransactions) -----
+    async def describe_tx(self, tx_id: str) -> tuple[Optional[TxMeta], int]:
+        """(meta, error_code) for one transactional id; meta is None
+        when this broker is not its coordinator or the id is unknown."""
+        shard = await self._shard_for(tx_id)
+        if shard is None:
+            return None, int(_E.not_coordinator)
+        meta = shard.get(tx_id)
+        if meta is None:
+            return None, int(_E.invalid_producer_id_mapping)
+        return meta, 0
+
+    async def list_local_txs(self) -> list[TxMeta]:
+        """Every transaction coordinated by partitions this broker
+        leads (tx_gateway_frontend.cc get_all_transactions)."""
+        out: list[TxMeta] = []
+        for pid in range(self.n_partitions):
+            try:
+                if not await self.ensure_replayed_pid(pid):
+                    continue
+            except asyncio.TimeoutError:
+                continue
+            out.extend(self._txs.get(pid, {}).values())
+        return out
 
     async def init_producer_id(
         self, tx_id: str, timeout_ms: int

@@ -518,3 +518,230 @@ CREATE_PARTITIONS = register(
         ],
     )
 )
+
+
+DESCRIBE_LOG_DIRS = register(
+    Api(
+        key=35,
+        name="describe_log_dirs",
+        versions=(0, 3),
+        flex_since=2,
+        request=[
+            F(
+                "topics",
+                Array(
+                    [
+                        F("topic", "string"),
+                        F("partitions", Array("int32")),
+                    ]
+                ),
+                nullable=(0, None),
+                default=None,
+            ),
+        ],
+        response=[
+            F("throttle_time_ms", "int32"),
+            F("error_code", "int16", versions=(3, None), default=0),
+            F(
+                "results",
+                Array(
+                    [
+                        F("error_code", "int16"),
+                        F("log_dir", "string"),
+                        F(
+                            "topics",
+                            Array(
+                                [
+                                    F("name", "string"),
+                                    F(
+                                        "partitions",
+                                        Array(
+                                            [
+                                                F("partition_index", "int32"),
+                                                F("partition_size", "int64"),
+                                                F("offset_lag", "int64"),
+                                                F("is_future_key", "bool"),
+                                            ]
+                                        ),
+                                    ),
+                                ]
+                            ),
+                        ),
+                    ]
+                ),
+            ),
+        ],
+    )
+)
+
+ALTER_PARTITION_REASSIGNMENTS = register(
+    Api(
+        key=45,
+        name="alter_partition_reassignments",
+        versions=(0, 0),
+        flex_since=0,
+        request=[
+            F("timeout_ms", "int32"),
+            F(
+                "topics",
+                Array(
+                    [
+                        F("name", "string"),
+                        F(
+                            "partitions",
+                            Array(
+                                [
+                                    F("partition_index", "int32"),
+                                    F(
+                                        "replicas",
+                                        Array("int32"),
+                                        nullable=(0, None),
+                                        default=None,
+                                    ),
+                                ]
+                            ),
+                        ),
+                    ]
+                ),
+            ),
+        ],
+        response=[
+            F("throttle_time_ms", "int32"),
+            F("error_code", "int16"),
+            F("error_message", "string", nullable=(0, None), default=None),
+            F(
+                "responses",
+                Array(
+                    [
+                        F("name", "string"),
+                        F(
+                            "partitions",
+                            Array(
+                                [
+                                    F("partition_index", "int32"),
+                                    F("error_code", "int16"),
+                                    F(
+                                        "error_message",
+                                        "string",
+                                        nullable=(0, None),
+                                        default=None,
+                                    ),
+                                ]
+                            ),
+                        ),
+                    ]
+                ),
+            ),
+        ],
+    )
+)
+
+LIST_PARTITION_REASSIGNMENTS = register(
+    Api(
+        key=46,
+        name="list_partition_reassignments",
+        versions=(0, 0),
+        flex_since=0,
+        request=[
+            F("timeout_ms", "int32"),
+            F(
+                "topics",
+                Array(
+                    [
+                        F("name", "string"),
+                        F("partition_indexes", Array("int32")),
+                    ]
+                ),
+                nullable=(0, None),
+                default=None,
+            ),
+        ],
+        response=[
+            F("throttle_time_ms", "int32"),
+            F("error_code", "int16"),
+            F("error_message", "string", nullable=(0, None), default=None),
+            F(
+                "topics",
+                Array(
+                    [
+                        F("name", "string"),
+                        F(
+                            "partitions",
+                            Array(
+                                [
+                                    F("partition_index", "int32"),
+                                    F("replicas", Array("int32")),
+                                    F("adding_replicas", Array("int32")),
+                                    F("removing_replicas", Array("int32")),
+                                ]
+                            ),
+                        ),
+                    ]
+                ),
+            ),
+        ],
+    )
+)
+
+DESCRIBE_PRODUCERS = register(
+    Api(
+        key=61,
+        name="describe_producers",
+        versions=(0, 0),
+        flex_since=0,
+        request=[
+            F(
+                "topics",
+                Array(
+                    [
+                        F("name", "string"),
+                        F("partition_indexes", Array("int32")),
+                    ]
+                ),
+            ),
+        ],
+        response=[
+            F("throttle_time_ms", "int32"),
+            F(
+                "topics",
+                Array(
+                    [
+                        F("name", "string"),
+                        F(
+                            "partitions",
+                            Array(
+                                [
+                                    F("partition_index", "int32"),
+                                    F("error_code", "int16"),
+                                    F(
+                                        "error_message",
+                                        "string",
+                                        nullable=(0, None),
+                                        default=None,
+                                    ),
+                                    F(
+                                        "active_producers",
+                                        Array(
+                                            [
+                                                F("producer_id", "int64"),
+                                                F("producer_epoch", "int32"),
+                                                F("last_sequence", "int32", default=-1),
+                                                F("last_timestamp", "int64", default=-1),
+                                                F("coordinator_epoch", "int32"),
+                                                F(
+                                                    "current_txn_start_offset",
+                                                    "int64",
+                                                    default=-1,
+                                                ),
+                                            ]
+                                        ),
+                                    ),
+                                ]
+                            ),
+                        ),
+                    ]
+                ),
+            ),
+        ],
+    )
+)

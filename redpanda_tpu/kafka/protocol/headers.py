@@ -87,6 +87,7 @@ class ErrorCode(enum.IntEnum):
     group_subscribed_to_topic = 86
     unstable_offset_commit = 88
     sasl_authentication_failed = 58
+    no_reassignment_in_progress = 85
     producer_fenced = 90
 
 

@@ -157,3 +157,70 @@ TXN_OFFSET_COMMIT = register(
         ],
     )
 )
+
+
+DESCRIBE_TRANSACTIONS = register(
+    Api(
+        key=65,
+        name="describe_transactions",
+        versions=(0, 0),
+        flex_since=0,
+        request=[
+            F("transactional_ids", Array("string")),
+        ],
+        response=[
+            F("throttle_time_ms", "int32"),
+            F(
+                "transaction_states",
+                Array(
+                    [
+                        F("error_code", "int16"),
+                        F("transactional_id", "string"),
+                        F("transaction_state", "string"),
+                        F("transaction_timeout_ms", "int32"),
+                        F("transaction_start_time_ms", "int64"),
+                        F("producer_id", "int64"),
+                        F("producer_epoch", "int16"),
+                        F(
+                            "topics",
+                            Array(
+                                [
+                                    F("topic", "string"),
+                                    F("partitions", Array("int32")),
+                                ]
+                            ),
+                        ),
+                    ]
+                ),
+            ),
+        ],
+    )
+)
+
+LIST_TRANSACTIONS = register(
+    Api(
+        key=66,
+        name="list_transactions",
+        versions=(0, 0),
+        flex_since=0,
+        request=[
+            F("state_filters", Array("string")),
+            F("producer_id_filters", Array("int64")),
+        ],
+        response=[
+            F("throttle_time_ms", "int32"),
+            F("error_code", "int16"),
+            F("unknown_state_filters", Array("string")),
+            F(
+                "transaction_states",
+                Array(
+                    [
+                        F("transactional_id", "string"),
+                        F("producer_id", "int64"),
+                        F("transaction_state", "string"),
+                    ]
+                ),
+            ),
+        ],
+    )
+)

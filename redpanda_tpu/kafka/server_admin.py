@@ -21,13 +21,17 @@ from ..security.acl import (
 from .protocol import ErrorCode, Msg
 from .protocol.admin_apis import (
     ALTER_CONFIGS,
+    ALTER_PARTITION_REASSIGNMENTS,
     CREATE_ACLS,
     CREATE_PARTITIONS,
     DELETE_ACLS,
     DELETE_RECORDS,
     DESCRIBE_ACLS,
     DESCRIBE_CONFIGS,
+    DESCRIBE_LOG_DIRS,
+    DESCRIBE_PRODUCERS,
     INCREMENTAL_ALTER_CONFIGS,
+    LIST_PARTITION_REASSIGNMENTS,
     OFFSET_DELETE,
     OFFSET_FOR_LEADER_EPOCH,
 )
@@ -71,6 +75,10 @@ def install(server: "KafkaServer") -> None:
             CREATE_PARTITIONS.key: h.create_partitions,
             DELETE_RECORDS.key: h.delete_records,
             OFFSET_DELETE.key: h.offset_delete,
+            DESCRIBE_LOG_DIRS.key: h.describe_log_dirs,
+            ALTER_PARTITION_REASSIGNMENTS.key: h.alter_partition_reassignments,
+            LIST_PARTITION_REASSIGNMENTS.key: h.list_partition_reassignments,
+            DESCRIBE_PRODUCERS.key: h.describe_producers,
         }
     )
 
@@ -714,3 +722,218 @@ class AdminHandlers:
                 for topic, parts in by_topic.items()
             ],
         )
+
+    # -- log dirs / reassignments / producers -------------------------
+    async def describe_log_dirs(self, hdr, req) -> Msg:
+        """DescribeLogDirs (handlers/describe_log_dirs.cc): one logical
+        log dir per broker; reports on-disk size of each locally hosted
+        replica and its flush lag."""
+        if not self.server.authorize(
+            AclOperation.describe, AclResourceType.cluster, "kafka-cluster"
+        ):
+            body = Msg(throttle_time_ms=0, results=[])
+            if hdr.api_version >= 3:
+                body.error_code = int(ErrorCode.cluster_authorization_failed)
+            return body
+        broker = self.server.broker
+        local = broker.partition_manager.partitions()
+        wanted: dict[str, set[int] | None] | None = None
+        if req.topics is not None:
+            wanted = {t.topic: set(t.partitions) for t in req.topics}
+        by_topic: dict[str, list[Msg]] = {}
+        for ntp, p in sorted(local.items(), key=lambda kv: str(kv[0])):
+            if ntp.ns != DEFAULT_NS:
+                continue
+            if wanted is not None:
+                sel = wanted.get(ntp.topic)
+                if sel is None or (sel and ntp.partition not in sel):
+                    continue
+            offs = p.log.offsets()
+            by_topic.setdefault(ntp.topic, []).append(
+                Msg(
+                    partition_index=ntp.partition,
+                    partition_size=p.log.size_bytes(),
+                    offset_lag=max(0, offs.dirty_offset - offs.committed_offset),
+                    is_future_key=False,
+                )
+            )
+        body = Msg(
+            throttle_time_ms=0,
+            results=[
+                Msg(
+                    error_code=0,
+                    log_dir=broker.config.data_dir,
+                    topics=[
+                        Msg(name=t, partitions=parts)
+                        for t, parts in by_topic.items()
+                    ],
+                )
+            ],
+        )
+        if hdr.api_version >= 3:
+            body.error_code = 0
+        return body
+
+    async def alter_partition_reassignments(self, hdr, req) -> Msg:
+        """AlterPartitionReassignments (handlers/
+        alter_partition_reassignments.cc): replicas=[...] starts a
+        replica move through the controller; replicas=null cancels an
+        in-flight move by moving back to the pre-move set."""
+        from ..cluster.controller import TopicError
+        from .server import _topic_error_code
+
+        if not self.server.authorize(
+            AclOperation.alter, AclResourceType.cluster, "kafka-cluster"
+        ):
+            return Msg(
+                throttle_time_ms=0,
+                error_code=int(ErrorCode.cluster_authorization_failed),
+                error_message=None,
+                responses=[],
+            )
+        table = self.controller.topic_table
+        out = []
+        for t in req.topics:
+            parts = []
+            for p in t.partitions:
+                code, message = 0, None
+                ntp = kafka_ntp(t.name, p.partition_index)
+                try:
+                    if p.replicas is not None:
+                        await self.controller.move_partition_replicas(
+                            t.name, p.partition_index, [int(r) for r in p.replicas]
+                        )
+                    else:
+                        prev = table.updates_in_progress.get(ntp)
+                        if prev is None:
+                            code = int(ErrorCode.no_reassignment_in_progress)
+                        else:
+                            await self.controller.move_partition_replicas(
+                                t.name, p.partition_index, list(prev)
+                            )
+                except TopicError as e:
+                    code, message = _topic_error_code(e.code), e.message
+                except TimeoutError:
+                    code = int(ErrorCode.request_timed_out)
+                parts.append(
+                    Msg(
+                        partition_index=p.partition_index,
+                        error_code=code,
+                        error_message=message,
+                    )
+                )
+            out.append(Msg(name=t.name, partitions=parts))
+        return Msg(
+            throttle_time_ms=0,
+            error_code=0,
+            error_message=None,
+            responses=out,
+        )
+
+    async def list_partition_reassignments(self, hdr, req) -> Msg:
+        """ListPartitionReassignments: the replicated
+        updates_in_progress view names every converging move; adding/
+        removing are the deltas vs the pre-move set."""
+        if not self.server.authorize(
+            AclOperation.describe, AclResourceType.cluster, "kafka-cluster"
+        ):
+            return Msg(
+                throttle_time_ms=0,
+                error_code=int(ErrorCode.cluster_authorization_failed),
+                error_message=None,
+                topics=[],
+            )
+        table = self.controller.topic_table
+        wanted: dict[str, set[int]] | None = None
+        if req.topics is not None:
+            wanted = {t.name: set(t.partition_indexes) for t in req.topics}
+        by_topic: dict[str, list[Msg]] = {}
+        for ntp, prev in sorted(
+            table.updates_in_progress.items(), key=lambda kv: str(kv[0])
+        ):
+            if ntp.ns != DEFAULT_NS:
+                continue
+            if wanted is not None:
+                sel = wanted.get(ntp.topic)
+                if sel is None or (sel and ntp.partition not in sel):
+                    continue
+            md = table.get(TopicNamespace(ntp.ns, ntp.topic))
+            if md is None or ntp.partition not in md.assignments:
+                continue
+            cur = md.assignments[ntp.partition].replicas
+            by_topic.setdefault(ntp.topic, []).append(
+                Msg(
+                    partition_index=ntp.partition,
+                    replicas=list(cur),
+                    adding_replicas=[r for r in cur if r not in prev],
+                    removing_replicas=[r for r in prev if r not in cur],
+                )
+            )
+        return Msg(
+            throttle_time_ms=0,
+            error_code=0,
+            error_message=None,
+            topics=[
+                Msg(name=t, partitions=parts) for t, parts in by_topic.items()
+            ],
+        )
+
+    async def describe_producers(self, hdr, req) -> Msg:
+        """DescribeProducers (handlers/describe_producers.cc): the
+        partition leader reports its producer-state table plus each
+        producer's open-transaction start offset from the tx tracker."""
+        broker = self.server.broker
+        out_topics = []
+        for t in req.topics:
+            parts = []
+            authorized = self.server.authorize(
+                AclOperation.read, AclResourceType.topic, t.name
+            )
+            for pid_idx in t.partition_indexes:
+                ntp = kafka_ntp(t.name, pid_idx)
+                if not authorized:
+                    parts.append(
+                        Msg(
+                            partition_index=pid_idx,
+                            error_code=int(ErrorCode.topic_authorization_failed),
+                            error_message=None,
+                            active_producers=[],
+                        )
+                    )
+                    continue
+                p = broker.partition_manager.get(ntp)
+                if p is None or not p.is_leader:
+                    parts.append(
+                        Msg(
+                            partition_index=pid_idx,
+                            error_code=int(ErrorCode.not_leader_for_partition),
+                            error_message=None,
+                            active_producers=[],
+                        )
+                    )
+                    continue
+                producers = []
+                for pid, epoch, last_seq in p.producers.snapshot():
+                    open_tx = p.tx.open.get(pid)
+                    producers.append(
+                        Msg(
+                            producer_id=pid,
+                            producer_epoch=epoch,
+                            last_sequence=last_seq,
+                            last_timestamp=-1,
+                            coordinator_epoch=-1,
+                            current_txn_start_offset=(
+                                open_tx[1] if open_tx is not None else -1
+                            ),
+                        )
+                    )
+                parts.append(
+                    Msg(
+                        partition_index=pid_idx,
+                        error_code=0,
+                        error_message=None,
+                        active_producers=producers,
+                    )
+                )
+            out_topics.append(Msg(name=t.name, partitions=parts))
+        return Msg(throttle_time_ms=0, topics=out_topics)

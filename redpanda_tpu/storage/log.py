@@ -392,6 +392,11 @@ class Log:
 
         return compact_log(self, max_offset, visible)
 
+    def size_bytes(self) -> int:
+        """On-disk bytes across all segments (disk_log_impl size probe;
+        DescribeLogDirs partition_size)."""
+        return sum(s.size_bytes() for s in self._segments)
+
     def segment_count(self) -> int:
         return len(self._segments)
 
