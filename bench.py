@@ -20,8 +20,11 @@ benchmarks so BENCH_r*.json tracks them round over round:
                the 50 ms heartbeat interval the tick leaves free.
   crc        — device record-batch CRC32C GB/s vs the host native
                path (north-star #1 axis; see ops/crc32c.py).
+  device_lz4 — batched cell-parallel LZ4 block compression GB/s vs
+               host liblz4 (north-star #1 codec axis; ops/lz4.py).
 
-Usage: python bench.py [--only quorum|live_tick|crc] [--skip-extras]
+Usage: python bench.py [--only quorum|live_tick|crc|device_lz4|codec|broker]
+       [--skip-extras]
 """
 
 from __future__ import annotations
@@ -243,13 +246,61 @@ def bench_crc() -> dict:
     }
 
 
+def bench_device_lz4() -> dict:
+    """Device LZ4 (the codec half of north-star #1, >=10x target):
+    batched cell-parallel LZ4 block compression (ops/lz4.py) vs host
+    liblz4 on the same redpanda-like payload. Output blocks are
+    standard LZ4 — the ratio column is the device parse's cost for
+    being parallel."""
+    import jax
+    import jax.numpy as jnp
+
+    from redpanda_tpu.compression import lz4_codec
+    from redpanda_tpu.ops.lz4 import CELL, _compress_chunks
+
+    B, N = 16, 65536
+    payload = b'{"key":"user-000001","topic":"orders","seq":12345,"flag":true},'
+    buf = (payload * (N // len(payload) + 1))[:N]
+    batch = np.zeros((B, N + CELL), np.uint8)
+    batch[:, :N] = np.frombuffer(buf, np.uint8)
+    valid = jnp.asarray(np.full(B, N, np.int32))
+    db = jnp.asarray(batch)
+    total = B * N
+
+    out, out_len = _compress_chunks(db, valid, N)  # compile
+    jax.block_until_ready(out)
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out, out_len = _compress_chunks(db, valid, N)
+    jax.block_until_ready(out)
+    dev_gbps = total / ((time.perf_counter() - t0) / iters) / 1e9
+
+    host_iters = 5
+    t0 = time.perf_counter()
+    for _ in range(host_iters):
+        for _ in range(B):
+            host_c = lz4_codec.compress_block(buf)
+    host_gbps = total / ((time.perf_counter() - t0) / host_iters) / 1e9
+
+    dev_c = np.asarray(out)[0, : int(np.asarray(out_len)[0])].tobytes()
+    assert lz4_codec.decompress_block(dev_c, N) == buf
+    return {
+        "metric": "lz4_compress_device_gbps",
+        "value": round(dev_gbps, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(dev_gbps / host_gbps, 2),
+        "host_gbps": round(host_gbps, 2),
+        "device_ratio": round(len(dev_c) / N, 4),
+        "host_ratio": round(len(host_c) / N, 4),
+    }
+
+
 def bench_codec() -> dict:
-    """Record-batch compress/decompress throughput (the codec half of
-    north-star #1; mirror of src/v/compression/tests zstd_stream_bench).
-    LZ match-finding is branchy byte-chasing — the one workload class
-    the design deliberately KEEPS on host (SURVEY §3): the TPU earns
-    its keep by taking CRC validation (114x host, see crc extra) off
-    the same core that runs the codec."""
+    """Host zstd compress/decompress throughput (mirror of
+    src/v/compression/tests zstd_stream_bench). zstd's FSE/huffman
+    entropy stages stay host-side; the device codec path is LZ4
+    (bench device_lz4)."""
     from redpanda_tpu.compression import CompressionType, compress, uncompress
 
     rng = np.random.default_rng(0)
@@ -397,6 +448,7 @@ BENCHES = {
     "quorum": bench_quorum,
     "live_tick": bench_live_tick,
     "crc": bench_crc,
+    "device_lz4": bench_device_lz4,
     "codec": bench_codec,
     "broker": bench_broker,
 }
@@ -423,7 +475,7 @@ def main() -> None:
         import subprocess
 
         extra = {}
-        for name in ("crc", "codec", "live_tick", "broker"):
+        for name in ("crc", "device_lz4", "codec", "live_tick", "broker"):
             try:
                 proc = subprocess.run(
                     [sys.executable, __file__, "--only", name],

@@ -130,13 +130,10 @@ class TopicTable:
         old = list(a.replicas)
         a.replicas = new
         ntp = NTP(cmd.ns, cmd.topic, a.partition)
-        # a move issued while another is converging (e.g. a cancel)
-        # keeps the ORIGINAL pre-move set as its rollback target only
-        # if it does not complete a round trip back to it
-        if self.updates_in_progress.get(ntp) == new:
-            self.updates_in_progress.pop(ntp)
-        else:
-            self.updates_in_progress.setdefault(ntp, old)
+        # the entry lives until finish_move: even a cancel (move back
+        # to the original set) is still a converging reconfiguration,
+        # and balancers bound cluster-wide concurrency on this map
+        self.updates_in_progress.setdefault(ntp, old)
         self._pending_deltas.append(
             Delta("move", ntp, a.group, new, old_replicas=old)
         )

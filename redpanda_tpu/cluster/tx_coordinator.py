@@ -492,21 +492,26 @@ class TxCoordinator:
             return None, int(_E.not_coordinator)
         meta = shard.get(tx_id)
         if meta is None:
-            return None, int(_E.invalid_producer_id_mapping)
+            return None, int(_E.transactional_id_not_found)
         return meta, 0
 
-    async def list_local_txs(self) -> list[TxMeta]:
-        """Every transaction coordinated by partitions this broker
-        leads (tx_gateway_frontend.cc get_all_transactions)."""
+    async def list_local_txs(self) -> tuple[list[TxMeta], bool]:
+        """(transactions, complete) over partitions this broker leads
+        (tx_gateway_frontend.cc get_all_transactions). complete=False
+        when a led partition is still hydrating — callers must answer
+        COORDINATOR_LOAD_IN_PROGRESS rather than a silently partial
+        list."""
         out: list[TxMeta] = []
+        complete = True
         for pid in range(self.n_partitions):
             try:
                 if not await self.ensure_replayed_pid(pid):
                     continue
             except asyncio.TimeoutError:
+                complete = False
                 continue
             out.extend(self._txs.get(pid, {}).values())
-        return out
+        return out, complete
 
     async def init_producer_id(
         self, tx_id: str, timeout_ms: int

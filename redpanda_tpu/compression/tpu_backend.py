@@ -1,0 +1,87 @@
+"""The `backend=tpu` codec: device-batched LZ4 behind the registry.
+
+Reference seam: src/v/compression/compression.cc gates codecs by type;
+the north star adds a device backend slot (BASELINE.md ≥10× CRC+
+compress GB/s). `enable()` registers an LZ4 compressor whose blocks
+are produced by the XLA kernel in ops/lz4.py and wrapped into a
+standard LZ4 frame (64 KiB independent blocks), so ANY consumer —
+including external Kafka clients and the host path with the backend
+disabled — decodes it with plain liblz4. Decompression stays on the
+host (frame parsing is branchy byte work the VPU hates; the win is
+the compress side, which dominates archival/produce recompression).
+
+`compress_many` is the real batched entry: it flattens every 64 KiB
+chunk of every buffer into one padded device batch, runs ONE program,
+and reassembles frames — amortizing dispatch exactly like the batched
+CRC validator (ops/crc32c.py).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from . import lz4_codec
+
+_MAGIC = 0x184D2204
+_BLOCK = 65536  # BD byte 4: 64 KiB max block, fits 16-bit lz4 offsets
+
+
+def _frame_header() -> bytes:
+    import xxhash
+
+    flg = (1 << 6) | (1 << 5)  # v1, block-independent, no content checksum
+    bd = 4 << 4  # 64 KiB max block size
+    desc = bytes([flg, bd])
+    hc = (xxhash.xxh32(desc, seed=0).intdigest() >> 8) & 0xFF
+    return struct.pack("<I", _MAGIC) + desc + bytes([hc])
+
+
+def _assemble_frame(chunks: list[bytes], blocks: list[bytes]) -> bytes:
+    out = bytearray(_frame_header())
+    for raw, comp in zip(chunks, blocks):
+        if len(comp) >= len(raw):
+            out += struct.pack("<I", len(raw) | 0x80000000) + raw
+        else:
+            out += struct.pack("<I", len(comp)) + comp
+    out += struct.pack("<I", 0)  # end mark
+    return bytes(out)
+
+
+def _split(data: bytes) -> list[bytes]:
+    return [data[o : o + _BLOCK] for o in range(0, len(data), _BLOCK)] or [b""]
+
+
+def compress(data: bytes) -> bytes:
+    """Single-buffer entry used behind the registry slot."""
+    return compress_many([data])[0]
+
+
+def compress_many(buffers: list[bytes]) -> list[bytes]:
+    """Batch-compress buffers into LZ4 frames with ONE device program
+    over all of their 64 KiB chunks."""
+    from ..ops.lz4 import compress_chunks
+
+    plan: list[list[bytes]] = [_split(b) for b in buffers]
+    flat = [c for chunks in plan for c in chunks if c]
+    compressed = iter(compress_chunks(flat))
+    out = []
+    for chunks in plan:
+        blocks = [next(compressed) if c else b"" for c in chunks]
+        out.append(_assemble_frame([c for c in chunks if c], [b for b in blocks if b]))
+    return out
+
+
+def enable() -> None:
+    """Register the device LZ4 compressor; uncompress stays host-side
+    (the emitted frames are standard, so liblz4 reads them)."""
+    from . import CompressionType, register_backend
+
+    register_backend(
+        CompressionType.lz4, compress, lz4_codec.decompress_frame
+    )
+
+
+def disable() -> None:
+    from . import clear_backend
+
+    clear_backend()

@@ -89,6 +89,7 @@ class ErrorCode(enum.IntEnum):
     sasl_authentication_failed = 58
     no_reassignment_in_progress = 85
     producer_fenced = 90
+    transactional_id_not_found = 105
 
 
 @dataclasses.dataclass(slots=True)

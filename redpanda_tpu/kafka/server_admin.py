@@ -861,12 +861,18 @@ class AdminHandlers:
             if md is None or ntp.partition not in md.assignments:
                 continue
             cur = md.assignments[ntp.partition].replicas
+            adding = [r for r in cur if r not in prev]
+            removing = [r for r in prev if r not in cur]
+            if not adding and not removing:
+                continue  # a cancel converging back: nothing to report
+            # KIP-455: replicas is the FULL current set — target union
+            # the replicas still being dropped
             by_topic.setdefault(ntp.topic, []).append(
                 Msg(
                     partition_index=ntp.partition,
-                    replicas=list(cur),
-                    adding_replicas=[r for r in cur if r not in prev],
-                    removing_replicas=[r for r in prev if r not in cur],
+                    replicas=list(cur) + removing,
+                    adding_replicas=adding,
+                    removing_replicas=removing,
                 )
             )
         return Msg(
@@ -896,6 +902,19 @@ class AdminHandlers:
                         Msg(
                             partition_index=pid_idx,
                             error_code=int(ErrorCode.topic_authorization_failed),
+                            error_message=None,
+                            active_producers=[],
+                        )
+                    )
+                    continue
+                md = self.controller.topic_table.get(ntp.tp_ns)
+                if md is None or ntp.partition not in md.assignments:
+                    parts.append(
+                        Msg(
+                            partition_index=pid_idx,
+                            error_code=int(
+                                ErrorCode.unknown_topic_or_partition
+                            ),
                             error_message=None,
                             active_producers=[],
                         )

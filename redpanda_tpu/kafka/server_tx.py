@@ -220,8 +220,16 @@ class TxHandlers:
         state_filters = set(req.state_filters or [])
         unknown = sorted(state_filters - valid_states)
         pid_filters = set(req.producer_id_filters or [])
+        metas, complete = await self.tx.list_local_txs()
+        if not complete:
+            return Msg(
+                throttle_time_ms=0,
+                error_code=int(ErrorCode.coordinator_load_in_progress),
+                unknown_state_filters=unknown,
+                transaction_states=[],
+            )
         rows = []
-        for meta in await self.tx.list_local_txs():
+        for meta in metas:
             if not self.server.authorize(
                 AclOperation.describe,
                 AclResourceType.transactional_id,

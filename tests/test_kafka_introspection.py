@@ -190,11 +190,20 @@ def test_reassignment_bookkeeping():
     move = MoveReplicasCmd(ns="kafka", topic="t", partition=0, replicas=[1])
     t.apply(CmdType.move_replicas, move, 2)
     assert t.updates_in_progress[ntp] == [0]
-    # cancel = move back to the original set -> no longer in progress
+    # cancel = move back to the original set: STILL converging (the
+    # balancer concurrency bound holds until finish_move lands)
     back = MoveReplicasCmd(ns="kafka", topic="t", partition=0, replicas=[0])
     t.apply(CmdType.move_replicas, back, 3)
-    assert ntp not in t.updates_in_progress
+    assert t.updates_in_progress[ntp] == [0]
     assert t.get(ntp.tp_ns).assignments[0].replicas == [0]
+    from redpanda_tpu.cluster.commands import FinishMoveCmd
+
+    t.apply(
+        CmdType.finish_move,
+        FinishMoveCmd(ns="kafka", topic="t", partition=0, replicas=[0]),
+        4,
+    )
+    assert ntp not in t.updates_in_progress
 
     # topic deletion mid-move clears the entry and keeps the dict shape
     # (further moves must still apply)
