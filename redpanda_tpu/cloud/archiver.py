@@ -165,6 +165,9 @@ class ArchivalService:
         self._task = asyncio.ensure_future(self._loop())
 
     async def stop(self) -> None:
+        # cancel every in-flight upload retry loop (retry_chain root
+        # abort), then the scheduler task
+        self.store.abort()
         if self._task is not None:
             self._task.cancel()
             try:

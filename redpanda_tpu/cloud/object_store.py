@@ -126,30 +126,47 @@ class FilesystemObjectStore:
 
 
 class RetryingStore:
-    """Exp-backoff retry wrapper (cloud_storage/remote.h retry_chain):
-    every operation retries transient StoreErrors with jittered
-    backoff before surfacing the failure."""
+    """Exp-backoff retry wrapper (cloud_storage/remote.h over
+    utils/retry_chain_node.h): every operation runs under a child of
+    the store's retry-chain root, so transient StoreErrors back off
+    with jitter, per-op deadlines bound total retry time, and
+    `abort()` (archiver shutdown) cancels every in-flight retry loop
+    at once."""
 
     def __init__(
         self,
         inner: ObjectStore,
         attempts: int = 4,
         base_backoff_s: float = 0.05,
+        op_deadline_s: float | None = None,
     ):
+        from ..utils.retry_chain import RetryChainNode
+
         self._inner = inner
         self._attempts = attempts
-        self._base = base_backoff_s
+        self._chain = RetryChainNode(base_backoff_s=base_backoff_s)
+        self._op_deadline = op_deadline_s
+
+    def abort(self) -> None:
+        self._chain.abort()
 
     async def _retry(self, op, *args):
-        delay = self._base
-        for attempt in range(self._attempts):
-            try:
-                return await op(*args)
-            except StoreError:
-                if attempt == self._attempts - 1:
-                    raise
-                await asyncio.sleep(delay * (0.5 + random.random()))
-                delay *= 2
+        from ..utils.retry_chain import RetryChainAborted
+
+        node = self._chain.child(deadline_s=self._op_deadline)
+        try:
+            for attempt in range(self._attempts):
+                node.check_abort()
+                try:
+                    return await op(*args)
+                except StoreError:
+                    if attempt == self._attempts - 1:
+                        raise
+                    if not await node.backoff():
+                        raise
+        except RetryChainAborted:
+            # callers handle store unavailability, not chain internals
+            raise StoreError("aborted (shutdown)") from None
 
     async def put(self, key: str, data: bytes) -> None:
         await self._retry(self._inner.put, key, data)

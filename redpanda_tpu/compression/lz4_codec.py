@@ -14,7 +14,7 @@ import ctypes
 import ctypes.util
 import struct
 
-import xxhash
+from ..utils.hash import xxh32_fast as xxh32
 
 _MAGIC = 0x184D2204
 _MAX_BLOCK = 4 << 20  # BD code 7 → 4 MB blocks
@@ -75,7 +75,7 @@ def compress_frame(data: bytes) -> bytes:
     flg = (1 << 6) | (1 << 5) | (1 << 2)  # v1, block-independent, content-checksum
     bd = 7 << 4  # 4 MB max block
     desc = bytes([flg, bd])
-    hc = (xxhash.xxh32(desc, seed=0).intdigest() >> 8) & 0xFF
+    hc = (xxh32(desc) >> 8) & 0xFF
     out += desc + bytes([hc])
     for off in range(0, len(data), _MAX_BLOCK):
         chunk = data[off : off + _MAX_BLOCK]
@@ -87,7 +87,7 @@ def compress_frame(data: bytes) -> bytes:
             out += struct.pack("<I", len(comp))
             out += comp
     out += struct.pack("<I", 0)  # end mark
-    out += struct.pack("<I", xxhash.xxh32(data, seed=0).intdigest())
+    out += struct.pack("<I", xxh32(data))
     return bytes(out)
 
 
@@ -110,7 +110,7 @@ def decompress_frame(data: bytes) -> bytes:
     desc_len = 2 + (8 if content_size_present else 0) + (4 if dict_id else 0)
     desc = data[pos : pos + desc_len]
     hc = data[pos + desc_len]
-    if ((xxhash.xxh32(desc, seed=0).intdigest() >> 8) & 0xFF) != hc:
+    if ((xxh32(desc) >> 8) & 0xFF) != hc:
         raise ValueError("lz4 frame header checksum mismatch")
     pos += desc_len + 1
     max_block = 1 << (8 + 2 * ((bd >> 4) & 0x7))
@@ -127,7 +127,7 @@ def decompress_frame(data: bytes) -> bytes:
         if block_checksum:
             (bc,) = struct.unpack_from("<I", data, pos)
             pos += 4
-            if xxhash.xxh32(block, seed=0).intdigest() != bc:
+            if xxh32(block) != bc:
                 raise ValueError("lz4 block checksum mismatch")
         if is_uncompressed:
             chunks.append(block)
@@ -136,6 +136,6 @@ def decompress_frame(data: bytes) -> bytes:
     result = b"".join(chunks)
     if content_checksum:
         (cc,) = struct.unpack_from("<I", data, pos)
-        if xxhash.xxh32(result, seed=0).intdigest() != cc:
+        if xxh32(result) != cc:
             raise ValueError("lz4 content checksum mismatch")
     return result

@@ -27,12 +27,12 @@ _BLOCK = 65536  # BD byte 4: 64 KiB max block, fits 16-bit lz4 offsets
 
 
 def _frame_header() -> bytes:
-    import xxhash
+    from ..utils.hash import xxh32
 
     flg = (1 << 6) | (1 << 5)  # v1, block-independent, no content checksum
     bd = 4 << 4  # 64 KiB max block size
     desc = bytes([flg, bd])
-    hc = (xxhash.xxh32(desc, seed=0).intdigest() >> 8) & 0xFF
+    hc = (xxh32(desc) >> 8) & 0xFF
     return struct.pack("<I", _MAGIC) + desc + bytes([hc])
 
 

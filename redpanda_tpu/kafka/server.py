@@ -134,6 +134,17 @@ class KafkaServer:
         self._latency_hist = broker.metrics.histogram(
             "kafka_handler_seconds", "Kafka handler latency"
         )
+        # hdr_hist quantiles (latency_probe.h): bounded-relative-error
+        # percentiles the log2 Prometheus buckets cannot resolve
+        from ..utils.hdr_hist import HdrHist
+
+        self._latency_hdr = HdrHist()  # microseconds, 1us..60s
+        for q in (50, 99, 99.9):
+            broker.metrics.gauge(
+                f"kafka_request_latency_p{str(q).replace('.', '_')}_us",
+                lambda q=q: self._latency_hdr.value_at_percentile(q),
+                f"Kafka handler latency p{q} (us, hdr_hist)",
+            )
         from .fetch_session import FetchSessionCache
         from .quotas import QuotaManager
 
@@ -328,9 +339,9 @@ class KafkaServer:
             finally:
                 CURRENT_PRINCIPAL.reset(token)
                 self._req_counter.inc(api=api.name)
-                self._latency_hist.observe(
-                    asyncio.get_event_loop().time() - t0
-                )
+                elapsed = asyncio.get_event_loop().time() - t0
+                self._latency_hist.observe(elapsed)
+                self._latency_hdr.record(int(elapsed * 1e6))
         if asyncio.iscoroutine(resp):
             # staged handler (produce): dispatch done, response later —
             # encode when it settles, off the reader path
