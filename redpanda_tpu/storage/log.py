@@ -134,9 +134,22 @@ class Log:
                 found.append((int(base), int(term)))
         for base, term in sorted(found):
             seg = Segment(self._dir, base, term)
-            if seg.dirty_offset < seg.base_offset and self._segments:
-                # empty tail segment: keep only if it is the active head
-                pass
+            if self._segments and self._segments[-1].base_offset == base:
+                # two files share a base (crash between creating a
+                # replacement for an empty placeholder and unlinking
+                # it): the empty one is the stale placeholder — keep
+                # whichever holds data, preferring the later term on a
+                # tie of two empties
+                prev = self._segments[-1]
+                if seg.dirty_offset < seg.base_offset and (
+                    prev.dirty_offset >= prev.base_offset
+                ):
+                    seg.close()
+                    seg.remove_files()
+                    continue
+                prev.close()
+                prev.remove_files()
+                self._segments.pop()
             self._segments.append(seg)
 
     # -- offsets -----------------------------------------------------
@@ -218,8 +231,19 @@ class Log:
                 and seg.size_bytes() < self.config.segment_max_bytes
             ):
                 return seg
-            if seg.dirty_offset < seg.base_offset and seg.term == term:
-                return seg  # empty segment, reuse
+            if seg.dirty_offset < seg.base_offset:
+                if seg.term == term:
+                    return seg  # empty segment, reuse
+                # an empty placeholder (post-truncation boundary) being
+                # appended to at a different term: REPLACE it — two
+                # same-base segment files with different terms would
+                # shadow each other after recovery
+                seg.close()
+                seg.remove_files()
+                self._segments.pop()
+                new = Segment(self._dir, seg.base_offset, term)
+                self._segments.append(new)
+                return new
             seg.flush()
             seg.persist_index()
         base = self.offsets().dirty_offset + 1
@@ -299,12 +323,30 @@ class Log:
     # -- truncation --------------------------------------------------
     def truncate(self, offset: int) -> None:
         """Remove everything at-or-after offset (suffix truncation)."""
+        if not self._segments:
+            return
+        start = self._segments[0].base_offset
+        last_term = self._segments[-1].term
         while self._segments and self._segments[-1].base_offset >= offset:
             seg = self._segments.pop()
+            last_term = seg.term
             seg.close()
             seg.remove_files()
         if self._segments:
             self._segments[-1].truncate(offset)
+        else:
+            # Full-suffix truncation must not forget where the log is
+            # positioned: an empty log after prefix truncation still
+            # starts at `start`, not 0 (the install_snapshot_reset
+            # representation: one empty segment at the boundary).
+            # Reaching here implies offset <= start (the first
+            # segment's base was >= offset); the base stays `start` so
+            # appends can never land below the snapshotted boundary.
+            # The placeholder's term is the deleted suffix's term — an
+            # upper bound on the true prev term, which can only make
+            # this node DENY votes it could have granted (safe) until
+            # the leader's replacement entries land.
+            self._reset_to(start, max(last_term, 0))
         if self._cache_index is not None:
             self._cache_index.truncate(offset)
         for fn in self.on_truncate:
@@ -328,6 +370,14 @@ class Log:
             for fn in self.on_prefix_truncate:
                 fn(new_start)
 
+    def _reset_to(self, base: int, term: int) -> None:
+        """Restart the log as ONE empty segment positioned at `base`
+        (shared by full-suffix truncation and install_snapshot_reset)."""
+        for seg in self._segments:
+            seg.close()
+            seg.remove_files()
+        self._segments = [Segment(self._dir, base, term)]
+
     def install_snapshot_reset(self, next_offset: int, term: int) -> None:
         """Drop the ENTIRE log and restart it empty at next_offset —
         the follower install_snapshot path (raft snapshot replaces the
@@ -336,10 +386,7 @@ class Log:
         on_truncate/on_prefix_truncate: the caller restores derived
         state (offset translator, producer table) from the snapshot
         payload instead of replaying."""
-        for seg in self._segments:
-            seg.close()
-            seg.remove_files()
-        self._segments = [Segment(self._dir, next_offset, max(term, 0))]
+        self._reset_to(next_offset, max(term, 0))
         if self._cache_index is not None:
             self._cache_index.truncate(0)
 
