@@ -29,6 +29,11 @@ from .iobuf import IOBufParser
 class SerdeType(NamedTuple):
     encode: Callable[[bytearray, Any], None]
     decode: Callable[[IOBufParser], Any]
+    # structural descriptor for generic tooling (the compat corpus
+    # generator, schema dumps): ("fixed", fmt) | ("bool",) | ("bytes",)
+    # | ("string",) | ("optional", t) | ("vector", t)
+    # | ("mapping", kt, vt) | ("envelope", cls)
+    spec: Any = None
 
 
 def _fixed(fmt: str) -> SerdeType:
@@ -40,7 +45,7 @@ def _fixed(fmt: str) -> SerdeType:
     def dec(p: IOBufParser) -> Any:
         return s.unpack(p.read(s.size))[0]
 
-    return SerdeType(enc, dec)
+    return SerdeType(enc, dec, ("fixed", fmt))
 
 
 i8 = _fixed("<b")
@@ -62,7 +67,7 @@ def _dec_bool(p: IOBufParser) -> bool:
     return p.read(1)[0] != 0
 
 
-boolean = SerdeType(_enc_bool, _dec_bool)
+boolean = SerdeType(_enc_bool, _dec_bool, ("bool",))
 
 
 def _enc_bytes(out: bytearray, v: bytes) -> None:
@@ -75,11 +80,12 @@ def _dec_bytes(p: IOBufParser) -> bytes:
     return p.read(n)
 
 
-bytes_t = SerdeType(_enc_bytes, _dec_bytes)
+bytes_t = SerdeType(_enc_bytes, _dec_bytes, ("bytes",))
 
 string = SerdeType(
     lambda out, v: _enc_bytes(out, v.encode("utf-8")),
     lambda p: _dec_bytes(p).decode("utf-8"),
+    ("string",),
 )
 
 
@@ -94,7 +100,7 @@ def optional(t: SerdeType) -> SerdeType:
     def dec(p: IOBufParser) -> Any:
         return t.decode(p) if p.read(1)[0] else None
 
-    return SerdeType(enc, dec)
+    return SerdeType(enc, dec, ("optional", t))
 
 
 _FIXED_FMT = {}  # SerdeType -> struct letter, filled after the fixed defs
@@ -124,7 +130,7 @@ def vector(t: SerdeType) -> SerdeType:
             # frombuffer+tolist: one C pass, no per-item struct calls
             return np.frombuffer(p.read(n * item.size), np_dtype).tolist()
 
-        return SerdeType(enc_fast, dec_fast)
+        return SerdeType(enc_fast, dec_fast, ("vector", t))
 
     def enc(out: bytearray, v: Any) -> None:
         out += struct.pack("<I", len(v))
@@ -135,7 +141,7 @@ def vector(t: SerdeType) -> SerdeType:
         (n,) = struct.unpack("<I", p.read(4))
         return [t.decode(p) for _ in range(n)]
 
-    return SerdeType(enc, dec)
+    return SerdeType(enc, dec, ("vector", t))
 
 
 _FIXED_FMT.update(
@@ -164,7 +170,7 @@ def mapping(kt: SerdeType, vt: SerdeType) -> SerdeType:
         (n,) = struct.unpack("<I", p.read(4))
         return {kt.decode(p): vt.decode(p) for _ in range(n)}
 
-    return SerdeType(enc, dec)
+    return SerdeType(enc, dec, ("mapping", kt, vt))
 
 
 class SerdeError(ValueError):
@@ -239,6 +245,7 @@ class Envelope:
         return SerdeType(
             lambda out, v: out.extend(v.encode()),
             lambda p: cls.decode(p),
+            ("envelope", cls),
         )
 
     def __eq__(self, other: object) -> bool:
