@@ -77,6 +77,7 @@ class AdminServer(HttpServer):
         r("POST", r"/v1/debug/fault_injection", self._fault_injection)
         r("DELETE", r"/v1/debug/fault_injection", self._fault_clear)
         r("POST", r"/v1/debug/self_test", self._self_test)
+        r("GET", r"/v1/debug/scheduler", self._scheduler_stats)
         r("GET", r"/v1/features", self._features)
         r("GET", r"/metrics", self._metrics)
 
@@ -445,6 +446,11 @@ class AdminServer(HttpServer):
 
     async def _features(self, _m, _q, _b):
         return self.broker.controller.features.snapshot()
+
+    async def _scheduler_stats(self, _m, _q, _b):
+        """Per-group shares/queue/consumption of the background
+        weighted-fair scheduler (resource_mgmt)."""
+        return self.broker.scheduler.stats()
 
     async def _metrics(self, _m, _q, _b):
         return self.broker.metrics.render()

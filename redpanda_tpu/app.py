@@ -168,6 +168,12 @@ class Broker:
         self.admin = AdminServer(
             self, config.admin_host, config.admin_port
         ) if config.enable_admin else None
+        # weighted-fair scheduling groups for background work
+        # (resource_mgmt/cpu_scheduling.h shares): compaction/archival
+        # units interleave instead of monopolizing the event loop
+        from .resource_mgmt import FairScheduler
+
+        self.scheduler = FairScheduler()
         self.archival = None
         self.remote_reader = None
         if self.object_store is not None:
@@ -179,6 +185,7 @@ class Broker:
                 partitions=self.partition_manager.partitions,
                 topic_table=self.controller.topic_table,
                 interval_s=config.archival_interval_s,
+                sched_group=self.scheduler.group("archival"),
             )
             self.remote_reader = RemoteReader(RetryingStore(self.object_store))
             self.controller.on_partition_added = self._maybe_recover_partition
@@ -333,6 +340,7 @@ class Broker:
 
     # -- lifecycle ---------------------------------------------------
     async def start(self) -> None:
+        self.scheduler.start()
         for svc in (
             self.group_manager.service,
             self.controller.service,
@@ -406,15 +414,34 @@ class Broker:
 
     async def _housekeeping_loop(self) -> None:
         """Periodic retention + compaction sweep (log_manager.h:228-244
-        housekeeping timer). Runs ON the event loop: segment state is
-        mutated by concurrent appends/rolls, and single-threading is the
-        synchronization model everywhere else in this runtime."""
+        housekeeping timer). Each log's pass is ONE unit through the
+        `compaction` scheduling group: the sweep no longer blocks the
+        event loop for all partitions at once, and competing background
+        groups interleave by their shares."""
+        import time as _time
+
+        group = self.scheduler.group("compaction")
         while True:
             await asyncio.sleep(self.config.housekeeping_interval_s)
-            try:
-                self.storage.log_mgr.housekeeping()
-            except Exception:
-                logging.getLogger("app").exception("housekeeping pass failed")
+            now_ms = int(_time.time() * 1000)
+            for ntp, log in self.storage.log_mgr.logs().items():
+
+                async def unit(ntp=ntp, log=log):
+                    # the sweep awaits between units: a partition
+                    # deleted mid-sweep must not get a retention pass
+                    # on its closed, file-deleted log
+                    if self.storage.log_mgr.get(ntp) is not log:
+                        return
+                    self.storage.log_mgr.housekeeping_one(log, now_ms)
+
+                try:
+                    await group.run(unit)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logging.getLogger("app").exception(
+                        "housekeeping pass failed"
+                    )
 
     async def stop(self) -> None:
         if not self._started:
@@ -451,6 +478,7 @@ class Broker:
         await self.group_coordinator.stop()
         await self.controller.stop()
         await self.group_manager.stop()
+        await self.scheduler.stop()
         await self._conn_cache.close()
         if self._rpc_server is not None:
             await self._rpc_server.stop()

@@ -133,11 +133,13 @@ class ArchivalService:
         partitions: Callable[[], dict],  # ntp -> Partition
         topic_table,  # cluster.topic_table.TopicTable
         interval_s: float = 1.0,
+        sched_group=None,  # resource_mgmt.SchedulingGroup | None
     ):
         self.store = RetryingStore(store)
         self._partitions = partitions
         self._topic_table = topic_table
         self.interval_s = interval_s
+        self._sched_group = sched_group
         self._archivers: dict = {}
         # tp_ns -> uploaded (partition_count, rf, config) shape
         self._topic_manifests: dict = {}
@@ -189,8 +191,18 @@ class ArchivalService:
         for ntp, p in list(self._partitions().items()):
             if not self.remote_write_enabled(ntp.tp_ns):
                 continue
-            await self._ensure_topic_manifest(ntp.tp_ns)
-            total += await self.archiver_for(p).upload_pass()
+
+            async def unit(ntp=ntp, p=p) -> int:
+                await self._ensure_topic_manifest(ntp.tp_ns)
+                return await self.archiver_for(p).upload_pass()
+
+            # one partition's upload pass = one unit through the
+            # archival scheduling group (when wired): uploads share the
+            # loop fairly with compaction instead of racing it
+            if self._sched_group is not None:
+                total += await self._sched_group.run(unit)
+            else:
+                total += await unit()
         # drop archivers for partitions no longer hosted
         live = self._partitions()
         for ntp in list(self._archivers):

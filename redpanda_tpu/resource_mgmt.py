@@ -1,0 +1,213 @@
+"""Scheduling groups: weighted-fair CPU partitioning for background
+work (P6).
+
+Reference: src/v/resource_mgmt/cpu_scheduling.h:23-40 — Seastar
+scheduling groups with shares (admin=100, raft=1000, kafka=1000,
+cluster=300, compaction, archival, ...) keep maintenance work from
+starving the hot path. The asyncio re-imagining: latency-critical
+paths (raft ticks, kafka handlers) stay direct on the event loop, and
+the *background work* — compaction passes, retention sweeps, archival
+uploads, balancer planning — is split into awaitable UNITS submitted
+through weighted-fair group queues. Units within a group run serially
+(single-threading stays the synchronization model); DIFFERENT groups
+run concurrently, so an I/O-bound archival unit never head-of-line
+blocks a compaction unit. Fairness is enforced at unit START: each
+completion charges measured wall time / shares against the group's
+virtual time, and a group may only start while not ahead of the
+busiest competitor — so a group with 10x the shares gets 10x the
+units over any contended window, and the event loop yields between
+units instead of blocking for a whole all-partitions sweep.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from typing import Any, Awaitable, Callable
+
+logger = logging.getLogger("resource_mgmt")
+
+# the reference's share table (cpu_scheduling.h:23-40)
+DEFAULT_SHARES = {
+    "admin": 100,
+    "raft": 1000,
+    "kafka": 1000,
+    "cluster": 300,
+    "compaction": 100,
+    "archival": 100,
+    "recovery": 200,
+}
+
+_MIN_COST_S = 1e-6
+
+
+class SchedulingGroup:
+    def __init__(self, scheduler: "FairScheduler", name: str, shares: int):
+        self.scheduler = scheduler
+        self.name = name
+        self.shares = max(1, shares)
+        self.vtime = 0.0
+        self.queue: deque[tuple[Callable[[], Awaitable[Any]], asyncio.Future]] = (
+            deque()
+        )
+        # observability: cumulative wall seconds burned by this group
+        self.consumed_s = 0.0
+        self.units_run = 0
+        self.inflight: asyncio.Task | None = None  # at most one
+
+    def submit(self, fn: Callable[[], Awaitable[Any]]) -> asyncio.Future:
+        """Enqueue one unit; resolves with fn()'s result."""
+        return self.scheduler._submit(self, fn)
+
+    async def run(self, fn: Callable[[], Awaitable[Any]]) -> Any:
+        return await self.submit(fn)
+
+
+class FairScheduler:
+    """Deficit-style weighted-fair runner over scheduling groups."""
+
+    def __init__(self, shares: dict[str, int] | None = None):
+        self.groups: dict[str, SchedulingGroup] = {}
+        for name, s in (shares or DEFAULT_SHARES).items():
+            self.groups[name] = SchedulingGroup(self, name, s)
+        self._wakeup = asyncio.Event()
+        self._runner: asyncio.Task | None = None
+        self._stopped = False
+        # system virtual time: the vtime of the last unit run. A group
+        # activating after an idle spell is lifted to it, so it neither
+        # banks credit (monopolizing until others catch up) nor carries
+        # debt from a solo-run period (being locked out until the
+        # newcomer catches up) — classic WFQ virtual-clock restart.
+        self._vnow = 0.0
+
+    def group(self, name: str) -> SchedulingGroup:
+        return self.groups[name]
+
+    def add_group(self, name: str, shares: int) -> SchedulingGroup:
+        g = self.groups[name] = SchedulingGroup(self, name, shares)
+        return g
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> None:
+        if self._runner is None:
+            self._stopped = False
+            self._runner = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        self._wakeup.set()
+        if self._runner is not None:
+            self._runner.cancel()
+            try:
+                await self._runner
+            except asyncio.CancelledError:
+                pass
+            self._runner = None
+        # fail queued units so callers never hang on shutdown
+        for g in self.groups.values():
+            while g.queue:
+                _fn, fut = g.queue.popleft()
+                if not fut.done():
+                    fut.cancel()
+
+    # -- submission ---------------------------------------------------
+    def _vmin_other(self, group: SchedulingGroup) -> float | None:
+        """Minimum vtime over OTHER groups with queued or in-flight
+        work; None when this group is alone."""
+        vals = [
+            g.vtime
+            for g in self.groups.values()
+            if g is not group and (g.queue or g.inflight)
+        ]
+        return min(vals) if vals else None
+
+    def _submit(self, group: SchedulingGroup, fn) -> asyncio.Future:
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        if not group.queue and not group.inflight:
+            # activation lift: enter level with the busiest competitor
+            # (no banked credit) but never behind it (no banked debt)
+            floor = self._vmin_other(group)
+            group.vtime = max(
+                group.vtime, self._vnow if floor is None else floor
+            )
+        group.queue.append((fn, fut))
+        self._wakeup.set()
+        return fut
+
+    # -- the runner ---------------------------------------------------
+    async def _exec(self, g: SchedulingGroup, fn, fut) -> None:
+        t0 = time.perf_counter()
+        try:
+            result = await fn()
+        except asyncio.CancelledError:
+            if not fut.done():
+                fut.cancel()
+            raise
+        except Exception as e:
+            if not fut.done():
+                fut.set_exception(e)
+        else:
+            if not fut.done():
+                fut.set_result(result)
+        finally:
+            cost = max(time.perf_counter() - t0, _MIN_COST_S)
+            g.vtime += cost / g.shares
+            self._vnow = max(self._vnow, g.vtime)
+            g.consumed_s += cost
+            g.units_run += 1
+            g.inflight = None
+            self._wakeup.set()
+
+    async def _run(self) -> None:
+        """Dispatch loop: at most ONE in-flight unit per group (units
+        within a group stay serial — the single-threading model), but
+        DIFFERENT groups run concurrently, so an I/O-bound archival
+        unit can never head-of-line block a compaction unit. Fairness
+        is enforced at START time: a group may only start a unit while
+        its vtime is at the minimum over backlogged groups — a group
+        whose shares it has outrun waits for virtual time (i.e. other
+        groups' completions) to catch up."""
+        def eligible(g: SchedulingGroup) -> bool:
+            if not g.queue or g.inflight is not None:
+                return False
+            floor = self._vmin_other(g)
+            return floor is None or g.vtime <= floor
+
+        try:
+            while not self._stopped:
+                started = False
+                for g in sorted(
+                    self.groups.values(), key=lambda g: g.vtime
+                ):
+                    if eligible(g):
+                        fn, fut = g.queue.popleft()
+                        g.inflight = asyncio.ensure_future(
+                            self._exec(g, fn, fut)
+                        )
+                        started = True
+                if started:
+                    await asyncio.sleep(0)  # yield between dispatches
+                    continue
+                self._wakeup.clear()
+                # re-check: a completion/submit may have raced the clear
+                if any(eligible(g) for g in self.groups.values()):
+                    continue
+                await self._wakeup.wait()
+        finally:
+            for g in self.groups.values():
+                if g.inflight is not None:
+                    g.inflight.cancel()
+
+    # -- observability ------------------------------------------------
+    def stats(self) -> dict[str, dict]:
+        return {
+            name: {
+                "shares": g.shares,
+                "queued": len(g.queue),
+                "units_run": g.units_run,
+                "consumed_s": round(g.consumed_s, 6),
+            }
+            for name, g in self.groups.items()
+        }

@@ -57,16 +57,23 @@ class LogManager:
                     os.remove(os.path.join(d, name))
                 os.rmdir(d)
 
+    @staticmethod
+    def housekeeping_one(log: Log, now_ms: int) -> None:
+        """One log's retention/compaction pass. Raft-replicated logs
+        route through their snapshot-gated override so retention never
+        strands a lagging follower."""
+        if log.housekeeping_override is not None:
+            log.housekeeping_override(now_ms)
+        else:
+            log.apply_retention(now_ms)
+
     def housekeeping(self) -> None:
         """Retention pass over all logs (log_manager.h:228-244 timer).
-        Raft-replicated logs route through their snapshot-gated
-        override so retention never strands a lagging follower."""
+        The broker's sweep routes each log through the compaction
+        scheduling group instead (app._housekeeping_loop)."""
         now_ms = int(time.time() * 1000)
         for log in self._logs.values():
-            if log.housekeeping_override is not None:
-                log.housekeeping_override(now_ms)
-            else:
-                log.apply_retention(now_ms)
+            self.housekeeping_one(log, now_ms)
 
     def logs(self) -> dict[NTP, Log]:
         return dict(self._logs)
