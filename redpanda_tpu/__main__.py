@@ -1,0 +1,148 @@
+"""Standalone broker entrypoint (reference: redpanda/main.cc:17 →
+application::run).
+
+    python -m redpanda_tpu --node-id 0 --data-dir /var/lib/rp \\
+        --seeds host0:33145,host1:33145,host2:33145 \\
+        --kafka-port 9092 --rpc-port 33145 --admin-port 9644
+
+Seeds are ordered: seed i is node id i (the k8s StatefulSet maps pod
+ordinals the same way; --node-id-from-hostname derives the id from a
+trailing -<ordinal> hostname). Runs until SIGTERM/SIGINT, then stops
+the broker cleanly (drain, flush, close).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import socket
+import sys
+
+from .app import Broker, BrokerConfig
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(prog="redpanda_tpu", description=__doc__)
+    ap.add_argument("--node-id", type=int, default=None)
+    ap.add_argument(
+        "--node-id-from-hostname",
+        action="store_true",
+        help="derive node id from a trailing -<n> in the hostname "
+        "(StatefulSet pod ordinal)",
+    )
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument(
+        "--seeds",
+        default="",
+        help="comma-separated host:rpc_port, ordered by node id",
+    )
+    ap.add_argument("--kafka-host", default="0.0.0.0")
+    ap.add_argument("--kafka-port", type=int, default=9092)
+    ap.add_argument("--rpc-port", type=int, default=33145)
+    ap.add_argument("--admin-port", type=int, default=9644)
+    ap.add_argument("--advertised-host", default=None)
+    ap.add_argument("--rack", default=None)
+    ap.add_argument("--enable-sasl", action="store_true")
+    ap.add_argument("--superuser", action="append", default=None)
+    ap.add_argument("--cloud-storage-dir", default=None)
+    ap.add_argument("--enable-pandaproxy", action="store_true")
+    ap.add_argument("--pandaproxy-port", type=int, default=8082)
+    ap.add_argument("--enable-schema-registry", action="store_true")
+    ap.add_argument("--schema-registry-port", type=int, default=8081)
+    ap.add_argument("--log-level", default="INFO")
+    return ap.parse_args(argv)
+
+
+def node_id_from_hostname() -> int:
+    host = socket.gethostname()
+    tail = host.rsplit("-", 1)[-1]
+    if not tail.isdigit():
+        raise SystemExit(
+            f"--node-id-from-hostname: hostname {host!r} has no trailing "
+            f"-<ordinal>"
+        )
+    return int(tail)
+
+
+def build_config(args) -> BrokerConfig:
+    node_id = (
+        node_id_from_hostname() if args.node_id_from_hostname else args.node_id
+    )
+    if node_id is None:
+        raise SystemExit("--node-id or --node-id-from-hostname required")
+    peers: dict[int, tuple[str, int]] = {}
+    for i, hp in enumerate(s for s in args.seeds.split(",") if s):
+        host, _, port = hp.partition(":")
+        peers[i] = (host, int(port or 33145))
+    members = sorted(peers) if peers else [node_id]
+    if node_id in peers:
+        # this node's own listener binds the configured port; its seed
+        # entry tells PEERS where to reach it
+        advertised = args.advertised_host or peers[node_id][0]
+    else:
+        # beyond the seed set (scale-out pod): the node JOINS via the
+        # seeds (auto_join), but must advertise a routable address —
+        # silently announcing 0.0.0.0 would make it a zombie member
+        advertised = args.advertised_host
+        if peers and advertised is None:
+            raise SystemExit(
+                f"node {node_id} is not in the seed list; scale-out "
+                f"nodes need --advertised-host (k8s: the pod's stable "
+                f"DNS name via $(POD_NAME))"
+            )
+    return BrokerConfig(
+        node_id=node_id,
+        data_dir=args.data_dir,
+        members=members,
+        peer_addresses=peers or None,
+        kafka_host=args.kafka_host,
+        kafka_port=args.kafka_port,
+        rpc_host="0.0.0.0",
+        rpc_port=args.rpc_port,
+        advertised_host=advertised,
+        rack=args.rack,
+        enable_sasl=args.enable_sasl,
+        superusers=args.superuser,
+        cloud_storage_dir=args.cloud_storage_dir,
+        admin_host="0.0.0.0",
+        admin_port=args.admin_port,
+        enable_pandaproxy=args.enable_pandaproxy,
+        pandaproxy_port=args.pandaproxy_port,
+        enable_schema_registry=args.enable_schema_registry,
+        schema_registry_port=args.schema_registry_port,
+    )
+
+
+async def run(config: BrokerConfig) -> None:
+    broker = Broker(config)
+    await broker.start()
+    logging.getLogger("main").info(
+        "node %d serving: kafka :%d rpc :%d admin :%d",
+        config.node_id,
+        broker.kafka_server.port,
+        config.rpc_port,
+        broker.admin.port if broker.admin else -1,
+    )
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    logging.getLogger("main").info("shutting down")
+    await broker.stop()
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    asyncio.run(run(build_config(args)))
+
+
+if __name__ == "__main__":
+    main()

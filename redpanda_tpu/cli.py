@@ -414,6 +414,96 @@ async def cmd_partition(args) -> None:
         print("leadership transfer requested")
 
 
+async def cmd_generate(args) -> None:
+    """Static deployment manifests (the k8s-operator analog at the
+    manifest level: headless Service for seed discovery + StatefulSet
+    with stable node ids derived from the pod ordinal — the same shape
+    src/go/k8s's controllers reconcile toward)."""
+    if args.action == "k8s":
+        seeds = ",".join(
+            f"{args.name}-{i}.{args.name}.{args.namespace}.svc:33145"
+            for i in range(args.replicas)
+        )
+        print(
+            K8S_TEMPLATE.format(
+                name=args.name,
+                namespace=args.namespace,
+                replicas=args.replicas,
+                image=args.image,
+                storage=args.storage,
+                seeds=seeds,
+            )
+        )
+
+
+K8S_TEMPLATE = """\
+apiVersion: v1
+kind: Service
+metadata:
+  name: {name}
+  namespace: {namespace}
+  labels: {{app: {name}}}
+spec:
+  clusterIP: None            # headless: stable per-pod DNS for seeds
+  selector: {{app: {name}}}
+  ports:
+  - {{name: kafka, port: 9092}}
+  - {{name: rpc, port: 33145}}
+  - {{name: admin, port: 9644}}
+---
+apiVersion: apps/v1
+kind: StatefulSet
+metadata:
+  name: {name}
+  namespace: {namespace}
+spec:
+  serviceName: {name}
+  replicas: {replicas}
+  podManagementPolicy: Parallel
+  selector:
+    matchLabels: {{app: {name}}}
+  template:
+    metadata:
+      labels: {{app: {name}}}
+    spec:
+      terminationGracePeriodSeconds: 60
+      containers:
+      - name: broker
+        image: {image}
+        command: ["python", "-m", "redpanda_tpu"]
+        env:
+        - name: POD_NAME
+          valueFrom: {{fieldRef: {{fieldPath: metadata.name}}}}
+        args:
+        - --data-dir=/var/lib/redpanda-tpu
+        - --node-id-from-hostname    # pod ordinal -> node id
+        - --seeds={seeds}
+        # stable per-pod DNS: correct even for pods scaled out beyond
+        # the seed list (they join via the seeds and advertise this)
+        - --advertised-host=$(POD_NAME).{name}.{namespace}.svc
+        - --kafka-port=9092
+        - --rpc-port=33145
+        - --admin-port=9644
+        ports:
+        - {{containerPort: 9092, name: kafka}}
+        - {{containerPort: 33145, name: rpc}}
+        - {{containerPort: 9644, name: admin}}
+        readinessProbe:
+          httpGet: {{path: /v1/status/ready, port: admin}}
+          initialDelaySeconds: 5
+          periodSeconds: 5
+        volumeMounts:
+        - {{name: data, mountPath: /var/lib/redpanda-tpu}}
+  volumeClaimTemplates:
+  - metadata:
+      name: data
+    spec:
+      accessModes: [ReadWriteOnce]
+      resources:
+        requests: {{storage: {storage}}}
+"""
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="rpk", description=__doc__)
     ap.add_argument("--brokers", default="127.0.0.1:9092")
@@ -488,6 +578,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--replicas", default=None)
     p.add_argument("--target", type=int, default=None)
     p.set_defaults(fn=cmd_partition)
+
+    gen = sub.add_parser("generate")
+    gen.add_argument("action", choices=["k8s"])
+    gen.add_argument("--name", default="redpanda-tpu")
+    gen.add_argument("--namespace", default="default")
+    gen.add_argument("--replicas", type=int, default=3)
+    gen.add_argument("--image", default="redpanda-tpu:latest")
+    gen.add_argument("--storage", default="10Gi")
+    gen.set_defaults(fn=cmd_generate)
 
     return ap
 

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Offline log viewer: parse a broker data dir without a running node.
+
+Reference: tools/offline_log_viewer — segment/kvstore/controller-log
+decoding for debugging and forensics. Strictly read-only: segment
+files are parsed from raw bytes (never opened for append), so the
+viewer is safe to point at a LIVE broker's directory.
+
+Usage:
+    python tools/log_viewer.py DATA_DIR                    # overview
+    python tools/log_viewer.py DATA_DIR --ntp kafka/t/0    # one log
+    python tools/log_viewer.py DATA_DIR --controller       # raft0 cmds
+    python tools/log_viewer.py DATA_DIR -v                 # + records
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from redpanda_tpu.models.record import (  # noqa: E402
+    HEADER_SIZE,
+    RecordBatch,
+    RecordBatchHeader,
+    RecordBatchType,
+)
+
+
+def iter_batches(path: str):
+    """CRC-checked batch stream from one segment file (read-only raw
+    parse — the log_replayer loop, minus recovery side effects)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    while pos + HEADER_SIZE <= len(data):
+        try:
+            header = RecordBatchHeader.unpack(data[pos : pos + HEADER_SIZE])
+        except Exception:
+            yield pos, None, "unparseable header"
+            return
+        if header.size_bytes < HEADER_SIZE or pos + header.size_bytes > len(data):
+            yield pos, None, "torn tail"
+            return
+        batch = RecordBatch(
+            header, data[pos + HEADER_SIZE : pos + header.size_bytes]
+        )
+        note = "" if batch.verify_crc() else "CRC MISMATCH"
+        yield pos, batch, note
+        pos += header.size_bytes
+
+
+def segments_of(log_dir: str) -> list[str]:
+    segs = [f for f in os.listdir(log_dir) if f.endswith(".log")]
+    return sorted(segs, key=lambda f: int(f.split("-")[0]))
+
+
+def _preview(b: bytes | None, limit: int = 40) -> str:
+    if b is None:
+        return "null"
+    try:
+        s = b.decode("utf-8")
+        printable = all(32 <= ord(ch) < 127 for ch in s)
+    except UnicodeDecodeError:
+        printable = False
+    if printable and len(s) <= limit:
+        return repr(s)
+    return f"<{len(b)}B {b[:8].hex()}{'…' if len(b) > 8 else ''}>"
+
+
+def dump_log(log_dir: str, verbose: bool, controller: bool = False) -> None:
+    for seg in segments_of(log_dir):
+        path = os.path.join(log_dir, seg)
+        print(f"  segment {seg} ({os.path.getsize(path)} bytes)")
+        for pos, batch, note in iter_batches(path):
+            if batch is None:
+                print(f"    @{pos}: {note}")
+                continue
+            h = batch.header
+            btype = (
+                RecordBatchType(h.type).name
+                if h.type in RecordBatchType._value2member_map_
+                else f"type{h.type}"
+            )
+            flag = f"  [{note}]" if note else ""
+            print(
+                f"    @{pos}: [{h.base_offset}..{h.last_offset}] "
+                f"term={h.term} {btype} "
+                f"{len(batch.body)}B records={h.record_count}{flag}"
+            )
+            if controller and h.type == RecordBatchType.topic_management_cmd:
+                try:
+                    from redpanda_tpu.cluster.commands import decode_commands
+
+                    for ctype, cmd in decode_commands(batch):
+                        print(f"        {ctype.name}: {cmd!r}")
+                except Exception as e:
+                    print(f"        <undecodable: {e}>")
+            elif verbose:
+                for r in batch.records():
+                    print(
+                        f"        +{r.offset_delta} key={_preview(r.key)} "
+                        f"value={_preview(r.value)}"
+                    )
+
+
+def find_ntp_dirs(data_dir: str) -> dict[str, str]:
+    """ntp string -> log dir for every partition under data/."""
+    out = {}
+    root = os.path.join(data_dir, "data")
+    if not os.path.isdir(root):
+        return out
+    for ns in sorted(os.listdir(root)):
+        for topic in sorted(os.listdir(os.path.join(root, ns))):
+            tdir = os.path.join(root, ns, topic)
+            for part in sorted(os.listdir(tdir), key=lambda p: int(p)):
+                out[f"{ns}/{topic}/{part}"] = os.path.join(tdir, part)
+    return out
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("data_dir")
+    ap.add_argument("--ntp", help="ns/topic/partition to dump")
+    ap.add_argument(
+        "--controller", action="store_true", help="decode the raft0 log"
+    )
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.controller:
+        cdir = os.path.join(args.data_dir, "group_0")
+        if not os.path.isdir(cdir):
+            raise SystemExit(f"no controller log at {cdir}")
+        print("controller log (raft group 0):")
+        dump_log(cdir, args.verbose, controller=True)
+        return
+
+    ntps = find_ntp_dirs(args.data_dir)
+    if args.ntp:
+        if args.ntp not in ntps:
+            raise SystemExit(
+                f"unknown ntp {args.ntp}; have: {', '.join(ntps) or 'none'}"
+            )
+        print(f"{args.ntp}:")
+        dump_log(ntps[args.ntp], args.verbose)
+        return
+
+    print(f"{args.data_dir}: {len(ntps)} partition logs")
+    for ntp, d in ntps.items():
+        segs = segments_of(d)
+        total = sum(os.path.getsize(os.path.join(d, s)) for s in segs)
+        batches = records = 0
+        last = None
+        for s in segs:
+            for _pos, b, _n in iter_batches(os.path.join(d, s)):
+                if b is not None:
+                    batches += 1
+                    records += b.header.record_count
+                    last = b.header.last_offset
+        print(
+            f"  {ntp}: {len(segs)} segments, {total}B, "
+            f"{batches} batches, {records} records, last offset {last}"
+        )
+
+
+if __name__ == "__main__":
+    main()
