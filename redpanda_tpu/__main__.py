@@ -116,6 +116,13 @@ def build_config(args) -> BrokerConfig:
 
 
 async def run(config: BrokerConfig) -> None:
+    import os
+
+    from . import syschecks
+
+    os.makedirs(config.data_dir, exist_ok=True)
+    # exclusive dir ownership BEFORE touching any on-disk state
+    pidlock = syschecks.acquire_pidlock(config.data_dir)
     broker = Broker(config)
     await broker.start()
     logging.getLogger("main").info(
@@ -132,6 +139,7 @@ async def run(config: BrokerConfig) -> None:
     await stop.wait()
     logging.getLogger("main").info("shutting down")
     await broker.stop()
+    pidlock.release()
 
 
 def main(argv=None) -> None:

@@ -50,6 +50,7 @@ class AdminServer(HttpServer):
         r("POST", r"/v1/brokers/(\d+)/decommission", self._decommission)
         r("POST", r"/v1/brokers/(\d+)/recommission", self._recommission)
         r("GET", r"/v1/cluster/health_overview", self._health)
+        r("GET", r"/v1/cluster/stats", self._cluster_stats)
         r("GET", r"/v1/cluster_config", self._get_config)
         r("PUT", r"/v1/cluster_config", self._put_config)
         r("GET", r"/v1/cluster_config/schema", self._config_schema)
@@ -446,6 +447,10 @@ class AdminServer(HttpServer):
 
     async def _features(self, _m, _q, _b):
         return self.broker.controller.features.snapshot()
+
+    async def _cluster_stats(self, _m, _q, _b):
+        """Aggregated cluster/node stats (metrics_reporter analog)."""
+        return self.broker.stats_reporter.report()
 
     async def _scheduler_stats(self, _m, _q, _b):
         """Per-group shares/queue/consumption of the background

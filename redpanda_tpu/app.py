@@ -82,6 +82,8 @@ class BrokerConfig:
     cloud_storage_dir: Optional[str] = None
     # archival upload pass cadence; <= 0 disables the timer
     archival_interval_s: float = 1.0
+    # cluster stats report cadence (metrics_reporter analog); <= 0 off
+    stats_interval_s: float = 900.0
     # admin HTTP listener (admin_server.cc); port 0 = ephemeral
     admin_host: str = "127.0.0.1"
     admin_port: int = 0
@@ -164,6 +166,11 @@ class Broker:
         )
         self.node_status_service = NodeStatusService(config.node_id)
         self.health_monitor = HealthMonitor(self)
+        from .cluster.stats_reporter import StatsReporter
+
+        self.stats_reporter = StatsReporter(
+            self, interval_s=config.stats_interval_s
+        )
         self._register_probes()
         self.admin = AdminServer(
             self, config.admin_host, config.admin_port
@@ -340,6 +347,13 @@ class Broker:
 
     # -- lifecycle ---------------------------------------------------
     async def start(self) -> None:
+        # environment checks + crash-loop tracking (syschecks,
+        # application.cc:357): unclean-shutdown counting is advisory;
+        # an un-fsyncable data dir is fatal
+        from . import syschecks
+
+        syschecks.run_startup_checks(self.config.data_dir)
+        syschecks.note_startup(self.config.data_dir)
         self.scheduler.start()
         for svc in (
             self.group_manager.service,
@@ -364,6 +378,7 @@ class Broker:
             await self.node_status.start()
         if self.archival is not None and self.config.archival_interval_s > 0:
             await self.archival.start()
+        await self.stats_reporter.start()
         if self.admin is not None:
             await self.admin.start()
         self.pandaproxy = None
@@ -455,6 +470,7 @@ class Broker:
                 pass
             self._join_task = None
         await self.node_status.stop()
+        await self.stats_reporter.stop()
         if self.pandaproxy is not None:
             await self.pandaproxy.stop()
             self.pandaproxy = None
@@ -483,6 +499,9 @@ class Broker:
         if self._rpc_server is not None:
             await self._rpc_server.stop()
         self.storage.close()
+        from . import syschecks
+
+        syschecks.note_clean_stop(self.config.data_dir)
 
     async def send_rpc(
         self, node_id: int, method_id: int, payload: bytes, timeout: float

@@ -33,6 +33,7 @@ class CmdType(enum.IntEnum):
     move_replicas = 14
     finish_move = 15
     feature_update = 16
+    migration_done = 17
 
 
 class PartitionAssignmentE(serde.Envelope):
@@ -215,6 +216,14 @@ class FeatureUpdateCmd(serde.Envelope):
     ]
 
 
+class MigrationDoneCmd(serde.Envelope):
+    """One-shot cluster migration completion marker (migrations/):
+    replicated by the leader after the migration applied so it never
+    re-runs, across failovers and on replaying nodes."""
+
+    SERDE_FIELDS = [("name", serde.string)]
+
+
 CMD_CLASSES = {
     CmdType.create_topic: CreateTopicCmd,
     CmdType.delete_topic: DeleteTopicCmd,
@@ -232,6 +241,7 @@ CMD_CLASSES = {
     CmdType.move_replicas: MoveReplicasCmd,
     CmdType.finish_move: FinishMoveCmd,
     CmdType.feature_update: FeatureUpdateCmd,
+    CmdType.migration_done: MigrationDoneCmd,
 }
 
 

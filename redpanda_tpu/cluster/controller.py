@@ -45,6 +45,7 @@ from .commands import (
     DeleteUserCmd,
     FeatureUpdateCmd,
     FinishMoveCmd,
+    MigrationDoneCmd,
     MoveReplicasCmd,
     PartitionAssignmentE,
     RecommissionNodeCmd,
@@ -183,6 +184,8 @@ class ControllerStm(StateMachine):
                 self._c.features.apply(
                     cmd.name, cmd.state, int(cmd.cluster_version)
                 )
+            elif cmd_type == CmdType.migration_done:
+                self._c.migrations_done.add(cmd.name)
             elif cmd_type == CmdType.move_replicas:
                 md = self.topic_table.get(TopicNamespace(cmd.ns, cmd.topic))
                 if md is not None:
@@ -326,6 +329,8 @@ class Controller:
         self.authorizer = Authorizer(self.acls)
         self.members_table = MembersTable()
         self.features = FeatureTable()
+        # replicated one-shot migration completion set (migrations/)
+        self.migrations_done: set[str] = set()
         from ..config import ClusterConfig
 
         self.cluster_config = ClusterConfig()
@@ -582,6 +587,16 @@ class Controller:
         node to raft group 0's voter set if it isn't one yet."""
         if self.consensus is None or not self.is_leader:
             raise NotLeaderError(self.leader_id)
+        # version gate (handle_join_request): a build below the ACTIVE
+        # cluster version cannot replay feature-gated controller
+        # commands (e.g. MigrationDoneCmd) — admitting it would wedge
+        # its state machine mid-replay
+        if int(cmd.logical_version) < self.features.cluster_version:
+            raise TopicError(
+                "invalid_request",
+                f"node {cmd.node_id} build version {cmd.logical_version} "
+                f"< active cluster version {self.features.cluster_version}",
+            )
         base = await self.replicate_cmd_local(CmdType.register_node, cmd)
         nid = int(cmd.node_id)
         voters = list(self.consensus.config.voters)
@@ -905,6 +920,7 @@ class Controller:
                 self._move_repair_pass()
                 if self.is_leader:
                     await self._feature_pass()
+                    await self._migration_pass()
                     await self._drain_pass()
                     self._balance_ticks += 1
                     if self._balance_ticks >= 5:  # ~5s of idle ticks
@@ -1111,6 +1127,30 @@ class Controller:
                     "feature_manager: activation of %s failed; will retry",
                     f.name,
                     exc_info=True,
+                )
+                return
+
+    async def _migration_pass(self) -> None:
+        """Leader-only: run feature-gated one-shot migrations that have
+        not yet replicated a completion marker (migrations/ driven by
+        feature activation). apply() is idempotent; the marker only
+        lands after it succeeds."""
+        from .migrations import registered
+
+        for m in registered():
+            if m.name in self.migrations_done:
+                continue
+            if not self.features.is_active(m.feature):
+                continue
+            try:
+                await m.apply(self)
+                await self.replicate_cmd_local(
+                    CmdType.migration_done, MigrationDoneCmd(name=m.name)
+                )
+                logger.info("migration %s completed", m.name)
+            except Exception:
+                logger.warning(
+                    "migration %s failed; will retry", m.name, exc_info=True
                 )
                 return
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 import dataclasses
 
 # this build's logical version (bump when adding a gated feature)
-LATEST_LOGICAL_VERSION = 2
+LATEST_LOGICAL_VERSION = 3
 
 
 @dataclasses.dataclass(frozen=True, slots=True)
@@ -29,9 +29,13 @@ class FeatureSpec:
 # about what a mixed-version cluster protects):
 #   delete_records — older builds mis-handle the replicated floor marker
 #   fetch_sessions — session state assumes every node's session cache
+#   migrations — older builds don't understand MigrationDoneCmd in the
+#                controller log, so no migration may run (or replicate
+#                its marker) until every member speaks it
 FEATURES = [
     FeatureSpec("delete_records", 2),
     FeatureSpec("fetch_sessions", 2),
+    FeatureSpec("migrations", 3),
 ]
 
 

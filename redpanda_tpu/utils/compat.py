@@ -63,6 +63,10 @@ def all_envelope_types() -> dict[str, type]:
     for cls in _subclasses(serde.Envelope):
         if not cls.SERDE_FIELDS:
             continue
+        # only the package's own wire types: tests and embedders may
+        # define scratch envelopes that are not wire contracts
+        if not cls.__module__.startswith("redpanda_tpu."):
+            continue
         out[f"{cls.__module__}.{cls.__qualname__}"] = cls
     return out
 
