@@ -345,6 +345,10 @@ async def _broker_async() -> dict:
     record_bytes = 1024
     duration_s = 4.0
 
+    # NOTE: client AND broker share this process and the machine is
+    # 1-core in this environment — the number is a whole-system
+    # single-core figure, not the reference's 24-core i3en.6xlarge
+    # smoke (BASELINE.md); see "cores" in the result.
     b = Broker(
         BrokerConfig(
             node_id=0,
@@ -425,6 +429,7 @@ async def _broker_async() -> dict:
             "produce_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
             "produce_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
             "consume_mbps": round(consume_mbps, 1),
+            "cores": os.cpu_count(),
             "batches": len(lat_ms),
         }
     finally:

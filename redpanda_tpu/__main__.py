@@ -47,6 +47,17 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--enable-sasl", action="store_true")
     ap.add_argument("--superuser", action="append", default=None)
     ap.add_argument("--cloud-storage-dir", default=None)
+    ap.add_argument(
+        "--cloud-storage-endpoint",
+        default=None,
+        help="S3-compatible host:port (takes precedence over "
+        "--cloud-storage-dir)",
+    )
+    ap.add_argument("--cloud-storage-bucket", default="redpanda")
+    ap.add_argument("--cloud-storage-region", default="us-east-1")
+    ap.add_argument("--cloud-storage-access-key", default="")
+    ap.add_argument("--cloud-storage-secret-key", default="")
+    ap.add_argument("--cloud-storage-tls", action="store_true")
     ap.add_argument("--enable-pandaproxy", action="store_true")
     ap.add_argument("--pandaproxy-port", type=int, default=8082)
     ap.add_argument("--enable-schema-registry", action="store_true")
@@ -106,6 +117,12 @@ def build_config(args) -> BrokerConfig:
         enable_sasl=args.enable_sasl,
         superusers=args.superuser,
         cloud_storage_dir=args.cloud_storage_dir,
+        cloud_storage_endpoint=args.cloud_storage_endpoint,
+        cloud_storage_bucket=args.cloud_storage_bucket,
+        cloud_storage_region=args.cloud_storage_region,
+        cloud_storage_access_key=args.cloud_storage_access_key,
+        cloud_storage_secret_key=args.cloud_storage_secret_key,
+        cloud_storage_tls=args.cloud_storage_tls,
         admin_host="0.0.0.0",
         admin_port=args.admin_port,
         enable_pandaproxy=args.enable_pandaproxy,

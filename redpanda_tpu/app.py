@@ -80,6 +80,15 @@ class BrokerConfig:
     # (cloud_storage_enabled + bucket analog); None disables tiering
     # unless an object store is injected on the Broker directly
     cloud_storage_dir: Optional[str] = None
+    # ... or a real S3-compatible endpoint (cloud_storage_clients/s3):
+    # "host:port" + bucket + sigv4 credentials; takes precedence over
+    # cloud_storage_dir
+    cloud_storage_endpoint: Optional[str] = None
+    cloud_storage_bucket: str = "redpanda"
+    cloud_storage_region: str = "us-east-1"
+    cloud_storage_access_key: str = ""
+    cloud_storage_secret_key: str = ""
+    cloud_storage_tls: bool = False
     # archival upload pass cadence; <= 0 disables the timer
     archival_interval_s: float = 1.0
     # cluster stats report cadence (metrics_reporter analog); <= 0 off
@@ -108,6 +117,21 @@ class Broker:
 
         self.storage = StorageApi(config.data_dir)
         self.metrics = MetricsRegistry()
+        if object_store is None and config.cloud_storage_endpoint is not None:
+            from .cloud.s3_client import S3ObjectStore, StaticCredentialsProvider
+
+            host, _, port = config.cloud_storage_endpoint.partition(":")
+            object_store = S3ObjectStore(
+                host,
+                int(port or (443 if config.cloud_storage_tls else 80)),
+                config.cloud_storage_bucket,
+                StaticCredentialsProvider(
+                    config.cloud_storage_access_key,
+                    config.cloud_storage_secret_key,
+                ),
+                region=config.cloud_storage_region,
+                tls=config.cloud_storage_tls,
+            )
         if object_store is None and config.cloud_storage_dir is not None:
             from .cloud import FilesystemObjectStore
 
@@ -498,6 +522,9 @@ class Broker:
         await self._conn_cache.close()
         if self._rpc_server is not None:
             await self._rpc_server.stop()
+        store_close = getattr(self.object_store, "close", None)
+        if store_close is not None:
+            await store_close()  # S3 client: drain the connection pool
         self.storage.close()
         from . import syschecks
 

@@ -150,6 +150,11 @@ class RetryingStore:
     def abort(self) -> None:
         self._chain.abort()
 
+    async def close(self) -> None:
+        close = getattr(self._inner, "close", None)
+        if close is not None:
+            await close()
+
     async def _retry(self, op, *args):
         from ..utils.retry_chain import RetryChainAborted
 

@@ -1,0 +1,153 @@
+"""In-process S3-compatible server for tests (the reference's
+s3_imposter, cloud_storage/tests/s3_imposter.{h,cc}).
+
+Implements exactly what the S3 client speaks — PUT/GET/HEAD/DELETE
+object, ListObjectsV2 with continuation tokens — over an in-memory
+dict, VERIFYING every request's SigV4 signature server-side (so the
+client's signing is proven against an independent consumer, not a
+round-trip). Supports injected failures for retry-path tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import urllib.parse
+from xml.sax.saxutils import escape
+
+from redpanda_tpu.cloud.signature import verify_request
+
+_LIST_PAGE = 2  # tiny page size so tests exercise continuation tokens
+
+
+class S3Imposter:
+    def __init__(self, access_key: str = "AK", secret_key: str = "SK"):
+        self.objects: dict[str, bytes] = {}
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.requests: list[tuple[str, str]] = []  # (method, path)
+        self.fail_next: int = 0  # inject N 500s
+        self.reject_unsigned = True
+        self._server: asyncio.AbstractServer | None = None
+        self._writers: set = set()
+        self.port = 0
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_conn, "127.0.0.1", 0
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # 3.12 wait_closed() waits for handler coroutines: force
+            # keep-alive client connections shut first
+            for w in list(self._writers):
+                w.close()
+            await self._server.wait_closed()
+
+    def _secret_for(self, access_key: str):
+        return self.secret_key if access_key == self.access_key else None
+
+    async def _on_conn(self, reader, writer) -> None:
+        self._writers.add(writer)
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                method, target, _ = line.decode().split(" ", 2)
+                headers: dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                n = int(headers.get("content-length", "0") or 0)
+                body = await reader.readexactly(n) if n else b""
+                status, resp_headers, payload = self._handle(
+                    method.upper(), target, headers, body
+                )
+                head = f"HTTP/1.1 {status} X\r\n" + "".join(
+                    f"{k}: {v}\r\n" for k, v in resp_headers.items()
+                )
+                if "content-length" not in resp_headers:
+                    head += f"content-length: {len(payload)}\r\n"
+                head += "\r\n"
+                writer.write(head.encode() + payload)
+                await writer.drain()
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            ValueError,
+        ):
+            pass
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    def _handle(self, method, target, headers, body):
+        self.requests.append((method, target))
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            return 500, {}, b"injected"
+        if self.reject_unsigned:
+            who = verify_request(
+                self._secret_for, method, target, headers, body
+            )
+            if who is None:
+                return 403, {}, b"<Error><Code>SignatureDoesNotMatch</Code></Error>"
+
+        path, _, query = target.partition("?")
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+
+        if method == "GET" and not key and "list-type=2" in query:
+            q = urllib.parse.parse_qs(query)
+            prefix = q.get("prefix", [""])[0]
+            start = q.get("continuation-token", [""])[0]
+            keys = sorted(
+                k for k in self.objects if k.startswith(prefix)
+            )
+            if start:
+                keys = [k for k in keys if k > start]
+            page, rest = keys[:_LIST_PAGE], keys[_LIST_PAGE:]
+            items = "".join(
+                f"<Contents><Key>{escape(k)}</Key></Contents>" for k in page
+            )
+            trunc = "true" if rest else "false"
+            token = (
+                f"<NextContinuationToken>{escape(page[-1])}"
+                f"</NextContinuationToken>"
+                if rest
+                else ""
+            )
+            xml = (
+                f"<ListBucketResult><IsTruncated>{trunc}</IsTruncated>"
+                f"{token}{items}</ListBucketResult>"
+            )
+            return 200, {"content-type": "application/xml"}, xml.encode()
+
+        if method == "PUT" and key:
+            self.objects[key] = body
+            return 200, {}, b""
+        if method == "GET" and key:
+            if key not in self.objects:
+                return 404, {}, b"<Error><Code>NoSuchKey</Code></Error>"
+            return 200, {}, self.objects[key]
+        if method == "HEAD" and key:
+            if key not in self.objects:
+                return 404, {"content-length": "0"}, b""
+            # real S3: content-length describes the object, NO body
+            # bytes follow — a client that tries to read them hangs
+            return (
+                200,
+                {"content-length": str(len(self.objects[key]))},
+                b"",
+            )
+        if method == "DELETE" and key:
+            self.objects.pop(key, None)
+            return 204, {}, b""
+        return 400, {}, b"bad request"
