@@ -79,6 +79,7 @@ class AdminServer(HttpServer):
         r("DELETE", r"/v1/debug/fault_injection", self._fault_clear)
         r("POST", r"/v1/debug/self_test", self._self_test)
         r("GET", r"/v1/debug/scheduler", self._scheduler_stats)
+        r("GET", r"/v1/transforms", self._transforms)
         r("GET", r"/v1/features", self._features)
         r("GET", r"/metrics", self._metrics)
 
@@ -451,6 +452,10 @@ class AdminServer(HttpServer):
     async def _cluster_stats(self, _m, _q, _b):
         """Aggregated cluster/node stats (metrics_reporter analog)."""
         return self.broker.stats_reporter.report()
+
+    async def _transforms(self, _m, _q, _b):
+        """Per-transform per-partition fiber status (coproc status)."""
+        return self.broker.transforms.status()
 
     async def _scheduler_stats(self, _m, _q, _b):
         """Per-group shares/queue/consumption of the background

@@ -195,6 +195,9 @@ class Broker:
         self.stats_reporter = StatsReporter(
             self, interval_s=config.stats_interval_s
         )
+        from .transforms import TransformService
+
+        self.transforms = TransformService(self)
         self._register_probes()
         self.admin = AdminServer(
             self, config.admin_host, config.admin_port
@@ -403,6 +406,7 @@ class Broker:
         if self.archival is not None and self.config.archival_interval_s > 0:
             await self.archival.start()
         await self.stats_reporter.start()
+        await self.transforms.start()
         if self.admin is not None:
             await self.admin.start()
         self.pandaproxy = None
@@ -494,6 +498,7 @@ class Broker:
                 pass
             self._join_task = None
         await self.node_status.stop()
+        await self.transforms.stop()
         await self.stats_reporter.stop()
         if self.pandaproxy is not None:
             await self.pandaproxy.stop()

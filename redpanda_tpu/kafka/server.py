@@ -848,16 +848,19 @@ class KafkaServer:
             from ..cloud.object_store import StoreError
 
             # ONE budget across all remote rows, mirroring the local
-            # read loop's `budget - total` accounting
+            # read loop's `budget - total` accounting. The hydrations
+            # themselves run CONCURRENTLY (parallel_fetch_plan_executor
+            # analog — the parallel axis here is object-store I/O, not
+            # shards): each candidate reads under its own per-partition
+            # cap and the global budget is settled in plan order.
             remote_budget = req.max_bytes if req.max_bytes > 0 else 1 << 30
+            candidates = []
             for t in plan_topics:
                 if not authorized.get(t.topic):
                     continue
                 if not self._remote_read_enabled(t.topic):
                     continue
                 for p in t.partitions:
-                    if remote_budget <= 0:
-                        break
                     partition = self.broker.partition_manager.get(
                         kafka_ntp(t.topic, p.partition)
                     )
@@ -871,52 +874,81 @@ class KafkaServer:
                         or p.fetch_offset < cstart
                     ):
                         continue
-                    lso = partition.last_stable_offset()
-                    upto = lso if read_committed else None
-                    budget = min(p.partition_max_bytes, remote_budget)
-                    try:
-                        pairs = await partition.read_kafka_remote(
-                            reader,
-                            p.fetch_offset,
-                            max_bytes=budget,
-                            upto_kafka=upto,
+                    candidates.append((t.topic, p, partition, cstart))
+
+            async def read_one(p, partition, budget):
+                lso = partition.last_stable_offset()
+                upto = lso if read_committed else None
+                try:
+                    pairs = await partition.read_kafka_remote(
+                        reader,
+                        p.fetch_offset,
+                        max_bytes=budget,
+                        upto_kafka=upto,
+                    )
+                except StoreError:
+                    # corrupt/missing object: fail ONE partition
+                    # (out_of_range via the poll loop), not the fetch
+                    return None
+                # stitch the local tail into the same response when
+                # the archived range hands over within budget
+                used = sum(b.size_bytes() for _kb, b in pairs)
+                next_off = (
+                    pairs[-1][0] + pairs[-1][1].header.last_offset_delta + 1
+                    if pairs
+                    else p.fetch_offset
+                )
+                if used < budget and next_off >= partition.start_offset():
+                    pairs += partition.read_kafka(
+                        next_off,
+                        max_bytes=budget - used,
+                        upto_kafka=upto,
+                    )
+                wire = b"".join(_frame_kafka(b, kb) for kb, b in pairs)
+                aborted = None
+                if read_committed and pairs:
+                    fetch_end = (
+                        pairs[-1][0]
+                        + pairs[-1][1].header.last_offset_delta
+                        + 1
+                    )
+                    aborted = [
+                        Msg(producer_id=pid, first_offset=first)
+                        for pid, first in partition.aborted_in(
+                            p.fetch_offset, fetch_end
                         )
-                    except StoreError:
-                        # corrupt/missing object: fail ONE partition
-                        # (out_of_range via the poll loop), not the fetch
+                    ]
+                return wire, aborted, lso
+
+            # hydrate in CHUNKS: reads within a chunk run concurrently,
+            # the budget settles between chunks — so an exhausted
+            # budget stops issuing object-store reads (no wasted
+            # hydrations), and overshoot is bounded by one chunk's
+            # worth of partition_max_bytes (Kafka's max_bytes is
+            # explicitly approximate; unbounded N-way overshoot is not)
+            CHUNK = 4
+            for i in range(0, len(candidates), CHUNK):
+                if remote_budget <= 0:
+                    break
+                chunk = candidates[i : i + CHUNK]
+                results = await asyncio.gather(
+                    *(
+                        read_one(
+                            p,
+                            partition,
+                            min(p.partition_max_bytes, remote_budget),
+                        )
+                        for _topic, p, partition, _cs in chunk
+                    )
+                )
+                for (topic, p, partition, cstart), res in zip(
+                    chunk, results
+                ):
+                    if res is None or remote_budget <= 0:
                         continue
-                    # stitch the local tail into the same response when
-                    # the archived range hands over within budget
-                    used = sum(b.size_bytes() for _kb, b in pairs)
-                    next_off = (
-                        pairs[-1][0] + pairs[-1][1].header.last_offset_delta + 1
-                        if pairs
-                        else p.fetch_offset
-                    )
-                    if used < budget and next_off >= partition.start_offset():
-                        pairs += partition.read_kafka(
-                            next_off,
-                            max_bytes=budget - used,
-                            upto_kafka=upto,
-                        )
-                    wire = b"".join(
-                        _frame_kafka(b, kb) for kb, b in pairs
-                    )
+                    wire, aborted, lso = res
                     remote_budget -= len(wire)
-                    aborted = None
-                    if read_committed and pairs:
-                        fetch_end = (
-                            pairs[-1][0]
-                            + pairs[-1][1].header.last_offset_delta
-                            + 1
-                        )
-                        aborted = [
-                            Msg(producer_id=pid, first_offset=first)
-                            for pid, first in partition.aborted_in(
-                                p.fetch_offset, fetch_end
-                            )
-                        ]
-                    remote_rows[(t.topic, p.partition)] = Msg(
+                    remote_rows[(topic, p.partition)] = Msg(
                         partition_index=p.partition,
                         error_code=0,
                         high_watermark=partition.high_watermark(),
