@@ -45,6 +45,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--advertised-host", default=None)
     ap.add_argument("--rack", default=None)
     ap.add_argument("--enable-sasl", action="store_true")
+    ap.add_argument("--kafka-tls-cert", default=None)
+    ap.add_argument("--kafka-tls-key", default=None)
+    ap.add_argument("--kafka-tls-ca", default=None)
+    ap.add_argument("--kafka-tls-require-client-auth", action="store_true")
+    ap.add_argument(
+        "--mtls-principal-rule",
+        action="append",
+        default=None,
+        help="RULE:pattern/replacement/[LU] or DEFAULT (repeatable)",
+    )
     ap.add_argument("--superuser", action="append", default=None)
     ap.add_argument("--cloud-storage-dir", default=None)
     ap.add_argument(
@@ -115,6 +125,11 @@ def build_config(args) -> BrokerConfig:
         advertised_host=advertised,
         rack=args.rack,
         enable_sasl=args.enable_sasl,
+        kafka_tls_cert=args.kafka_tls_cert,
+        kafka_tls_key=args.kafka_tls_key,
+        kafka_tls_ca=args.kafka_tls_ca,
+        kafka_tls_require_client_auth=args.kafka_tls_require_client_auth,
+        mtls_principal_rules=args.mtls_principal_rule,
         superusers=args.superuser,
         cloud_storage_dir=args.cloud_storage_dir,
         cloud_storage_endpoint=args.cloud_storage_endpoint,

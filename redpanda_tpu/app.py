@@ -68,6 +68,14 @@ class BrokerConfig:
     # join raft0 as a voter when not a seed); loopback fixtures that
     # don't exercise membership can turn it off
     auto_join: bool = True
+    # TLS on the kafka listener (config::tls_config analog): cert/key
+    # enable TLS; require_client_auth turns on mTLS, with the client
+    # certificate's DN mapped to a principal by mtls_principal_rules
+    kafka_tls_cert: Optional[str] = None
+    kafka_tls_key: Optional[str] = None
+    kafka_tls_ca: Optional[str] = None
+    kafka_tls_require_client_auth: bool = False
+    mtls_principal_rules: Optional[list[str]] = None
     # SASL/SCRAM authentication on the kafka listener; when on,
     # authorization (ACLs) is enforced too unless overridden
     enable_sasl: bool = False
@@ -545,6 +553,36 @@ class Broker:
     def kafka_advertised(self) -> tuple[str, int]:
         host = self.config.advertised_host or self.config.kafka_host
         return host, self.kafka_server.port
+
+    @property
+    def internal_kafka_address(self) -> tuple[str, int]:
+        """Where IN-BROKER clients (transforms, proxy, schema registry)
+        connect; pair with internal_kafka_ssl()."""
+        return self.kafka_advertised
+
+    def internal_kafka_ssl(self):
+        """ssl context for in-broker clients. Under mTLS they present
+        the broker's OWN certificate — its DN principal is registered
+        super at listener start — so internal traffic authenticates
+        like any client and keeps working cross-broker."""
+        cfg = self.config
+        if cfg.kafka_tls_cert is None:
+            return None
+        from .security.tls import client_context
+
+        return client_context(
+            ca=cfg.kafka_tls_ca,
+            cert=(
+                cfg.kafka_tls_cert
+                if cfg.kafka_tls_require_client_auth
+                else None
+            ),
+            key=(
+                cfg.kafka_tls_key
+                if cfg.kafka_tls_require_client_auth
+                else None
+            ),
+        )
 
     def kafka_address_of(self, node_id: int) -> Optional[tuple[str, int]]:
         if node_id == self.node_id:

@@ -50,11 +50,13 @@ class BrokerConnection:
         port: int,
         client_id: str,
         sasl: tuple[str, str, str] | None = None,  # (user, password, mechanism)
+        ssl=None,  # ssl.SSLContext for TLS/mTLS listeners
     ):
         self.host = host
         self.port = port
         self._client_id = client_id
         self._sasl = sasl
+        self._ssl = ssl
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._corr = itertools.count(1)
@@ -68,7 +70,7 @@ class BrokerConnection:
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port
+            self.host, self.port, ssl=self._ssl
         )
         self._read_task = asyncio.ensure_future(self._read_loop())
         resp = await self.request(API_VERSIONS, Msg(), version=2)
@@ -232,10 +234,12 @@ class KafkaClient:
         bootstrap: Sequence[tuple[str, int]],
         client_id: str = "redpanda-tpu-client",
         sasl: tuple[str, str, str] | None = None,  # (user, password, mechanism)
+        ssl=None,  # ssl.SSLContext (security.tls.client_context)
     ):
         self._bootstrap = list(bootstrap)
         self._client_id = client_id
         self._sasl = sasl
+        self._ssl = ssl
         self._conns: dict[tuple[str, int], BrokerConnection] = {}
         self._brokers: dict[int, tuple[str, int]] = {}
         self._leaders: dict[tuple[str, int], int] = {}  # (topic,part)→node
@@ -245,7 +249,8 @@ class KafkaClient:
         conn = self._conns.get(addr)
         if conn is None:
             conn = BrokerConnection(
-                addr[0], addr[1], self._client_id, sasl=self._sasl
+                addr[0], addr[1], self._client_id, sasl=self._sasl,
+                ssl=self._ssl,
             )
             await conn.connect()
             self._conns[addr] = conn
@@ -600,10 +605,13 @@ class KafkaClient:
         offset: int,
         max_bytes: int = 1 << 20,
         max_wait_ms: int = 0,
-    ) -> tuple[bytes, int]:
-        """One fetch round returning (raw records wire, next_offset)
-        without per-record decode — broker-throughput measurement and
-        mirroring consumers that hand wire bytes onward."""
+        return_lso: bool = False,
+    ) -> tuple[bytes, int] | tuple[bytes, int, int]:
+        """One fetch round returning (raw records wire, next_offset[,
+        last_stable_offset]) without per-record decode —
+        broker-throughput measurement, mirroring consumers that hand
+        wire bytes onward, and position probes over windows whose
+        committed view is empty (all aborted/control batches)."""
         pr = None
         for attempt in range(8):
             if attempt:
@@ -637,6 +645,8 @@ class KafkaClient:
             lod = int.from_bytes(wire[pos + 23 : pos + 27], "big", signed=True)
             next_off = max(next_off, base + lod + 1)
             pos += 12 + blen
+        if return_lso:
+            return wire, next_off, getattr(pr, "last_stable_offset", -1)
         return wire, next_off
 
     async def list_offset(

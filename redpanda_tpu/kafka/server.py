@@ -145,6 +145,7 @@ class KafkaServer:
                 lambda q=q: self._latency_hdr.value_at_percentile(q),
                 f"Kafka handler latency p{q} (us, hdr_hist)",
             )
+        self._mtls_mapper = None
         from .fetch_session import FetchSessionCache
         from .quotas import QuotaManager
 
@@ -171,10 +172,41 @@ class KafkaServer:
 
     async def start(self) -> None:
         cfg = self.broker.config
+        ssl_ctx = None
+        self._mtls_mapper = None
+        if cfg.kafka_tls_cert is not None:
+            from ..security.tls import PrincipalMapper, server_context
+
+            ssl_ctx = server_context(
+                cfg.kafka_tls_cert,
+                cfg.kafka_tls_key,
+                ca=cfg.kafka_tls_ca,
+                require_client_auth=cfg.kafka_tls_require_client_auth,
+            )
+            if cfg.kafka_tls_require_client_auth:
+                self._mtls_mapper = PrincipalMapper(
+                    cfg.mtls_principal_rules
+                )
         self._server = await asyncio.start_server(
-            self._on_conn, cfg.kafka_host, cfg.kafka_port
+            self._on_conn, cfg.kafka_host, cfg.kafka_port, ssl=ssl_ctx
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        if ssl_ctx is not None and cfg.kafka_tls_require_client_auth:
+            # in-broker clients (transforms, proxy, schema registry)
+            # authenticate with the broker's OWN certificate; its DN
+            # principal is implicitly super so internal traffic keeps
+            # working under mTLS + authorization
+            from cryptography import x509
+
+            with open(cfg.kafka_tls_cert, "rb") as f:
+                own = x509.load_pem_x509_certificate(f.read())
+            name = self._mtls_mapper.principal_for_dn(
+                own.subject.rfc4514_string()
+            )
+            if name is not None:
+                self.broker.controller.authorizer.superusers.add(
+                    f"User:{name}"
+                )
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -205,6 +237,23 @@ class KafkaServer:
         task = asyncio.current_task()
         self._conns.add(task)
         ctx = ConnectionContext()
+        if self._mtls_mapper is not None:
+            # mTLS: the verified client certificate IS the identity
+            # (mtls.cc) — mapped through the principal rules and fed to
+            # authorization exactly like a SASL identity
+            ssl_obj = writer.get_extra_info("ssl_object")
+            peercert = ssl_obj.getpeercert() if ssl_obj is not None else None
+            name = (
+                self._mtls_mapper.principal_for(peercert)
+                if peercert
+                else None
+            )
+            if name is None:
+                writer.close()
+                self._conns.discard(task)
+                return
+            ctx.principal = f"User:{name}"
+            ctx.authenticated = True
         pending: asyncio.Queue = asyncio.Queue()
         conn_failed = asyncio.Event()
 

@@ -64,7 +64,9 @@ class ConsumerInstance:
         self.last_used = asyncio.get_event_loop().time()
         from ..kafka.client import KafkaClient
 
-        self.client = KafkaClient([broker.kafka_advertised])
+        self.client = KafkaClient(
+            [broker.internal_kafka_address], ssl=broker.internal_kafka_ssl()
+        )
         self.gc = self.client.group(group)
         self._hb_task: Optional[asyncio.Task] = None
 
@@ -221,7 +223,10 @@ class PandaproxyServer(HttpServer):
     async def start(self) -> None:
         from ..kafka.client import KafkaClient
 
-        self._client = KafkaClient([self.broker.kafka_advertised])
+        self._client = KafkaClient(
+                [self.broker.internal_kafka_address],
+                ssl=self.broker.internal_kafka_ssl(),
+            )
         self._gc_task = asyncio.ensure_future(self._gc_loop())
         await super().start()
 

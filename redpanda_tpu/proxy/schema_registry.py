@@ -219,7 +219,10 @@ class SchemaRegistryServer(HttpServer):
     async def start(self) -> None:
         from ..kafka.client import KafkaClient
 
-        self._client = KafkaClient([self.broker.kafka_advertised])
+        self._client = KafkaClient(
+                [self.broker.internal_kafka_address],
+                ssl=self.broker.internal_kafka_ssl(),
+            )
         # bootstrap in the background: creating _schemas needs a
         # controller quorum, which may not exist yet when brokers boot
         # sequentially — gating Broker.start() on it would deadlock the

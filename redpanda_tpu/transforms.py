@@ -114,7 +114,10 @@ class TransformService:
         if self._client is None:
             from .kafka.client import KafkaClient
 
-            self._client = KafkaClient([self.broker.kafka_advertised])
+            self._client = KafkaClient(
+                [self.broker.internal_kafka_address],
+                ssl=self.broker.internal_kafka_ssl(),
+            )
         return self._client
 
     # -- the pacemaker (coproc/pacemaker.cc) --------------------------
@@ -155,11 +158,26 @@ class TransformService:
 
     # -- one (transform, partition) fiber -----------------------------
     async def _run_fiber(self, spec: TransformSpec, pid: int) -> None:
+        key = (spec.name, pid)
+        try:
+            await self._fiber_body(spec, pid, key)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # record + throttle: the pacemaker respawns done fibers
+            # every scan, and an unhandled setup error (listener not
+            # ready, client connect failure) must not crash-loop hot
+            fiber = self._fibers.get(key)
+            if fiber is not None:
+                fiber.errors += 1
+                fiber.last_error = f"fiber: {e}"
+            await asyncio.sleep(1.0)
+
+    async def _fiber_body(self, spec: TransformSpec, pid: int, key) -> None:
         from .models.fundamental import kafka_ntp
 
         client = await self._get_client()
         group = client.group(GROUP_PREFIX + spec.name)
-        key = (spec.name, pid)
         # the committed offset must be READ, not guessed: defaulting to
         # 0 on a transient coordinator error would replay the whole
         # source into the destination. Retry briefly, then die — the
@@ -233,6 +251,28 @@ class TransformService:
                 continue
             backoff = 0.05
             if not recs:
+                # an empty COMMITTED view can hide a full window of
+                # aborted/control batches; without advancing past them
+                # the fiber would re-read the same window forever. Skip
+                # to the window's end, clamped to the LSO (never past
+                # records whose transaction could still commit).
+                try:
+                    _w, nxt, lso = await client.fetch_raw(
+                        spec.source_topic,
+                        pid,
+                        offset,
+                        max_wait_ms=0,
+                        return_lso=True,
+                    )
+                    if lso >= 0:
+                        nxt = min(nxt, lso)
+                    if nxt > offset:
+                        offset = nxt
+                        continue
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    pass
                 await asyncio.sleep(0.05)
                 continue
             outs: list[tuple[bytes | None, bytes | None]] = []
