@@ -11,7 +11,13 @@ same axes map onto the TPU:
 """
 
 from .mesh import group_sharding, make_mesh, shard_group_state
-from .cluster_step import make_cluster_state, cluster_tick, cluster_tick_sharded
+from .cluster_step import (
+    cluster_tick,
+    cluster_tick_sharded,
+    election_round,
+    election_round_sharded,
+    make_cluster_state,
+)
 
 __all__ = [
     "group_sharding",
@@ -20,4 +26,6 @@ __all__ = [
     "make_cluster_state",
     "cluster_tick",
     "cluster_tick_sharded",
+    "election_round",
+    "election_round_sharded",
 ]

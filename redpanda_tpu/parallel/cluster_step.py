@@ -81,8 +81,13 @@ def cluster_tick(state: ClusterState, new_dirty: jax.Array) -> tuple[ClusterStat
     leader = leader._replace(match_index=match, flushed_index=flushed)
     old_commit = leader.commit_index
 
+    # a deposed leader (is_leader False after an election) must not
+    # heartbeat: its divergent suffix at the bumped term would poison
+    # follower mirrors as untruncatable new-term data. Advertise term
+    # -1 so followers reject the row wholesale.
+    hb_term = jnp.where(leader.is_leader, leader.term, -1)
     payload = jnp.stack(
-        [leader.term, leader.commit_index, leader.match_index[:, 0]], axis=-1
+        [hb_term, leader.commit_index, leader.match_index[:, 0]], axis=-1
     )  # [G, 3]
 
     fol_dirty, fol_flushed, fol_commit, fol_term = (
@@ -101,13 +106,28 @@ def cluster_tick(state: ClusterState, new_dirty: jax.Array) -> tuple[ClusterStat
         # 3. term gate (do_append_entries term check, consensus.cc:1752):
         # heartbeats from a stale term are rejected wholesale
         accept = r_term >= fol_term[:, j]
+        new_term = r_term > fol_term[:, j]
         fol_term = fol_term.at[:, j].max(r_term)
-        # follower accepts the append (mirror advances to leader dirty)
-        # and applies the follower commit rule
+        # follower accepts the append. Same term: the mirror only
+        # advances. A NEW term: the follower adopts the new leader's
+        # log wholesale — a divergent uncommitted suffix from the
+        # deposed leader is TRUNCATED down to the new leader's dirty
+        # offset (do_append_entries prev-term mismatch rule). Raft's
+        # election log_ok gate guarantees the new leader's log covers
+        # every committed entry, so the mirror can never truncate below
+        # its own commit index (asserted by the multi-device tests).
         new_f_dirty = jnp.where(
-            accept, jnp.maximum(fol_dirty[:, j], r_dirty), fol_dirty[:, j]
+            new_term,
+            jnp.maximum(r_dirty, fol_commit[:, j]),
+            jnp.where(
+                accept,
+                jnp.maximum(fol_dirty[:, j], r_dirty),
+                fol_dirty[:, j],
+            ),
         )
-        new_f_flushed = jnp.maximum(fol_flushed[:, j], new_f_dirty)
+        new_f_flushed = jnp.where(
+            new_term, new_f_dirty, jnp.maximum(fol_flushed[:, j], new_f_dirty)
+        )
         proposed = jnp.minimum(r_commit, new_f_flushed)
         new_f_commit = jnp.where(
             accept & (proposed > fol_commit[:, j]), proposed, fol_commit[:, j]
@@ -137,13 +157,117 @@ def cluster_tick(state: ClusterState, new_dirty: jax.Array) -> tuple[ClusterStat
     )
 
 
-def cluster_tick_sharded(mesh: Mesh):
-    """Build the jitted shard_map'd cluster step for `mesh`."""
+def election_round(
+    state: ClusterState, candidate_mask: jax.Array, candidate_hop: int
+) -> tuple[ClusterState, jax.Array, jax.Array]:
+    """A cross-device ELECTION for the masked groups: the follower at
+    ring hop `candidate_hop` campaigns to replace the (presumed dead)
+    leader on the home device.
+
+    The complete RequestVote exchange rides ICI (vote_stm.cc over
+    rpc → here ppermute):
+
+      1. the candidate device bumps its follower-side term and sends
+         (term, last_dirty) to every OTHER replica device,
+      2. each voter applies the raft vote rule — grant iff the
+         candidate's term beats anything seen AND the candidate's log
+         is at least as long (the log_ok gate, consensus.cc handle_vote
+         / vote_stm): this is THE safety property that makes the
+         truncation rule in cluster_tick lossless,
+      3. grants ride back; candidate + grants >= quorum(RF) elects.
+
+    Returns (state, elected_mask [G] on the candidate's HOME-block
+    positions, cand_term [G]). The home device's leader lane observes
+    the higher term (steps down: is_leader cleared for elected groups)
+    — leadership HANDOFF of the SoA block itself is host-runtime
+    bookkeeping (group_manager), exactly like the reference where the
+    winning node starts serving and the deposed leader steps down.
+    """
+    if not (1 <= candidate_hop < RF):
+        raise ValueError(f"candidate_hop must be in [1, {RF}): {candidate_hop}")
+    axis = SHARD_AXIS
+    n = jax.lax.axis_size(axis)
+    j = candidate_hop - 1
+    leader = state.leader
+    fol_term = state.fol_term
+
+    # candidate_mask is HOME-block aligned (like `elected`): ship it to
+    # the candidate device (home+hop), where the campaigning mirror
+    # positions for home's groups live
+    to_cand = [(i, (i + candidate_hop) % n) for i in range(n)]
+    mask_at_cand = jax.lax.ppermute(candidate_mask, axis, to_cand)
+
+    cand_term = fol_term[:, j] + 1
+    cand_dirty = state.fol_dirty[:, j]
+    payload = jnp.stack(
+        [mask_at_cand.astype(jnp.int64), cand_term, cand_dirty], axis=-1
+    )
+
+    grants = jnp.ones_like(cand_term, dtype=jnp.int64)  # self-vote
+    voter_hops = [h for h in range(RF) if h != candidate_hop]
+    for h in voter_hops:
+        # route candidate->voter: both arrays are aligned to the HOME
+        # block's positions; the voter for hop h holds them at device
+        # home+h, and the candidate sits at home+candidate_hop, so the
+        # ICI shift is (h - candidate_hop) forward
+        fwd = [(i, (i + h - candidate_hop) % n) for i in range(n)]
+        recv = jax.lax.ppermute(payload, axis, fwd)
+        is_cand, r_term, r_dirty = recv[:, 0] != 0, recv[:, 1], recv[:, 2]
+        if h == 0:
+            # the home device votes with its LEADER lane state
+            my_term = leader.term
+            my_dirty = leader.match_index[:, 0]
+        else:
+            my_term = fol_term[:, h - 1]
+            my_dirty = state.fol_dirty[:, h - 1]
+        log_ok = r_dirty >= my_dirty
+        grant = is_cand & (r_term > my_term) & log_ok
+        # one vote per term (voted_for): granting ADOPTS the candidate
+        # term, so a later same-term candidate (another hop) is refused
+        if h == 0:
+            leader = leader._replace(
+                term=jnp.maximum(leader.term, jnp.where(grant, r_term, 0)),
+                is_leader=leader.is_leader & ~grant,
+            )
+        else:
+            fol_term = fol_term.at[:, h - 1].max(
+                jnp.where(grant, r_term, -1)
+            )
+        back = [(i, (i - (h - candidate_hop)) % n) for i in range(n)]
+        grants = grants + jax.lax.ppermute(
+            grant.astype(jnp.int64), axis, back
+        )
+
+    elected_at_cand = mask_at_cand & (grants >= (RF // 2 + 1))
+    # the winner records its own term (its next heartbeat carries it)
+    fol_term = fol_term.at[:, j].max(
+        jnp.where(elected_at_cand, cand_term, -1)
+    )
+    # report election results at the HOME block positions
+    home_shift = [(i, (i - candidate_hop) % n) for i in range(n)]
+    elected = jax.lax.ppermute(elected_at_cand, axis, home_shift)
+    observed_term = jax.lax.ppermute(cand_term, axis, home_shift)
+
+    # the deposed home leader steps down for elected groups
+    # (consensus.cc term check -> become follower)
+    new_leader = leader._replace(
+        is_leader=leader.is_leader & ~elected,
+        term=jnp.maximum(leader.term, jnp.where(elected, observed_term, 0)),
+    )
+    return (
+        state._replace(leader=new_leader, fol_term=fol_term),
+        elected,
+        jnp.where(elected, observed_term, -1),
+    )
+
+
+def _cluster_specs(mesh: Mesh):
+    """(spec, ClusterState specs) for `mesh`, guarding the ring size:
+    with fewer devices than the replication factor the ring hops wrap
+    onto the sender — a leader would count its own payload as a
+    follower ack and commit unreplicated data."""
     n = mesh.devices.size
     if n < RF:
-        # with fewer devices than the replication factor the ring hops
-        # wrap onto the sender: a leader would count its own payload as
-        # a follower ack and commit unreplicated data
         raise ValueError(f"mesh has {n} devices; ring replication needs >= RF={RF}")
     spec = P(SHARD_AXIS)
     state_specs = ClusterState(
@@ -153,6 +277,26 @@ def cluster_tick_sharded(mesh: Mesh):
         fol_commit=spec,
         fol_term=spec,
     )
+    return spec, state_specs
+
+
+def election_round_sharded(mesh: Mesh, candidate_hop: int = 1):
+    """Build the jitted shard_map'd cross-device election for `mesh`."""
+    if not (1 <= candidate_hop < RF):
+        raise ValueError(f"candidate_hop must be in [1, {RF}): {candidate_hop}")
+    spec, state_specs = _cluster_specs(mesh)
+    fn = jax.shard_map(
+        lambda s, m: election_round(s, m, candidate_hop),
+        mesh=mesh,
+        in_specs=(state_specs, spec),
+        out_specs=(state_specs, spec, spec),
+    )
+    return jax.jit(fn)
+
+
+def cluster_tick_sharded(mesh: Mesh):
+    """Build the jitted shard_map'd cluster step for `mesh`."""
+    spec, state_specs = _cluster_specs(mesh)
     fn = jax.shard_map(
         cluster_tick,
         mesh=mesh,

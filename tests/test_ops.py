@@ -354,3 +354,179 @@ class TestHostDeviceTickParity:
                 assert np.array_equal(
                     getattr(a_host, name), getattr(a_dev, name)
                 ), f"trial {trial}: {name} diverged"
+
+
+class TestClusterElection:
+    """Cross-device elections + divergence truncation over the ICI ring
+    (the beyond-happy-path multi-chip semantics: vote_stm's log_ok gate
+    and do_append_entries' new-term truncation, as collectives)."""
+
+    def _sharded_state(self, g=64):
+        from redpanda_tpu.parallel import make_cluster_state, make_mesh
+        from redpanda_tpu.parallel.mesh import group_sharding
+
+        mesh = make_mesh(8)
+        state = make_cluster_state(g)
+        sharding = group_sharding(mesh)
+        state = jax.tree.map(lambda a: jax.device_put(a, sharding), state)
+        return mesh, state, sharding, g
+
+    def test_failover_election_log_ok_gate(self):
+        from redpanda_tpu.parallel import (
+            cluster_tick_sharded,
+            election_round_sharded,
+        )
+
+        mesh, state, sharding, g = self._sharded_state()
+        tick = cluster_tick_sharded(mesh)
+        dirty5 = jax.device_put(jnp.full(g, 5, jnp.int64), sharding)
+        none = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
+        state, _ = tick(state, dirty5)
+        state, _ = tick(state, none)  # commit=5 known everywhere
+
+        # home leaders die after appending a divergent UNCOMMITTED
+        # suffix (dirty 9) that never replicated
+        state = state._replace(
+            leader=state.leader._replace(
+                match_index=state.leader.match_index.at[:, 0].set(9),
+                flushed_index=state.leader.flushed_index.at[:, 0].set(9),
+            )
+        )
+
+        # hop-1 followers (log dirty=5 == every voter's committed data)
+        # campaign for ALL groups and must WIN: quorum = self + hop-2
+        # voter (log_ok 5>=5), without the dead home's vote
+        elect = election_round_sharded(mesh, candidate_hop=1)
+        mask = jax.device_put(jnp.ones(g, bool), sharding)
+        state, elected, term = elect(state, mask)
+        assert bool(np.all(np.asarray(elected))), "log_ok quorum failed"
+        assert np.all(np.asarray(term) == 1)
+        # deposed home leaders stepped down and observed the new term
+        assert not np.any(np.asarray(state.leader.is_leader))
+        assert np.all(np.asarray(state.leader.term) == 1)
+
+    def test_short_log_candidate_loses(self):
+        from redpanda_tpu.parallel import (
+            cluster_tick_sharded,
+            election_round_sharded,
+        )
+
+        mesh, state, sharding, g = self._sharded_state()
+        tick = cluster_tick_sharded(mesh)
+        dirty5 = jax.device_put(jnp.full(g, 5, jnp.int64), sharding)
+        none = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
+        state, _ = tick(state, dirty5)
+        state, _ = tick(state, none)
+
+        # hop-1 candidate artificially LOSES its tail (mirror dirty 3 <
+        # committed 5): the hop-2 voter's log_ok must reject it — the
+        # gate that makes truncation-on-new-term lossless
+        state = state._replace(
+            fol_dirty=state.fol_dirty.at[:, 0].set(3),
+            fol_flushed=state.fol_flushed.at[:, 0].set(3),
+            fol_commit=state.fol_commit.at[:, 0].set(3),
+        )
+        elect = election_round_sharded(mesh, candidate_hop=1)
+        mask = jax.device_put(jnp.ones(g, bool), sharding)
+        state, elected, _term = elect(state, mask)
+        assert not np.any(np.asarray(elected)), (
+            "a candidate missing committed entries won an election"
+        )
+
+    def test_non_uniform_mask_targets_home_blocks(self):
+        """candidate_mask is HOME-block aligned: masking only device
+        0's groups must elect exactly those groups, nothing else."""
+        from redpanda_tpu.parallel import (
+            cluster_tick_sharded,
+            election_round_sharded,
+        )
+
+        mesh, state, sharding, g = self._sharded_state()
+        tick = cluster_tick_sharded(mesh)
+        dirty5 = jax.device_put(jnp.full(g, 5, jnp.int64), sharding)
+        none = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
+        state, _ = tick(state, dirty5)
+        state, _ = tick(state, none)
+        per_dev = g // 8
+        mask = jnp.zeros(g, bool).at[:per_dev].set(True)  # device 0 only
+        elect = election_round_sharded(mesh, candidate_hop=1)
+        state, elected, _t = elect(state, jax.device_put(mask, sharding))
+        e = np.asarray(elected)
+        assert e[:per_dev].all(), "home block 0 not elected"
+        assert not e[per_dev:].any(), "election leaked to other blocks"
+        # only block 0's home leaders stepped down
+        il = np.asarray(state.leader.is_leader)
+        assert not il[:per_dev].any() and il[per_dev:].all()
+
+    def test_one_vote_per_term(self):
+        """Granting adopts the candidate's term (voted_for): a SECOND
+        candidate at the same term (the other ring follower) must not
+        also win — no two leaders for one group and term."""
+        from redpanda_tpu.parallel import (
+            cluster_tick_sharded,
+            election_round_sharded,
+        )
+
+        mesh, state, sharding, g = self._sharded_state()
+        tick = cluster_tick_sharded(mesh)
+        dirty5 = jax.device_put(jnp.full(g, 5, jnp.int64), sharding)
+        none = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
+        state, _ = tick(state, dirty5)
+        state, _ = tick(state, none)
+        mask = jax.device_put(jnp.ones(g, bool), sharding)
+        state, won1, t1 = election_round_sharded(mesh, 1)(state, mask)
+        assert np.all(np.asarray(won1))
+        assert np.all(np.asarray(t1) == 1)
+        # a STALE hop-2 candidate that never heard of the election
+        # (its own term record forced back to 0) campaigns at the SAME
+        # term 1: every voter already adopted term 1 when granting, so
+        # it gets only its self-vote and loses everywhere
+        state = state._replace(
+            fol_term=jax.device_put(
+                jnp.asarray(state.fol_term).at[:, 1].set(0), sharding
+            )
+        )
+        state, won2, _t2 = election_round_sharded(mesh, 2)(state, mask)
+        assert not np.any(np.asarray(won2)), "two leaders at one term"
+        # once it LEARNS term 1, its next candidacy runs at term 2 and
+        # wins legitimately — elections stay live
+        state = state._replace(
+            fol_term=jax.device_put(
+                jnp.asarray(state.fol_term).at[:, 1].set(1), sharding
+            )
+        )
+        state, won3, t3 = election_round_sharded(mesh, 2)(state, mask)
+        assert np.all(np.asarray(won3))
+        assert np.all(np.asarray(t3) == 2)
+
+    def test_new_term_heartbeat_truncates_divergent_mirror(self):
+        from redpanda_tpu.parallel import cluster_tick_sharded
+
+        mesh, state, sharding, g = self._sharded_state()
+        tick = cluster_tick_sharded(mesh)
+        dirty5 = jax.device_put(jnp.full(g, 5, jnp.int64), sharding)
+        none = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
+        state, _ = tick(state, dirty5)
+        state, _ = tick(state, none)
+        assert np.all(np.asarray(state.fol_commit) == 5)
+
+        # followers mirrored a deposed leader's uncommitted suffix
+        # (dirty 7 > committed 5); the NEW leader (term 1) has dirty 5
+        state = state._replace(
+            fol_dirty=jax.device_put(
+                jnp.full_like(state.fol_dirty, 7), sharding
+            ),
+            fol_flushed=jax.device_put(
+                jnp.full_like(state.fol_flushed, 7), sharding
+            ),
+            leader=state.leader._replace(
+                term=state.leader.term + 1,  # new-term leadership
+            ),
+        )
+        state, _ = tick(state, none)
+        fd = np.asarray(state.fol_dirty)
+        fc = np.asarray(state.fol_commit)
+        # divergent suffix truncated to the new leader's log...
+        assert np.all(fd == 5), fd[:4]
+        # ...and NEVER below anything committed
+        assert np.all(fc == 5) and np.all(fd >= fc)
