@@ -1,0 +1,124 @@
+"""In-process Azure-Blob-compatible server for tests, verifying the
+SharedKey signature of every request server-side (the abs analog of
+s3_imposter; reference: cloud_storage_clients ABS tests)."""
+
+from __future__ import annotations
+
+import asyncio
+import urllib.parse
+from xml.sax.saxutils import escape
+
+from redpanda_tpu.cloud.abs_client import shared_key_signature
+
+_PAGE = 2
+
+
+class AbsImposter:
+    def __init__(self, account: str = "acct", key_b64: str = "c2VjcmV0LWtleQ=="):
+        self.account = account
+        self.key_b64 = key_b64
+        self.blobs: dict[str, bytes] = {}
+        self.requests: list[tuple[str, str]] = []
+        self.fail_next = 0
+        self._writers: set = set()
+        self._server: asyncio.AbstractServer | None = None
+        self.port = 0
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_conn, "127.0.0.1", 0)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for w in list(self._writers):
+                w.close()
+            await self._server.wait_closed()
+
+    async def _on_conn(self, reader, writer) -> None:
+        self._writers.add(writer)
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                method, target, _ = line.decode().split(" ", 2)
+                headers: dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                n = int(headers.get("content-length", "0") or 0)
+                body = await reader.readexactly(n) if n else b""
+                status, rh, payload = self._handle(method.upper(), target, headers, body)
+                head = f"HTTP/1.1 {status} X\r\n" + "".join(
+                    f"{k}: {v}\r\n" for k, v in rh.items()
+                )
+                if "content-length" not in rh:
+                    head += f"content-length: {len(payload)}\r\n"
+                writer.write(head.encode() + b"\r\n" + payload)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError, ValueError):
+            pass
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    def _verify(self, method, target, headers) -> bool:
+        auth = headers.get("authorization", "")
+        want = f"SharedKey {self.account}:"
+        if not auth.startswith(want):
+            return False
+        sig = auth[len(want):]
+        expect = shared_key_signature(
+            self.account, self.key_b64, method, target, headers
+        )
+        return sig == expect
+
+    def _handle(self, method, target, headers, body):
+        self.requests.append((method, target))
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            return 500, {}, b"injected"
+        if not self._verify(method, target, headers):
+            return 403, {}, b"<Error><Code>AuthenticationFailed</Code></Error>"
+        path, _, query = target.partition("?")
+        parts = path.lstrip("/").split("/", 1)
+        blob = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+
+        if method == "GET" and not blob and "comp=list" in query:
+            q = urllib.parse.parse_qs(query)
+            prefix = q.get("prefix", [""])[0]
+            marker = q.get("marker", [""])[0]
+            keys = sorted(k for k in self.blobs if k.startswith(prefix))
+            if marker:
+                keys = [k for k in keys if k > marker]
+            page, rest = keys[:_PAGE], keys[_PAGE:]
+            items = "".join(
+                f"<Blob><Name>{escape(k)}</Name></Blob>" for k in page
+            )
+            nxt = f"<NextMarker>{escape(page[-1])}</NextMarker>" if rest else ""
+            xml = (
+                f"<EnumerationResults><Blobs>{items}</Blobs>{nxt}"
+                f"</EnumerationResults>"
+            )
+            return 200, {"content-type": "application/xml"}, xml.encode()
+        if method == "PUT" and blob:
+            if headers.get("x-ms-blob-type") != "BlockBlob":
+                return 400, {}, b"<Error><Code>MissingRequiredHeader</Code></Error>"
+            self.blobs[blob] = body
+            return 201, {}, b""
+        if method == "GET" and blob:
+            if blob not in self.blobs:
+                return 404, {}, b""
+            return 200, {}, self.blobs[blob]
+        if method == "HEAD" and blob:
+            if blob not in self.blobs:
+                return 404, {"content-length": "0"}, b""
+            return 200, {"content-length": str(len(self.blobs[blob]))}, b""
+        if method == "DELETE" and blob:
+            self.blobs.pop(blob, None)
+            return 202, {}, b""
+        return 400, {}, b"bad request"
