@@ -72,6 +72,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--pandaproxy-port", type=int, default=8082)
     ap.add_argument("--enable-schema-registry", action="store_true")
     ap.add_argument("--schema-registry-port", type=int, default=8081)
+    ap.add_argument(
+        "--logical-version",
+        type=int,
+        default=None,
+        help="advertise an older feature level (mixed-version testing)",
+    )
     ap.add_argument("--log-level", default="INFO")
     return ap.parse_args(argv)
 
@@ -125,6 +131,7 @@ def build_config(args) -> BrokerConfig:
         advertised_host=advertised,
         rack=args.rack,
         enable_sasl=args.enable_sasl,
+        logical_version=args.logical_version,
         kafka_tls_cert=args.kafka_tls_cert,
         kafka_tls_key=args.kafka_tls_key,
         kafka_tls_ca=args.kafka_tls_ca,

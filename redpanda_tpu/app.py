@@ -101,6 +101,9 @@ class BrokerConfig:
     archival_interval_s: float = 1.0
     # cluster stats report cadence (metrics_reporter analog); <= 0 off
     stats_interval_s: float = 900.0
+    # advertise an older feature level (mixed-version upgrade testing;
+    # None = this build's LATEST_LOGICAL_VERSION)
+    logical_version: Optional[int] = None
     # admin HTTP listener (admin_server.cc); port 0 = ephemeral
     admin_host: str = "127.0.0.1"
     admin_port: int = 0
@@ -181,6 +184,7 @@ class Broker:
             send,
         )
         self.controller.authorizer.superusers = set(config.superusers or [])
+        self.controller.logical_version_override = config.logical_version
         self.leaders = PartitionLeadersTable()
         self.controller.leaders_table = self.leaders
         self.metadata_cache = MetadataCache(

@@ -331,6 +331,8 @@ class Controller:
         self.features = FeatureTable()
         # replicated one-shot migration completion set (migrations/)
         self.migrations_done: set[str] = set()
+        # advertise an older feature level (mixed-version test seam)
+        self._logical_version_override: int | None = None
         from ..config import ClusterConfig
 
         self.cluster_config = ClusterConfig()
@@ -354,6 +356,22 @@ class Controller:
         self.leader_balancer_enabled = True
         self.partition_balancer_enabled = True
         self._closed = False
+
+    @property
+    def logical_version_override(self) -> int | None:
+        return self._logical_version_override
+
+    @logical_version_override.setter
+    def logical_version_override(self, v: int | None) -> None:
+        """Only OLDER levels may be advertised: a value above this
+        build's LATEST would replicate a cluster_version no real build
+        can match — and cluster_version is monotonic, so every genuine
+        build would be locked out of joins forever."""
+        if v is not None and not (1 <= v <= LATEST_LOGICAL_VERSION):
+            raise ValueError(
+                f"logical_version must be in [1, {LATEST_LOGICAL_VERSION}]: {v}"
+            )
+        self._logical_version_override = v
 
     @property
     def members(self) -> list[int]:
@@ -622,7 +640,14 @@ class Controller:
             kafka_host=kafka_addr[0],
             kafka_port=int(kafka_addr[1]),
             rack=rack,
-            logical_version=LATEST_LOGICAL_VERSION,
+            # override = mixed-version testing seam (the reference's
+            # redpanda_installer runs real old builds; here the build
+            # ADVERTISES an older feature level instead)
+            logical_version=(
+                self._logical_version_override
+                if self._logical_version_override is not None
+                else LATEST_LOGICAL_VERSION
+            ),
         )
         deadline = asyncio.get_event_loop().time() + timeout
         payload = cmd.encode()
@@ -651,6 +676,11 @@ class Controller:
                             ),
                         )
                     return
+                if reply.code == "invalid_request":
+                    # PERMANENT: the version gate (build too old for
+                    # the active cluster) — retrying cannot succeed,
+                    # and a silently-unregistered broker serves nothing
+                    raise TopicError(reply.code, f"join: {reply.message}")
                 last_err = reply.code
             if asyncio.get_event_loop().time() > deadline:
                 raise TopicError("request_timed_out", f"join: {last_err}")
