@@ -118,6 +118,17 @@ class MetadataDissemination:
         ]
         if not entries:
             return
+        # a broker is its own gossip audience too: keeps the RAW hints
+        # table consistent on the new leader itself. Client-visible
+        # metadata is already correct without this (leader_of prefers
+        # the hosted partition's consensus view) — this is hygiene for
+        # direct `leaders` readers and debugging, not a client fix.
+        for e in entries:
+            self.apply_hint(
+                NTP(e.ns, e.topic, int(e.partition)),
+                int(e.term),
+                int(e.leader),
+            )
         msg = _LeaderUpdate(
             from_node=self.broker.node_id, entries=entries
         ).encode()

@@ -241,20 +241,35 @@ class KafkaClient:
         self._sasl = sasl
         self._ssl = ssl
         self._conns: dict[tuple[str, int], BrokerConnection] = {}
+        self._conn_locks: dict[tuple[str, int], asyncio.Lock] = {}
         self._brokers: dict[int, tuple[str, int]] = {}
         self._leaders: dict[tuple[str, int], int] = {}  # (topic,part)→node
         self._topic_errors: dict[str, int] = {}
 
     async def _connect_addr(self, addr: tuple[str, int]) -> BrokerConnection:
-        conn = self._conns.get(addr)
-        if conn is None:
-            conn = BrokerConnection(
-                addr[0], addr[1], self._client_id, sasl=self._sasl,
-                ssl=self._ssl,
-            )
-            await conn.connect()
-            self._conns[addr] = conn
-        return conn
+        # per-address serialization: concurrent callers racing a
+        # reconnect would each open a socket and the loser's
+        # connection (+ read task) would leak
+        lock = self._conn_locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(addr)
+            if conn is not None and conn._dead is not None:
+                # a cached connection whose read loop died (broker
+                # restart/crash) must not be handed out: every request
+                # on it fails instantly and any_conn's per-seed
+                # fallback never fires (the CONNECT succeeded long
+                # ago) — wedging the whole client on one dead broker
+                await conn.close()
+                self._conns.pop(addr, None)
+                conn = None
+            if conn is None:
+                conn = BrokerConnection(
+                    addr[0], addr[1], self._client_id, sasl=self._sasl,
+                    ssl=self._ssl,
+                )
+                await conn.connect()
+                self._conns[addr] = conn
+            return conn
 
     async def any_conn(self) -> BrokerConnection:
         last: Exception | None = None
@@ -300,7 +315,17 @@ class KafkaClient:
                 await self.metadata([topic])
             leader = self._leaders.get(key)
             if leader is not None and leader in self._brokers:
-                return await self._connect_addr(self._brokers[leader])
+                try:
+                    return await self._connect_addr(self._brokers[leader])
+                except (OSError, KafkaClientError):
+                    # the cached "leader" is unreachable or dies during
+                    # the handshake (connect refused = OSError; socket
+                    # reset mid-API_VERSIONS = KafkaClientError): treat
+                    # exactly like not_leader — drop the cache entry
+                    # and re-resolve, instead of letting the error
+                    # escape and strand every caller on attempt-0
+                    # stale state
+                    self._leaders.pop(key, None)
             terr = self._topic_errors.get(topic, 0)
             if terr in (
                 int(ErrorCode.unknown_topic_or_partition),
@@ -695,7 +720,12 @@ class GroupClient:
         from .protocol.group_apis import FIND_COORDINATOR
 
         if self._coord is not None and not refresh:
-            return self._coord
+            if self._coord._dead is None:
+                return self._coord
+            # cached coordinator connection died (broker restart):
+            # re-resolve instead of failing every request forever —
+            # the object cache bypasses _connect_addr's eviction
+            self._coord = None
         deadline = asyncio.get_event_loop().time() + 5.0
         while True:
             conn = await self.client.any_conn()
@@ -948,7 +978,12 @@ class TransactionalProducer:
         from .protocol.group_apis import FIND_COORDINATOR
 
         if self._coord is not None and not refresh:
-            return self._coord
+            if self._coord._dead is None:
+                return self._coord
+            # cached coordinator connection died (broker restart):
+            # re-resolve instead of failing every request forever —
+            # the object cache bypasses _connect_addr's eviction
+            self._coord = None
         deadline = asyncio.get_event_loop().time() + 5.0
         while True:
             conn = await self.client.any_conn()
