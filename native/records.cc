@@ -1,0 +1,217 @@
+// Record wire-format walker + builder — host-native hot path.
+//
+// TPU-native rebuild of the reference's record parsing/serialization
+// (reference: src/v/model/record_utils.cc parse_one_record,
+// src/v/storage/parser_utils.cc, src/v/storage/record_batch_builder.cc).
+// The reference keeps this in C++ because it is the per-record inner
+// loop of compaction, state-machine replay and protocol conversion; we
+// do the same, exposed to Python via ctypes with a pure-Python
+// fallback (models/record.py).
+//
+// Wire format per record (Kafka record v2 == reference model::record):
+//   length       : signed zig-zag varint (bytes after this field)
+//   attributes   : 1 byte
+//   ts_delta     : signed varint
+//   offset_delta : signed varint
+//   key_len      : signed varint (-1 = null), then key bytes
+//   val_len      : signed varint (-1 = null), then value bytes
+//   hdr_count    : signed varint, then per header:
+//     hk_len vint, hk bytes, hv_len vint, hv bytes   (-1 len = empty)
+//
+// Instead of materializing objects, rp_parse_records emits one fixed
+// descriptor row per record (offsets into the caller's buffer) so the
+// Python side can build objects lazily — or, as compaction does, slice
+// surviving records' wire bytes verbatim without re-encoding.
+
+#include <cstddef>
+#include <cstdint>
+
+namespace {
+
+// Decode one unsigned LEB128 varint. Returns bytes consumed, or -1 on
+// truncation / >64-bit overflow.
+inline int64_t vint_decode_u(const uint8_t* buf, uint64_t len, uint64_t* out) {
+    uint64_t result = 0;
+    int shift = 0;
+    uint64_t pos = 0;
+    for (;;) {
+        if (pos >= len || shift > 63) return -1;
+        uint8_t b = buf[pos++];
+        result |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) {
+            *out = result;
+            return (int64_t)pos;
+        }
+        shift += 7;
+    }
+}
+
+inline int64_t vint_decode(const uint8_t* buf, uint64_t len, int64_t* out) {
+    uint64_t u;
+    int64_t n = vint_decode_u(buf, len, &u);
+    if (n < 0) return -1;
+    *out = (int64_t)(u >> 1) ^ -(int64_t)(u & 1);  // zig-zag
+    return n;
+}
+
+// Encode one signed zig-zag varint; returns bytes written (<= 10).
+inline uint64_t vint_encode(int64_t v, uint8_t* out) {
+    uint64_t u = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+    uint64_t n = 0;
+    do {
+        uint8_t b = u & 0x7F;
+        u >>= 7;
+        out[n++] = u ? (b | 0x80) : b;
+    } while (u);
+    return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Number of int64 slots per record descriptor row.
+enum { RP_REC_DESC_WIDTH = 11 };
+
+// Descriptor row layout (all int64):
+//   0 rec_off    start of the record (the length-prefix varint)
+//   1 end_off    one past the record's last byte
+//   2 attrs
+//   3 ts_delta
+//   4 offset_delta
+//   5 key_off    (byte offset of key data; 0 when key_len < 0)
+//   6 key_len    (-1 = null)
+//   7 val_off
+//   8 val_len    (-1 = null)
+//   9 hdr_off    start of the header-count varint
+//  10 hdr_count
+//
+// Parses exactly `count` records from body[0..len). Headers are
+// walked (validated + skipped); Python re-parses the [hdr_off,
+// end_off) region only for the rare records that carry any.
+// Trailing bytes after the last record are ignored — the pure-Python
+// decoder stops after `count` records too, and the two paths must
+// accept the same inputs on every host.
+// Returns 0 on success; -(i+1) when record i is malformed; -1000-i
+// when record i overruns/underruns its declared length.
+int64_t rp_parse_records(const uint8_t* body, uint64_t len, int64_t count,
+                         int64_t* out) {
+    uint64_t pos = 0;
+    for (int64_t i = 0; i < count; i++) {
+        int64_t* d = out + i * RP_REC_DESC_WIDTH;
+        int64_t rec_len, v, n;
+        uint64_t start = pos;
+        n = vint_decode(body + pos, len - pos, &rec_len);
+        if (n < 0 || rec_len < 1) return -(i + 1);
+        pos += (uint64_t)n;
+        if (rec_len > (int64_t)(len - pos)) return -(i + 1);
+        uint64_t end = pos + (uint64_t)rec_len;
+
+        int64_t attrs = body[pos++];
+        n = vint_decode(body + pos, end - pos, &v);
+        if (n < 0) return -(i + 1);
+        int64_t ts_delta = v;
+        pos += (uint64_t)n;
+        n = vint_decode(body + pos, end - pos, &v);
+        if (n < 0) return -(i + 1);
+        int64_t off_delta = v;
+        pos += (uint64_t)n;
+
+        int64_t key_len, val_len;
+        uint64_t key_off = 0, val_off = 0;
+        n = vint_decode(body + pos, end - pos, &key_len);
+        if (n < 0) return -(i + 1);
+        pos += (uint64_t)n;
+        if (key_len >= 0) {
+            if ((uint64_t)key_len > end - pos) return -(i + 1);
+            key_off = pos;
+            pos += (uint64_t)key_len;
+        } else {
+            key_len = -1;
+        }
+        n = vint_decode(body + pos, end - pos, &val_len);
+        if (n < 0) return -(i + 1);
+        pos += (uint64_t)n;
+        if (val_len >= 0) {
+            if ((uint64_t)val_len > end - pos) return -(i + 1);
+            val_off = pos;
+            pos += (uint64_t)val_len;
+        } else {
+            val_len = -1;
+        }
+
+        uint64_t hdr_off = pos;
+        int64_t hdr_count;
+        n = vint_decode(body + pos, end - pos, &hdr_count);
+        if (n < 0 || hdr_count < 0) return -(i + 1);
+        pos += (uint64_t)n;
+        for (int64_t h = 0; h < hdr_count; h++) {
+            for (int part = 0; part < 2; part++) {  // key then value
+                int64_t hlen;
+                n = vint_decode(body + pos, end - pos, &hlen);
+                if (n < 0) return -(i + 1);
+                pos += (uint64_t)n;
+                if (hlen > 0) {
+                    if ((uint64_t)hlen > end - pos) return -(i + 1);
+                    pos += (uint64_t)hlen;
+                }
+            }
+        }
+        if (pos != end) return -1000 - i;
+        d[0] = (int64_t)start;
+        d[1] = (int64_t)end;
+        d[2] = attrs;
+        d[3] = ts_delta;
+        d[4] = off_delta;
+        d[5] = (int64_t)key_off;
+        d[6] = key_len;
+        d[7] = (int64_t)val_off;
+        d[8] = val_len;
+        d[9] = (int64_t)hdr_off;
+        d[10] = hdr_count;
+    }
+    return 0;
+}
+
+// Serialize `count` header-less records (attributes 0, offset_delta ==
+// index — the builder's layout; records with headers take the Python
+// path). keys/vals are the concatenated non-null payloads in record
+// order; key_lens/val_lens give each record's length with -1 = null.
+// Returns bytes written into out[0..out_cap), or -1 when out_cap is
+// too small (caller sizes it with rp_encode_records_bound).
+int64_t rp_encode_records(int64_t count, const int64_t* ts_deltas,
+                          const uint8_t* keys, const int64_t* key_lens,
+                          const uint8_t* vals, const int64_t* val_lens,
+                          uint8_t* out, uint64_t out_cap) {
+    uint64_t kpos = 0, vpos = 0, opos = 0;
+    for (int64_t i = 0; i < count; i++) {
+        uint8_t pre[32];   // attrs + ts vint + offset vint + klen vint
+        uint8_t vpre[10];  // vlen vint
+        uint64_t klen = key_lens[i] < 0 ? 0 : (uint64_t)key_lens[i];
+        uint64_t vlen = val_lens[i] < 0 ? 0 : (uint64_t)val_lens[i];
+
+        uint64_t pn = 0;
+        pre[pn++] = 0;  // attributes
+        pn += vint_encode(ts_deltas[i], pre + pn);
+        pn += vint_encode(i, pre + pn);  // offset_delta == index
+        pn += vint_encode(key_lens[i] < 0 ? -1 : key_lens[i], pre + pn);
+        uint64_t vn = vint_encode(val_lens[i] < 0 ? -1 : val_lens[i], vpre);
+
+        uint64_t body_len = pn + klen + vn + vlen + 1;  // +1: hdr count 0
+        uint8_t lenbuf[10];
+        uint64_t lenn = vint_encode((int64_t)body_len, lenbuf);
+        if (opos + lenn + body_len > out_cap) return -1;
+
+        for (uint64_t b = 0; b < lenn; b++) out[opos++] = lenbuf[b];
+        for (uint64_t b = 0; b < pn; b++) out[opos++] = pre[b];
+        for (uint64_t b = 0; b < klen; b++) out[opos++] = keys[kpos + b];
+        kpos += klen;
+        for (uint64_t b = 0; b < vn; b++) out[opos++] = vpre[b];
+        for (uint64_t b = 0; b < vlen; b++) out[opos++] = vals[vpos + b];
+        vpos += vlen;
+        out[opos++] = 0;  // header count varint(0)
+    }
+    return (int64_t)opos;
+}
+
+}  // extern "C"

@@ -38,8 +38,14 @@ import numpy as np
 from .. import compression as compression_mod
 from ..compression import CompressionType
 from ..utils import crc as crc_mod
+from ..utils import native as native_mod
 from ..utils import vint
 from ..utils.iobuf import IOBufParser
+
+# Width of one native record descriptor row (native/records.cc
+# RP_REC_DESC_WIDTH): [rec_off, end_off, attrs, ts_delta, offset_delta,
+# key_off, key_len, val_off, val_len, hdr_off, hdr_count].
+_DESC_W = 11
 
 
 class RecordBatchType(enum.IntEnum):
@@ -377,14 +383,48 @@ class RecordBatch:
         return batch
 
     # -- records access ---------------------------------------------
-    def records(self) -> list[Record]:
-        """Decode records (decompressing the body if needed)."""
+    def _records_body(self) -> bytes:
         data = self.body
         ctype = self.header.compression
         if ctype != CompressionType.none:
             data = compression_mod.uncompress(data, ctype)
-        parser = IOBufParser(data)
-        return [Record.decode(parser) for _ in range(self.header.record_count)]
+        return data if isinstance(data, bytes) else bytes(data)
+
+    def records(self) -> list[Record]:
+        """Decode records (decompressing the body if needed).
+
+        Hot path (compaction key scans, STM replay, command decode)
+        dispatches to the native walker — one C call per batch — and
+        builds the objects from its descriptor rows; pure Python is the
+        fallback (reference keeps this loop native too:
+        model/record_utils.cc parse_one_record).
+        """
+        data = self._records_body()
+        count = self.header.record_count
+        desc = parse_record_descriptors(data, count)
+        if desc is None:
+            parser = IOBufParser(data)
+            return [Record.decode(parser) for _ in range(count)]
+        out: list[Record] = []
+        for i in range(count):
+            o = i * _DESC_W
+            key_len = desc[o + 6]
+            val_len = desc[o + 8]
+            key = data[desc[o + 5] : desc[o + 5] + key_len] if key_len >= 0 else None
+            value = data[desc[o + 7] : desc[o + 7] + val_len] if val_len >= 0 else None
+            headers: list[RecordHeader] = []
+            if desc[o + 10] > 0:
+                hp = IOBufParser(data[desc[o + 9] : desc[o + 1]])
+                for _ in range(hp.read_vint()):
+                    hklen = hp.read_vint()
+                    hk = hp.read(hklen) if hklen >= 0 else b""
+                    hvlen = hp.read_vint()
+                    hv = hp.read(hvlen) if hvlen >= 0 else b""
+                    headers.append(RecordHeader(hk, hv))
+            out.append(
+                Record(desc[o + 2], desc[o + 3], desc[o + 4], key, value, headers)
+            )
+        return out
 
     def __repr__(self) -> str:  # pragma: no cover
         h = self.header
@@ -396,6 +436,33 @@ class RecordBatch:
 
 class CrcMismatch(ValueError):
     pass
+
+
+def parse_record_descriptors(data: bytes, count: int) -> list[int] | None:
+    """One native call → flat descriptor list (`_DESC_W` int64 slots per
+    record, offsets into `data`); None when the native library is
+    unavailable. Raises ValueError on malformed input. Lets scan-heavy
+    callers (compaction's key map, verbatim record slicing) avoid
+    materializing Record objects entirely."""
+    lib = native_mod.load()
+    if lib is None:
+        return None
+    if count <= 0:
+        # match the pure-Python decoder: range(count) is empty
+        return []
+    if count > len(data) // 7:
+        # the header's record_count is corruption/attacker-controlled
+        # and CRC only proves it was sent that way — bound the
+        # descriptor allocation by the smallest possible wire record
+        # (7 bytes) BEFORE sizing the array
+        raise ValueError(f"record_count {count} impossible for {len(data)}-byte body")
+    import ctypes
+
+    desc = (ctypes.c_int64 * (count * _DESC_W))()
+    rc = lib.rp_parse_records(data, len(data), count, desc)
+    if rc != 0:
+        raise ValueError(f"malformed record body (native walker code {rc})")
+    return list(desc)
 
 
 class RecordBatchBuilder:
@@ -426,7 +493,10 @@ class RecordBatchBuilder:
             timestamp_ms if timestamp_ms is not None else int(time.time() * 1000)
         )
         self._max_ts = self._base_ts
-        self._records: list[bytes] = []
+        # (ts_delta, key, value, headers) — encoding is deferred to
+        # build() so the whole batch goes through one native call when
+        # no record carries headers (the common case).
+        self._records: list[tuple[int, bytes | None, bytes | None, list]] = []
 
     def add(
         self,
@@ -437,24 +507,53 @@ class RecordBatchBuilder:
     ) -> "RecordBatchBuilder":
         ts = timestamp_ms if timestamp_ms is not None else self._base_ts
         self._max_ts = max(self._max_ts, ts)
-        rec = Record(
-            attributes=0,
-            timestamp_delta=ts - self._base_ts,
-            offset_delta=len(self._records),
-            key=key,
-            value=value,
-            headers=[RecordHeader(k, v) for k, v in headers],
+        self._records.append(
+            (ts - self._base_ts, key, value, [RecordHeader(k, v) for k, v in headers])
         )
-        self._records.append(rec.encode())
         return self
 
     def empty(self) -> bool:
         return not self._records
 
+    def _encode_raw(self) -> bytes:
+        lib = native_mod.load()
+        if lib is not None and not any(h for _, _, _, h in self._records):
+            import ctypes
+
+            n = len(self._records)
+            ts = (ctypes.c_int64 * n)(*(r[0] for r in self._records))
+            key_lens = (ctypes.c_int64 * n)(
+                *((-1 if r[1] is None else len(r[1])) for r in self._records)
+            )
+            val_lens = (ctypes.c_int64 * n)(
+                *((-1 if r[2] is None else len(r[2])) for r in self._records)
+            )
+            keys = b"".join(r[1] for r in self._records if r[1] is not None)
+            vals = b"".join(r[2] for r in self._records if r[2] is not None)
+            cap = 64 * n + len(keys) + len(vals)
+            out = ctypes.create_string_buffer(cap)
+            written = lib.rp_encode_records(
+                n, ts, keys, key_lens, vals, val_lens, out, cap
+            )
+            if written > 0:
+                return out.raw[:written]
+            # fall through to Python on the (impossible) bound miss
+        return b"".join(
+            Record(
+                attributes=0,
+                timestamp_delta=ts_delta,
+                offset_delta=i,
+                key=key,
+                value=value,
+                headers=headers,
+            ).encode()
+            for i, (ts_delta, key, value, headers) in enumerate(self._records)
+        )
+
     def build(self) -> RecordBatch:
         if not self._records:
             raise ValueError("empty batch")
-        raw = b"".join(self._records)
+        raw = self._encode_raw()
         attrs = int(self._compression) & _COMPRESSION_MASK
         if self._transactional:
             attrs |= _TRANSACTIONAL_BIT

@@ -31,11 +31,14 @@ from __future__ import annotations
 import os
 
 from ..models.record import (
+    _DESC_W,
     CompressionType,
     Record,
     RecordBatch,
     RecordBatchType,
+    parse_record_descriptors,
 )
+from ..utils.iobuf import IOBufParser
 
 _COMPRESSION_MASK = 0x07
 
@@ -65,11 +68,37 @@ def build_key_map(segments, participates) -> dict[bytes, int]:
         for batch in seg.read_batches(seg.base_offset):
             if not _is_compactable(batch.header):
                 continue
+            base = batch.header.base_offset
             try:
-                records = batch.records()
+                # descriptor scan: one native call, then slice only the
+                # keys — no Record objects on this whole-log pass
+                data = batch._records_body()
+                desc = parse_record_descriptors(data, batch.header.record_count)
             except Exception:
                 continue
-            base = batch.header.base_offset
+            if desc is not None:
+                for o in range(0, len(desc), _DESC_W):
+                    key_len = desc[o + 6]
+                    if key_len < 0:
+                        continue
+                    off = base + desc[o + 4]
+                    if not participates(batch, off):
+                        continue
+                    key = data[desc[o + 5] : desc[o + 5] + key_len]
+                    if off > latest.get(key, -1):
+                        latest[key] = off
+                continue
+            try:
+                # no native lib: decode from the already-decompressed
+                # body rather than batch.records() (which would
+                # decompress a second time)
+                parser = IOBufParser(data)
+                records = [
+                    Record.decode(parser)
+                    for _ in range(batch.header.record_count)
+                ]
+            except Exception:
+                continue
             for r in records:
                 if r.key is not None:
                     off = base + r.offset_delta
@@ -93,23 +122,49 @@ def _filter_batch(
     their visibility; removing them here would race the tx outcome."""
     if not _is_compactable(batch.header):
         return None
+    base = batch.header.base_offset
+    n = batch.header.record_count
     try:
-        records = batch.records()
+        data = batch._records_body()
+        desc = parse_record_descriptors(data, n)
     except Exception:
         return None
-    base = batch.header.base_offset
-    keep: list[Record] = []
-    for r in records:
-        off = base + r.offset_delta
-        if (
-            r.key is None
-            or not participates(batch, off)
-            or key_map.get(r.key) == off
-        ):
-            keep.append(r)
-    if len(keep) == len(records):
-        return None
-    body = b"".join(r.encode() for r in keep)
+    if desc is not None:
+        # verbatim slices: surviving records keep their offset/timestamp
+        # deltas, so their wire bytes are reused unchanged
+        slices: list[tuple[int, int]] = []
+        for o in range(0, len(desc), _DESC_W):
+            key_len = desc[o + 6]
+            off = base + desc[o + 4]
+            if (
+                key_len < 0
+                or not participates(batch, off)
+                or key_map.get(data[desc[o + 5] : desc[o + 5] + key_len]) == off
+            ):
+                slices.append((desc[o + 0], desc[o + 1]))
+        if len(slices) == n:
+            return None
+        body = b"".join(data[s:e] for s, e in slices)
+        n_keep = len(slices)
+    else:
+        try:
+            parser = IOBufParser(data)
+            records = [Record.decode(parser) for _ in range(n)]
+        except Exception:
+            return None
+        keep: list[Record] = []
+        for r in records:
+            off = base + r.offset_delta
+            if (
+                r.key is None
+                or not participates(batch, off)
+                or key_map.get(r.key) == off
+            ):
+                keep.append(r)
+        if len(keep) == len(records):
+            return None
+        body = b"".join(r.encode() for r in keep)
+        n_keep = len(keep)
     hdr = batch.header
     new_hdr = type(hdr)(
         header_crc=0,
@@ -125,7 +180,7 @@ def _filter_batch(
         producer_id=hdr.producer_id,
         producer_epoch=hdr.producer_epoch,
         base_sequence=hdr.base_sequence,
-        record_count=len(keep),
+        record_count=n_keep,
         term=hdr.term,
     )
     out = RecordBatch(new_hdr, body)

@@ -84,5 +84,23 @@ def load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_uint32),
             ctypes.c_size_t,
         ]
+        lib.rp_parse_records.restype = ctypes.c_int64
+        lib.rp_parse_records.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.rp_encode_records.restype = ctypes.c_int64
+        lib.rp_encode_records.argtypes = [
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),  # ts_deltas
+            ctypes.c_char_p,                 # keys
+            ctypes.POINTER(ctypes.c_int64),  # key_lens
+            ctypes.c_char_p,                 # vals
+            ctypes.POINTER(ctypes.c_int64),  # val_lens
+            ctypes.POINTER(ctypes.c_char),   # out (writable)
+            ctypes.c_uint64,
+        ]
         _lib = lib
         return _lib
