@@ -81,6 +81,15 @@ class BrokerConfig:
     enable_sasl: bool = False
     enable_authorization: Optional[bool] = None  # None = follow enable_sasl
     superusers: Optional[list[str]] = None
+    # OIDC / SASL OAUTHBEARER (oidc_service analogs). Setting all
+    # three of issuer/audience/jwks enables the OAUTHBEARER mechanism
+    # alongside SCRAM when enable_sasl is on. jwks is a path to a JWKS
+    # JSON document (zero-egress stand-in for the issuer's
+    # .well-known endpoint; a production refresher would rewrite it).
+    oidc_issuer: Optional[str] = None
+    oidc_audience: Optional[str] = None
+    oidc_jwks_file: Optional[str] = None
+    oidc_principal_claim: str = "sub"
     # retention + compaction pass interval (log_compaction_interval_ms
     # analog); <= 0 disables the timer (tests drive housekeeping directly)
     housekeeping_interval_s: float = 10.0
@@ -184,6 +193,35 @@ class Broker:
             send,
         )
         self.controller.authorizer.superusers = set(config.superusers or [])
+        self.oidc = None
+        _oidc_fields = (
+            config.oidc_issuer,
+            config.oidc_audience,
+            config.oidc_jwks_file,
+        )
+        if any(_oidc_fields) and not all(_oidc_fields):
+            raise ValueError(
+                "OIDC config incomplete: oidc_issuer, oidc_audience and "
+                "oidc_jwks_file must all be set to enable OAUTHBEARER "
+                f"(got issuer={config.oidc_issuer!r}, "
+                f"audience={config.oidc_audience!r}, "
+                f"jwks_file={config.oidc_jwks_file!r})"
+            )
+        if all(_oidc_fields):
+            import json as _json
+
+            from .security.oidc import OidcAuthenticator, OidcConfig
+
+            with open(config.oidc_jwks_file) as f:
+                jwks = _json.load(f)
+            self.oidc = OidcAuthenticator(
+                OidcConfig(
+                    issuer=config.oidc_issuer,
+                    audience=config.oidc_audience,
+                    jwks=jwks,
+                    principal_claim=config.oidc_principal_claim,
+                )
+            )
         self.controller.logical_version_override = config.logical_version
         self.leaders = PartitionLeadersTable()
         self.controller.leaders_table = self.leaders

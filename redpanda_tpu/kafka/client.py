@@ -85,7 +85,8 @@ class BrokerConnection:
     async def _authenticate(
         self, user: str, password: str, mechanism: str
     ) -> None:
-        """SCRAM client exchange (RFC 5802) over SaslHandshake +
+        """SCRAM client exchange (RFC 5802) or OAUTHBEARER (RFC 7628,
+        token passed in the password slot) over SaslHandshake +
         SaslAuthenticate."""
         from ..security import scram as sc
         from .protocol.admin_apis import SASL_AUTHENTICATE, SASL_HANDSHAKE
@@ -95,6 +96,17 @@ class BrokerConnection:
         )
         if resp.error_code != 0:
             raise KafkaClientError(resp.error_code, "sasl_handshake")
+        if mechanism == "OAUTHBEARER":
+            from ..security import oidc as oidc_mod
+
+            resp = await self.request(
+                SASL_AUTHENTICATE,
+                Msg(auth_bytes=oidc_mod.client_first_message(password)),
+                version=1,
+            )
+            if resp.error_code != 0:
+                raise KafkaClientError(resp.error_code, "oauthbearer auth")
+            return
         first, nonce = sc.client_first_message(user)
         resp = await self.request(
             SASL_AUTHENTICATE, Msg(auth_bytes=first.encode()), version=1

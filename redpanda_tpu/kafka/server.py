@@ -22,6 +22,7 @@ import contextvars
 import dataclasses
 import logging
 import struct
+import time
 from typing import TYPE_CHECKING
 
 from ..cluster.producer_state import (
@@ -92,13 +93,22 @@ class ConnectionContext:
     """Per-connection state: SASL exchange + authenticated principal
     (reference: kafka/server/connection_context.h sasl state)."""
 
-    __slots__ = ("principal", "mechanism", "scram", "authenticated")
+    __slots__ = (
+        "principal",
+        "mechanism",
+        "scram",
+        "authenticated",
+        "session_expires_at",
+    )
 
     def __init__(self) -> None:
         self.principal: str | None = None
         self.mechanism: str | None = None
         self.scram = None
         self.authenticated = False
+        # unix seconds after which the SASL session is no longer valid
+        # (OAUTHBEARER: the token's exp; None = unbounded)
+        self.session_expires_at: float | None = None
 
 
 # the principal of the request currently being handled (set around the
@@ -352,6 +362,22 @@ class KafkaServer:
                 "unauthenticated %s request: closing connection", api.name
             )
             raise _CloseConnection(b"")
+        if (
+            ctx.authenticated
+            and ctx.session_expires_at is not None
+            and time.time() >= ctx.session_expires_at
+            and hdr.api_key
+            not in (API_VERSIONS.key, SASL_HANDSHAKE.key, SASL_AUTHENTICATE.key)
+        ):
+            # SASL session bounded by token expiry (KIP-368 semantics:
+            # past the lifetime the broker disconnects unless the
+            # client re-authenticates; handshake/authenticate stay
+            # allowed so re-auth on the live connection works)
+            logger.info(
+                "sasl session expired for %s: closing connection",
+                ctx.principal,
+            )
+            raise _CloseConnection(b"")
         if not api.supports(hdr.api_version):
             # only ApiVersions has a downgrade contract (reply v0 +
             # UNSUPPORTED_VERSION so the client renegotiates); for any
@@ -439,22 +465,30 @@ class KafkaServer:
     def handle_sasl_handshake(
         self, ctx: ConnectionContext, hdr: RequestHeader, req: Msg
     ) -> Msg:
+        from ..security import oidc as oidc_mod
         from ..security.scram import MECHANISMS, ScramServerExchange
 
-        if req.mechanism not in MECHANISMS:
+        supported = list(MECHANISMS)
+        if self.broker.oidc is not None:
+            supported.append(oidc_mod.SASL_MECHANISM)
+        if req.mechanism not in supported:
             return Msg(
                 error_code=int(ErrorCode.unsupported_sasl_mechanism),
-                mechanisms=list(MECHANISMS),
+                mechanisms=supported,
             )
         ctx.mechanism = req.mechanism
-        ctx.scram = ScramServerExchange(
-            self.broker.controller.credentials, req.mechanism
-        )
-        return Msg(error_code=0, mechanisms=list(MECHANISMS))
+        if req.mechanism == oidc_mod.SASL_MECHANISM:
+            ctx.scram = oidc_mod.OauthBearerExchange(self.broker.oidc)
+        else:
+            ctx.scram = ScramServerExchange(
+                self.broker.controller.credentials, req.mechanism
+            )
+        return Msg(error_code=0, mechanisms=supported)
 
     def handle_sasl_authenticate(
         self, ctx: ConnectionContext, hdr: RequestHeader, req: Msg
     ) -> Msg:
+        from ..security.oidc import OidcError
         from ..security.scram import ScramError
 
         def err(code: int, message: str) -> Msg:
@@ -476,7 +510,7 @@ class KafkaServer:
                 return err(
                     int(ErrorCode.illegal_sasl_state), "exchange complete"
                 )
-        except ScramError as e:
+        except (ScramError, OidcError) as e:
             logger.info("sasl authentication failed: %s", e)
             return err(int(ErrorCode.sasl_authentication_failed), str(e))
         except Exception as e:
@@ -488,15 +522,21 @@ class KafkaServer:
                 int(ErrorCode.sasl_authentication_failed),
                 "malformed SASL message",
             )
+        lifetime_ms = 0
         if ctx.scram.done:
             ctx.principal = f"User:{ctx.scram.username}"
             ctx.authenticated = True
+            ctx.session_expires_at = getattr(ctx.scram, "expires_at", None)
+            if ctx.session_expires_at is not None:
+                lifetime_ms = max(
+                    0, int((ctx.session_expires_at - time.time()) * 1000)
+                )
             logger.info("sasl: authenticated %s", ctx.principal)
         return Msg(
             error_code=0,
             error_message=None,
             auth_bytes=out,
-            session_lifetime_ms=0,
+            session_lifetime_ms=lifetime_ms,
         )
 
     # -- handlers ----------------------------------------------------
