@@ -434,6 +434,21 @@ async def cmd_generate(args) -> None:
                 seeds=seeds,
             )
         )
+    elif args.action == "crd":
+        # CRD + a sample CR for the reconcile controller
+        # (redpanda_tpu/operator.py); kubectl apply this, then run the
+        # operator pointed at the apiserver
+        print(CRD_TEMPLATE)
+    elif args.action == "cluster":
+        print(
+            CLUSTER_CR_TEMPLATE.format(
+                name=args.name,
+                namespace=args.namespace,
+                replicas=args.replicas,
+                image=args.image,
+                storage=args.storage,
+            )
+        )
 
 
 K8S_TEMPLATE = """\
@@ -501,6 +516,53 @@ spec:
       accessModes: [ReadWriteOnce]
       resources:
         requests: {{storage: {storage}}}
+"""
+
+
+CRD_TEMPLATE = """\
+apiVersion: apiextensions.k8s.io/v1
+kind: CustomResourceDefinition
+metadata:
+  name: clusters.redpanda.tpu
+spec:
+  group: redpanda.tpu
+  scope: Namespaced
+  names: {plural: clusters, singular: cluster, kind: Cluster}
+  versions:
+  - name: v1
+    served: true
+    storage: true
+    subresources: {status: {}}
+    schema:
+      openAPIV3Schema:
+        type: object
+        properties:
+          spec:
+            type: object
+            required: [replicas]
+            properties:
+              replicas: {type: integer, minimum: 1}
+              image: {type: string}
+              storage: {type: string}
+              kafkaPort: {type: integer}
+              rpcPort: {type: integer}
+              adminPort: {type: integer}
+              extraArgs: {type: array, items: {type: string}}
+          status:
+            type: object
+            x-kubernetes-preserve-unknown-fields: true
+"""
+
+CLUSTER_CR_TEMPLATE = """\
+apiVersion: redpanda.tpu/v1
+kind: Cluster
+metadata:
+  name: {name}
+  namespace: {namespace}
+spec:
+  replicas: {replicas}
+  image: {image}
+  storage: {storage}
 """
 
 
@@ -580,7 +642,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_partition)
 
     gen = sub.add_parser("generate")
-    gen.add_argument("action", choices=["k8s"])
+    gen.add_argument("action", choices=["k8s", "crd", "cluster"])
     gen.add_argument("--name", default="redpanda-tpu")
     gen.add_argument("--namespace", default="default")
     gen.add_argument("--replicas", type=int, default=3)
