@@ -19,6 +19,9 @@ import asyncio
 import logging
 from typing import TYPE_CHECKING, Callable, Optional
 
+from ..cluster import archival_stm
+from ..models.record import RecordBatchBuilder, RecordBatchType
+from ..raft.consensus import NotLeaderError, ReplicateTimeout
 from .manifest import PartitionManifest, SegmentMeta
 from .object_store import ObjectStore, RetryingStore, StoreError
 
@@ -29,55 +32,119 @@ logger = logging.getLogger("cloud.archiver")
 
 
 class NtpArchiver:
+    """Leader-side upload loop for one partition.
+
+    Archived-range METADATA lives in the replicated archival stm
+    (partition.archival — cluster/archival_stm.py): after each segment
+    upload the leader replicates an add_segment command, so every
+    replica learns the archived boundary from its own log. The object
+    store's manifest.bin is the EXTERNAL record (remote readers, topic
+    recovery) and is re-exported from the replicated state after each
+    upload. Reference: archival/ntp_archiver_service.cc upload loop +
+    archival_metadata_stm command replication."""
+
     def __init__(self, partition: "Partition", store: ObjectStore):
         self.partition = partition
         self.store = store
-        self.manifest: Optional[PartitionManifest] = None
+        # store-manifest fallback for remote reads before the stm has
+        # state (e.g. topic recovery attach before the seed snapshot
+        # restores); the property below prefers replicated state
+        self._manifest_fallback: Optional[PartitionManifest] = None
+        self._synced_term = -1
+        # archived_upto of the store's exported manifest.bin (learned
+        # at sync, advanced by _export_manifest)
+        self._store_upto = -1
 
-    async def _load_manifest(self, refresh: bool = False) -> PartitionManifest:
-        if self.manifest is not None and not refresh:
-            return self.manifest
-        ntp = self.partition.ntp
-        key = (
-            f"{PartitionManifest.prefix(ntp.ns, ntp.topic, ntp.partition)}"
-            "/manifest.bin"
-        )
-        if await self.store.exists(key):
-            self.manifest = PartitionManifest.decode(await self.store.get(key))
-        elif self.manifest is None:
-            self.manifest = PartitionManifest(
-                ns=ntp.ns,
-                topic=ntp.topic,
-                partition=ntp.partition,
-                revision=0,
-                segments=[],
-            )
-        return self.manifest
+    @property
+    def manifest(self) -> Optional[PartitionManifest]:
+        stm = self.partition.archival
+        stm.apply_committed(self.partition.consensus.commit_index)
+        if stm.segments:
+            ntp = self.partition.ntp
+            return stm.to_manifest(ntp.ns, ntp.topic, ntp.partition)
+        return self._manifest_fallback
+
+    @manifest.setter
+    def manifest(self, m: Optional[PartitionManifest]) -> None:
+        self._manifest_fallback = m
 
     @property
     def archived_upto(self) -> int:
-        """Last archived raft offset (-1 until the manifest is loaded —
-        retention treats unknown as nothing-archived)."""
-        return self.manifest.archived_upto if self.manifest is not None else -1
+        """Last archived raft offset from the REPLICATED stm (-1 =
+        nothing known archived; retention then reclaims nothing)."""
+        stm = self.partition.archival
+        stm.apply_committed(self.partition.consensus.commit_index)
+        return stm.archived_upto
+
+    def _manifest_key(self) -> str:
+        ntp = self.partition.ntp
+        return (
+            f"{PartitionManifest.prefix(ntp.ns, ntp.topic, ntp.partition)}"
+            "/manifest.bin"
+        )
+
+    async def _replicate_cmd(self, key: bytes, value: bytes) -> None:
+        b = RecordBatchBuilder(batch_type=RecordBatchType.archival_metadata)
+        b.add(value=value, key=key)
+        await self.partition.replicate(b.build(), acks=-1)
+
+    async def _sync_from_store(self) -> None:
+        """Once per leadership term: if the store manifest is AHEAD of
+        the replicated state (crash after upload before the command
+        committed, or a bucket-recovered topic), replicate a reset so
+        the cluster converges on what the store already holds. Also
+        learns how far the store's exported manifest reaches, so
+        `_export_manifest` can heal the opposite skew (replicated
+        ahead of the store: crash between the commit and the put)."""
+        p = self.partition
+        if self._synced_term == p.consensus.term:
+            return
+        key = self._manifest_key()
+        self._store_upto = -1
+        if await self.store.exists(key):
+            store_m = PartitionManifest.decode(await self.store.get(key))
+            self._store_upto = store_m.archived_upto
+            if store_m.archived_upto > self.archived_upto:
+                await self._replicate_cmd(archival_stm.RESET, store_m.encode())
+        self._synced_term = p.consensus.term
+
+    async def _export_manifest(self) -> None:
+        """Re-publish manifest.bin when the replicated state is ahead
+        of the store copy (external readers + topic recovery read the
+        store, so it must converge even without new uploads)."""
+        stm = self.partition.archival
+        if stm.archived_upto <= self._store_upto:
+            return
+        ntp = self.partition.ntp
+        await self.store.put(
+            self._manifest_key(),
+            stm.to_manifest(ntp.ns, ntp.topic, ntp.partition).encode(),
+        )
+        self._store_upto = stm.archived_upto
 
     async def upload_pass(self) -> int:
         """One archival round: upload every closed segment whose range
         is fully committed+flushed and above the archived boundary, in
-        offset order. Returns the number of segments uploaded."""
+        offset order; replicate add_segment after each upload. Returns
+        the number of segments uploaded. Followers do nothing — their
+        state arrives through the log."""
         p = self.partition
         if not p.consensus.is_leader():
-            # followers track the leader's manifest so their retention
-            # stays gated on the true archived boundary
-            await self._load_manifest(refresh=True)
             return 0
-        manifest = await self._load_manifest()
+        try:
+            await self._sync_from_store()
+            await self._export_manifest()
+        except (StoreError, NotLeaderError, ReplicateTimeout) as e:
+            logger.warning("%s: archival store sync failed: %s", p.ntp, e)
+            return 0
         log = p.log
+        stm = p.archival
         boundary = min(p.consensus.commit_index, log.offsets().committed_offset)
         uploaded = 0
         for seg in list(log._segments[:-1]):  # never the active tail
             if seg.dirty_offset < seg.base_offset:
                 continue
-            if seg.base_offset <= manifest.archived_upto:
+            if seg.base_offset <= self.archived_upto:
                 continue
             if seg.dirty_offset > boundary:
                 break  # in offset order: later segments are above too
@@ -105,12 +172,22 @@ class NtpArchiver:
                     seg.dirty_offset - p.translator.to_kafka(seg.dirty_offset)
                 ),
             )
+            ntp = p.ntp
+            seg_key = (
+                f"{PartitionManifest.prefix(ntp.ns, ntp.topic, ntp.partition)}"
+                f"/{meta.name}"
+            )
             try:
-                await self.store.put(manifest.segment_key(meta), data)
-                manifest.add(meta)
-                manifest.revision += 1
-                await self.store.put(manifest.key(), manifest.encode())
-            except StoreError as e:
+                await self.store.put(seg_key, data)
+                # replicate FIRST: the archived fact must be raft-agreed
+                # before anything (retention!) can act on it. A crash
+                # between the replicate and the export leaves the store
+                # manifest behind; _export_manifest heals it (here, or
+                # on the next pass / next leadership sync).
+                await self._replicate_cmd(archival_stm.ADD_SEGMENT, meta.encode())
+                stm.apply_committed(p.consensus.commit_index)
+                await self._export_manifest()
+            except (StoreError, NotLeaderError, ReplicateTimeout) as e:
                 logger.warning(
                     "%s: upload failed at segment %d: %s",
                     p.ntp,

@@ -18,6 +18,7 @@ from ..raft.offset_translator import OffsetTranslator
 from ..raft.replicate_batcher import ReplicateStages, consume_exc
 from ..storage.log import Log
 from ..utils import serde
+from .archival_stm import ArchivalState
 from .producer_state import (
     DuplicateSequence,
     ProducerFenced,
@@ -30,11 +31,15 @@ class _PartitionSnapshot(serde.Envelope):
     """Partition contribution to the raft snapshot payload
     (rm_stm snapshot analog: translator + producer dedupe + tx state)."""
 
+    SERDE_VERSION = 2
     SERDE_FIELDS = [
         ("translator", serde.bytes_t),
         ("producers", serde.bytes_t),
         ("tx", serde.bytes_t),
+        # v2: replicated archival metadata (archival_metadata_stm)
+        ("archival", serde.bytes_t),
     ]
+    SERDE_DEFAULTS = {"archival": b""}
 
 
 class Partition:
@@ -60,7 +65,13 @@ class Partition:
         # would prefix-truncate one replica while the cluster never
         # agreed to delete. Set BEFORE replay.
         self._dr_markers: list[tuple[int, int]] = []
+        # replicated archival metadata (archival_metadata_stm analog):
+        # every replica learns "archived upto X" from the log, so
+        # retention gating and failover never consult the object
+        # store. Set BEFORE replay.
+        self.archival = ArchivalState()
         self._rebuild_state()
+        self.archival.apply_committed(consensus.commit_index)
         self.log.on_append.append(self._on_append)
         self.log.on_truncate.append(self._on_truncate)
         self.log.on_prefix_truncate.append(self._on_prefix_truncate)
@@ -97,6 +108,12 @@ class Partition:
     def _observe(self, batch: RecordBatch) -> None:
         h = batch.header
         self.translator.track(h.type, h.base_offset, h.last_offset)
+        if h.type == RecordBatchType.archival_metadata:
+            try:
+                self.archival.stage_batch(batch)
+            except Exception:
+                pass  # replay must never wedge on a bad command batch
+            return
         if h.type == RecordBatchType.checkpoint:
             # replicated DeleteRecords marker: every replica moves its
             # log start identically once the marker commits (the
@@ -159,6 +176,9 @@ class Partition:
         # from the surviving log (rare path — divergent-leader healing)
         self.producers.truncate()
         self.tx.clear()
+        # applied archival state covers only COMMITTED commands, which
+        # truncation can never reach — only the staged tail rebuilds
+        self.archival.drop_pending()
         self._replay_from(0)
 
     def _on_prefix_truncate(self, new_start: int) -> None:
@@ -171,10 +191,12 @@ class Partition:
         """The producer table tracks appends, so its capture may run
         slightly ahead of `upto`; re-observing those batches after a
         restore is idempotent (observe() dedupes by epoch/seq)."""
+        self.archival.apply_committed(self.consensus.commit_index)
         return _PartitionSnapshot(
             translator=self.translator.capture_upto(upto),
             producers=self.producers.encode(),
             tx=self.tx.encode(),
+            archival=self.archival.encode(),
         ).encode()
 
     def restore_snapshot(self, blob: bytes, last_included: int) -> None:
@@ -182,6 +204,7 @@ class Partition:
         self.translator.restore(ps.translator)
         self.producers = ProducerStateTable.decode(ps.producers)
         self.tx = TxTracker.decode(ps.tx)
+        self.archival = ArchivalState.decode(ps.archival)
         # re-track whatever survives in the log above the boundary
         # (normally nothing: install resets the log)
         self._replay_from(last_included + 1)
@@ -259,8 +282,12 @@ class Partition:
             return
         if self.archiver is not None:
             # tiered topics: local data may only be reclaimed once it
-            # is in the object store (ntp_archiver retention hand-off)
-            target = min(target, self.archiver.archived_upto + 1)
+            # is in the object store. The boundary comes from the
+            # REPLICATED archival stm — every replica gates on the
+            # same raft-agreed fact, no store reads (reference:
+            # archival_metadata_stm retention hand-off)
+            self.archival.apply_committed(self.consensus.commit_index)
+            target = min(target, self.archival.archived_upto + 1)
             if target <= self.log.offsets().start_offset:
                 return
         self.consensus.write_snapshot(target - 1)
@@ -322,10 +349,14 @@ class Partition:
             base=last + 1,
             base_delta=int(seg.delta_offset_end),
         ).encode()
+        seeded = ArchivalState()
+        seeded.segments = list(manifest.segments)
+        seeded.revision = int(manifest.revision)
         payload = _PartitionSnapshot(
             translator=translator_state,
             producers=ProducerStateTable().encode(),
             tx=TxTracker().encode(),
+            archival=seeded.encode(),
         ).encode()
         meta = RaftSnapshotMetadata(
             group=c.group_id,

@@ -197,6 +197,135 @@ def test_topic_recovery_from_cloud(tmp_path):
     asyncio.run(_recovery(tmp_path))
 
 
+async def _replicated_archival_stm(tmp_path):
+    """archival_metadata_stm behavior: followers learn the archived
+    boundary from the raft log (zero object-store reads), and a new
+    leader whose replicated state lags the store converges via a
+    replicated reset."""
+    net = LoopbackNetwork()
+    store = MemoryObjectStore()
+    members = [0, 1, 2]
+    brokers = [
+        Broker(
+            BrokerConfig(
+                node_id=i,
+                data_dir=str(tmp_path / f"n{i}"),
+                members=members,
+                election_timeout_s=0.15,
+                heartbeat_interval_s=0.03,
+                housekeeping_interval_s=0,
+                archival_interval_s=0,
+            ),
+            loopback=net,
+            object_store=store,
+        )
+        for i in members
+    ]
+    for b in brokers:
+        await b.start()
+    addrs = {b.node_id: b.kafka_advertised for b in brokers}
+    for b in brokers:
+        b.config.peer_kafka_addresses = addrs
+    try:
+        await brokers[0].wait_controller_leader()
+        client = KafkaClient([b.kafka_advertised for b in brokers])
+        await client.create_topic(
+            "rt",
+            partitions=1,
+            replication_factor=3,
+            configs={
+                "redpanda.remote.write": "true",
+                "segment.bytes": "400",
+            },
+        )
+        for i in range(10):
+            await client.produce("rt", 0, [(b"k%d" % i, b"v%d" % i)], acks=-1)
+
+        parts = {}
+        for b in brokers:
+            p = b.partition_manager.get(kafka_ntp("rt", 0))
+            assert p is not None
+            parts[b.node_id] = p
+        leader = next(
+            b for b in brokers if parts[b.node_id].consensus.is_leader()
+        )
+        lp = parts[leader.node_id]
+        lp.log.flush()
+        uploaded = await leader.archival.run_once()
+        assert uploaded >= 1
+        # follower passes attach their archivers and do NOTHING else —
+        # no store reads, no uploads (state arrives via the log)
+        gets_before = store.get_count
+        for b in brokers:
+            if b is not leader:
+                assert await b.archival.run_once() == 0
+        assert store.get_count == gets_before, "follower touched the store"
+
+        # every follower sees the archived boundary via REPLICATION —
+        # none of them ever ran an upload or read the store. The
+        # archiver property folds committed commands before reading.
+        upto = lp.archiver.archived_upto
+        assert upto >= 0
+        for _ in range(100):
+            if all(
+                p.archiver.archived_upto == upto for p in parts.values()
+            ):
+                break
+            await asyncio.sleep(0.02)
+        for nid, p in parts.items():
+            assert p.archiver.archived_upto == upto, f"node {nid} lags"
+
+        # store-ahead heal: wipe the replicated state on the leader
+        # (stand-in for a crash after the store put but before the
+        # command committed) — the next leader pass replicates a reset
+        # that restores it cluster-wide from the store manifest
+        lp.archival.clear()
+        lp.archiver._synced_term = -1
+        assert lp.archival.archived_upto == -1
+        await leader.archival.run_once()
+        assert lp.archiver.archived_upto == upto
+        for _ in range(100):
+            if all(
+                p.archiver.archived_upto == upto for p in parts.values()
+            ):
+                break
+            await asyncio.sleep(0.02)
+        for nid, p in parts.items():
+            assert p.archiver.archived_upto == upto, f"node {nid} not healed"
+
+        # opposite skew: replicated state AHEAD of the store manifest
+        # (crash between the committed add_segment and the manifest
+        # put) — the next pass re-exports manifest.bin even with no
+        # new segments to upload
+        mkey = lp.archiver._manifest_key()
+        del store._data[mkey]
+        lp.archiver._synced_term = -1
+        await leader.archival.run_once()
+        assert await store.exists(mkey), "manifest.bin not re-exported"
+        healed = PartitionManifest.decode(await store.get(mkey))
+        assert healed.archived_upto == upto
+
+        # snapshot round-trip carries the archival state
+        blob = lp.capture_snapshot(lp.consensus.commit_index)
+        from redpanda_tpu.cluster.partition import _PartitionSnapshot
+        from redpanda_tpu.cluster.archival_stm import ArchivalState
+
+        ps = _PartitionSnapshot.decode(blob)
+        restored = ArchivalState.decode(ps.archival)
+        assert restored.archived_upto == upto
+        assert [s.base_offset for s in restored.segments] == [
+            s.base_offset for s in lp.archival.segments
+        ]
+        await client.close()
+    finally:
+        for b in brokers:
+            await b.stop()
+
+
+def test_replicated_archival_stm(tmp_path):
+    asyncio.run(_replicated_archival_stm(tmp_path))
+
+
 def test_remote_reader_segment_location():
     m = PartitionManifest(ns="kafka", topic="t", partition=0, revision=1, segments=[])
     m.add(SegmentMeta(base_offset=0, last_offset=9, term=1, size_bytes=100,
