@@ -45,13 +45,23 @@ class TransformSpec:
     fn: Callable
 
 
-class _Fiber:
-    def __init__(self, task: asyncio.Task):
-        self.task = task
+class _Stats:
+    """Per-(transform, partition) counters, owned by the SERVICE and
+    carried across fiber restarts — a leadership bounce must not zero
+    the observable progress counters."""
+
+    __slots__ = ("offset", "transformed", "errors", "last_error")
+
+    def __init__(self) -> None:
         self.offset = -1
         self.transformed = 0
         self.errors = 0
         self.last_error: Optional[str] = None
+
+
+class _Fiber:
+    def __init__(self, task: asyncio.Task):
+        self.task = task
 
 
 class TransformService:
@@ -60,6 +70,7 @@ class TransformService:
         self.scan_interval_s = scan_interval_s
         self._specs: dict[str, TransformSpec] = {}
         self._fibers: dict[tuple[str, int], _Fiber] = {}
+        self._stats: dict[tuple[str, int], _Stats] = {}
         self._client = None
         self._task: Optional[asyncio.Task] = None
         self._closed = False
@@ -76,16 +87,20 @@ class TransformService:
             if key[0] == name:
                 fiber.task.cancel()
                 del self._fibers[key]
+        for key in list(self._stats):
+            if key[0] == name:
+                del self._stats[key]
 
     def status(self) -> dict:
         out: dict = {}
-        for (name, pid), f in sorted(self._fibers.items()):
+        for (name, pid), st in sorted(self._stats.items()):
+            f = self._fibers.get((name, pid))
             out.setdefault(name, {})[str(pid)] = {
-                "offset": f.offset,
-                "transformed": f.transformed,
-                "errors": f.errors,
-                "last_error": f.last_error,
-                "running": not f.task.done(),
+                "offset": st.offset,
+                "transformed": st.transformed,
+                "errors": st.errors,
+                "last_error": st.last_error,
+                "running": f is not None and not f.task.done(),
             }
         return out
 
@@ -146,6 +161,7 @@ class TransformService:
                             task = asyncio.ensure_future(
                                 self._run_fiber(spec, pid)
                             )
+                            self._stats.setdefault(key, _Stats())
                             self._fibers[key] = _Fiber(task)
                         elif not is_leader and fiber is not None:
                             # leadership moved: the new leader's
@@ -167,10 +183,9 @@ class TransformService:
             # record + throttle: the pacemaker respawns done fibers
             # every scan, and an unhandled setup error (listener not
             # ready, client connect failure) must not crash-loop hot
-            fiber = self._fibers.get(key)
-            if fiber is not None:
-                fiber.errors += 1
-                fiber.last_error = f"fiber: {e}"
+            st = self._stats.setdefault(key, _Stats())
+            st.errors += 1
+            st.last_error = f"fiber: {e}"
             await asyncio.sleep(1.0)
 
     async def _fiber_body(self, spec: TransformSpec, pid: int, key) -> None:
@@ -193,9 +208,9 @@ class TransformService:
             except asyncio.CancelledError:
                 raise
             except Exception as e:
-                fiber = self._fibers.get(key)
-                if fiber is not None:
-                    fiber.last_error = f"offset_fetch: {e}"
+                self._stats.setdefault(key, _Stats()).last_error = (
+                    f"offset_fetch: {e}"
+                )
                 await asyncio.sleep(0.2)
         if offset is None:
             return
@@ -206,7 +221,7 @@ class TransformService:
             )
             if p is None or not p.is_leader:
                 return
-            fiber = self._fibers.get(key)
+            st = self._stats.setdefault(key, _Stats())
             try:
                 # read_committed: aborted-transaction records must
                 # never materialize into the destination
@@ -236,16 +251,14 @@ class TransformService:
                         offset = await client.list_offset(
                             spec.source_topic, pid, -2
                         )
-                        if fiber is not None:
-                            fiber.last_error = (
-                                f"offset reset to log start {offset}"
-                            )
+                        st.last_error = (
+                            f"offset reset to log start {offset}"
+                        )
                         continue
                     except Exception:
                         pass
-                if fiber is not None:
-                    fiber.errors += 1
-                    fiber.last_error = f"fetch: {e}"
+                st.errors += 1
+                st.last_error = f"fetch: {e}"
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, 2.0)
                 continue
@@ -283,9 +296,8 @@ class TransformService:
                     # a poisoned record must not wedge the partition:
                     # count it, skip it (the reference aborts the
                     # script; skipping keeps at-least-once for the rest)
-                    if fiber is not None:
-                        fiber.errors += 1
-                        fiber.last_error = f"fn@{off}: {e}"
+                    st.errors += 1
+                    st.last_error = f"fn@{off}: {e}"
                     continue
                 if res is None:
                     continue
@@ -303,15 +315,13 @@ class TransformService:
                     {(spec.source_topic, pid): new_offset}
                 )
                 offset = new_offset
-                if fiber is not None:
-                    fiber.offset = offset
-                    fiber.transformed += len(outs)
+                st.offset = offset
+                st.transformed += len(outs)
             except asyncio.CancelledError:
                 raise
             except Exception as e:
-                if fiber is not None:
-                    fiber.errors += 1
-                    fiber.last_error = f"produce/commit: {e}"
+                st.errors += 1
+                st.last_error = f"produce/commit: {e}"
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, 2.0)
 

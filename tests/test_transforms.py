@@ -55,7 +55,15 @@ async def _basic(tmp_path):
             got1 = await _poll_dest(client, "dst", 1, 1)
             assert [(k, v) for _o, k, v in got1] == [(b"k3", b"WORLD")]
 
-            st = b.transforms.status()
+            # the counter bumps only after the fiber's offset-commit
+            # lands, which can trail the (already visible) dst produce
+            # on a loaded box — poll instead of reading instantly
+            deadline = asyncio.get_event_loop().time() + 15.0
+            while asyncio.get_event_loop().time() < deadline:
+                st = b.transforms.status()
+                if st.get("upper", {}).get("0", {}).get("transformed") == 3:
+                    break
+                await asyncio.sleep(0.05)
             assert st["upper"]["0"]["transformed"] == 3
             assert st["upper"]["0"]["errors"] == 0
 
@@ -79,6 +87,17 @@ async def _resume(tmp_path):
             for i in range(5):
                 await client.produce("src", 0, [(b"k", b"v%d" % i)])
             assert len(await _poll_dest(client, "dst", 0, 5)) == 5
+            # wait for the fiber's offset-commit to land before the
+            # "restart": deregistering inside the produce→commit window
+            # legitimately replays (at-least-once) and is not what this
+            # test pins
+            deadline = asyncio.get_event_loop().time() + 15.0
+            while asyncio.get_event_loop().time() < deadline:
+                st = b.transforms.status().get("echo", {}).get("0", {})
+                if st.get("offset") == 5:
+                    break
+                await asyncio.sleep(0.05)
+            assert st.get("offset") == 5, st
 
             # stop fibers (deregister), produce more, re-register
             b.transforms.deregister("echo")
