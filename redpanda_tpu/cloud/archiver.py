@@ -57,12 +57,9 @@ class NtpArchiver:
 
     @property
     def manifest(self) -> Optional[PartitionManifest]:
-        stm = self.partition.archival
-        stm.apply_committed(self.partition.consensus.commit_index)
-        if stm.segments:
-            ntp = self.partition.ntp
-            return stm.to_manifest(ntp.ns, ntp.topic, ntp.partition)
-        return self._manifest_fallback
+        # single derivation: the partition's stm-backed view (which
+        # consults our _manifest_fallback when the stm is empty)
+        return self.partition.cloud_manifest()
 
     @manifest.setter
     def manifest(self, m: Optional[PartitionManifest]) -> None:

@@ -70,7 +70,11 @@ class Partition:
         # retention gating and failover never consult the object
         # store. Set BEFORE replay.
         self.archival = ArchivalState()
-        self._rebuild_state()
+        if consensus.staged_snapshot("partition") is None:
+            self._rebuild_state()
+        # else: registration below restores the snapshot payload and
+        # replays only the log suffix — running the full-log rebuild
+        # first would be thrown-away work
         self.archival.apply_committed(consensus.commit_index)
         self.log.on_append.append(self._on_append)
         self.log.on_truncate.append(self._on_truncate)
@@ -294,13 +298,27 @@ class Partition:
         self.log.apply_retention(now_ms, max_offset=self.consensus.snapshot_index)
 
     # -- tiered storage ------------------------------------------------
+    def cloud_manifest(self):
+        """Archived-range manifest from the REPLICATED stm — available
+        on every replica the moment the commands commit, independent of
+        whether an archiver object is attached yet (a freshly restarted
+        broker can win leadership before its first archival sweep and
+        must still serve archived reads). Falls back to the archiver's
+        store-loaded manifest (topic recovery attach)."""
+        self.archival.apply_committed(self.consensus.commit_index)
+        if self.archival.segments:
+            return self.archival.to_manifest(
+                self.ntp.ns, self.ntp.topic, self.ntp.partition
+            )
+        if self.archiver is not None:
+            return self.archiver._manifest_fallback
+        return None
+
     def cloud_start_kafka(self) -> int | None:
         """First kafka offset readable from the object store, or None
         when nothing is archived / tiering is off."""
-        if self.archiver is None or self.archiver.manifest is None:
-            return None
-        m = self.archiver.manifest
-        if not m.segments:
+        m = self.cloud_manifest()
+        if m is None or not m.segments:
             return None
         from ..cloud.remote_partition import RemoteReader
 
@@ -315,11 +333,10 @@ class Partition:
     ) -> list[tuple[int, RecordBatch]]:
         """Archived-range read (remote_partition.cc): same (kafka_base,
         batch) shape as read_kafka, served from uploaded segments."""
-        if self.archiver is None or self.archiver.manifest is None:
+        m = self.cloud_manifest()
+        if m is None:
             return []
-        return await reader.read_kafka(
-            self.archiver.manifest, kafka_offset, max_bytes, upto_kafka
-        )
+        return await reader.read_kafka(m, kafka_offset, max_bytes, upto_kafka)
 
     def recover_from_cloud(self, manifest) -> bool:
         """Seed a FRESH, empty replica from a partition manifest

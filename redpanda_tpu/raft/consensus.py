@@ -332,8 +332,27 @@ class Consensus:
         )
         if self.log.offsets().dirty_offset < self._snap_index:
             self.log.install_snapshot_reset(self._snap_index + 1, self._snap_term)
+        # stage the payload for contributors in EVERY restart, not just
+        # the crash-mid-install case: derived state whose commands sit
+        # below the log start (producer dedupe, tx ranges, archival
+        # metadata trimmed away by retention) is only recoverable from
+        # the snapshot — log replay alone silently loses it
+        try:
             sp = SnapshotPayload.decode(payload)
             self._install_blobs = dict(zip(sp.names, sp.blobs))
+        except serde.SerdeError:
+            logger.exception(
+                "g%d: snapshot payload undecodable; contributors will "
+                "rebuild from the log suffix only",
+                self.group_id,
+            )
+
+    def staged_snapshot(self, name: str) -> bytes | None:
+        """Snapshot payload blob waiting for contributor `name`, if a
+        local snapshot exists — lets a contributor skip its own
+        full-log rebuild at boot (registration restores the blob and
+        replays only the suffix)."""
+        return self._install_blobs.get(name)
 
     def register_snapshot_contributor(self, name: str, obj) -> None:
         """obj: capture_snapshot(upto)->bytes, restore_snapshot(blob, last_included)."""

@@ -29,11 +29,12 @@ from redpanda_tpu.rpc.loopback import LoopbackNetwork
 
 
 class ChaosCluster:
-    def __init__(self, tmp_path, n: int = 3):
+    def __init__(self, tmp_path, n: int = 3, object_store=None):
         self.tmp = tmp_path
         self.n = n
         self.net = LoopbackNetwork()
         self.brokers: dict[int, Broker] = {}
+        self.object_store = object_store
 
     def _config(self, nid: int) -> BrokerConfig:
         return BrokerConfig(
@@ -44,11 +45,20 @@ class ChaosCluster:
             heartbeat_interval_s=0.03,
             node_status_interval_s=0.2,
             enable_admin=False,
+            housekeeping_interval_s=0 if self.object_store else 10.0,
+            archival_interval_s=0,
+        )
+
+    def _make_broker(self, nid: int) -> Broker:
+        return Broker(
+            self._config(nid),
+            loopback=self.net,
+            object_store=self.object_store,
         )
 
     async def start(self) -> None:
         for nid in range(self.n):
-            b = Broker(self._config(nid), loopback=self.net)
+            b = self._make_broker(nid)
             self.brokers[nid] = b
             await b.start()
         addrs = {b.node_id: b.kafka_advertised for b in self.brokers.values()}
@@ -66,7 +76,7 @@ class ChaosCluster:
 
     async def restart(self, nid: int) -> None:
         """Boot a fresh broker process over the surviving data dir."""
-        b = Broker(self._config(nid), loopback=self.net)
+        b = self._make_broker(nid)
         self.brokers[nid] = b
         await b.start()
         addrs = {
@@ -181,16 +191,60 @@ async def run_chaos(
     duration_s: float = 6.0,
     partitions: int = 2,
     faults=("partition", "crash", "transfer"),
+    tiered: bool = False,
 ) -> dict:
+    """`tiered=True` runs the same fault schedule against a
+    remote.write topic with aggressive segment roll + retention, with
+    archival passes + housekeeping churning THROUGHOUT the faults —
+    the validator's fetch-from-0 then crosses the remote/local seam,
+    so I1..I3 hold the whole tiered read path to the acked ground
+    truth, and the replicated archival boundary is checked for
+    cluster-wide agreement afterwards."""
     rng = random.Random(seed)
-    cluster = ChaosCluster(tmp_path, n=3)
+    store = None
+    if tiered:
+        from redpanda_tpu.cloud import MemoryObjectStore
+
+        store = MemoryObjectStore()
+    cluster = ChaosCluster(tmp_path, n=3, object_store=store)
     await cluster.start()
+    housekeeper: asyncio.Task | None = None
     try:
         boot = KafkaClient(cluster.addresses())
+        configs = None
+        if tiered:
+            configs = {
+                "redpanda.remote.write": "true",
+                "redpanda.remote.read": "true",
+                "segment.bytes": "600",
+                "retention.bytes": "600",
+            }
         await boot.create_topic(
-            "chaos", partitions=partitions, replication_factor=3
+            "chaos",
+            partitions=partitions,
+            replication_factor=3,
+            configs=configs,
         )
         await boot.close()
+
+        if tiered:
+
+            async def _housekeep() -> None:
+                while True:
+                    await asyncio.sleep(0.25)
+                    for b in list(cluster.brokers.values()):
+                        # bound each pass: an upload whose replicate
+                        # straddles a leadership change can wait out
+                        # its full raft timeout — that must not wedge
+                        # the sweep for the whole chaos window
+                        with contextlib.suppress(Exception):
+                            await asyncio.wait_for(
+                                b.archival.run_once(), timeout=1.5
+                            )
+                        with contextlib.suppress(Exception):
+                            b.storage.log_mgr.housekeeping()
+
+            housekeeper = asyncio.ensure_future(_housekeep())
         producer = SeqProducer(cluster, "chaos", partitions)
         ptask = asyncio.ensure_future(producer.run())
 
@@ -246,6 +300,80 @@ async def run_chaos(
         await asyncio.sleep(0.5)
         stats = await validate(cluster, "chaos", partitions, producer)
         stats["events"] = events
+        if tiered:
+            if housekeeper is not None:
+                housekeeper.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await housekeeper
+                housekeeper = None
+            # healed-cluster settle sweeps: uploads that were cut off
+            # mid-fault finish now, so the post-chaos checks examine a
+            # converged tiered state (skew healing included)
+            for _ in range(4):
+                for b in list(cluster.brokers.values()):
+                    with contextlib.suppress(Exception):
+                        await asyncio.wait_for(
+                            b.archival.run_once(), timeout=5.0
+                        )
+                    with contextlib.suppress(Exception):
+                        b.storage.log_mgr.housekeeping()
+                await asyncio.sleep(0.2)
+            stats.update(
+                await _validate_tiered(cluster, store, "chaos", partitions)
+            )
         return stats
     finally:
+        if housekeeper is not None:
+            housekeeper.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await housekeeper
         await cluster.stop()
+
+
+async def _validate_tiered(cluster, store, topic, partitions) -> dict:
+    """Post-chaos tiered checks: retention actually trimmed behind the
+    archived boundary somewhere (the seam was exercised), every
+    manifest-listed segment object exists, and no replica claims an
+    archived boundary beyond what the object store can back — the
+    independent reference that catches a replica applying uncommitted
+    archived-facts (which would let retention reclaim unarchived
+    data)."""
+    from redpanda_tpu.cloud.manifest import PartitionManifest
+    from redpanda_tpu.models.fundamental import DEFAULT_NS, kafka_ntp
+
+    trimmed = 0
+    archived = 0
+    for pid in range(partitions):
+        store_key = (
+            f"{PartitionManifest.prefix(DEFAULT_NS, topic, pid)}/manifest.bin"
+        )
+        store_upto = -1
+        if await store.exists(store_key):
+            store_upto = PartitionManifest.decode(
+                await store.get(store_key)
+            ).archived_upto
+        for nid, b in cluster.brokers.items():
+            p = b.partition_manager.get(kafka_ntp(topic, pid))
+            if p is None:
+                continue
+            p.archival.apply_committed(p.consensus.commit_index)
+            v = p.archival.archived_upto
+            # independent reference: after the settle sweeps exported
+            # the manifest, no replica may claim more archived than
+            # the store records
+            assert v <= store_upto, (
+                f"p{pid}: node {nid} claims archived_upto {v} beyond "
+                f"the store manifest's {store_upto}"
+            )
+            if p.log.offsets().start_offset > 0:
+                trimmed += 1
+            m = p.cloud_manifest()
+            if m is not None:
+                for meta in m.segments:
+                    assert await store.exists(m.segment_key(meta)), (
+                        f"p{pid}: manifest references missing object "
+                        f"{m.segment_key(meta)}"
+                    )
+        if store_upto >= 0:
+            archived += 1
+    return {"tiered_trimmed": trimmed, "tiered_archived": archived}

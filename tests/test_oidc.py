@@ -296,15 +296,28 @@ def test_partial_oidc_config_rejected(tmp_path):
 
 async def _session_bounded_by_exp(tmp_path, rsa_key):
     async with oidc_cluster(tmp_path, rsa_key) as b:
-        # short-lived token: authenticates now (within skew), but the
-        # session must die at exp even though the connection stays up
-        tok = sign_jwt(rsa_key, _claims(sub="boss", exp=int(time.time()) + 1), "k1")
+        # the session must die at the token's exp even though the
+        # connection stays up. Deterministic: authenticate with a
+        # 60s token, then advance the SERVER's clock past exp instead
+        # of racing a short-lived token against suite load.
+        import redpanda_tpu.kafka.server as kserver
+
+        exp = int(time.time()) + 60
+        tok = sign_jwt(rsa_key, _claims(sub="boss", exp=exp), "k1")
         c = KafkaClient([b.kafka_advertised], sasl=("", tok, "OAUTHBEARER"))
         await c.create_topic("t2", partitions=1, replication_factor=1)
         await c.produce("t2", 0, [(b"k", b"v")])
-        await asyncio.sleep(1.3)
-        with pytest.raises(Exception):  # broker closes the connection
-            await c.produce("t2", 0, [(b"k2", b"v2")])
+        real_time = kserver.time.time
+        kserver.time = type(
+            "T", (), {"time": staticmethod(lambda: real_time() + 120)}
+        )()
+        try:
+            with pytest.raises(Exception):  # broker closes the connection
+                await c.produce("t2", 0, [(b"k2", b"v2")])
+        finally:
+            import time as _time
+
+            kserver.time = _time
         await c.close()
 
 
