@@ -282,6 +282,64 @@ def test_partition_snapshot_restores_translator_and_producers(tmp_path):
     run(main())
 
 
+def test_restart_restores_stm_state_below_log_start(tmp_path):
+    """Regression: a NORMAL restart (not install_snapshot) must restore
+    the partition's snapshot payload. Producer-dedupe state whose
+    batches were prefix-truncated by the snapshot lives ONLY there —
+    before the fix, log-suffix replay silently dropped it and a retried
+    old sequence was accepted as new data (duplicate)."""
+
+    async def main():
+        cluster = RaftCluster(tmp_path, n_nodes=1)
+        await cluster.start()
+        await create_small_segment_group(cluster)
+        leader = await cluster.wait_leader()
+        part = Partition(NTP("kafka", "t", 0), 1, leader)
+
+        def pbatch(pid, i, value):
+            b = RecordBatchBuilder(
+                batch_type=RecordBatchType.raft_data,
+                producer_id=pid,
+                producer_epoch=0,
+                base_sequence=i,
+            )
+            b.add(value=value, key=b"k")
+            return b.build()
+
+        last7 = -1
+        for i in range(30):
+            last7 = await part.replicate(pbatch(7, i, b"x" * 100), acks=-1)
+
+        # fill with a SECOND producer until every producer-7 batch sits
+        # in a closed segment, then snapshot: prefix truncation drops
+        # those segments entirely — pid 7's history is physically
+        # unreplayable and survives ONLY in the snapshot payload
+        for i in range(15):
+            await part.replicate(pbatch(8, i, b"y" * 200), acks=-1)
+        snap = leader.write_snapshot(leader.commit_index)
+        assert snap > 0
+        start = leader.log.offsets().start_offset
+        raft_last7 = part.translator.from_kafka(last7)
+        assert start > raft_last7, (start, raft_last7)
+        await cluster.stop()
+
+        cluster2 = RaftCluster(tmp_path, n_nodes=1)
+        await cluster2.start()
+        await create_small_segment_group(cluster2)
+        leader2 = await cluster2.wait_leader()
+        part2 = Partition(NTP("kafka", "t", 0), 1, leader2)
+        # the restored table must remember producer 7's sequences
+        from redpanda_tpu.cluster.producer_state import DuplicateSequence
+
+        with pytest.raises(DuplicateSequence):
+            part2.producers.check(7, 0, 29, 29)
+        # and the translator agrees with pre-restart kafka offsets
+        assert part2.high_watermark() == part.high_watermark()
+        await cluster2.stop()
+
+    run(main())
+
+
 def test_housekeeping_gates_retention_on_snapshot(tmp_path):
     async def main():
         cluster = RaftCluster(tmp_path, n_nodes=1)
