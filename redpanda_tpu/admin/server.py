@@ -10,6 +10,7 @@ cluster config, fault injection (hbadger), and Prometheus /metrics.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import TYPE_CHECKING
 
@@ -24,6 +25,8 @@ logger = logging.getLogger("admin")
 class AdminServer(HttpServer):
     def __init__(self, broker: "Broker", host: str = "127.0.0.1", port: int = 0):
         self.broker = broker
+        # per-logger generation counters for expiring level overrides
+        self._log_level_gen: dict[str, int] = {}
         super().__init__(host, port)
 
     async def start(self) -> None:
@@ -81,6 +84,8 @@ class AdminServer(HttpServer):
         r("GET", r"/v1/debug/scheduler", self._scheduler_stats)
         r("GET", r"/v1/transforms", self._transforms)
         r("GET", r"/v1/features", self._features)
+        r("GET", r"/v1/loggers", self._get_loggers)
+        r("PUT", r"/v1/loggers/([\w.\-]+)", self._set_log_level)
         r("GET", r"/metrics", self._metrics)
 
     async def _ready(self, _m, _q, _b):
@@ -448,6 +453,49 @@ class AdminServer(HttpServer):
 
     async def _features(self, _m, _q, _b):
         return self.broker.controller.features.snapshot()
+
+    async def _get_loggers(self, _m, _q, _b):
+        """Logger names + effective levels (admin loggers API analog:
+        the reference sets per-logger levels at runtime)."""
+        out = {"root": logging.getLevelName(logging.getLogger().getEffectiveLevel())}
+        for name in sorted(logging.Logger.manager.loggerDict):
+            lg = logging.getLogger(name)
+            out[name] = logging.getLevelName(lg.getEffectiveLevel())
+        return out
+
+    async def _set_log_level(self, m, q, _b):
+        """PUT /v1/loggers/<name>?level=debug[&expires_s=30] — set a
+        logger's level at runtime, optionally reverting after
+        expires_s (reference: admin_server.cc set_log_level with
+        expiry)."""
+        name = m.group(1)
+        level_name = (q.get("level") or "").upper()
+        level = logging.getLevelNamesMapping().get(level_name)
+        if level is None:
+            raise HttpError(400, f"unknown level {q.get('level')!r}")
+        try:
+            expires_s = float(q.get("expires_s", 0) or 0)
+        except ValueError:
+            raise HttpError(400, f"bad expires_s {q.get('expires_s')!r}") from None
+        lg = logging.getLogger(None if name == "root" else name)
+        previous = lg.level
+        lg.setLevel(level)
+        # generation guard: a later PUT on the same logger invalidates
+        # any in-flight expiry revert (otherwise a stale timer clobbers
+        # the newer setting)
+        gen = self._log_level_gen.get(name, 0) + 1
+        self._log_level_gen[name] = gen
+        if expires_s > 0:
+            def revert(lg=lg, previous=previous, name=name, gen=gen):
+                if self._log_level_gen.get(name) == gen:
+                    lg.setLevel(previous)
+
+            asyncio.get_event_loop().call_later(expires_s, revert)
+        return {
+            "logger": name,
+            "level": level_name,
+            "expires_s": expires_s or None,
+        }
 
     async def _cluster_stats(self, _m, _q, _b):
         """Aggregated cluster/node stats (metrics_reporter analog)."""

@@ -650,7 +650,67 @@ def build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--storage", default="10Gi")
     gen.set_defaults(fn=cmd_generate)
 
+    dbg = sub.add_parser("debug")
+    dbg.add_argument("action", choices=["bundle"])
+    dbg.add_argument("-o", "--output", default="debug-bundle.json.gz")
+    dbg.set_defaults(fn=cmd_debug)
+
     return ap
+
+
+_BUNDLE_ROUTES = [
+    ("status", "/v1/status/ready"),
+    ("brokers", "/v1/brokers"),
+    ("health", "/v1/cluster/health_overview"),
+    ("cluster_stats", "/v1/cluster/stats"),
+    ("cluster_config", "/v1/cluster_config"),
+    ("config_schema", "/v1/cluster_config/schema"),
+    ("topics", "/v1/topics"),
+    ("features", "/v1/features"),
+    ("scheduler", "/v1/debug/scheduler"),
+    ("transforms", "/v1/transforms"),
+    ("loggers", "/v1/loggers"),
+]
+
+
+async def cmd_debug(args) -> None:
+    """`rpk debug bundle` analog: one archive of everything a support
+    engineer asks for first — admin-API snapshots + raw /metrics —
+    written as gzipped JSON."""
+    import gzip
+    import time as time_mod
+
+    if not args.admin:
+        raise SystemExit("debug bundle needs --admin URL")
+    bundle: dict = {
+        "generated_at": time_mod.strftime("%Y-%m-%dT%H:%M:%SZ", time_mod.gmtime()),
+        "admin": args.admin,
+        "sections": {},
+        "errors": {},
+    }
+    for name, path in _BUNDLE_ROUTES:
+        try:
+            bundle["sections"][name] = _admin(args, "GET", path)
+        except (SystemExit, Exception) as e:  # per-section: a dead or
+            bundle["errors"][name] = str(e)   # hung route must not
+            # sink the whole bundle (timeouts/resets raise URLError,
+            # not the SystemExit _admin uses for HTTP errors)
+    try:
+        req = urllib.request.Request(args.admin.rstrip("/") + "/metrics")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            bundle["sections"]["metrics"] = resp.read().decode(errors="replace")
+    except Exception as e:
+        bundle["errors"]["metrics"] = str(e)
+    out = args.output
+    data = json.dumps(bundle, indent=1, default=str).encode()
+    if out.endswith(".gz"):
+        with gzip.open(out, "wb") as f:
+            f.write(data)
+    else:
+        with open(out, "wb") as f:
+            f.write(data)
+    ok = len(bundle["sections"])
+    print(f"wrote {out}: {ok} sections, {len(bundle['errors'])} errors")
 
 
 def main(argv: list[str] | None = None) -> None:

@@ -24,6 +24,7 @@ import struct
 
 from ..models.record import HEADER_SIZE, RecordBatch, RecordBatchHeader
 from ..utils.crc import crc32c
+from . import file_sanitizer
 
 INDEX_INTERVAL_BYTES = 32 * 1024
 
@@ -52,7 +53,7 @@ class Segment:
         self._rfd: int | None = None  # cached pread descriptor
         if os.path.exists(self._path):
             self._recover()
-        self._file = open(self._path, "ab")
+        self._file = file_sanitizer.wrap(open(self._path, "ab"), self._path)
         self._size = self._file.tell()
 
     # -- recovery (log_replayer analog: re-checksum the tail) --------
@@ -203,7 +204,7 @@ class Segment:
             f.truncate(keep_end)
             f.flush()
             os.fsync(f.fileno())
-        self._file = open(self._path, "ab")
+        self._file = file_sanitizer.wrap(open(self._path, "ab"), self._path)
         self._size = keep_end
         self.dirty_offset = new_dirty
         self.stable_offset = min(self.stable_offset, new_dirty)

@@ -112,3 +112,47 @@ async def _cli(tmp_path):
 
 def test_cli_families(tmp_path):
     asyncio.run(_cli(tmp_path))
+
+
+async def _debug_bundle(tmp_path):
+    import gzip
+
+    async with broker(tmp_path) as b:
+        out_path = str(tmp_path / "bundle.json.gz")
+        rc, out, err = await rpk(b, "debug", "bundle", "-o", out_path)
+        assert rc == 0, err
+        assert "0 errors" in out, out
+        with gzip.open(out_path) as f:
+            bundle = json.load(f)
+        s = bundle["sections"]
+        assert s["brokers"] and s["health"] and s["cluster_config"]
+        assert "redpanda_tpu" in s["metrics"] or "# TYPE" in s["metrics"]
+        assert "root" in s["loggers"]
+
+
+def test_debug_bundle(tmp_path):
+    asyncio.run(_debug_bundle(tmp_path))
+
+
+async def _log_levels(tmp_path):
+    import logging
+
+    from test_admin_server import http
+
+    async with broker(tmp_path) as b:
+        addr = b.admin.address
+        st, levels = await http(addr, "GET", "/v1/loggers")
+        assert st == 200 and "root" in levels
+        st, resp = await http(
+            addr, "PUT", "/v1/loggers/raft?level=debug&expires_s=0.3"
+        )
+        assert st == 200, resp
+        assert logging.getLogger("raft").level == logging.DEBUG
+        await asyncio.sleep(0.5)  # expiry reverts the level
+        assert logging.getLogger("raft").level != logging.DEBUG
+        st, resp = await http(addr, "PUT", "/v1/loggers/raft?level=bogus")
+        assert st == 400
+
+
+def test_runtime_log_levels(tmp_path):
+    asyncio.run(_log_levels(tmp_path))
