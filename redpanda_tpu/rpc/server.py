@@ -15,7 +15,15 @@ import logging
 from typing import Awaitable, Callable, Optional
 
 from ..utils.hbadger import honey_badger
-from .types import HEADER_SIZE, FrameHeader, RpcError, Status, make_frame, verify_payload
+from .types import (
+    HEADER_SIZE,
+    FrameHeader,
+    RpcError,
+    Status,
+    make_frame,
+    verify_payload,
+    write_frame,
+)
 
 logger = logging.getLogger("rpc.server")
 
@@ -172,7 +180,7 @@ class RpcServer:
         frame = make_frame(hdr.method_id, hdr.correlation, reply, status=status)
         async with write_lock:
             try:
-                writer.write(frame)
+                write_frame(writer, frame)
                 await writer.drain()
             except (ConnectionResetError, BrokenPipeError):
                 pass

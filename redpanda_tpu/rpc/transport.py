@@ -15,7 +15,15 @@ import itertools
 import logging
 from typing import Optional, Protocol
 
-from .types import HEADER_SIZE, FrameHeader, RpcError, Status, make_frame, verify_payload
+from .types import (
+    HEADER_SIZE,
+    FrameHeader,
+    RpcError,
+    Status,
+    make_frame,
+    verify_payload,
+    write_frame,
+)
 
 logger = logging.getLogger("rpc.transport")
 
@@ -98,7 +106,7 @@ class TcpTransport:
         frame = make_frame(method_id, corr, payload)
         async with self._write_lock:
             assert self._writer is not None
-            self._writer.write(frame)
+            write_frame(self._writer, frame)
             await self._writer.drain()
         try:
             return await asyncio.wait_for(fut, timeout)

@@ -22,6 +22,7 @@ from __future__ import annotations
 import struct
 
 from ..utils.crc import crc32c
+from ..utils.iobuf import IOBuf
 
 MAGIC = 0xA7
 FRAME_VERSION = 0
@@ -92,12 +93,40 @@ class FrameHeader:
 
 
 def make_frame(
-    method_id: int, correlation: int, payload: bytes, status: int = Status.OK
-) -> bytes:
-    hdr = FrameHeader(
-        method_id, correlation, len(payload), crc32c(payload), status=status
-    )
-    return hdr.pack() + payload
+    method_id: int,
+    correlation: int,
+    payload: "bytes | IOBuf",
+    status: int = Status.OK,
+) -> IOBuf:
+    """Frame without linearizing: the payload CRC extends over the
+    fragments (reference: crc_extend_iobuf) and the result is an IOBuf
+    of [header, *payload fragments] — writers emit the fragments
+    straight into the socket buffer, skipping the header+payload
+    concatenation copy a multi-MB append payload would otherwise pay."""
+    buf = payload if isinstance(payload, IOBuf) else IOBuf(payload)
+    crc = 0
+    for frag in buf.fragments():
+        crc = crc32c(_frag_bytes(frag), crc)
+    hdr = FrameHeader(method_id, correlation, len(buf), crc, status=status)
+    out = IOBuf(hdr.pack())
+    out.append(buf)
+    return out
+
+
+def _frag_bytes(frag: memoryview) -> bytes:
+    """Fragment as bytes WITHOUT copying when the view spans a whole
+    bytes object (the common append case; sub-range shares copy)."""
+    base = frag.obj
+    if isinstance(base, bytes) and len(frag) == len(base):
+        return base
+    return frag.tobytes()
+
+
+def write_frame(writer, frame: IOBuf) -> None:
+    """Emit a frame's fragments into an asyncio StreamWriter — one
+    copy into the transport buffer, no linearization first."""
+    for frag in frame.fragments():
+        writer.write(frag)
 
 
 def verify_payload(hdr: FrameHeader, payload: bytes) -> None:
