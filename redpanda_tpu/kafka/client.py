@@ -801,16 +801,27 @@ class GroupClient:
         protocol_type: str = "consumer",
         session_timeout_ms: int = 10000,
         rebalance_timeout_ms: int = 30000,
+        group_instance_id: str | None = None,
     ) -> Msg:
         from .protocol.group_apis import JOIN_GROUP
 
         conn = await self.coordinator()
-        v = conn.pick_version(JOIN_GROUP, 4)
+        # always prefer v5: the leader's member list carries
+        # group_instance_id only from v5 up. A static join MUST NOT
+        # silently downgrade below it (the instance id would be
+        # dropped on the wire and the member become dynamic).
+        v = conn.pick_version(JOIN_GROUP, 5)
+        if group_instance_id is not None and v < 5:
+            raise KafkaClientError(
+                int(ErrorCode.unsupported_version),
+                "broker too old for static membership (JoinGroup v5)",
+            )
         req = Msg(
             group_id=self.group_id,
             session_timeout_ms=session_timeout_ms,
             rebalance_timeout_ms=rebalance_timeout_ms,
             member_id=self.member_id,
+            group_instance_id=group_instance_id,
             protocol_type=protocol_type,
             protocols=[Msg(name=n, metadata=md) for n, md in protocols],
         )
@@ -861,6 +872,35 @@ class GroupClient:
         await self._coord_request(LEAVE_GROUP, req, v)
         self.member_id = ""
         self.generation = -1
+
+    async def remove_members(
+        self, members: list[tuple[str | None, str | None]]
+    ) -> list[Msg]:
+        """LeaveGroup v4 batched removal: (member_id, group_instance_id)
+        pairs — instance id alone removes a static member that is not
+        running (KIP-345 admin removal). Returns per-member rows."""
+        from .protocol.group_apis import LEAVE_GROUP
+
+        conn = await self.coordinator()
+        v = conn.pick_version(LEAVE_GROUP, 4)
+        if v < 3:
+            # below v3 there is no members array at all — downgrading
+            # would send a semantically different single-member leave
+            raise KafkaClientError(
+                int(ErrorCode.unsupported_version),
+                "broker too old for batched LeaveGroup (v3)",
+            )
+        req = Msg(
+            group_id=self.group_id,
+            members=[
+                Msg(member_id=mid or "", group_instance_id=iid)
+                for mid, iid in members
+            ],
+        )
+        resp = await self._coord_request(LEAVE_GROUP, req, v)
+        if resp.error_code != 0:
+            raise KafkaClientError(resp.error_code, "leave_group v4")
+        return list(resp.members)
 
     async def commit_offsets(
         self, offsets: dict[tuple[str, int], int], metadata: str | None = None

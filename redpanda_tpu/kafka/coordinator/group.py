@@ -43,6 +43,9 @@ class Member:
     last_heartbeat: float = dataclasses.field(default_factory=time.monotonic)
     # set when this member has (re)joined the current rebalance
     joined: bool = False
+    # KIP-345 static membership: a restarting client presenting the
+    # same group.instance.id takes over this member without a rebalance
+    group_instance_id: Optional[str] = None
 
     def metadata_for(self, protocol: str) -> bytes:
         for name, md in self.protocols:
@@ -108,6 +111,26 @@ class Group:
     def member(self, member_id: str) -> Optional[Member]:
         return self.members.get(member_id)
 
+    def static_member_id(self, instance_id: str) -> Optional[str]:
+        for mid, m in self.members.items():
+            if m.group_instance_id == instance_id:
+                return mid
+        return None
+
+    def check_static(
+        self, group_instance_id: Optional[str], member_id: str
+    ) -> int:
+        """KIP-345 fence: an operation naming a registered
+        group.instance.id must come from the member currently holding
+        it — a zombie using its pre-takeover member id gets
+        FENCED_INSTANCE_ID, not UNKNOWN_MEMBER (so it stops retrying)."""
+        if group_instance_id is None:
+            return 0
+        owner = self.static_member_id(group_instance_id)
+        if owner is not None and owner != member_id:
+            return int(ErrorCode.fenced_instance_id)
+        return 0
+
     # -- join --------------------------------------------------------
     async def join(
         self,
@@ -118,6 +141,7 @@ class Group:
         rebalance_timeout_ms: int,
         protocol_type: str,
         protocols: list[tuple[str, bytes]],
+        group_instance_id: Optional[str] = None,
     ) -> JoinResult:
         if self.state == GroupState.DEAD:
             return JoinResult(error=int(ErrorCode.unknown_member_id))
@@ -130,6 +154,25 @@ class Group:
                 return JoinResult(
                     error=int(ErrorCode.inconsistent_group_protocol)
                 )
+
+        if group_instance_id is not None:
+            registered = self.static_member_id(group_instance_id)
+            if registered is not None:
+                if member_id == "":
+                    # static TAKEOVER (KIP-345): the restarting client
+                    # inherits the registered member — new member id,
+                    # same assignment/slot, and when the group is
+                    # Stable with unchanged protocols, NO rebalance
+                    return await self._static_takeover(
+                        registered,
+                        client_id,
+                        client_host,
+                        session_timeout_ms,
+                        rebalance_timeout_ms,
+                        protocols,
+                    )
+                if member_id != registered:
+                    return JoinResult(error=int(ErrorCode.fenced_instance_id))
 
         if member_id == "":
             member_id = f"{client_id or 'member'}-{uuid.uuid4()}"
@@ -160,6 +203,7 @@ class Group:
                 session_timeout_ms=session_timeout_ms,
                 rebalance_timeout_ms=rebalance_timeout_ms,
                 protocols=list(protocols),
+                group_instance_id=group_instance_id,
             )
             self.members[member_id] = m
             self.protocol_type = protocol_type
@@ -167,13 +211,67 @@ class Group:
             m.protocols = list(protocols)
             m.session_timeout_ms = session_timeout_ms
             m.rebalance_timeout_ms = rebalance_timeout_ms
+            if group_instance_id is not None:
+                # (re)register the static mapping on ANY join carrying
+                # an instance id — e.g. metadata replayed from a
+                # pre-static-membership record lacks it, and the live
+                # client's next rejoin must restore the registration
+                m.group_instance_id = group_instance_id
+                self.dirty = True
         m.last_heartbeat = time.monotonic()
+        return await self._await_rebalance(member_id, rebalance_timeout_ms, m)
+
+    async def _static_takeover(
+        self,
+        old_member_id: str,
+        client_id: str,
+        client_host: str,
+        session_timeout_ms: int,
+        rebalance_timeout_ms: int,
+        protocols: list[tuple[str, bytes]],
+    ) -> JoinResult:
+        """Replace a static member's identity in place (reference /
+        Kafka GroupMetadata.replaceStaticMember): the old member id is
+        fenced, the new one inherits the slot + assignment, and a
+        Stable group with unchanged protocols skips the rebalance."""
+        old = self.members.pop(old_member_id)
+        new_id = f"{client_id or 'member'}-{uuid.uuid4()}"
+        m = Member(
+            member_id=new_id,
+            client_id=client_id,
+            client_host=client_host,
+            session_timeout_ms=session_timeout_ms,
+            rebalance_timeout_ms=rebalance_timeout_ms,
+            protocols=list(protocols),
+            assignment=old.assignment,
+            joined=old.joined,
+            group_instance_id=old.group_instance_id,
+        )
+        self.members[new_id] = m
+        if self.leader == old_member_id:
+            self.leader = new_id
+        self.dirty = True
+        if (
+            self.state == GroupState.STABLE
+            and old.protocols == list(protocols)
+        ):
+            # same subscription: answer from the current generation;
+            # the member fetches its inherited assignment via SyncGroup
+            return self._join_result_for(new_id)
+        # changed subscription (or mid-rebalance): fall into the
+        # normal rebalance round under the NEW id
+        return await self._await_rebalance(new_id, rebalance_timeout_ms, m)
+
+    async def _await_rebalance(
+        self, member_id: str, rebalance_timeout_ms: int, m: Member
+    ) -> JoinResult:
+        """Kick (or join) the preparing rebalance and wait for the
+        timer to complete the round. The timer — not the joiner —
+        finishes the rebalance so a burst of concurrent joins
+        coalesces into one generation
+        (group.initial.rebalance.delay semantics)."""
         self._start_rebalance()  # no-op if one is already preparing
         m.joined = True  # after the reset inside _start_rebalance
-        # wait for the rebalance timer to complete the round. The
-        # timer — not the joiner — finishes the rebalance so that a
-        # burst of concurrent joins coalesces into one generation
-        # (group.initial.rebalance.delay semantics).
         join_done = self._join_done
         timeout = max(rebalance_timeout_ms, 5000) / 1000.0 + 5.0
         try:

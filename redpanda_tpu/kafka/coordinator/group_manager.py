@@ -66,7 +66,11 @@ class _MemberMeta(serde.Envelope):
         ("protocol_names", serde.vector(serde.string)),
         ("protocol_metas", serde.vector(serde.bytes_t)),
         ("assignment", serde.bytes_t),
+        # v2: KIP-345 static membership (appended; old records default)
+        ("group_instance_id", serde.optional(serde.string)),
     ]
+    SERDE_VERSION = 2
+    SERDE_DEFAULTS = {"group_instance_id": None}
 
 
 class _GroupMetaValue(serde.Envelope):
@@ -340,6 +344,7 @@ class GroupCoordinator:
                         ),
                         assignment=m.assignment,
                         joined=True,
+                        group_instance_id=m.group_instance_id,
                     )
                     for m in val.members
                 }
@@ -419,6 +424,7 @@ class GroupCoordinator:
                     protocol_names=[n for n, _ in m.protocols],
                     protocol_metas=[md for _, md in m.protocols],
                     assignment=m.assignment,
+                    group_instance_id=m.group_instance_id,
                 )
                 for m in g.members.values()
             ],

@@ -93,15 +93,51 @@ LEAVE_GROUP = register(
     Api(
         key=13,
         name="leave_group",
-        versions=(0, 2),
-        flex_since=None,  # v3 moves to batched members
+        versions=(0, 4),
+        flex_since=4,
         request=[
             F("group_id", "string"),
-            F("member_id", "string"),
+            F("member_id", "string", versions=(0, 2)),
+            # v3+ (KIP-345): batched removals, each addressable by
+            # member id OR group.instance.id (admin removal of a
+            # static member that is not running)
+            F(
+                "members",
+                Array(
+                    [
+                        F("member_id", "string"),
+                        F(
+                            "group_instance_id",
+                            "string",
+                            nullable=(3, None),
+                            default=None,
+                        ),
+                    ]
+                ),
+                versions=(3, None),
+                default=[],
+            ),
         ],
         response=[
             F("throttle_time_ms", "int32", versions=(1, None)),
             F("error_code", "int16"),
+            F(
+                "members",
+                Array(
+                    [
+                        F("member_id", "string"),
+                        F(
+                            "group_instance_id",
+                            "string",
+                            nullable=(3, None),
+                            default=None,
+                        ),
+                        F("error_code", "int16"),
+                    ]
+                ),
+                versions=(3, None),
+                default=[],
+            ),
         ],
     )
 )

@@ -78,6 +78,7 @@ class ErrorCode(enum.IntEnum):
     kafka_storage_error = 56
     unknown_server_error = -1
     non_empty_group = 68
+    fenced_instance_id = 82
     group_id_not_found = 69
     fetch_session_id_not_found = 70
     invalid_fetch_session_epoch = 71

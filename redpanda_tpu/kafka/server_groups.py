@@ -131,6 +131,7 @@ class GroupHandlers:
         res = await g.join(
             member_id=req.member_id,
             client_id=hdr.client_id or "",
+            group_instance_id=getattr(req, "group_instance_id", None),
             client_host="",
             session_timeout_ms=req.session_timeout_ms,
             rebalance_timeout_ms=(
@@ -151,7 +152,15 @@ class GroupHandlers:
             leader=res.leader,
             member_id=res.member_id,
             members=[
-                Msg(member_id=mid, group_instance_id=None, metadata=md)
+                Msg(
+                    member_id=mid,
+                    group_instance_id=(
+                        g.members[mid].group_instance_id
+                        if mid in g.members
+                        else None
+                    ),
+                    metadata=md,
+                )
                 for mid, md in res.members
             ],
         )
@@ -166,6 +175,11 @@ class GroupHandlers:
         g, code = await self.coordinator.get_group(req.group_id)
         if code:
             return Msg(throttle_time_ms=0, error_code=code, assignment=b"")
+        fence = g.check_static(
+            getattr(req, "group_instance_id", None), req.member_id
+        )
+        if fence:
+            return Msg(throttle_time_ms=0, error_code=fence, assignment=b"")
         res = await g.sync(
             member_id=req.member_id,
             generation=req.generation_id,
@@ -192,6 +206,11 @@ class GroupHandlers:
         g, code = await self.coordinator.get_group(req.group_id)
         if code:
             return Msg(throttle_time_ms=0, error_code=code)
+        fence = g.check_static(
+            getattr(req, "group_instance_id", None), req.member_id
+        )
+        if fence:
+            return Msg(throttle_time_ms=0, error_code=fence)
         return Msg(
             throttle_time_ms=0,
             error_code=g.heartbeat(req.member_id, req.generation_id),
@@ -206,10 +225,34 @@ class GroupHandlers:
         g, code = await self.coordinator.get_group(req.group_id)
         if code:
             return Msg(throttle_time_ms=0, error_code=code)
+        if hdr.api_version >= 3:
+            # batched removals, member id OR group.instance.id
+            rows = []
+            any_ok = False
+            for entry in req.members:
+                mid = entry.member_id or ""
+                iid = entry.group_instance_id
+                if iid is not None:
+                    owner = g.static_member_id(iid)
+                    if owner is None:
+                        ec = int(ErrorCode.unknown_member_id)
+                    elif mid and mid != owner:
+                        ec = int(ErrorCode.fenced_instance_id)
+                    else:
+                        ec = g.leave(owner)
+                else:
+                    ec = g.leave(mid)
+                any_ok = any_ok or ec == 0
+                rows.append(
+                    Msg(member_id=mid, group_instance_id=iid, error_code=ec)
+                )
+            if any_ok:
+                await self.coordinator.checkpoint_group(g)
+            return Msg(throttle_time_ms=0, error_code=0, members=rows)
         code = g.leave(req.member_id)
         if code == 0:
             await self.coordinator.checkpoint_group(g)
-        return Msg(throttle_time_ms=0, error_code=code)
+        return Msg(throttle_time_ms=0, error_code=code, members=[])
 
     async def offset_commit(self, hdr, req) -> Msg:
         def all_errors(code: int) -> Msg:
@@ -354,7 +397,7 @@ class GroupHandlers:
                     members=[
                         Msg(
                             member_id=m.member_id,
-                            group_instance_id=None,
+                            group_instance_id=m.group_instance_id,
                             client_id=m.client_id,
                             client_host=m.client_host,
                             member_metadata=m.metadata_for(g.protocol),

@@ -223,3 +223,146 @@ def test_group_coordinator_on_three_brokers(tmp_path):
                     assert got == {("t1", 0): i * 10}
 
     asyncio.run(run())
+
+
+def test_static_membership(tmp_path):
+    """KIP-345: a restarting static member (same group.instance.id)
+    takes over its slot without a rebalance; zombies with the old
+    member id are fenced; admin removes static members by instance id."""
+
+    async def run():
+        async with broker_cluster(tmp_path, 1) as brokers:
+            async with client_for(brokers) as c1, client_for(brokers) as c2:
+                g1 = c1.group("sg")
+                g2 = c2.group("sg")
+                j1, j2 = await asyncio.gather(
+                    g1.join(PROTO, group_instance_id="inst-a"),
+                    g2.join(PROTO),
+                )
+                gen0 = j1.generation_id
+                leader = g1 if j1.leader == j1.member_id else g2
+                members = (j1 if leader is g1 else j2).members
+                # instance id is visible in the leader's member list
+                by_id = {m.member_id: m.group_instance_id for m in members}
+                assert by_id[j1.member_id] == "inst-a"
+                assigns = [
+                    (m.member_id, b"assign-%d" % i)
+                    for i, m in enumerate(members)
+                ]
+                follower = g2 if leader is g1 else g1
+                a1, a2 = await asyncio.gather(
+                    leader.sync(assigns), follower.sync([])
+                )
+                static_assignment = a1 if leader is g1 else a2
+                old_static_id = g1.member_id
+
+                # "restart" of the static member: fresh client, same
+                # instance id, empty member id
+                async with client_for(brokers) as c3:
+                    g3 = c3.group("sg")
+                    j3 = await g3.join(PROTO, group_instance_id="inst-a")
+                    # same generation: NO rebalance happened
+                    assert j3.generation_id == gen0
+                    assert j3.member_id != old_static_id
+                    # inherited assignment via sync
+                    got = await g3.sync([])
+                    assert got == static_assignment
+                    # the dynamic member never saw a rebalance
+                    assert await g2.heartbeat() == 0
+
+                    # zombie (old member id) is FENCED on heartbeat and
+                    # on join with the stale id
+                    from redpanda_tpu.kafka.protocol import Msg
+                    from redpanda_tpu.kafka.protocol.group_apis import (
+                        HEARTBEAT,
+                        JOIN_GROUP,
+                    )
+
+                    conn = await g1.coordinator()
+                    resp = await conn.request(
+                        HEARTBEAT,
+                        Msg(
+                            group_id="sg",
+                            generation_id=gen0,
+                            member_id=old_static_id,
+                            group_instance_id="inst-a",
+                        ),
+                        3,
+                    )
+                    assert resp.error_code == int(
+                        ErrorCode.fenced_instance_id
+                    )
+                    resp = await conn.request(
+                        JOIN_GROUP,
+                        Msg(
+                            group_id="sg",
+                            session_timeout_ms=10000,
+                            rebalance_timeout_ms=10000,
+                            member_id=old_static_id,
+                            group_instance_id="inst-a",
+                            protocol_type="consumer",
+                            protocols=[
+                                Msg(name=n, metadata=md) for n, md in PROTO
+                            ],
+                        ),
+                        5,
+                    )
+                    assert resp.error_code == int(
+                        ErrorCode.fenced_instance_id
+                    )
+
+                    # admin removal by instance id alone (LeaveGroup v4)
+                    rows = await g2.remove_members([(None, "inst-a")])
+                    assert rows[0].error_code == 0
+                    # the survivor now rebalances into a new generation
+                    code = await g2.heartbeat()
+                    assert code == int(ErrorCode.rebalance_in_progress)
+                    j4 = await g2.join(PROTO)
+                    assert j4.generation_id > gen0
+                    assert len(j4.members) == 1
+
+    asyncio.run(run())
+
+
+def test_static_membership_survives_coordinator_restart(tmp_path):
+    """The instance-id registration is part of the replicated group
+    metadata: after a broker restart (log replay), a static takeover
+    still resolves and is still fenced correctly."""
+
+    async def run():
+        from redpanda_tpu.app import Broker, BrokerConfig
+        from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+        cfg = lambda: BrokerConfig(
+            node_id=0,
+            data_dir=str(tmp_path / "n0"),
+            members=[0],
+            election_timeout_s=0.15,
+            heartbeat_interval_s=0.03,
+        )
+        b = Broker(cfg(), loopback=LoopbackNetwork())
+        await b.start()
+        b.config.peer_kafka_addresses = {0: b.kafka_advertised}
+        await b.wait_controller_leader()
+        client = KafkaClient([b.kafka_advertised])
+        g = client.group("sgr")
+        await g.join(PROTO, group_instance_id="inst-p")
+        await g.sync([(g.member_id, b"sticky")])
+        await client.close()
+        await b.stop()
+
+        b2 = Broker(cfg(), loopback=LoopbackNetwork())
+        await b2.start()
+        b2.config.peer_kafka_addresses = {0: b2.kafka_advertised}
+        await b2.wait_controller_leader()
+        client2 = KafkaClient([b2.kafka_advertised])
+        g2 = client2.group("sgr")
+        j = await g2.join(PROTO, group_instance_id="inst-p")
+        # static slot recovered from the replicated metadata: the
+        # takeover inherits the checkpointed assignment
+        got = await g2.sync([])
+        assert got == b"sticky"
+        await client2.close()
+        await b2.stop()
+
+    asyncio.run(run())
