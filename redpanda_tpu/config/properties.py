@@ -104,6 +104,13 @@ def default_properties() -> list[Property]:
             _positive,
         ),
         Property(
+            "group_offset_retention_ms",
+            "int",
+            7 * 24 * 3600 * 1000,
+            "Committed offsets of an EMPTY group expire after this "
+            "long (KIP-211); <= 0 disables expiry",
+        ),
+        Property(
             "kafka_max_request_bytes",
             "int",
             100 * 1024 * 1024,

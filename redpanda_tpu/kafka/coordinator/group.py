@@ -97,6 +97,14 @@ class Group:
         # a zombie's TxnOffsetCommit below this is rejected
         self.tx_fences: dict[int, int] = {}
         self._initial_delay = initial_rebalance_delay_s
+        # wall-clock when the group last became EMPTY (KIP-211 offset
+        # retention starts here, not at commit time); None while live.
+        # Maintained at the membership transitions, persisted in the
+        # group metadata record so restarts don't reset the clock.
+        self.empty_since: Optional[float] = None
+        # serializes offset mutation+replication: a commit landing
+        # inside a tombstone's replicate window must not be deleted
+        self.offsets_lock = asyncio.Lock()
         self._join_done = asyncio.Event()  # fires when a rebalance completes
         self._sync_done = asyncio.Event()  # fires when leader assigns
         self._rebalance_task: Optional[asyncio.Task] = None
@@ -207,6 +215,7 @@ class Group:
             )
             self.members[member_id] = m
             self.protocol_type = protocol_type
+            self.empty_since = None
         else:
             m.protocols = list(protocols)
             m.session_timeout_ms = session_timeout_ms
@@ -437,6 +446,9 @@ class Group:
         if member_id not in self.members:
             return int(ErrorCode.unknown_member_id)
         del self.members[member_id]
+        if not self.members:
+            self.empty_since = time.time()
+            self.dirty = True
         if self.state in (
             GroupState.STABLE,
             GroupState.COMPLETING_REBALANCE,

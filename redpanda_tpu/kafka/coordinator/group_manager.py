@@ -74,6 +74,7 @@ class _MemberMeta(serde.Envelope):
 
 
 class _GroupMetaValue(serde.Envelope):
+    SERDE_VERSION = 2
     SERDE_FIELDS = [
         ("generation", serde.i32),
         ("protocol_type", serde.string),
@@ -81,7 +82,11 @@ class _GroupMetaValue(serde.Envelope):
         ("leader", serde.string),
         ("state", serde.string),
         ("members", serde.vector(_MemberMeta.serde())),
+        # v2 (KIP-211): when the group went EMPTY (0 = live/unknown);
+        # the offset-retention clock must survive coordinator restarts
+        ("empty_since_ms", serde.i64),
     ]
+    SERDE_DEFAULTS = {"empty_since_ms": 0}
 
 
 class _OffsetValue(serde.Envelope):
@@ -330,6 +335,11 @@ class GroupCoordinator:
                 g.protocol = val.protocol
                 g.leader = val.leader or None
                 g.state = GroupState(val.state)
+                g.empty_since = (
+                    val.empty_since_ms / 1000.0
+                    if int(val.empty_since_ms) > 0
+                    else None
+                )
                 from .group import Member
 
                 g.members = {
@@ -414,6 +424,7 @@ class GroupCoordinator:
             protocol=g.protocol,
             leader=g.leader or "",
             state=g.state.value,
+            empty_since_ms=int((g.empty_since or 0) * 1000),
             members=[
                 _MemberMeta(
                     member_id=m.member_id,
@@ -466,14 +477,15 @@ class GroupCoordinator:
                     kind=_KIND_OFFSET, group=g.group_id, topic=topic, partition=part
                 ).encode(),
             )
-        try:
-            await p.replicate(b.build(), acks=-1)
-        except NotLeaderError:
-            return int(ErrorCode.not_coordinator)
-        except ReplicateTimeout:
-            return int(ErrorCode.request_timed_out)
-        for topic, part, off, md in items:
-            g.offsets[(topic, part)] = (off, md, now)
+        async with g.offsets_lock:
+            try:
+                await p.replicate(b.build(), acks=-1)
+            except NotLeaderError:
+                return int(ErrorCode.not_coordinator)
+            except ReplicateTimeout:
+                return int(ErrorCode.request_timed_out)
+            for topic, part, off, md in items:
+                g.offsets[(topic, part)] = (off, md, now)
         return 0
 
     async def delete_offsets(
@@ -494,33 +506,68 @@ class GroupCoordinator:
             return {
                 tp: int(ErrorCode.group_subscribed_to_topic) for tp in items
             }
-        to_delete = []
-        for tp in items:
-            if tp in g.offsets:
-                to_delete.append(tp)
-                out[tp] = 0
-            else:
-                out[tp] = 0  # deleting a non-existent offset is a no-op
-        if to_delete:
-            b = RecordBatchBuilder()
-            for topic, part in to_delete:
-                b.add(
-                    value=None,
-                    key=_Key(
-                        kind=_KIND_OFFSET,
-                        group=g.group_id,
-                        topic=topic,
-                        partition=part,
-                    ).encode(),
-                )
-            try:
-                await p.replicate(b.build(), acks=-1)
-            except NotLeaderError:
-                return {tp: int(ErrorCode.not_coordinator) for tp in items}
-            except ReplicateTimeout:
-                return {tp: int(ErrorCode.request_timed_out) for tp in items}
-            for tp in to_delete:
-                g.offsets.pop(tp, None)
+        async with g.offsets_lock:
+            to_delete = []
+            snapshot: dict[tuple[str, int], tuple] = {}
+            for tp in items:
+                if tp in g.offsets:
+                    to_delete.append(tp)
+                    snapshot[tp] = g.offsets[tp]
+                    out[tp] = 0
+                else:
+                    out[tp] = 0  # deleting a non-existent offset: no-op
+            if to_delete:
+                b = RecordBatchBuilder()
+                for topic, part in to_delete:
+                    b.add(
+                        value=None,
+                        key=_Key(
+                            kind=_KIND_OFFSET,
+                            group=g.group_id,
+                            topic=topic,
+                            partition=part,
+                        ).encode(),
+                    )
+                try:
+                    await p.replicate(b.build(), acks=-1)
+                except NotLeaderError:
+                    return {tp: int(ErrorCode.not_coordinator) for tp in items}
+                except ReplicateTimeout:
+                    return {tp: int(ErrorCode.request_timed_out) for tp in items}
+                survivors = []
+                for tp in to_delete:
+                    cur = g.offsets.get(tp)
+                    if cur == snapshot[tp]:
+                        g.offsets.pop(tp, None)
+                    elif cur is not None:
+                        # a tx-marker materialization landed during the
+                        # replicate await: the tombstone now sits AFTER
+                        # that commit in the log, so re-replicate the
+                        # surviving value to keep replay == memory
+                        survivors.append((tp, cur))
+                if survivors:
+                    rb = RecordBatchBuilder()
+                    for (topic, part), (off, md, ts) in survivors:
+                        rb.add(
+                            value=_OffsetValue(
+                                offset=off, metadata=md, commit_ts_ms=ts
+                            ).encode(),
+                            key=_Key(
+                                kind=_KIND_OFFSET,
+                                group=g.group_id,
+                                topic=topic,
+                                partition=part,
+                            ).encode(),
+                        )
+                    try:
+                        await p.replicate(rb.build(), acks=-1)
+                    except (NotLeaderError, ReplicateTimeout):
+                        logger.warning(
+                            "group %s: failed to restore %d offsets that "
+                            "survived a concurrent delete",
+                            g.group_id,
+                            len(survivors),
+                        )
         return out
 
     async def txn_commit_offsets(
@@ -659,5 +706,51 @@ class GroupCoordinator:
                             "group %s: expired members %s", g.group_id, expired
                         )
                         await self.checkpoint_group(g)
+                    await self._expire_offsets(g)
             except Exception:
                 logger.exception("group expiration sweep failed")
+
+    async def _expire_offsets(self, g: Group) -> None:
+        """KIP-211 offset retention: committed offsets of an EMPTY
+        group expire `group_offset_retention_ms` after the group went
+        empty (never while members exist — an active group's positions
+        are permanent). Expiry writes the same tombstones OffsetDelete
+        does, so replay and compaction agree."""
+        import time as time_mod
+
+        now = time_mod.time()
+        if g.members:
+            g.empty_since = None
+            return
+        if g.empty_since is None:
+            g.empty_since = now
+            return
+        if not g.offsets:
+            return
+        retention_ms = self.broker.controller.cluster_config.get(
+            "group_offset_retention_ms"
+        )
+        if retention_ms <= 0:  # 0/negative disables expiry
+            return
+        boundary_ms = (now - g.empty_since) * 1000.0
+        if boundary_ms < retention_ms:
+            return
+        expired = [
+            tp
+            for tp, (_off, _md, ts) in g.offsets.items()
+            if now * 1000.0 - ts >= retention_ms
+        ]
+        if not expired:
+            return
+        logger.info(
+            "group %s: expiring %d offsets after %.0f ms empty",
+            g.group_id,
+            len(expired),
+            boundary_ms,
+        )
+        await self.delete_offsets(g, expired)
+        if not g.offsets and not g.members:
+            # nothing left: tombstone the group itself so neither the
+            # in-memory shard nor the compacted log accumulates dead
+            # group ids (Kafka transitions such groups to DEAD)
+            await self.delete_group(g.group_id)

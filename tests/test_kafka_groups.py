@@ -366,3 +366,49 @@ def test_static_membership_survives_coordinator_restart(tmp_path):
         await b2.stop()
 
     asyncio.run(run())
+
+
+def test_offset_expiration_for_empty_group(tmp_path):
+    """KIP-211: committed offsets of an EMPTY group expire after
+    group_offset_retention_ms; a live group's offsets never do."""
+
+    async def run():
+        async with broker_cluster(tmp_path, 1) as brokers:
+            b = brokers[0]
+            async with client_for(brokers) as client:
+                await client.create_topic("t", partitions=1)
+                g = client.group("exp")
+                await g.join(PROTO)
+                await g.sync([(g.member_id, b"")])
+                await g.commit_offsets({("t", 0): 42})
+                # live group: offsets stay even with tiny retention
+                b.controller.cluster_config.apply(
+                    {"group_offset_retention_ms": "100"}, []
+                )
+                await asyncio.sleep(1.2)
+                assert await g.fetch_offsets({"t": [0]}) == {("t", 0): 42}
+                # empty group: retention clock starts at leave
+                await g.leave()
+                deadline = asyncio.get_event_loop().time() + 10.0
+                gone = False
+                while asyncio.get_event_loop().time() < deadline:
+                    got = await g.fetch_offsets({"t": [0]})
+                    if ("t", 0) not in got:
+                        gone = True
+                        break
+                    await asyncio.sleep(0.2)
+                assert gone, "offsets never expired"
+                # the emptied group itself is garbage-collected
+                coord = b.group_coordinator
+                deadline = asyncio.get_event_loop().time() + 10.0
+                while asyncio.get_event_loop().time() < deadline:
+                    if all(
+                        gg.group_id != "exp" for gg in coord.local_groups()
+                    ):
+                        break
+                    await asyncio.sleep(0.2)
+                assert all(
+                    gg.group_id != "exp" for gg in coord.local_groups()
+                ), "dead group never collected"
+
+    asyncio.run(run())
