@@ -310,6 +310,15 @@ class Broker:
             "archival_interval_s", set_archival, self.config.archival_interval_s
         )
 
+        def set_producer_expiry(v):
+            # per-broker, not process-global: loopback fixtures run
+            # several brokers (even clusters) in one process
+            self.partition_manager.producer_expiry_ms = v
+            for p in self.partition_manager.partitions().values():
+                p.producer_expiry_ms = v
+
+        cfg.bind("producer_id_expiration_ms", set_producer_expiry)
+
     def _register_probes(self) -> None:
         """Scrape-time gauges over live subsystem state (the probe
         objects of raft/probe.cc and kafka server probes, pull-based)."""

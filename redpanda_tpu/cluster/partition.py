@@ -10,6 +10,8 @@ translation is the only adaptation needed at this stage).
 from __future__ import annotations
 
 import asyncio
+import logging
+import time
 
 from ..models.fundamental import NTP
 from ..models.record import RecordBatch, RecordBatchBuilder, RecordBatchType
@@ -25,6 +27,8 @@ from .producer_state import (
     ProducerStateTable,
 )
 from .tx_state import COMMIT_MARKER, TxTracker, control_record_key, parse_control_key
+
+logger = logging.getLogger("partition")
 
 
 class _PartitionSnapshot(serde.Envelope):
@@ -43,6 +47,10 @@ class _PartitionSnapshot(serde.Envelope):
 
 
 class Partition:
+    # producer.id.expiration.ms analog (rm_stm producer eviction);
+    # class-wide so the cluster-config binding reaches every replica
+    producer_expiry_ms: int = 24 * 3600 * 1000
+
     def __init__(self, ntp: NTP, group_id: int, consensus: Consensus):
         self.ntp = ntp
         self.group_id = group_id
@@ -162,6 +170,7 @@ class Partition:
                 h.base_sequence,
                 h.base_sequence + h.last_offset_delta,
                 kbase,
+                ts_ms=h.max_timestamp,
             )
         if h.is_transactional:
             self.tx.observe_data(h.producer_id, h.producer_epoch, kbase)
@@ -273,6 +282,15 @@ class Partition:
         follower recovers via install_snapshot instead of being
         stranded."""
         self.apply_delete_records()
+        evicted = self.producers.expire(
+            now_ms if now_ms is not None else int(time.time() * 1000),
+            self.producer_expiry_ms,
+            active=set(self._inflight_seq),
+        )
+        if evicted:
+            logger.info(
+                "%s: expired %d idle producer ids", self.ntp, len(evicted)
+            )
         if self.log.config.compaction_enabled:
             boundary = min(
                 self.consensus.commit_index, self.log.offsets().committed_offset

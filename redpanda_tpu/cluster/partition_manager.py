@@ -22,6 +22,9 @@ class PartitionManager:
         self._group_manager = group_manager
         self._ntp_table: dict[NTP, Partition] = {}
         self._group_table: dict[int, Partition] = {}
+        # per-BROKER producer.id.expiration.ms (cluster-config bound);
+        # applied to every managed partition, new and existing
+        self.producer_expiry_ms = Partition.producer_expiry_ms
 
     def get(self, ntp: NTP) -> Optional[Partition]:
         return self._ntp_table.get(ntp)
@@ -46,6 +49,7 @@ class PartitionManager:
             group_id, voters=replicas, log=log
         )
         p = Partition(ntp, group_id, consensus)
+        p.producer_expiry_ms = self.producer_expiry_ms
         self._ntp_table[ntp] = p
         self._group_table[group_id] = p
         return p

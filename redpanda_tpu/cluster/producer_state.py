@@ -32,7 +32,7 @@ class DuplicateSequence(Exception):
 
 
 class _Producer:
-    __slots__ = ("epoch", "last_seq", "batches")
+    __slots__ = ("epoch", "last_seq", "batches", "last_ts_ms")
 
     def __init__(self, epoch: int):
         self.epoch = epoch
@@ -41,6 +41,10 @@ class _Producer:
         self.batches: deque[tuple[int, int, int]] = deque(
             maxlen=_CACHED_BATCHES
         )
+        # batch max_timestamp of the latest observation: replay-stable
+        # (comes from the record, not the wall), drives idle-producer
+        # eviction (rm_stm producer expiration)
+        self.last_ts_ms = 0
 
 
 class ProducerStateTable:
@@ -89,7 +93,13 @@ class ProducerStateTable:
         )
 
     def observe(
-        self, pid: int, epoch: int, first_seq: int, last_seq: int, kafka_base: int
+        self,
+        pid: int,
+        epoch: int,
+        first_seq: int,
+        last_seq: int,
+        kafka_base: int,
+        ts_ms: int = 0,
     ) -> None:
         """Fold an appended batch into the table (log-replay safe:
         called from the log-append observer on leader AND follower)."""
@@ -104,6 +114,7 @@ class ProducerStateTable:
                 return  # already tracked (snapshot restore + re-replay)
         p.batches.append((first_seq, last_seq, kafka_base))
         p.last_seq = max(p.last_seq, last_seq)
+        p.last_ts_ms = max(p.last_ts_ms, ts_ms)
 
     def snapshot(self) -> list[tuple[int, int, int]]:
         """(producer_id, epoch, last_seq) rows for introspection
@@ -120,6 +131,27 @@ class ProducerStateTable:
         self._pids.clear()
 
     # -- snapshot capture/restore (rm_stm.h:182 snapshot analog) ------
+    def expire(
+        self, now_ms: int, retention_ms: int, active: set[int] | None = None
+    ) -> list[int]:
+        """Evict producers idle longer than retention (rm_stm
+        producer-id expiration): their dedupe window is long past its
+        usefulness and the table must not grow with every producer id
+        ever seen. Producers in `active` (in-flight dispatches) and
+        those with unknown timestamps never expire here."""
+        if retention_ms <= 0:
+            return []
+        evicted = [
+            pid
+            for pid, p in self._pids.items()
+            if p.last_ts_ms > 0
+            and now_ms - p.last_ts_ms >= retention_ms
+            and (active is None or pid not in active)
+        ]
+        for pid in evicted:
+            del self._pids[pid]
+        return evicted
+
     def encode(self) -> bytes:
         out = bytearray()
         out += struct.pack("<I", len(self._pids))
@@ -127,6 +159,11 @@ class ProducerStateTable:
             out += struct.pack("<qiqI", pid, p.epoch, p.last_seq, len(p.batches))
             for f, l, base in p.batches:
                 out += struct.pack("<qqq", f, l, base)
+        # appended timestamp trailer: decoders that predate it ignore
+        # trailing bytes; new decoders treat its absence as unknown
+        out += struct.pack("<I", len(self._pids))
+        for pid, p in self._pids.items():
+            out += struct.pack("<qq", pid, p.last_ts_ms)
         return bytes(out)
 
     @classmethod
@@ -145,4 +182,12 @@ class ProducerStateTable:
                 pos += 24
                 p.batches.append((f, l, base))
             t._pids[pid] = p
+        if pos < len(data):  # timestamp trailer (absent in old blobs)
+            (nt,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            for _ in range(nt):
+                pid, ts = struct.unpack_from("<qq", data, pos)
+                pos += 16
+                if pid in t._pids:
+                    t._pids[pid].last_ts_ms = ts
         return t

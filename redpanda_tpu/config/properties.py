@@ -104,6 +104,13 @@ def default_properties() -> list[Property]:
             _positive,
         ),
         Property(
+            "producer_id_expiration_ms",
+            "int",
+            24 * 3600 * 1000,
+            "Idle idempotent-producer state is evicted after this "
+            "long (rm_stm producer expiration); <= 0 disables",
+        ),
+        Property(
             "group_offset_retention_ms",
             "int",
             7 * 24 * 3600 * 1000,
