@@ -53,6 +53,11 @@ class ClusterState(NamedTuple):
     fol_flushed: jax.Array  # [G, RF-1] i64
     fol_commit: jax.Array   # [G, RF-1] i64
     fol_term: jax.Array     # [G, RF-1] i64 highest leader term seen
+    # leader-side first retained log offset (snapshot boundary + 1):
+    # retention advances it up to commit+1; a follower whose mirror
+    # fell below it cannot be served appends and must install the
+    # snapshot (recovery_stm.cc install_snapshot fallback over ICI)
+    log_start: jax.Array    # [G] i64
 
 
 def make_cluster_state(num_groups: int, replica_slots: int = 8) -> ClusterState:
@@ -62,14 +67,24 @@ def make_cluster_state(num_groups: int, replica_slots: int = 8) -> ClusterState:
     leader = leader._replace(is_leader=jnp.ones(num_groups, bool), is_voter=voters)
     shape = (num_groups, RF - 1)
     neg = jnp.full(shape, -1, jnp.int64)
-    return ClusterState(leader, neg, neg, neg, jnp.zeros(shape, jnp.int64))
+    return ClusterState(
+        leader,
+        neg,
+        neg,
+        neg,
+        jnp.zeros(shape, jnp.int64),
+        jnp.zeros(num_groups, jnp.int64),
+    )
 
 
-def cluster_tick(state: ClusterState, new_dirty: jax.Array) -> tuple[ClusterState, jax.Array]:
+def cluster_tick(
+    state: ClusterState, new_dirty: jax.Array
+) -> tuple[ClusterState, jax.Array, jax.Array]:
     """One heartbeat round. new_dirty: [G] i64 — offsets appended to
-    each leader's local log this tick. Returns (state, total_committed)
-    where total_committed is the cluster-wide count of groups whose
-    commit index advanced (psum'd)."""
+    each leader's local log this tick. Returns (state, total_committed,
+    total_installs): cluster-wide counts (psum'd) of groups whose
+    commit advanced and of stranded followers that installed the
+    leader's snapshot boundary this round."""
     axis = SHARD_AXIS
     n = jax.lax.axis_size(axis)
     leader = state.leader
@@ -87,8 +102,9 @@ def cluster_tick(state: ClusterState, new_dirty: jax.Array) -> tuple[ClusterStat
     # -1 so followers reject the row wholesale.
     hb_term = jnp.where(leader.is_leader, leader.term, -1)
     payload = jnp.stack(
-        [hb_term, leader.commit_index, leader.match_index[:, 0]], axis=-1
-    )  # [G, 3]
+        [hb_term, leader.commit_index, leader.match_index[:, 0], state.log_start],
+        axis=-1,
+    )  # [G, 4]
 
     fol_dirty, fol_flushed, fol_commit, fol_term = (
         state.fol_dirty,
@@ -96,13 +112,19 @@ def cluster_tick(state: ClusterState, new_dirty: jax.Array) -> tuple[ClusterStat
         state.fol_commit,
         state.fol_term,
     )
+    installs = jnp.zeros((), jnp.int64)
     replies = []
     for hop in range(1, RF):
         # 2. heartbeat rides ICI to the follower device
         fwd = [(i, (i + hop) % n) for i in range(n)]
         recv = jax.lax.ppermute(payload, axis, fwd)  # groups of device d-hop
         j = hop - 1
-        r_term, r_commit, r_dirty = recv[:, 0], recv[:, 1], recv[:, 2]
+        r_term, r_commit, r_dirty, r_start = (
+            recv[:, 0],
+            recv[:, 1],
+            recv[:, 2],
+            recv[:, 3],
+        )
         # 3. term gate (do_append_entries term check, consensus.cc:1752):
         # heartbeats from a stale term are rejected wholesale
         accept = r_term >= fol_term[:, j]
@@ -125,13 +147,27 @@ def cluster_tick(state: ClusterState, new_dirty: jax.Array) -> tuple[ClusterStat
                 fol_dirty[:, j],
             ),
         )
+        # install_snapshot over ICI: the mirror's next entry fell below
+        # the leader's retained log — appends cannot be served, the
+        # follower adopts the snapshot boundary wholesale. The boundary
+        # is <= the leader's commit (retention is snapshot-gated), so
+        # installed state is committed by definition.
+        stranded = accept & (fol_dirty[:, j] + 1 < r_start)
+        snap = r_start - 1
+        new_f_dirty = jnp.where(stranded, snap, new_f_dirty)
         new_f_flushed = jnp.where(
-            new_term, new_f_dirty, jnp.maximum(fol_flushed[:, j], new_f_dirty)
+            new_term | stranded,
+            new_f_dirty,
+            jnp.maximum(fol_flushed[:, j], new_f_dirty),
         )
         proposed = jnp.minimum(r_commit, new_f_flushed)
         new_f_commit = jnp.where(
             accept & (proposed > fol_commit[:, j]), proposed, fol_commit[:, j]
         )
+        # (no extra commit bump for installs: snap <= r_commit by the
+        # retention invariant, so min(r_commit, flushed=snap) above
+        # already commits the installed boundary)
+        installs = installs + jnp.sum(stranded)
         fol_dirty = fol_dirty.at[:, j].set(new_f_dirty)
         fol_flushed = fol_flushed.at[:, j].set(new_f_flushed)
         fol_commit = fol_commit.at[:, j].set(new_f_commit)
@@ -151,9 +187,13 @@ def cluster_tick(state: ClusterState, new_dirty: jax.Array) -> tuple[ClusterStat
 
     advanced = jnp.sum(leader.commit_index > old_commit)
     total = jax.lax.psum(advanced, axis)
+    total_installs = jax.lax.psum(installs, axis)
     return (
-        ClusterState(leader, fol_dirty, fol_flushed, fol_commit, fol_term),
+        ClusterState(
+            leader, fol_dirty, fol_flushed, fol_commit, fol_term, state.log_start
+        ),
         total,
+        total_installs,
     )
 
 
@@ -276,6 +316,7 @@ def _cluster_specs(mesh: Mesh):
         fol_flushed=spec,
         fol_commit=spec,
         fol_term=spec,
+        log_start=spec,
     )
     return spec, state_specs
 
@@ -301,6 +342,6 @@ def cluster_tick_sharded(mesh: Mesh):
         cluster_tick,
         mesh=mesh,
         in_specs=(state_specs, spec),
-        out_specs=(state_specs, P()),
+        out_specs=(state_specs, P(), P()),
     )
     return jax.jit(fn)

@@ -280,7 +280,7 @@ class TestClusterStep:
         state = jax.tree.map(lambda a: jax.device_put(a, sharding), state)
         tick = cluster_tick_sharded(mesh)
         new_dirty = jax.device_put(jnp.full(g, 5, jnp.int64), sharding)
-        state, total = tick(state, new_dirty)
+        state, total, _inst = tick(state, new_dirty)
         # after one round every leader has both follower acks at 5 and
         # its own flush at 5 → all 64 groups commit
         assert int(total) == g
@@ -291,9 +291,60 @@ class TestClusterStep:
         # second tick with no new appends: no further leader advancement,
         # but followers learn the commit index
         zero = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
-        state, total2 = tick(state, zero)
+        state, total2, _inst = tick(state, zero)
         assert int(total2) == 0
         assert np.all(np.asarray(state.fol_commit) == 5)
+
+    def test_stranded_follower_installs_snapshot_over_ici(self):
+        """A mirror whose next entry fell below the leader's retained
+        log cannot be append-served: one tick installs the snapshot
+        boundary (committed by construction), and the NEXT tick
+        catches it up to the leader's head normally."""
+        from redpanda_tpu.parallel import (
+            cluster_tick_sharded,
+            make_cluster_state,
+            make_mesh,
+        )
+        from redpanda_tpu.parallel.mesh import group_sharding
+
+        mesh = make_mesh(8)
+        g = 64
+        state = make_cluster_state(g)
+        sharding = group_sharding(mesh)
+        put = lambda s: jax.tree.map(
+            lambda a: jax.device_put(a, sharding), s
+        )
+        state = put(state)
+        tick = cluster_tick_sharded(mesh)
+        dirty9 = jax.device_put(jnp.full(g, 9, jnp.int64), sharding)
+        none = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
+        state, total, inst = tick(state, dirty9)
+        assert int(total) == g and int(inst) == 0
+
+        # strand hop-1 mirrors at 2; retention moves leaders' log
+        # start to 8 (snapshot boundary 7 <= commit 9)
+        state = put(
+            state._replace(
+                fol_dirty=state.fol_dirty.at[:, 0].set(2),
+                fol_flushed=state.fol_flushed.at[:, 0].set(2),
+                fol_commit=state.fol_commit.at[:, 0].set(2),
+                log_start=jnp.full(g, 8, jnp.int64),
+            )
+        )
+        state, _, inst = tick(state, none)
+        assert int(inst) == g
+        fd = np.asarray(state.fol_dirty)
+        fc = np.asarray(state.fol_commit)
+        # installed exactly to the boundary, commit jumped with it
+        assert (fd[:, 0] == 7).all(), fd[:, 0]
+        assert (fc[:, 0] >= 7).all(), fc[:, 0]
+        # healthy hop-2 mirrors never install
+        assert (fd[:, 1] == 9).all()
+        # next tick: normal appends resume from the boundary
+        state, _, inst2 = tick(state, none)
+        assert int(inst2) == 0
+        fd = np.asarray(state.fol_dirty)
+        assert (fd[:, 0] == 9).all(), fd[:, 0]
 
 
 class TestHostDeviceTickParity:
@@ -381,8 +432,8 @@ class TestClusterElection:
         tick = cluster_tick_sharded(mesh)
         dirty5 = jax.device_put(jnp.full(g, 5, jnp.int64), sharding)
         none = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
-        state, _ = tick(state, dirty5)
-        state, _ = tick(state, none)  # commit=5 known everywhere
+        state, _, _ = tick(state, dirty5)
+        state, _, _ = tick(state, none)  # commit=5 known everywhere
 
         # home leaders die after appending a divergent UNCOMMITTED
         # suffix (dirty 9) that never replicated
@@ -415,8 +466,8 @@ class TestClusterElection:
         tick = cluster_tick_sharded(mesh)
         dirty5 = jax.device_put(jnp.full(g, 5, jnp.int64), sharding)
         none = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
-        state, _ = tick(state, dirty5)
-        state, _ = tick(state, none)
+        state, _, _ = tick(state, dirty5)
+        state, _, _ = tick(state, none)
 
         # hop-1 candidate artificially LOSES its tail (mirror dirty 3 <
         # committed 5): the hop-2 voter's log_ok must reject it — the
@@ -445,8 +496,8 @@ class TestClusterElection:
         tick = cluster_tick_sharded(mesh)
         dirty5 = jax.device_put(jnp.full(g, 5, jnp.int64), sharding)
         none = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
-        state, _ = tick(state, dirty5)
-        state, _ = tick(state, none)
+        state, _, _ = tick(state, dirty5)
+        state, _, _ = tick(state, none)
         per_dev = g // 8
         mask = jnp.zeros(g, bool).at[:per_dev].set(True)  # device 0 only
         elect = election_round_sharded(mesh, candidate_hop=1)
@@ -471,8 +522,8 @@ class TestClusterElection:
         tick = cluster_tick_sharded(mesh)
         dirty5 = jax.device_put(jnp.full(g, 5, jnp.int64), sharding)
         none = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
-        state, _ = tick(state, dirty5)
-        state, _ = tick(state, none)
+        state, _, _ = tick(state, dirty5)
+        state, _, _ = tick(state, none)
         mask = jax.device_put(jnp.ones(g, bool), sharding)
         state, won1, t1 = election_round_sharded(mesh, 1)(state, mask)
         assert np.all(np.asarray(won1))
@@ -506,8 +557,8 @@ class TestClusterElection:
         tick = cluster_tick_sharded(mesh)
         dirty5 = jax.device_put(jnp.full(g, 5, jnp.int64), sharding)
         none = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
-        state, _ = tick(state, dirty5)
-        state, _ = tick(state, none)
+        state, _, _ = tick(state, dirty5)
+        state, _, _ = tick(state, none)
         assert np.all(np.asarray(state.fol_commit) == 5)
 
         # followers mirrored a deposed leader's uncommitted suffix
@@ -523,7 +574,7 @@ class TestClusterElection:
                 term=state.leader.term + 1,  # new-term leadership
             ),
         )
-        state, _ = tick(state, none)
+        state, _, _ = tick(state, none)
         fd = np.asarray(state.fol_dirty)
         fc = np.asarray(state.fol_commit)
         # divergent suffix truncated to the new leader's log...
