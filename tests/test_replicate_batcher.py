@@ -180,9 +180,13 @@ def test_produce_pipelining_overlaps_rounds(tmp_path):
             loopback=LoopbackNetwork(),
         )
         await b.start()
-        client = KafkaClient([b.kafka_advertised])
+        # several CONNECTIONS so server-side concurrency is structural
+        # (a single pipelined connection only overlaps via the staged
+        # produce, which can collapse on a loaded box and flake the
+        # coalescing assertion)
+        clients = [KafkaClient([b.kafka_advertised]) for _ in range(4)]
         try:
-            await client.create_topic("pp", partitions=1)
+            await clients[0].create_topic("pp", partitions=1)
             ntp = NTP("kafka", "pp", 0)
             part = b.partition_manager.get(ntp)
             rounds_before = part.consensus._batcher.flush_rounds
@@ -190,17 +194,18 @@ def test_produce_pipelining_overlaps_rounds(tmp_path):
             n = 40
             offsets = await asyncio.gather(
                 *(
-                    client.produce("pp", 0, [(b"k", b"m%d" % i)])
+                    clients[i % 4].produce("pp", 0, [(b"k", b"m%d" % i)])
                     for i in range(n)
                 )
             )
             assert sorted(set(offsets)) == sorted(offsets)  # unique bases
-            got = await client.fetch("pp", 0, 0)
+            got = await clients[0].fetch("pp", 0, 0)
             assert len(got) == n
             rounds = part.consensus._batcher.flush_rounds - rounds_before
             assert rounds < n, f"no coalescing: {rounds} rounds for {n}"
         finally:
-            await client.close()
+            for client in clients:
+                await client.close()
             await b.stop()
 
     run(main())
