@@ -565,10 +565,12 @@ class KafkaClient:
         max_wait_ms: int,
         min_bytes: int,
         read_committed: bool,
+        rack: str | None = None,
     ) -> Msg:
         """One sessionless single-partition FETCH request (shared by
         fetch/fetch_raw so the wire shape can't diverge)."""
         return Msg(
+            rack_id=rack or "",
             replica_id=-1,
             max_wait_ms=max_wait_ms,
             min_bytes=min_bytes,
@@ -591,7 +593,6 @@ class KafkaClient:
                 )
             ],
             forgotten_topics_data=[],
-            rack_id="",
         )
 
     async def fetch(
@@ -603,20 +604,64 @@ class KafkaClient:
         max_wait_ms: int = 500,
         min_bytes: int = 1,
         read_committed: bool = False,
+        rack: str | None = None,
     ) -> list[tuple[int, bytes | None, bytes | None]]:
-        """Returns [(offset, key, value)] at-or-after `offset`."""
-        for attempt in range(8):
-            if attempt:
-                await asyncio.sleep(0.1)
-            conn = await self.leader_conn(topic, partition, refresh=attempt > 0)
+        """Returns [(offset, key, value)] at-or-after `offset`.
+        `rack` opts into KIP-392 follower fetching: the leader may
+        redirect to a same-rack replica via preferred_read_replica,
+        which this client follows."""
+        read_node: int | None = None  # KIP-392 redirect target
+        attempt = 0
+        redirects = 0
+        while attempt < 8:
+            if read_node is not None:
+                # follow the redirect immediately: it is routing, not a
+                # failure — no backoff, no attempt consumed
+                if read_node not in self._brokers:
+                    await self.metadata([topic])
+                addr = self._brokers.get(read_node)
+                conn = None
+                if addr is not None:
+                    try:
+                        conn = await self._connect_addr(addr)
+                    except (OSError, KafkaClientError):
+                        conn = None  # dead replica: leader still serves
+                if conn is None:
+                    read_node = None
+                    rack = None  # stop advertising: read from the leader
+                    attempt += 1
+                    continue
+            else:
+                if attempt:
+                    await asyncio.sleep(0.1)
+                conn = await self.leader_conn(
+                    topic, partition, refresh=attempt > 0
+                )
             v = conn.pick_version(FETCH, 11)
             req = self._fetch_request(
                 topic, partition, offset, max_bytes, max_wait_ms,
-                min_bytes, read_committed,
+                min_bytes, read_committed, rack=rack,
             )
             resp = await conn.request(FETCH, req, v)
             pr = resp.responses[0].partitions[0]
             if pr.error_code == int(ErrorCode.not_leader_for_partition):
+                read_node = None
+                attempt += 1
+                continue
+            preferred = getattr(pr, "preferred_read_replica", -1)
+            if (
+                pr.error_code == 0
+                and preferred is not None
+                and preferred >= 0
+                and not pr.records
+            ):
+                redirects += 1
+                if redirects > 2:  # redirect loop guard: use the leader
+                    read_node = None
+                    rack = None
+                    attempt += 1
+                    continue
+                read_node = preferred
                 continue
             if pr.error_code != 0:
                 raise KafkaClientError(

@@ -864,6 +864,33 @@ class KafkaServer:
         # isolation 1 = READ_COMMITTED: serve only below the LSO and
         # report aborted ranges (fetch.cc read_result + rm_stm LSO)
         read_committed = getattr(req, "isolation_level", 0) == 1
+        # KIP-392 follower fetching: a consumer advertising its rack
+        # may be redirected by the leader to a same-rack replica, and
+        # that replica serves the read bounded by ITS high watermark
+        rack_id = getattr(req, "rack_id", "") or ""
+
+        def rack_replica(topic: str, pid: int) -> int | None:
+            """A replica (not us) whose broker sits in the consumer's
+            rack, or None (replica_selector / rack_aware_replica_selector
+            analog)."""
+            from ..models.fundamental import TopicNamespace
+
+            md = self.broker.controller.topic_table.get(
+                TopicNamespace(DEFAULT_NS, topic)
+            )
+            if md is None:
+                return None
+            a = md.assignments.get(pid)
+            if a is None:
+                return None
+            members = self.broker.controller.members_table
+            for nid in a.replicas:
+                if nid == self.broker.node_id:
+                    continue
+                ep = members.get(nid)
+                if ep is not None and ep.rack == rack_id:
+                    return nid
+            return None
 
         # -- fetch sessions (KIP-227, fetch_session_cache.h) ----------
         # epoch -1: sessionless full fetch. id 0 + epoch 0: create a
@@ -1093,7 +1120,12 @@ class KafkaServer:
                             )
                         )
                         continue
-                    if not partition.is_leader:
+                    follower_serve = (
+                        not partition.is_leader
+                        and rack_id != ""
+                        and (self.broker.config.rack or "") == rack_id
+                    )
+                    if not partition.is_leader and not follower_serve:
                         has_error = True
                         parts.append(
                             Msg(
@@ -1107,6 +1139,31 @@ class KafkaServer:
                             )
                         )
                         continue
+                    if (
+                        partition.is_leader
+                        and rack_id != ""
+                        and (self.broker.config.rack or "") != rack_id
+                    ):
+                        nid = rack_replica(t.topic, p.partition)
+                        if nid is not None:
+                            # redirect: empty row naming the same-rack
+                            # replica; fast-exit the poll so the client
+                            # switches immediately (fetch.cc
+                            # preferred_read_replica)
+                            has_error = True
+                            parts.append(
+                                Msg(
+                                    partition_index=p.partition,
+                                    error_code=0,
+                                    high_watermark=partition.high_watermark(),
+                                    last_stable_offset=partition.last_stable_offset(),
+                                    log_start_offset=partition.start_offset(),
+                                    aborted_transactions=None,
+                                    preferred_read_replica=nid,
+                                    records=None,
+                                )
+                            )
+                            continue
                     hw = partition.high_watermark()
                     lso = partition.last_stable_offset()
                     start = partition.start_offset()
@@ -1120,6 +1177,24 @@ class KafkaServer:
                             # served from the archived range
                             total += len(remote.records or b"")
                             parts.append(remote)
+                            continue
+                        if follower_serve and p.fetch_offset > hw:
+                            # lagging replica: the offset may be valid
+                            # on the leader — answer EMPTY (retriable),
+                            # never out_of_range, or a redirected
+                            # rack consumer crashes on data the
+                            # cluster definitely has (KIP-392)
+                            parts.append(
+                                Msg(
+                                    partition_index=p.partition,
+                                    error_code=0,
+                                    high_watermark=hw,
+                                    last_stable_offset=lso,
+                                    log_start_offset=start,
+                                    aborted_transactions=None,
+                                    records=None,
+                                )
+                            )
                             continue
                         cloud_start = partition.cloud_start_kafka()
                         has_error = True
@@ -1232,6 +1307,10 @@ class KafkaServer:
                     sp is None
                     or p.records
                     or p.error_code != 0
+                    # a KIP-392 redirect is always news: suppressing it
+                    # strands a sessioned rack consumer on instant
+                    # empty responses with no preferred replica
+                    or getattr(p, "preferred_read_replica", -1) >= 0
                     or sp.last_hw != p.high_watermark
                     or sp.last_lso != p.last_stable_offset
                     or sp.last_start != p.log_start_offset

@@ -241,3 +241,103 @@ async def _quotas(tmp_path):
 
 def test_quotas(tmp_path):
     asyncio.run(_quotas(tmp_path))
+
+
+def test_follower_fetch_kip392(tmp_path):
+    """KIP-392: a consumer advertising its rack is redirected by the
+    leader to the same-rack replica, which serves the read bounded by
+    its high watermark; consumers without a rack keep leader-only
+    routing."""
+
+    async def run():
+        from redpanda_tpu.app import Broker, BrokerConfig
+        from redpanda_tpu.rpc.loopback import LoopbackNetwork
+        from redpanda_tpu.models.fundamental import kafka_ntp
+
+        net = LoopbackNetwork()
+        racks = {0: "rack-a", 1: "rack-b", 2: "rack-c"}
+        brokers = [
+            Broker(
+                BrokerConfig(
+                    node_id=i,
+                    data_dir=str(tmp_path / f"n{i}"),
+                    members=[0, 1, 2],
+                    election_timeout_s=0.15,
+                    heartbeat_interval_s=0.03,
+                    rack=racks[i],
+                ),
+                loopback=net,
+            )
+            for i in range(3)
+        ]
+        for b in brokers:
+            await b.start()
+        addrs = {b.node_id: b.kafka_advertised for b in brokers}
+        for b in brokers:
+            b.config.peer_kafka_addresses = addrs
+        await brokers[0].wait_controller_leader()
+        client = KafkaClient([b.kafka_advertised for b in brokers])
+        try:
+            await client.create_topic("ff", partitions=1, replication_factor=3)
+            for i in range(10):
+                await client.produce("ff", 0, [(b"k%d" % i, b"v%d" % i)], acks=-1)
+
+            leader_b = next(
+                b
+                for b in brokers
+                if b.partition_manager.get(kafka_ntp("ff", 0)) is not None
+                and b.partition_manager.get(kafka_ntp("ff", 0)).is_leader
+            )
+            follower_b = next(b for b in brokers if b is not leader_b)
+            follower_rack = follower_b.config.rack
+
+            # wait for the follower's high watermark to catch up
+            fp = follower_b.partition_manager.get(kafka_ntp("ff", 0))
+            deadline = asyncio.get_event_loop().time() + 5.0
+            while asyncio.get_event_loop().time() < deadline:
+                if fp.high_watermark() >= 10:
+                    break
+                await asyncio.sleep(0.05)
+
+            # rack-aware consumer: redirected + served the full data
+            got = await client.fetch("ff", 0, 0, rack=follower_rack)
+            assert [(k, v) for _o, k, v in got] == [
+                (b"k%d" % i, b"v%d" % i) for i in range(10)
+            ]
+            # the leader really redirects (raw probe from the leader)
+            from redpanda_tpu.kafka.protocol import FETCH
+
+            conn = await client._connect_addr(addrs[leader_b.node_id])
+            req = KafkaClient._fetch_request(
+                "ff", 0, 0, 1 << 20, 0, 0, False, rack=follower_rack
+            )
+            resp = await conn.request(FETCH, req, 11)
+            pr = resp.responses[0].partitions[0]
+            assert pr.preferred_read_replica == follower_b.node_id
+            assert not pr.records
+            # an unknown rack is served by the leader directly
+            got = await client.fetch("ff", 0, 0, rack="nowhere")
+            assert len(got) == 10
+            # and rackless fetches never touch the follower path
+            got = await client.fetch("ff", 0, 0)
+            assert len(got) == 10
+
+            # lagging follower: isolate it, commit more on the leader
+            # (quorum 2/3 holds), then rack-fetch past its HW — the
+            # follower answers EMPTY (retriable), never out_of_range
+            net.isolate(follower_b.node_id)
+            for i in range(10, 12):
+                await client.produce(
+                    "ff", 0, [(b"k%d" % i, b"v%d" % i)], acks=-1
+                )
+            got = await client.fetch(
+                "ff", 0, 10, rack=follower_rack, max_wait_ms=0
+            )
+            assert got == []  # no crash, no stale error
+            net.heal()
+        finally:
+            await client.close()
+            for b in brokers:
+                await b.stop()
+
+    asyncio.run(run())
