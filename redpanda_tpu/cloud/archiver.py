@@ -51,9 +51,10 @@ class NtpArchiver:
         # restores); the property below prefers replicated state
         self._manifest_fallback: Optional[PartitionManifest] = None
         self._synced_term = -1
-        # archived_upto of the store's exported manifest.bin (learned
-        # at sync, advanced by _export_manifest)
+        # (archived_upto, revision) of the store's exported
+        # manifest.bin (learned at sync, advanced by _export_manifest)
         self._store_upto = -1
+        self._store_rev = -1
 
     @property
     def manifest(self) -> Optional[PartitionManifest]:
@@ -98,9 +99,11 @@ class NtpArchiver:
             return
         key = self._manifest_key()
         self._store_upto = -1
+        self._store_rev = -1
         if await self.store.exists(key):
             store_m = PartitionManifest.decode(await self.store.get(key))
             self._store_upto = store_m.archived_upto
+            self._store_rev = int(store_m.revision)
             if store_m.archived_upto > self.archived_upto:
                 await self._replicate_cmd(archival_stm.RESET, store_m.encode())
         self._synced_term = p.consensus.term
@@ -110,7 +113,10 @@ class NtpArchiver:
         of the store copy (external readers + topic recovery read the
         store, so it must converge even without new uploads)."""
         stm = self.partition.archival
-        if stm.archived_upto <= self._store_upto:
+        if (
+            stm.archived_upto <= self._store_upto
+            and stm.revision == self._store_rev
+        ):
             return
         ntp = self.partition.ntp
         await self.store.put(
@@ -118,6 +124,83 @@ class NtpArchiver:
             stm.to_manifest(ntp.ns, ntp.topic, ntp.partition).encode(),
         )
         self._store_upto = stm.archived_upto
+        self._store_rev = stm.revision
+
+    async def _cloud_retention_pass(self, now_ms: int | None = None) -> None:
+        """Apply retention.* to the ARCHIVED history (the reference's
+        archival retention_calculator + garbage collection): without
+        it the bucket grows forever. Only runs for topics with split
+        retention (retention.local.target.* set) — otherwise
+        retention.* already governs the local log and the cloud keeps
+        the full history for recovery. Drops whole leading segments,
+        never the newest one; the replicated TRUNCATE commits BEFORE
+        objects are deleted, so no replica can serve a dropped range
+        from a manifest that still lists it."""
+        import time as _time
+
+        if now_ms is None:
+            now_ms = int(_time.time() * 1000)
+        p = self.partition
+        cfg = p.log.config
+        if (
+            cfg.local_retention_bytes is None
+            and cfg.local_retention_ms is None
+        ):
+            return
+        if cfg.retention_bytes is None and cfg.retention_ms is None:
+            return
+        stm = p.archival
+        stm.apply_committed(p.consensus.commit_index)
+        segs = stm.segments
+        if len(segs) <= 1:
+            return
+        from ..storage.log import retention_drop_upto
+
+        drop_upto = retention_drop_upto(
+            [
+                (int(s.size_bytes), int(s.max_timestamp), int(s.last_offset))
+                for s in segs
+            ],
+            cfg.retention_bytes,
+            cfg.retention_ms,
+            now_ms,
+        )
+        if drop_upto is None:
+            return
+        new_start = drop_upto + 1
+        dropped = [s for s in segs if int(s.last_offset) < new_start]
+        ntp = p.ntp
+        prefix = PartitionManifest.prefix(ntp.ns, ntp.topic, ntp.partition)
+        # replicate FIRST: once committed, no replica's manifest view
+        # references the doomed range; object deletion follows
+        await self._replicate_cmd(
+            archival_stm.TRUNCATE,
+            int(new_start).to_bytes(8, "little", signed=True),
+        )
+        stm.apply_committed(p.consensus.commit_index)
+        # publish the truncated manifest BEFORE deleting objects: an
+        # external reader following the store manifest must never see
+        # entries whose objects are already gone (module invariant)
+        await self._export_manifest()
+        for meta in dropped:
+            try:
+                await self.store.delete(f"{prefix}/{meta.name}")
+            except StoreError as e:
+                # orphaned object: harmless, retried never (reference
+                # GC has the same leak-on-crash window)
+                logger.warning(
+                    "%s: failed to delete archived %s: %s",
+                    ntp,
+                    meta.name,
+                    e,
+                )
+        logger.info(
+            "%s: cloud retention dropped %d archived segments (new "
+            "start %d)",
+            ntp,
+            len(dropped),
+            new_start,
+        )
 
     async def upload_pass(self) -> int:
         """One archival round: upload every closed segment whose range
@@ -193,6 +276,12 @@ class NtpArchiver:
                 )
                 break
             uploaded += 1
+        try:
+            # retention AFTER uploads: the pass's own tail upload counts
+            # against the budget it is judged by
+            await self._cloud_retention_pass()
+        except (StoreError, NotLeaderError, ReplicateTimeout) as e:
+            logger.warning("%s: cloud retention failed: %s", p.ntp, e)
         return uploaded
 
 

@@ -33,6 +33,9 @@ logger = logging.getLogger("rp.archival_stm")
 
 ADD_SEGMENT = b"add_segment"
 RESET = b"reset"
+# drop archived segments entirely below a raft offset (cloud retention:
+# the bucket must not grow forever; value = 8-byte LE new start offset)
+TRUNCATE = b"truncate"
 
 
 class _ArchivalStateE(serde.Envelope):
@@ -91,6 +94,16 @@ class ArchivalState:
                 if m.archived_upto > self.archived_upto:
                     self.segments = list(m.segments)
                     self.revision = int(m.revision)
+            elif key == TRUNCATE and value:
+                new_start = int.from_bytes(value, "little", signed=True)
+                before = len(self.segments)
+                self.segments = [
+                    s
+                    for s in self.segments
+                    if int(s.last_offset) >= new_start
+                ]
+                if len(self.segments) != before:
+                    self.revision += 1
         except Exception:
             # a malformed command from a newer/corrupt writer must not
             # wedge log replay; the archiver re-syncs from the store

@@ -299,7 +299,18 @@ class Partition:
                 self.log.compact(boundary, visible=self._record_decided)
         if not self.log.config.deletion_enabled:
             return
-        target = self.log.retention_offset(now_ms)
+        cfg = self.log.config
+        local_limits = None
+        if self.archiver is not None and (
+            cfg.local_retention_bytes is not None
+            or cfg.local_retention_ms is not None
+        ):
+            # tiered topic with split retention (Redpanda semantics):
+            # retention.local.target.* trims the local suffix; the
+            # archiver applies retention.* to the CLOUD history. The
+            # pair REPLACES the cloud knobs for local trimming.
+            local_limits = (cfg.local_retention_bytes, cfg.local_retention_ms)
+        target = self.log.retention_offset(now_ms, limits=local_limits)
         if target is None:
             return
         if self.archiver is not None:
@@ -313,7 +324,11 @@ class Partition:
             if target <= self.log.offsets().start_offset:
                 return
         self.consensus.write_snapshot(target - 1)
-        self.log.apply_retention(now_ms, max_offset=self.consensus.snapshot_index)
+        self.log.apply_retention(
+            now_ms,
+            max_offset=self.consensus.snapshot_index,
+            limits=local_limits,
+        )
 
     # -- tiered storage ------------------------------------------------
     def cloud_manifest(self):

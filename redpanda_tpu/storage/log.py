@@ -25,10 +25,17 @@ class LogConfig:
         retention_ms: int | None = None,
         cleanup_policy: str = "delete",
         max_compacted_segment_bytes: int = 256 * 1024 * 1024,
+        local_retention_bytes: int | None = None,
+        local_retention_ms: int | None = None,
     ):
         self.segment_max_bytes = segment_max_bytes
         self.retention_bytes = retention_bytes
         self.retention_ms = retention_ms
+        # tiered topics (Redpanda semantics): retention.* bounds the
+        # TOTAL (cloud) history; retention.local.target.* bounds the
+        # locally-kept suffix. Non-tiered topics ignore the local pair.
+        self.local_retention_bytes = local_retention_bytes
+        self.local_retention_ms = local_retention_ms
         # "delete", "compact", or "compact,delete" (Kafka cleanup.policy)
         self.cleanup_policy = cleanup_policy
         # adjacent-merge budget for compacted segments — deliberately
@@ -72,10 +79,43 @@ class LogConfig:
             out.max_compacted_segment_bytes = mcs
         out.retention_bytes = _int("retention.bytes")
         out.retention_ms = _int("retention.ms")
+        out.local_retention_bytes = _int("retention.local.target.bytes")
+        out.local_retention_ms = _int("retention.local.target.ms")
         policy = config.get("cleanup.policy")
         if policy:
             out.cleanup_policy = str(policy)
         return out
+
+
+def retention_drop_upto(
+    entries: "list[tuple[int, int, int]]",
+    retention_bytes: int | None,
+    retention_ms: int | None,
+    now_ms: int | None,
+) -> int | None:
+    """Shared size/time retention rule over (size_bytes,
+    max_timestamp, last_offset) rows oldest-first, never dropping the
+    newest row. Returns the last offset of the last dropped row, or
+    None. Used by the local log AND the archiver's cloud retention so
+    the two tiers can't drift."""
+    drop_upto: int | None = None
+    if retention_bytes is not None:
+        total = sum(size for size, _ts, _off in entries)
+        i = 0
+        while i + 1 < len(entries) and total > retention_bytes:
+            total -= entries[i][0]
+            drop_upto = entries[i][2]
+            i += 1
+    if retention_ms is not None and now_ms is not None:
+        i = 0
+        while (
+            i + 1 < len(entries)
+            and entries[i][1] >= 0
+            and entries[i][1] < now_ms - retention_ms
+        ):
+            drop_upto = max(drop_upto or -1, entries[i][2])
+            i += 1
+    return drop_upto
 
 
 class LogOffsets:
@@ -397,39 +437,46 @@ class Log:
             self._cache_index.truncate(0)
 
     # -- housekeeping -------------------------------------------------
-    def retention_offset(self, now_ms: int | None = None) -> int | None:
+    def retention_offset(
+        self,
+        now_ms: int | None = None,
+        limits: "tuple[int | None, int | None] | None" = None,
+    ) -> int | None:
         """First offset retention WANTS to keep (None = nothing to do).
         Pure query — raft must take a snapshot covering everything
         below before any data is physically reclaimed
-        (max_collectible_offset in the reference's disk_log_impl)."""
+        (max_collectible_offset in the reference's disk_log_impl).
+        `limits=(bytes, ms)` REPLACES both config knobs entirely
+        (tiered topics trim locally by retention.local.target.*; an
+        unset dimension inside the pair means NO limit there, never a
+        fallback to the cloud knobs)."""
         cfg = self.config
-        drop_upto: int | None = None  # last offset of the last dropped segment
-        if cfg.retention_bytes is not None:
-            total = sum(s.size_bytes() for s in self._segments)
-            i = 0
-            while i + 1 < len(self._segments) and total > cfg.retention_bytes:
-                total -= self._segments[i].size_bytes()
-                drop_upto = self._segments[i].dirty_offset
-                i += 1
-        if cfg.retention_ms is not None and now_ms is not None:
-            i = 0
-            while (
-                i + 1 < len(self._segments)
-                and self._segments[i].max_timestamp >= 0
-                and self._segments[i].max_timestamp < now_ms - cfg.retention_ms
-            ):
-                drop_upto = max(drop_upto or -1, self._segments[i].dirty_offset)
-                i += 1
+        if limits is not None:
+            retention_bytes, retention_ms = limits
+        else:
+            retention_bytes, retention_ms = cfg.retention_bytes, cfg.retention_ms
+        drop_upto = retention_drop_upto(
+            [
+                (s.size_bytes(), s.max_timestamp, s.dirty_offset)
+                for s in self._segments
+            ],
+            retention_bytes,
+            retention_ms,
+            now_ms,
+        )
         return drop_upto + 1 if drop_upto is not None else None
 
     def apply_retention(
-        self, now_ms: int | None = None, max_offset: int | None = None
+        self,
+        now_ms: int | None = None,
+        max_offset: int | None = None,
+        limits: "tuple[int | None, int | None] | None" = None,
     ) -> int:
         """Size/time retention (log_manager housekeeping analog).
         Segments are only reclaimed when entirely below `max_offset`
         (the raft snapshot boundary — dropping data followers may
         still need would strand them). Returns first retained offset."""
-        target = self.retention_offset(now_ms)
+        target = self.retention_offset(now_ms, limits=limits)
         if target is not None:
             if max_offset is not None:
                 target = min(target, max_offset + 1)

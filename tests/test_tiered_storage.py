@@ -345,3 +345,69 @@ def test_remote_reader_segment_location():
         m.add(SegmentMeta(base_offset=20, last_offset=30, term=2, size_bytes=1,
                           base_timestamp=-1, max_timestamp=-1, delta_offset=0,
                           delta_offset_end=0))
+
+
+async def _cloud_retention(tmp_path):
+    """Split retention (Redpanda semantics): retention.local.target.*
+    trims the local log, retention.* bounds the ARCHIVED history — the
+    replicated TRUNCATE drops leading segments from every replica's
+    view and the objects are deleted from the bucket."""
+    store = MemoryObjectStore()
+    async with tiered_broker(tmp_path, store) as b:
+        client = KafkaClient([b.kafka_advertised])
+        await client.create_topic(
+            "cr",
+            partitions=1,
+            replication_factor=1,
+            configs={
+                "redpanda.remote.write": "true",
+                "redpanda.remote.read": "true",
+                "segment.bytes": "400",
+                "retention.local.target.bytes": "400",
+                "retention.bytes": "500",
+            },
+        )
+        # wave 1: one closed segment, inside the cloud budget
+        await _produce_n(client, "cr", 6)
+        p = b.partition_manager.get(kafka_ntp("cr", 0))
+        p.log.flush()
+        await b.archival.run_once()
+        objects_before = {k for k in store._data if k.endswith(".seg")}
+        assert objects_before, "nothing archived"
+        oldest = min(objects_before)
+        upto_before = p.archiver.archived_upto
+
+        # wave 2: more data pushes the ARCHIVED total over
+        # retention.bytes — the pass uploads the new segments, then
+        # cloud retention drops the oldest
+        await _produce_n(client, "cr", 6, start=6)
+        p.log.flush()
+        await b.archival.run_once()
+        b.storage.log_mgr.housekeeping()  # local trim by local target
+        assert p.log.offsets().start_offset > 0
+        objects_after = {k for k in store._data if k.endswith(".seg")}
+        assert oldest not in objects_after, sorted(objects_after)
+        stm_total = sum(int(s.size_bytes) for s in p.archival.segments)
+        assert stm_total <= 500 or len(p.archival.segments) == 1
+        # the newest archived range always survives
+        assert p.archiver.archived_upto >= upto_before
+        # the exported manifest reflects the truncation
+        m = PartitionManifest.decode(
+            await store.get(p.archiver._manifest_key())
+        )
+        assert len(m.segments) == len(p.archival.segments)
+
+        # reads: below the new cloud start -> out_of_range; from the
+        # new start -> served (remote+local stitched)
+        cstart = p.cloud_start_kafka()
+        assert cstart is not None and cstart > 0
+        with pytest.raises(KafkaClientError):
+            await client.fetch("cr", 0, 0)
+        got = await client.fetch("cr", 0, cstart, max_bytes=1 << 22)
+        offsets = [o for o, _k, _v in got]
+        assert offsets and offsets[0] == cstart and offsets[-1] == 11
+        await client.close()
+
+
+def test_cloud_retention(tmp_path):
+    asyncio.run(_cloud_retention(tmp_path))
