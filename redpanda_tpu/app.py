@@ -75,6 +75,10 @@ class BrokerConfig:
     kafka_tls_key: Optional[str] = None
     kafka_tls_ca: Optional[str] = None
     kafka_tls_require_client_auth: bool = False
+    # hostname verification for in-broker clients (transforms, proxy,
+    # schema registry). Disable only for certs lacking a SAN for the
+    # advertised host.
+    kafka_tls_verify_hostname: bool = True
     mtls_principal_rules: Optional[list[str]] = None
     # SASL/SCRAM authentication on the kafka listener; when on,
     # authorization (ACLs) is enforced too unless overridden
@@ -613,9 +617,11 @@ class Broker:
 
     def internal_kafka_ssl(self):
         """ssl context for in-broker clients. Under mTLS they present
-        the broker's OWN certificate — its DN principal is registered
-        super at listener start — so internal traffic authenticates
-        like any client and keeps working cross-broker."""
+        the broker's OWN certificate; the receiving listener pins the
+        internal identity to an exact (full-DER) certificate match, so
+        cross-broker internal traffic under mTLS requires all brokers
+        to share one certificate (or explicit ACLs for the per-broker
+        cert DNs) — a DN that merely equals ours grants nothing."""
         cfg = self.config
         if cfg.kafka_tls_cert is None:
             return None
@@ -633,6 +639,7 @@ class Broker:
                 if cfg.kafka_tls_require_client_auth
                 else None
             ),
+            check_hostname=cfg.kafka_tls_verify_hostname,
         )
 
     def kafka_address_of(self, node_id: int) -> Optional[tuple[str, int]]:

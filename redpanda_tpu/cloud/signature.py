@@ -151,6 +151,16 @@ def verify_request(
     date = headers.get("x-amz-date", "")
     if not date.startswith(day):
         return None
+    # freshness: a captured signed request must not verify forever
+    try:
+        when = datetime.datetime.strptime(date, "%Y%m%dT%H%M%SZ").replace(
+            tzinfo=datetime.timezone.utc
+        )
+    except ValueError:
+        return None
+    now = datetime.datetime.now(datetime.timezone.utc)
+    if abs((now - when).total_seconds()) > clock_skew_s:
+        return None
     # payload must match its declared hash
     if headers.get("x-amz-content-sha256") != _sha256(body):
         return None

@@ -238,8 +238,39 @@ class BrokerConnection:
                 pass
 
 
+class _LeaderRetry:
+    """Deadline-based leadership retry: a time budget, not a fixed
+    attempt count — on a loaded 1-core host an election can take
+    several seconds, so attempt-counted loops flake while a time
+    budget holds steady. First pass never sleeps; `refresh` is False
+    only on that first pass."""
+
+    __slots__ = ("_deadline", "attempt")
+
+    def __init__(self, budget_s: float):
+        self._deadline = asyncio.get_event_loop().time() + budget_s
+        self.attempt = 0
+
+    def more(self) -> bool:
+        return (
+            self.attempt == 0
+            or asyncio.get_event_loop().time() < self._deadline
+        )
+
+    async def pause(self) -> None:
+        if self.attempt:
+            await asyncio.sleep(0.1)
+        self.attempt += 1
+
+    @property
+    def refresh(self) -> bool:
+        return self.attempt > 1
+
+
 class KafkaClient:
     """Metadata-aware client: routes produce/fetch to partition leaders."""
+
+    LEADER_WAIT_S = 8.0  # _LeaderRetry budget for this client's calls
 
     def __init__(
         self,
@@ -514,10 +545,12 @@ class KafkaClient:
         encoding doesn't pollute the server number."""
         # leadership can be mid-flight (fresh topic, election, replica
         # move): retry with metadata refresh like real clients do
-        for attempt in range(8):
-            if attempt:
-                await asyncio.sleep(0.1)
-            conn = await self.leader_conn(topic, partition, refresh=attempt > 0)
+        retry = _LeaderRetry(self.LEADER_WAIT_S)
+        while retry.more():
+            await retry.pause()
+            conn = await self.leader_conn(
+                topic, partition, refresh=retry.refresh
+            )
             v = conn.pick_version(PRODUCE, 7)
             req = Msg(
                 transactional_id=None,
@@ -611,12 +644,12 @@ class KafkaClient:
         redirect to a same-rack replica via preferred_read_replica,
         which this client follows."""
         read_node: int | None = None  # KIP-392 redirect target
-        attempt = 0
         redirects = 0
-        while attempt < 8:
+        retry = _LeaderRetry(self.LEADER_WAIT_S)
+        while retry.more():
             if read_node is not None:
                 # follow the redirect immediately: it is routing, not a
-                # failure — no backoff, no attempt consumed
+                # failure — no backoff, no pause consumed
                 if read_node not in self._brokers:
                     await self.metadata([topic])
                 addr = self._brokers.get(read_node)
@@ -629,13 +662,12 @@ class KafkaClient:
                 if conn is None:
                     read_node = None
                     rack = None  # stop advertising: read from the leader
-                    attempt += 1
+                    retry.attempt += 1
                     continue
             else:
-                if attempt:
-                    await asyncio.sleep(0.1)
+                await retry.pause()
                 conn = await self.leader_conn(
-                    topic, partition, refresh=attempt > 0
+                    topic, partition, refresh=retry.refresh
                 )
             v = conn.pick_version(FETCH, 11)
             req = self._fetch_request(
@@ -646,7 +678,7 @@ class KafkaClient:
             pr = resp.responses[0].partitions[0]
             if pr.error_code == int(ErrorCode.not_leader_for_partition):
                 read_node = None
-                attempt += 1
+                retry.attempt += 1
                 continue
             preferred = getattr(pr, "preferred_read_replica", -1)
             if (
@@ -659,7 +691,7 @@ class KafkaClient:
                 if redirects > 2:  # redirect loop guard: use the leader
                     read_node = None
                     rack = None
-                    attempt += 1
+                    retry.attempt += 1
                     continue
                 read_node = preferred
                 continue
@@ -695,10 +727,12 @@ class KafkaClient:
         wire bytes onward, and position probes over windows whose
         committed view is empty (all aborted/control batches)."""
         pr = None
-        for attempt in range(8):
-            if attempt:
-                await asyncio.sleep(0.1)
-            conn = await self.leader_conn(topic, partition, refresh=attempt > 0)
+        retry = _LeaderRetry(self.LEADER_WAIT_S)
+        while retry.more():
+            await retry.pause()
+            conn = await self.leader_conn(
+                topic, partition, refresh=retry.refresh
+            )
             v = conn.pick_version(FETCH, 11)
             req = self._fetch_request(
                 topic, partition, offset, max_bytes, max_wait_ms, 0, False
@@ -1189,9 +1223,11 @@ class TransactionalProducer:
         for key, value in records:
             builder.add(value, key=key)
         wire = builder.build().to_kafka_wire()
-        for attempt in range(2):
+        retry = _LeaderRetry(self.client.LEADER_WAIT_S)
+        while retry.more():
+            await retry.pause()
             conn = await self.client.leader_conn(
-                topic, partition, refresh=attempt > 0
+                topic, partition, refresh=retry.refresh
             )
             v = conn.pick_version(PRODUCE, 7)
             req = Msg(

@@ -559,15 +559,33 @@ class GroupCoordinator:
                                 partition=part,
                             ).encode(),
                         )
-                    try:
-                        await p.replicate(rb.build(), acks=-1)
-                    except (NotLeaderError, ReplicateTimeout):
-                        logger.warning(
-                            "group %s: failed to restore %d offsets that "
-                            "survived a concurrent delete",
-                            g.group_id,
-                            len(survivors),
-                        )
+                    # retry until the log provably converges: a timed-out
+                    # replicate may still commit later, so only two
+                    # outcomes settle the replay-vs-memory question —
+                    # success (restore record is last; duplicates from
+                    # earlier timed-out appends are idempotent) or loss
+                    # of leadership (our memory stops mattering; the next
+                    # coordinator rebuilds from the log).
+                    for restore_try in range(3):
+                        try:
+                            await p.replicate(rb.build(), acks=-1)
+                            break
+                        except NotLeaderError:
+                            break
+                        except ReplicateTimeout:
+                            if restore_try == 2:
+                                # outcome unknown; keep memory (the
+                                # quorum usually catches up and commits
+                                # the appends) and flag the hazard
+                                logger.error(
+                                    "group %s: restore of %d offsets "
+                                    "surviving a concurrent delete timed "
+                                    "out repeatedly; replayed state may "
+                                    "lag live state until the appends "
+                                    "commit",
+                                    g.group_id,
+                                    len(survivors),
+                                )
         return out
 
     async def txn_commit_offsets(

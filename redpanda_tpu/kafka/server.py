@@ -99,6 +99,7 @@ class ConnectionContext:
         "scram",
         "authenticated",
         "session_expires_at",
+        "internal",
     )
 
     def __init__(self) -> None:
@@ -109,6 +110,10 @@ class ConnectionContext:
         # unix seconds after which the SASL session is no longer valid
         # (OAUTHBEARER: the token's exp; None = unbounded)
         self.session_expires_at: float | None = None
+        # True ONLY when the peer presented the broker's own certificate
+        # (exact DER match) under mTLS. A flag, not a principal name, so
+        # no SASL username or DN-mapping output can ever collide with it.
+        self.internal = False
 
 
 # the principal of the request currently being handled (set around the
@@ -116,9 +121,19 @@ class ConnectionContext:
 CURRENT_PRINCIPAL: "contextvars.ContextVar[str | None]" = contextvars.ContextVar(
     "kafka_principal", default=None
 )
+# mirrors ConnectionContext.internal for the current request: set only
+# for cert-pinned in-broker connections, short-circuits authorization
+CURRENT_INTERNAL: "contextvars.ContextVar[bool]" = contextvars.ContextVar(
+    "kafka_internal", default=False
+)
 
 
 class KafkaServer:
+    # display name for cert-pinned in-broker connections; authorization
+    # ignores it (the ConnectionContext.internal flag is what grants
+    # access), so a SASL user or mapped DN of the same name gains nothing
+    INTERNAL_PRINCIPAL = "User:__redpanda_tpu_internal__"
+
     def __init__(self, broker: "Broker"):
         self.broker = broker
         self._server: asyncio.AbstractServer | None = None
@@ -156,6 +171,7 @@ class KafkaServer:
                 f"Kafka handler latency p{q} (us, hdr_hist)",
             )
         self._mtls_mapper = None
+        self._own_cert_der = None
         from .fetch_session import FetchSessionCache
         from .quotas import QuotaManager
 
@@ -174,6 +190,10 @@ class KafkaServer:
         """ACL check for the current request's principal; always true
         when authorization is off (authorizer.h authorized())."""
         if not self.authorization_enabled:
+            return True
+        if CURRENT_INTERNAL.get():
+            # cert-pinned in-broker connection (exact DER match against
+            # our own certificate): implicitly super
             return True
         principal = CURRENT_PRINCIPAL.get() or "User:anonymous"
         return self.broker.controller.authorizer.authorized(
@@ -197,26 +217,26 @@ class KafkaServer:
                 self._mtls_mapper = PrincipalMapper(
                     cfg.mtls_principal_rules
                 )
+                # in-broker clients (transforms, proxy, schema registry)
+                # authenticate with the broker's OWN certificate. The
+                # internal identity is pinned to the exact certificate
+                # (full DER compare), NOT the mapped DN — a CA-issued
+                # cert that merely shares the subject DN maps to its DN
+                # principal like any client and gains nothing. Computed
+                # BEFORE the listener opens so the first accepted
+                # connection classifies correctly.
+                from cryptography import x509
+                from cryptography.hazmat.primitives.serialization import (
+                    Encoding,
+                )
+
+                with open(cfg.kafka_tls_cert, "rb") as f:
+                    own = x509.load_pem_x509_certificate(f.read())
+                self._own_cert_der = own.public_bytes(Encoding.DER)
         self._server = await asyncio.start_server(
             self._on_conn, cfg.kafka_host, cfg.kafka_port, ssl=ssl_ctx
         )
         self.port = self._server.sockets[0].getsockname()[1]
-        if ssl_ctx is not None and cfg.kafka_tls_require_client_auth:
-            # in-broker clients (transforms, proxy, schema registry)
-            # authenticate with the broker's OWN certificate; its DN
-            # principal is implicitly super so internal traffic keeps
-            # working under mTLS + authorization
-            from cryptography import x509
-
-            with open(cfg.kafka_tls_cert, "rb") as f:
-                own = x509.load_pem_x509_certificate(f.read())
-            name = self._mtls_mapper.principal_for_dn(
-                own.subject.rfc4514_string()
-            )
-            if name is not None:
-                self.broker.controller.authorizer.superusers.add(
-                    f"User:{name}"
-                )
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -253,17 +273,31 @@ class KafkaServer:
             # authorization exactly like a SASL identity
             ssl_obj = writer.get_extra_info("ssl_object")
             peercert = ssl_obj.getpeercert() if ssl_obj is not None else None
-            name = (
-                self._mtls_mapper.principal_for(peercert)
-                if peercert
+            peer_der = (
+                ssl_obj.getpeercert(binary_form=True)
+                if ssl_obj is not None
                 else None
             )
-            if name is None:
-                writer.close()
-                self._conns.discard(task)
-                return
-            ctx.principal = f"User:{name}"
-            ctx.authenticated = True
+            if (
+                self._own_cert_der is not None
+                and peer_der == self._own_cert_der
+            ):
+                # in-broker client presenting the broker's exact cert
+                ctx.principal = self.INTERNAL_PRINCIPAL
+                ctx.authenticated = True
+                ctx.internal = True
+            else:
+                name = (
+                    self._mtls_mapper.principal_for(peercert)
+                    if peercert
+                    else None
+                )
+                if name is None:
+                    writer.close()
+                    self._conns.discard(task)
+                    return
+                ctx.principal = f"User:{name}"
+                ctx.authenticated = True
         pending: asyncio.Queue = asyncio.Queue()
         conn_failed = asyncio.Event()
 
@@ -403,6 +437,7 @@ class KafkaServer:
             if handler is None:
                 raise _CloseConnection(b"")
             token = CURRENT_PRINCIPAL.set(ctx.principal)
+            itoken = CURRENT_INTERNAL.set(ctx.internal)
             t0 = asyncio.get_event_loop().time()
             try:
                 resp = await handler(hdr, req)
@@ -413,6 +448,7 @@ class KafkaServer:
                 raise
             finally:
                 CURRENT_PRINCIPAL.reset(token)
+                CURRENT_INTERNAL.reset(itoken)
                 self._req_counter.inc(api=api.name)
                 elapsed = asyncio.get_event_loop().time() - t0
                 self._latency_hist.observe(elapsed)

@@ -37,12 +37,18 @@ def server_context(
 
 
 def client_context(
-    ca: str | None = None, cert: str | None = None, key: str | None = None
+    ca: str | None = None,
+    cert: str | None = None,
+    key: str | None = None,
+    check_hostname: bool = True,
 ) -> ssl.SSLContext:
+    """When a CA is given, hostname verification is ON by default so a
+    CA-issued cert for host A cannot impersonate host B; pass
+    check_hostname=False only for SAN-less test certificates."""
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
     if ca is not None:
         ctx.load_verify_locations(ca)
-        ctx.check_hostname = False  # test certs carry no SAN for 127.0.0.1
+        ctx.check_hostname = check_hostname
     else:
         ctx.check_hostname = False
         ctx.verify_mode = ssl.CERT_NONE

@@ -336,13 +336,25 @@ def test_leadership_transfer(tmp_path):
         cluster = RaftCluster(tmp_path, n_nodes=3)
         await cluster.start()
         await cluster.create_group()
-        leader = await cluster.wait_leader()
-        target = next(
-            nid for nid in cluster.nodes if nid != leader.node_id
-        )
-        await leader.replicate(data_batch(b"pre"), acks=-1)
-        await leader.transfer_leadership(target)
-        deadline = asyncio.get_event_loop().time() + 5.0
+        # under full-suite load the leader can step down between
+        # wait_leader() and the calls below; re-acquire and retry
+        # instead of trusting one leadership observation
+        deadline = asyncio.get_event_loop().time() + 20.0
+        target = None
+        while True:
+            leader = await cluster.wait_leader()
+            target = next(
+                nid for nid in cluster.nodes if nid != leader.node_id
+            )
+            try:
+                await leader.replicate(data_batch(b"pre"), acks=-1)
+                await leader.transfer_leadership(target)
+                break
+            except NotLeaderError:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise
+                await asyncio.sleep(0.05)
+        deadline = asyncio.get_event_loop().time() + 10.0
         while asyncio.get_event_loop().time() < deadline:
             c = cluster.consensus(target)
             if c.role == Role.LEADER:

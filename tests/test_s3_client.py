@@ -65,6 +65,20 @@ def test_sigv4_sign_verify_mismatch_cases():
         verify_request(lambda a: "SK", "PUT", "/b/other", signed, b"data")
         is None
     )
+    # replayed (stale) request: signature math checks out but the
+    # x-amz-date is outside the skew window — must not verify forever
+    stale = sign_request(
+        "AK", "SK", "r1", "PUT", "/b/k", {"host": "h:1"}, b"data",
+        date="20150830T123600Z",
+    )
+    assert verify_request(lambda a: "SK", "PUT", "/b/k", stale, b"data") is None
+    # ...and a narrow skew rejects an otherwise-fresh request
+    assert (
+        verify_request(
+            lambda a: "SK", "PUT", "/b/k", signed, b"data", clock_skew_s=-1
+        )
+        is None
+    )
 
 
 async def _roundtrip():
