@@ -42,7 +42,10 @@ SendFn = Callable[[int, int, bytes, float], Awaitable[bytes]]
 class _PeerPlan:
     """Precomputed build vectors for one target node."""
 
-    __slots__ = ("rows", "slots", "gids", "gids_arr", "cons", "pos_by_gid")
+    __slots__ = (
+        "rows", "slots", "gids", "gids_arr", "cons", "pos_by_gid",
+        "tb_cache", "frame_cache", "reply_cache",
+    )
 
     def __init__(self, pairs: list[tuple[Consensus, int]]):
         self.rows = np.array([c.row for c, _ in pairs], np.int64)
@@ -51,6 +54,27 @@ class _PeerPlan:
         self.gids_arr = np.array(self.gids, np.int64)
         self.cons = [c for c, _ in pairs]
         self.pos_by_gid = {g: i for i, g in enumerate(self.gids)}
+        # (tb_epoch, prevs, prev_terms, known): prev-term lookups are
+        # identical tick after tick in steady state — recompute only
+        # rows whose prev offset moved or when a term boundary changed
+        self.tb_cache: tuple | None = None
+        # (prevs, terms, commits, tb_epoch, frame_prefix): in a steady
+        # tick the ONLY field of the request that changes is the seq
+        # vector — the last field of the envelope — so the whole frame
+        # up to it is spliced from cache instead of re-encoded
+        self.frame_cache: tuple | None = None
+        # (reply_prefix, reply_suffix): raw bytes of the last all-
+        # SUCCESS reply around its seq echo; a byte-equal reply needs
+        # only the seq-guard fold, not a decode + full fold
+        self.reply_cache: tuple | None = None
+
+    def prev_terms_cached(self, arrays, prevs: np.ndarray):
+        from .shard_state import term_at_batch_cached
+
+        terms, known, self.tb_cache = term_at_batch_cached(
+            arrays, self.tb_cache, self.rows, prevs
+        )
+        return terms, known
 
 
 class HeartbeatManager:
@@ -138,29 +162,52 @@ class HeartbeatManager:
             prevs = arrays.match_index[p.rows, p.slots]
             terms = arrays.term[p.rows]
             commits = arrays.commit_index[p.rows]
-            prev_terms, known = arrays.term_at_batch(p.rows, prevs)
-            if not known.all():
-                # rare laggards below the mirrored boundary window:
-                # per-group log walk fallback
-                for i in np.flatnonzero(~known):
-                    t = p.cons[i].term_at(int(prevs[i]))
-                    prev_terms[i] = t if t is not None else -1
-            msg = rt.HeartbeatRequest(
-                node_id=self.node_id,
-                target_node_id=peer,
-                groups=p.gids,
-                terms=terms,
-                prev_log_indices=prevs,
-                prev_log_terms=prev_terms,
-                commit_indices=commits,
-                seqs=seqs,
-            ).encode()
+            fc = p.frame_cache
+            if (
+                fc is not None
+                and fc[3] == arrays.tb_epoch
+                and np.array_equal(prevs, fc[0])
+                and np.array_equal(terms, fc[1])
+                and np.array_equal(commits, fc[2])
+            ):
+                # steady tick: splice cached frame + fresh seq vector
+                msg = fc[4] + np.ascontiguousarray(seqs, "<q").tobytes()
+            else:
+                prev_terms, known = p.prev_terms_cached(arrays, prevs)
+                if not known.all():
+                    # rare laggards below the mirrored boundary window:
+                    # per-group log walk fallback. Mark the row known
+                    # afterwards — the walked answer is cached with the
+                    # same (prevs, tb_epoch) key, so re-walking every
+                    # steady tick would defeat the cache.
+                    for i in np.flatnonzero(~known):
+                        t = p.cons[i].term_at(int(prevs[i]))
+                        prev_terms[i] = t if t is not None else -1
+                        known[i] = True
+                msg = rt.HeartbeatRequest(
+                    node_id=self.node_id,
+                    target_node_id=peer,
+                    groups=p.gids_arr,
+                    terms=terms,
+                    prev_log_indices=prevs,
+                    prev_log_terms=prev_terms,
+                    commit_indices=commits,
+                    seqs=seqs,
+                ).encode()
+                # prefix ends right after the seq vector's u32 count
+                p.frame_cache = (
+                    prevs,
+                    terms,
+                    commits,
+                    arrays.tb_epoch,
+                    msg[: len(msg) - 8 * len(p.gids)],
+                )
             sent[peer] = (p, prevs, seqs, msg)
 
         async def one_node(peer: int, msg: bytes):
             try:
                 raw = await self._send(peer, rt.HEARTBEAT, msg, self._rpc_timeout)
-                return peer, rt.HeartbeatReply.decode(raw)
+                return peer, raw
             except Exception:
                 return peer, None
 
@@ -174,13 +221,46 @@ class HeartbeatManager:
         dirty_acc: list[np.ndarray] = []
         flushed_acc: list[np.ndarray] = []
         seqs_acc: list[np.ndarray] = []
-        for peer, reply in results:
-            if reply is None:
+        for peer, raw in results:
+            if raw is None:
                 continue
             entry = sent.get(peer)
             if entry is None:
                 continue
             p, prevs, seqs, _msg = entry
+            # steady-state reply: byte-identical to the last all-SUCCESS
+            # reply except the echoed seq vector — fold only the seq
+            # guard and skip decode + the full min/mask pass. The skip
+            # is sound only if the LEADER's own state also sat still:
+            # a local append/fsync between ticks (flush-clamp release)
+            # or a config change must take the full fold.
+            n = len(p.gids)
+            seq_lo = len(raw) - (4 + n) - 8 * n
+            rc = p.reply_cache
+            if (
+                rc is not None
+                and self._plan is plan
+                and len(raw) == rc[2]
+                and raw[:seq_lo] == rc[0]
+                and raw[seq_lo + 8 * n :] == rc[1]
+                and not arrays.quorum_dirty.any()
+                and np.array_equal(
+                    arrays.match_index[p.rows, SELF_SLOT],
+                    arrays._folded_self_m[p.rows],
+                )
+                and np.array_equal(
+                    arrays.flushed_index[p.rows, SELF_SLOT],
+                    arrays._folded_self_f[p.rows],
+                )
+            ):
+                r_seqs = np.frombuffer(
+                    raw[seq_lo : seq_lo + 8 * n], "<q"
+                ).astype(np.int64, copy=False)
+                np.maximum.at(
+                    arrays.last_seq, (p.rows, p.slots), r_seqs
+                )
+                continue
+            reply = rt.HeartbeatReply.decode(raw)
             r_groups = np.asarray(reply.groups, np.int64)
             statuses = np.asarray(reply.statuses, np.int64)
             # the fast path indexes through the plan's row/slot vectors,
@@ -213,6 +293,15 @@ class HeartbeatManager:
                 )
                 for i in bad:
                     self._handle_failure(p.cons[int(i)], peer, reply, int(i))
+                # only an all-SUCCESS reply may arm the byte-splice fast
+                # path: FAILURE rows have per-tick side effects (match
+                # rewind, catch-up spawns) that a skip would suppress
+                if len(bad) == 0 and bool(ok.all()):
+                    p.reply_cache = (
+                        raw[:seq_lo], raw[seq_lo + 8 * n :], len(raw)
+                    )
+                else:
+                    p.reply_cache = None
             else:
                 # misaligned reply (defensive): per-entry slow path
                 for i, gid in enumerate(reply.groups):
@@ -235,19 +324,18 @@ class HeartbeatManager:
                         np.array([min(int(reply.last_flushed[i]), d)], np.int64)
                     )
                     seqs_acc.append(np.array([int(reply.seqs[i])], np.int64))
-        if not rows_acc:
-            return  # no successful replies: the sweep cannot advance
-        advanced = arrays.device_tick(
-            np.concatenate(rows_acc),
-            np.concatenate(slots_acc),
-            np.concatenate(dirty_acc),
-            np.concatenate(flushed_acc),
-            np.concatenate(seqs_acc),
-        )
-        for r in advanced:
-            c = self._by_row.get(int(r))
-            if c is not None:
-                c.on_batched_commit_advance()
+        if rows_acc:
+            advanced = arrays.device_tick(
+                np.concatenate(rows_acc),
+                np.concatenate(slots_acc),
+                np.concatenate(dirty_acc),
+                np.concatenate(flushed_acc),
+                np.concatenate(seqs_acc),
+            )
+            for r in advanced:
+                c = self._by_row.get(int(r))
+                if c is not None:
+                    c.on_batched_commit_advance()
         # recovery: schedule catch-up for lagging followers, found with
         # one vector compare per peer (match/flushed vs leader dirty)
         for peer, p in plan.items():

@@ -16,6 +16,10 @@ from . import types as rt
 
 logger = logging.getLogger("raft.service")
 
+import numpy as _np
+
+_EMPTY = _np.empty(0, _np.int64)
+
 
 class RaftService(Service):
     service_name = "raft"
@@ -27,6 +31,13 @@ class RaftService(Service):
         # peer leads a different group set — one shared slot would
         # thrash), invalidated by the registry epoch
         self._hb_plans: dict[int, tuple] = {}
+        # per-sender prev-term answer cache (steady-state prev offsets
+        # repeat; see _PeerPlan.prev_terms_cached for the leader twin)
+        self._tb_cache: dict[int, tuple] = {}
+        # per-sender steady-state reply cache: when neither the request
+        # vectors nor this node's per-group state moved, the reply is
+        # byte-identical except the echoed seq vector — splice it
+        self._reply_cache: dict[int, tuple] = {}
 
     def _consensus(self, group_id: int):
         return self._gm.get(group_id)
@@ -35,14 +46,19 @@ class RaftService(Service):
         """Called on group removal so stale plans don't pin stopped
         Consensus objects (and their logs) in memory."""
         self._hb_plans.clear()
+        self._tb_cache.clear()
 
     def _resolve_batch(self, sender: int, groups) -> tuple[list, "object"]:
         import numpy as np
 
-        key = bytes(np.asarray(groups, np.int64).data)
+        gids = np.asarray(groups, np.int64)
         epoch = self._gm.registry_epoch
         plan = self._hb_plans.get(sender)
-        if plan is not None and plan[0] == epoch and plan[1] == key:
+        if (
+            plan is not None
+            and plan[0] == epoch
+            and np.array_equal(plan[1], gids)
+        ):
             return plan[2], plan[3]
         cons = [self._gm.get(int(g)) for g in groups]
         rows = np.fromiter(
@@ -50,8 +66,18 @@ class RaftService(Service):
             np.int64,
             len(cons),
         )
-        self._hb_plans[sender] = (epoch, key, cons, rows)
+        self._hb_plans[sender] = (epoch, gids.copy(), cons, rows)
+        self._tb_cache.pop(sender, None)
+        self._reply_cache.pop(sender, None)
         return cons, rows
+
+    def _prev_terms_cached(self, sender: int, arrays, rows, prevs):
+        from .shard_state import term_at_batch_cached
+
+        terms, known, self._tb_cache[sender] = term_at_batch_cached(
+            arrays, self._tb_cache.get(sender), rows, prevs
+        )
+        return terms, known
 
     @method(rt.VOTE)
     async def vote(self, payload: bytes) -> bytes:
@@ -106,13 +132,49 @@ class RaftService(Service):
         lcommits = np.asarray(req.commit_indices, np.int64)
 
         my_term = arrays.term[r]
+        sender = int(req.node_id)
+        # steady-state fast path: if the request vectors AND this
+        # node's per-group state are unchanged since the last batch
+        # from this sender, the reply is byte-identical except the
+        # echoed seq vector — splice it around cached bytes. State is
+        # compared by value (gathers are the cheap part; it's the ~15
+        # downstream vector ops + re-encode that dominate a tick).
+        rc = self._reply_cache.get(sender)
+        if rc is not None:
+            (
+                c_treq, c_prevs, c_pterms, c_lcommits, c_myterm,
+                c_dirty, c_flushed, c_commit, c_follower, c_lstart,
+                c_snap, c_lr, c_prefix, c_suffix,
+            ) = rc
+            if (
+                np.array_equal(t_req, c_treq)
+                and np.array_equal(prevs, c_prevs)
+                and np.array_equal(pterms, c_pterms)
+                and np.array_equal(lcommits, c_lcommits)
+                and np.array_equal(my_term, c_myterm)
+                and np.array_equal(arrays.match_index[r, SELF_SLOT], c_dirty)
+                and np.array_equal(
+                    arrays.flushed_index[r, SELF_SLOT], c_flushed
+                )
+                and np.array_equal(arrays.commit_index[r], c_commit)
+                and np.array_equal(arrays.is_follower[r], c_follower)
+                and np.array_equal(arrays.log_start[r], c_lstart)
+                and np.array_equal(arrays.snap_index[r], c_snap)
+            ):
+                if len(c_lr):
+                    now = asyncio.get_event_loop().time()
+                    arrays.last_hb[c_lr] = now
+                seq_bytes = np.ascontiguousarray(req.seqs, "<q").tobytes()
+                return c_prefix + seq_bytes + c_suffix
         dirty_out = np.where(avail, arrays.match_index[r, SELF_SLOT], -1)
         flushed_out = np.where(avail, arrays.flushed_index[r, SELF_SLOT], -1)
         terms_out = np.where(avail, my_term, -1)
         statuses = np.full(n, rt.AppendEntriesReply.GROUP_UNAVAILABLE, np.int64)
 
         follower = avail & arrays.is_follower[r]
-        tb_terms, known = arrays.term_at_batch(r, prevs)
+        tb_terms, known = self._prev_terms_cached(
+            int(req.node_id), arrays, r, prevs
+        )
         in_log = (prevs >= 0) & (
             (prevs >= arrays.log_start[r]) | (prevs == arrays.snap_index[r])
         )
@@ -153,8 +215,8 @@ class RaftService(Service):
             )
             for i in idxs:
                 cons[int(i)]._notify_commit()
-        seqs = [int(s) for s in req.seqs]
-        for i in np.flatnonzero(slow):
+        slow_rows = np.flatnonzero(slow)
+        for i in slow_rows:
             i = int(i)
             t, d, f, _s, st = cons[i].handle_heartbeat(
                 int(req.node_id),
@@ -162,21 +224,41 @@ class RaftService(Service):
                 int(prevs[i]),
                 int(pterms[i]),
                 int(lcommits[i]),
-                seqs[i],
+                int(req.seqs[i]),
             )
             terms_out[i] = t
             dirty_out[i] = d
             flushed_out[i] = f
             statuses[i] = st
-        return rt.HeartbeatReply(
+        out = rt.HeartbeatReply(
             node_id=gm.node_id,
-            groups=list(req.groups),
+            groups=req.groups,
             terms=terms_out,
             last_dirty=dirty_out,
             last_flushed=flushed_out,
-            seqs=seqs,
+            seqs=req.seqs,
             statuses=statuses,
         ).encode()
+        if len(slow_rows) == 0:
+            # cacheable: no scalar-path side effects this batch. The
+            # seq vector sits between the flushed and status fields —
+            # remember the bytes around it.
+            suffix_len = 4 + n  # u32 count + n × i8 statuses
+            self._reply_cache[sender] = (
+                t_req, prevs, pterms, lcommits, my_term,
+                np.asarray(arrays.match_index[r, SELF_SLOT]),
+                np.asarray(arrays.flushed_index[r, SELF_SLOT]),
+                arrays.commit_index[r].copy(),
+                arrays.is_follower[r].copy(),
+                arrays.log_start[r].copy(),
+                arrays.snap_index[r].copy(),
+                r[live] if live.any() else _EMPTY,
+                out[: len(out) - suffix_len - 8 * n],
+                out[len(out) - suffix_len :],
+            )
+        else:
+            self._reply_cache.pop(sender, None)
+        return out
 
     @method(rt.INSTALL_SNAPSHOT)
     async def install_snapshot(self, payload: bytes) -> bytes:

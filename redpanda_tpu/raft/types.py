@@ -82,25 +82,38 @@ class HeartbeatRequest(serde.Envelope):
     SERDE_FIELDS = [
         ("node_id", serde.i32),
         ("target_node_id", serde.i32),
-        ("groups", serde.vector(serde.i64)),
-        ("terms", serde.vector(serde.i64)),
-        ("prev_log_indices", serde.vector(serde.i64)),
-        ("prev_log_terms", serde.vector(serde.i64)),
-        ("commit_indices", serde.vector(serde.i64)),
-        ("seqs", serde.vector(serde.i64)),
+        ("groups", serde.ndvector(serde.i64)),
+        ("terms", serde.ndvector(serde.i64)),
+        ("prev_log_indices", serde.ndvector(serde.i64)),
+        ("prev_log_terms", serde.ndvector(serde.i64)),
+        ("commit_indices", serde.ndvector(serde.i64)),
+        ("seqs", serde.ndvector(serde.i64)),
     ]
 
 
 class HeartbeatReply(serde.Envelope):
     SERDE_FIELDS = [
         ("node_id", serde.i32),
-        ("groups", serde.vector(serde.i64)),
-        ("terms", serde.vector(serde.i64)),
-        ("last_dirty", serde.vector(serde.i64)),
-        ("last_flushed", serde.vector(serde.i64)),
-        ("seqs", serde.vector(serde.i64)),
-        ("statuses", serde.vector(serde.i8)),
+        ("groups", serde.ndvector(serde.i64)),
+        ("terms", serde.ndvector(serde.i64)),
+        ("last_dirty", serde.ndvector(serde.i64)),
+        ("last_flushed", serde.ndvector(serde.i64)),
+        ("seqs", serde.ndvector(serde.i64)),
+        ("statuses", serde.ndvector(serde.i8)),
     ]
+
+
+# The heartbeat steady-state fast paths splice frames at FIXED offsets
+# from the end (heartbeat_manager frame/reply caches, service reply
+# cache): sound only while `seqs` is the LAST request field and
+# `seqs`, `statuses` (i8) are the last two reply fields. Appending a
+# trailing field — normally legal envelope evolution — must relocate
+# those splices first; these asserts make that impossible to miss.
+assert [n for n, _ in HeartbeatRequest.SERDE_FIELDS][-1] == "seqs"
+assert [n for n, _ in HeartbeatReply.SERDE_FIELDS][-2:] == [
+    "seqs",
+    "statuses",
+]
 
 
 class InstallSnapshotRequest(serde.Envelope):

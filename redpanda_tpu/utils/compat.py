@@ -141,6 +141,12 @@ def render(value: Any) -> Any:
         }
     if isinstance(value, (list, tuple)):
         return [render(v) for v in value]
+    if hasattr(value, "__array__"):  # numpy: ndvector fields / scalars
+        import numpy as np
+
+        if np.ndim(value) == 0:
+            return render(value.item())
+        return [render(v.item()) for v in value]
     if isinstance(value, float):
         return round(value, 6)
     return value

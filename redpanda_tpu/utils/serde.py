@@ -159,6 +159,33 @@ _FIXED_FMT.update(
 )
 
 
+def ndvector(t: SerdeType) -> SerdeType:
+    """Wire-identical to vector(t) for fixed-width scalars, but decodes
+    to a (read-only) numpy array instead of a list — for hot batched
+    types whose consumers are array programs (node-batched heartbeats):
+    skipping the tolist()/asarray round-trip is worth ~20% of a 5k-group
+    tick. Encode accepts ndarray or any sequence."""
+    import numpy as np
+
+    letter = _FIXED_FMT[t]
+    np_dtype = np.dtype("<" + letter)
+
+    def enc(out: bytearray, v: Any) -> None:
+        out += struct.pack("<I", len(v))
+        if isinstance(v, np.ndarray):
+            out += np.ascontiguousarray(v, np_dtype).tobytes()
+        else:
+            out += struct.pack(f"<{len(v)}{letter}", *v)
+
+    def dec(p: IOBufParser) -> Any:
+        (n,) = struct.unpack("<I", p.read(4))
+        return np.frombuffer(p.read(n * np_dtype.itemsize), np_dtype)
+
+    # spec says "vector": generic tooling (compat corpus, schema dumps)
+    # treats it exactly like the list form — same wire format
+    return SerdeType(enc, dec, ("vector", t))
+
+
 def mapping(kt: SerdeType, vt: SerdeType) -> SerdeType:
     def enc(out: bytearray, v: dict) -> None:
         out += struct.pack("<I", len(v))
@@ -251,8 +278,19 @@ class Envelope:
     def __eq__(self, other: object) -> bool:
         if type(other) is not type(self):
             return NotImplemented
+
+        def field_eq(a: Any, b: Any) -> bool:
+            # ndvector fields decode to numpy arrays whose == is
+            # elementwise; compare by content against arrays or lists
+            if hasattr(a, "__array__") or hasattr(b, "__array__"):
+                import numpy as np
+
+                return bool(np.array_equal(np.asarray(a), np.asarray(b)))
+            return a == b
+
         return all(
-            getattr(self, n) == getattr(other, n) for n, _ in self.SERDE_FIELDS
+            field_eq(getattr(self, n), getattr(other, n))
+            for n, _ in self.SERDE_FIELDS
         )
 
     def __repr__(self) -> str:  # pragma: no cover

@@ -406,6 +406,47 @@ class TestHostDeviceTickParity:
                     getattr(a_host, name), getattr(a_dev, name)
                 ), f"trial {trial}: {name} diverged"
 
+    def test_incremental_sweep_flush_clamp_release(self):
+        """The incremental sweep must not starve the flush-clamp
+        release: followers fully ack, leader's local fsync lands only
+        BETWEEN ticks (no remote change) — the next tick must still
+        advance commit via the SELF-slot change detection."""
+        import numpy as np
+
+        from redpanda_tpu.models.consensus_state import SELF_SLOT
+        from redpanda_tpu.raft.shard_state import ShardGroupArrays
+
+        a = ShardGroupArrays(capacity=8, replica_slots=8)
+        row = 0
+        a.is_leader[row] = True
+        a.is_voter[row, :3] = True  # self + 2 peers
+        a.term_start[row] = 0
+        # self appended to 10, fsync lags at 5
+        a.match_index[row, SELF_SLOT] = 10
+        a.flushed_index[row, SELF_SLOT] = 5
+
+        rows = np.array([row, row], np.int64)
+        slots = np.array([1, 2], np.int64)
+        ten = np.array([10, 10], np.int64)
+        # tick 1: both followers ack dirty=flushed=10 → commit clamps
+        # to the leader's own flushed offset (5)
+        adv = a.host_tick(rows, slots, ten, ten, np.array([1, 1], np.int64))
+        assert list(adv) == [row]
+        assert a.commit_index[row] == 5
+        # local fsync completes between ticks; no remote values change
+        a.flushed_index[row, SELF_SLOT] = 10
+        # tick 2: replies identical except the seq guard — the sweep
+        # must detect the SELF-slot movement and release the clamp
+        adv = a.host_tick(rows, slots, ten, ten, np.array([2, 2], np.int64))
+        assert list(adv) == [row]
+        assert a.commit_index[row] == 10
+        # tick 3: true steady state — nothing changed, nothing advances
+        adv = a.host_tick(rows, slots, ten, ten, np.array([3, 3], np.int64))
+        assert len(adv) == 0
+        assert a.commit_index[row] == 10
+        # seq guard still folded on the skip path
+        assert a.last_seq[row, 1] == 3 and a.last_seq[row, 2] == 3
+
 
 class TestClusterElection:
     """Cross-device elections + divergence truncation over the ICI ring

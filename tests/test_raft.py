@@ -464,9 +464,13 @@ def test_shard_arrays_scalar_vs_device_differential():
                     arrays.match_index[g, r] = match
                     arrays.flushed_index[g, r] = flushed
                     arrays.is_voter[g, r] = voter
-        # a: scalar backend per group; b: one device sweep
+        # a: scalar backend per group; b: one device sweep. The sweep
+        # is incremental (recomputes only changed rows), so directly-
+        # seeded state must be flagged dirty to request the full
+        # recompute the scalar loop performs.
         for g in range(n_groups):
             a.scalar_commit_update(g)
+        b.quorum_dirty[:] = True
         empty = np.array([], np.int64)
         b.device_tick(empty, empty, empty, empty, empty)
         assert np.array_equal(a.commit_index, b.commit_index), (
