@@ -383,7 +383,20 @@ class Controller:
         self.consensus = await self._gm.create_group(
             int(CONTROLLER_GROUP), voters=self.seeds
         )
+        # controller snapshot (ref cluster/controller_snapshot.h:211):
+        # register BEFORE the STM starts — registration restores a
+        # local snapshot's tables, and the STM then replays only the
+        # raft0 suffix behind it (bounded boot replay)
+        from .controller_snapshot import ControllerSnapshotter
+
+        self._snapshotter = ControllerSnapshotter(self)
+        self._stm_start_applied: int | None = None
+        self.consensus.register_snapshot_contributor(
+            "controller", self._snapshotter
+        )
         self.stm = ControllerStm(self.consensus, self)
+        if self._stm_start_applied is not None:
+            self.stm.last_applied = self._stm_start_applied
         await self.stm.start()
         self._backend_task = asyncio.ensure_future(self._backend_loop())
 
@@ -936,6 +949,26 @@ class Controller:
             raise TopicError(reply.code, reply.message)
 
     # -- backend reconciliation --------------------------------------
+    # entries of raft0 history a boot may replay before we compact
+    # (controller_stm.h maybe_write_snapshot; every node snapshots its
+    # own raft0 locally — the trigger needs no coordination)
+    SNAPSHOT_MAX_REPLAY = 1024
+
+    def _maybe_snapshot(self) -> None:
+        """Write a controller snapshot + prefix-truncate raft0 once the
+        replayable history behind the applied offset exceeds the
+        threshold. Runs on EVERY node (each keeps its own raft0 copy
+        bounded), exactly like per-node data-partition snapshots."""
+        c, stm = self.consensus, self.stm
+        if c is None or stm is None or stm.last_applied < 0:
+            return
+        if stm.last_applied - c._snap_index < self.SNAPSHOT_MAX_REPLAY:
+            return
+        try:
+            c.write_snapshot(last_included=stm.last_applied)
+        except Exception:
+            logger.exception("node %d: controller snapshot failed", self.node_id)
+
     async def _backend_loop(self) -> None:
         """Turn topic_table deltas into local partition create/remove
         (reference: cluster/controller_backend.{h,cc}); periodically
@@ -948,6 +981,7 @@ class Controller:
                 except Exception:
                     pass
                 self._move_repair_pass()
+                self._maybe_snapshot()
                 if self.is_leader:
                     await self._feature_pass()
                     await self._migration_pass()

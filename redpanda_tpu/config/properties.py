@@ -158,6 +158,9 @@ class ClusterConfig:
             p.name: p for p in (properties or default_properties())
         }
         self._values: dict[str, Any] = {}
+        # raw (string) forms of the overrides in _values — what the
+        # controller snapshot serializes, since parse() is one-way
+        self._raws: dict[str, str] = {}
         self._bindings: dict[str, list[Callable[[Any], None]]] = {}
         self.version = 0
 
@@ -200,14 +203,21 @@ class ClusterConfig:
             except ConfigError:
                 continue
             self._values[name] = value
+            self._raws[name] = raw
             for fn in self._bindings.get(name, []):
                 fn(value)
         for name in removes:
+            self._raws.pop(name, None)
             if name in self._values:
                 del self._values[name]
                 for fn in self._bindings.get(name, []):
                     fn(self.get(name))
         self.version += 1
+
+    def raw_overrides(self) -> dict[str, str]:
+        """Raw (string) forms of every non-default value — what the
+        controller snapshot serializes (parse() is one-way)."""
+        return dict(self._raws)
 
     def snapshot(self) -> dict[str, Any]:
         return {name: self.get(name) for name in self._props}

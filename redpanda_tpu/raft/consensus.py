@@ -300,6 +300,10 @@ class Consensus:
             self.arrays.flushed_index[row, slot] = int(NO_OFFSET)
             self.arrays.last_seq[row, slot] = 0
             self.arrays.next_seq[row, slot] = 0
+        self.arrays.voter_epoch += 1
+        # a config change alters quorum shape: force the incremental
+        # sweep to recompute this row even if no offsets move
+        self.arrays.quorum_dirty[row] = True
         self._notify_topology()
 
     def _load_snapshot(self) -> None:
@@ -332,6 +336,11 @@ class Consensus:
         )
         if self.log.offsets().dirty_offset < self._snap_index:
             self.log.install_snapshot_reset(self._snap_index + 1, self._snap_term)
+        else:
+            # the logical start is not persisted by the log — the
+            # snapshot metadata IS its durable form; re-establish it so
+            # replay and reads begin past the summarized prefix
+            self.log.prefix_truncate(self._snap_index + 1)
         # stage the payload for contributors in EVERY restart, not just
         # the crash-mid-install case: derived state whose commands sit
         # below the log start (producer dedupe, tx ranges, archival
@@ -1102,6 +1111,10 @@ class Consensus:
         )
         self._snap_index, self._snap_term = target, term
         self._install_blobs = {}
+        # roll first so the entire summarized history becomes whole
+        # segments below the cut — physically reclaimable now, not at
+        # the next incidental roll
+        self.log.force_roll()
         self.log.prefix_truncate(target + 1)
         logger.info(
             "g%d: snapshot at %d term %d (log start now %d)",

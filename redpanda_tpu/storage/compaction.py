@@ -210,8 +210,7 @@ def compact_segment(seg, key_map: dict[bytes, int], participates) -> tuple[int, 
     if not wrote:
         os.remove(tmp)
         return 0, 0
-    seg._drop_read_fd()  # old inode is about to be replaced
-    seg._file.close()
+    seg._release_handles()  # old inode is about to be replaced
     os.replace(tmp, path)
     if os.path.exists(seg._index_path):
         os.remove(seg._index_path)
@@ -242,10 +241,8 @@ def merge_adjacent(log, max_bytes: int) -> int:
                     f.write(batch.serialize())
             f.flush()
             os.fsync(f.fileno())
-        a._drop_read_fd()
-        b._drop_read_fd()
-        a._file.close()
-        b._file.close()
+        a._release_handles()
+        b._release_handles()
         os.replace(tmp, a._path)
         for p in (b._path, a._index_path, b._index_path):
             if os.path.exists(p):

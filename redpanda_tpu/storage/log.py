@@ -159,6 +159,18 @@ class Log:
         # here (Partition.housekeeping); LogManager's housekeeping timer
         # calls it instead of bare apply_retention when present
         self.housekeeping_override = None  # fn(now_ms) | None
+        # logical start offset (disk_log_impl's _start_offset): prefix
+        # truncation is batch-granular even when the cut lands inside a
+        # segment; whole segments below it are reclaimed physically.
+        # Durable via a sidecar marker (the reference stores it in the
+        # kvstore's storage keyspace, kvstore.h:93).
+        self._start_override: int = 0
+        self._start_path = os.path.join(directory, "start_offset")
+        try:
+            with open(self._start_path) as f:
+                self._start_override = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            pass
         self._recover()
 
     @property
@@ -196,7 +208,7 @@ class Log:
     def offsets(self) -> LogOffsets:
         if not self._segments:
             return LogOffsets(0, -1, -1)
-        start = self._segments[0].base_offset
+        start = max(self._segments[0].base_offset, self._start_override)
         dirty = self._segments[-1].dirty_offset
         # rolled segments are flushed at roll time, so the tail's stable
         # offset is the log's flushed offset
@@ -357,11 +369,15 @@ class Log:
         return None
 
     def timequery(self, ts: int) -> int | None:
+        log_start = self.offsets().start_offset
         for seg in self._segments:
             if seg.max_timestamp >= ts:
                 hint = seg.timequery(ts)
                 start = hint if hint is not None else seg.base_offset
                 for b in seg.read_batches(start):
+                    # batches below the logical start are truncated away
+                    if b.header.base_offset < log_start:
+                        continue
                     if b.header.max_timestamp >= ts:
                         return b.header.base_offset
         return None
@@ -398,23 +414,61 @@ class Log:
         for fn in self.on_truncate:
             fn(offset)
 
+    def _batch_align(self, offset: int) -> int:
+        """Base offset of the batch containing `offset` (round DOWN —
+        whole batches are the truncation unit; a mid-batch start would
+        leak partial batches into reads), or dirty+1 past the end."""
+        dirty = self.offsets().dirty_offset
+        if offset > dirty:
+            return dirty + 1
+        for seg in reversed(self._segments):
+            if offset >= seg.base_offset:
+                batches = seg.read_batches(offset, max_bytes=1)
+                if batches:
+                    return batches[0].header.base_offset
+                return seg.base_offset
+        return offset
+
     def prefix_truncate(self, offset: int) -> None:
-        """Drop whole segments entirely below offset (retention,
-        raft snapshots; disk_log_impl prefix truncation)."""
-        dropped = False
+        """Advance the logical start to the batch boundary at-or-below
+        `offset` and physically drop whole segments entirely below it
+        (retention, raft snapshots; disk_log_impl truncate_prefix)."""
+        old_start = self.offsets().start_offset
+        offset = self._batch_align(offset)
         while (
             len(self._segments) > 1 and self._segments[1].base_offset <= offset
         ):
             seg = self._segments.pop(0)
             seg.close()
             seg.remove_files()
-            dropped = True
-        if dropped:
-            new_start = self.offsets().start_offset
+        if offset > self._start_override:
+            self._start_override = offset
+            self._persist_start()
+        new_start = self.offsets().start_offset
+        if new_start > old_start:
             if self._cache_index is not None:
                 self._cache_index.prefix_truncate(new_start)
             for fn in self.on_prefix_truncate:
                 fn(new_start)
+
+    def force_roll(self, term: int | None = None) -> None:
+        """Seal the active segment and open a fresh one at dirty+1 —
+        lets a snapshot's prefix_truncate physically reclaim the whole
+        history below it (the reference rolls on snapshot/term events)."""
+        if not self._segments:
+            return
+        tail = self._segments[-1]
+        if tail.dirty_offset < tail.base_offset:
+            return  # already an empty head segment
+        tail.flush()
+        tail.persist_index()
+        self._segments.append(
+            Segment(
+                self._dir,
+                tail.dirty_offset + 1,
+                tail.term if term is None else term,
+            )
+        )
 
     def _reset_to(self, base: int, term: int) -> None:
         """Restart the log as ONE empty segment positioned at `base`
@@ -423,6 +477,16 @@ class Log:
             seg.close()
             seg.remove_files()
         self._segments = [Segment(self._dir, base, term)]
+        self._start_override = base
+        self._persist_start()
+
+    def _persist_start(self) -> None:
+        tmp = self._start_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(self._start_override))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._start_path)
 
     def install_snapshot_reset(self, next_offset: int, term: int) -> None:
         """Drop the ENTIRE log and restart it empty at next_offset —

@@ -33,8 +33,63 @@ _IDX_HDR = struct.Struct("<II")
 _IDX_ENTRY = struct.Struct("<IQq")
 
 
+class _FdBudget:
+    """Process-wide LRU cap on open segment file handles.
+
+    RLIMIT_NOFILE is shared by every log on the shard; a 50k-group
+    node holding one write handle (plus a cached pread fd) per active
+    segment exhausts any sane limit. Handles are opened lazily, touched
+    on use, and the least-recently-used segment's handles are closed
+    when the budget is exceeded — closing flushes buffered bytes to the
+    OS, so durability semantics (stable_offset advances only on fsync)
+    are unchanged. Reference: the fd-bounded readers_cache + segment
+    appender pool (src/v/storage/readers_cache.h:31,
+    segment_appender.cc fallocation/handle management)."""
+
+    def __init__(self) -> None:
+        try:
+            import resource
+
+            soft, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        except Exception:  # pragma: no cover - non-posix fallback
+            soft = 1024
+        # leave half the limit for sockets, kvstores, snapshots, etc.
+        self.limit = max(256, soft // 2)
+        from collections import OrderedDict
+
+        self._lru: "OrderedDict[int, Segment]" = OrderedDict()
+
+    def touch(self, seg: "Segment") -> None:
+        key = id(seg)
+        lru = self._lru
+        if key in lru:
+            lru.move_to_end(key)
+        else:
+            lru[key] = seg
+        spared: list[tuple[int, "Segment"]] = []
+        while len(lru) + len(spared) > self.limit and lru:
+            vkey, victim = lru.popitem(last=False)
+            if victim is seg or victim._pins:
+                spared.append((vkey, victim))  # in use: re-queue
+                continue
+            victim._release_handles()
+        for vkey, victim in spared:
+            lru[vkey] = victim
+            lru.move_to_end(vkey, last=False)
+
+    def drop(self, seg: "Segment") -> None:
+        self._lru.pop(id(seg), None)
+
+
+FD_BUDGET = _FdBudget()
+
+
 class Segment:
-    """One segment: data file + sparse index, append at tail only."""
+    """One segment: data file + sparse index, append at tail only.
+
+    File handles (append handle + cached pread fd) are opened lazily
+    and subject to the global FD_BUDGET LRU — any method may find them
+    closed and transparently reopen."""
 
     def __init__(self, directory: str, base_offset: int, term: int):
         self.base_offset = base_offset
@@ -51,10 +106,38 @@ class Segment:
         self.stable_offset = base_offset - 1  # last fsynced
         self.max_timestamp = -1
         self._rfd: int | None = None  # cached pread descriptor
+        self._file = None  # lazy append handle (FD_BUDGET)
+        self._pins = 0  # >0 while an executor fsync uses the fileno
+        self._size = 0
         if os.path.exists(self._path):
             self._recover()
-        self._file = file_sanitizer.wrap(open(self._path, "ab"), self._path)
-        self._size = self._file.tell()
+            self._size = os.path.getsize(self._path)
+        else:
+            # the file's existence is what marks this segment (and its
+            # base offset) on reopen scans — create it eagerly even
+            # though the append handle itself is lazy
+            open(self._path, "ab").close()
+
+    # -- fd budget ----------------------------------------------------
+    def _wfile(self):
+        if self._file is None:
+            self._file = file_sanitizer.wrap(
+                open(self._path, "ab"), self._path
+            )
+        FD_BUDGET.touch(self)
+        return self._file
+
+    def _release_handles(self) -> None:
+        """FD_BUDGET eviction: push buffered bytes to the OS and close.
+        stable_offset is untouched — only flush()'s fsync advances it."""
+        if self._file is not None:
+            try:
+                self._file.flush()
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        self._drop_read_fd()
 
     # -- recovery (log_replayer analog: re-checksum the tail) --------
     def _recover(self) -> None:
@@ -93,7 +176,7 @@ class Segment:
             )
         data = batch.serialize()
         self._maybe_index(batch, self._size)
-        self._file.write(data)
+        self._wfile().write(data)
         self._size += len(data)
         self.dirty_offset = batch.header.last_offset
         self.max_timestamp = max(self.max_timestamp, batch.header.max_timestamp)
@@ -109,8 +192,11 @@ class Segment:
     def flush(self) -> int:
         """fsync; advances the stable (flushed) offset — the acks=all
         boundary."""
-        self._file.flush()
-        os.fsync(self._file.fileno())
+        if self.stable_offset >= self.dirty_offset and self._file is None:
+            return self.stable_offset  # nothing unsynced: skip a reopen
+        f = self._wfile()
+        f.flush()
+        os.fsync(f.fileno())
         self.stable_offset = self.dirty_offset
         return self.stable_offset
 
@@ -120,10 +206,17 @@ class Segment:
         background flush). Only bytes pushed to the OS before the fsync
         are counted: the stable offset advances to the dirty offset
         captured at call time, never past it."""
-        self._file.flush()  # python buffer → OS (loop thread, cheap)
+        if self.stable_offset >= self.dirty_offset and self._file is None:
+            return self.stable_offset  # nothing unsynced: skip a reopen
+        f = self._wfile()
+        f.flush()  # python buffer → OS (loop thread, cheap)
         target = self.dirty_offset
         loop = asyncio.get_event_loop()
-        await loop.run_in_executor(None, os.fsync, self._file.fileno())
+        self._pins += 1  # hold the fileno against FD_BUDGET eviction
+        try:
+            await loop.run_in_executor(None, os.fsync, f.fileno())
+        finally:
+            self._pins -= 1
         self.stable_offset = max(self.stable_offset, target)
         return self.stable_offset
 
@@ -140,6 +233,7 @@ class Segment:
         open/close-per-call syscall pair."""
         if self._rfd is None:
             self._rfd = os.open(self._path, os.O_RDONLY)
+        FD_BUDGET.touch(self)
         return self._rfd
 
     def _drop_read_fd(self) -> None:
@@ -154,7 +248,8 @@ class Segment:
         self, start_offset: int, max_bytes: int = 1 << 30
     ) -> list[RecordBatch]:
         """Batches whose range intersects [start_offset, dirty]."""
-        self._file.flush()
+        if self._file is not None:
+            self._file.flush()
         out: list[RecordBatch] = []
         consumed = 0
         fd = self._read_fd()
@@ -186,7 +281,8 @@ class Segment:
     def truncate(self, offset: int) -> None:
         """Drop everything at-or-after `offset` (suffix truncation used
         by raft on log-matching conflicts)."""
-        self._file.flush()
+        if self._file is not None:
+            self._file.flush()
         keep_end = 0
         new_dirty = self.base_offset - 1
         with open(self._path, "rb") as f:
@@ -199,12 +295,14 @@ class Segment:
             pos += header.size_bytes
             keep_end = pos
             new_dirty = header.last_offset
-        self._file.close()
+        if self._file is not None:
+            self._file.close()
+            self._file = None  # lazily reopened via _wfile()
+        self._drop_read_fd()  # pread fd may cache pages past the cut
         with open(self._path, "r+b") as f:
             f.truncate(keep_end)
             f.flush()
             os.fsync(f.fileno())
-        self._file = file_sanitizer.wrap(open(self._path, "ab"), self._path)
         self._size = keep_end
         self.dirty_offset = new_dirty
         self.stable_offset = min(self.stable_offset, new_dirty)
@@ -229,10 +327,20 @@ class Segment:
         self.flush()
         self.persist_index()
         self._drop_read_fd()
-        self._file.close()
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        FD_BUDGET.drop(self)
 
     def remove_files(self) -> None:
         self._drop_read_fd()
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        FD_BUDGET.drop(self)
         for p in (self._path, self._index_path):
             if os.path.exists(p):
                 os.remove(p)

@@ -59,11 +59,12 @@ def test_segment_lifecycle_under_sanitizer(tmp_path, monkeypatch):
     from redpanda_tpu.storage.segment import Segment
 
     seg = Segment(str(tmp_path), 0, 1)
-    assert isinstance(seg._file, fs.SanitizedFile)
     for i in range(5):
         b = RecordBatchBuilder(base_offset=i, timestamp_ms=0)
         b.add(b"v%d" % i, key=b"k")
         seg.append(b.build())
+    # the append handle is lazy (FD_BUDGET); it exists after a write
+    assert isinstance(seg._file, fs.SanitizedFile)
     seg.flush()
     got = seg.read_batches(0)
     assert len(got) == 5

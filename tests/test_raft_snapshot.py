@@ -43,12 +43,16 @@ def test_snapshot_write_prefix_truncates_and_survives_restart(tmp_path):
             _, last = await leader.replicate(data_batch(b"x" * 100, 2), acks=-1)
         assert leader.log.segment_count() > 3
 
-        snap = leader.write_snapshot(leader.commit_index)
-        assert snap == leader.commit_index
+        # keep a suffix out of the snapshot so we can prove it stays
+        # readable (prefix truncation is batch-granular now: a snapshot
+        # at commit_index reclaims the ENTIRE history below it)
+        snap_at = leader.commit_index - 4
+        snap = leader.write_snapshot(snap_at)
+        assert snap == snap_at
         offs = leader.log.offsets()
-        assert offs.start_offset > 0
+        assert offs.start_offset == snap + 1
         assert os.path.exists(os.path.join(leader.log.directory, "snapshot"))
-        # data above the physical start remains readable
+        # data above the snapshot remains readable
         assert leader.log.read(offs.start_offset)
 
         # appends continue after the snapshot
