@@ -70,7 +70,7 @@ class BrokerConnection:
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port, ssl=self._ssl
+            self.host, self.port, ssl=self._ssl, limit=1 << 21
         )
         self._read_task = asyncio.ensure_future(self._read_loop())
         resp = await self.request(API_VERSIONS, Msg(), version=2)
@@ -170,8 +170,17 @@ class BrokerConnection:
         return v
 
     async def request(self, api, req, version: int) -> Msg:
+        return await self.request_raw(
+            api, api.encode_request(req, version), version
+        )
+
+    async def request_raw(self, api, body: bytes, version: int) -> Msg:
+        """Send a PRE-ENCODED request body. Benchmarks measuring broker
+        throughput encode the (identical) body once so client-side
+        encoding doesn't pollute the server number; normal callers use
+        request()."""
         hdr = RequestHeader(api.key, version, next(self._corr), self._client_id)
-        frame = encode_request_header(hdr) + api.encode_request(req, version)
+        head = encode_request_header(hdr)
         if self._dead is not None:
             raise KafkaClientError(
                 int(ErrorCode.network_exception), f"connection dead: {self._dead}"
@@ -179,7 +188,11 @@ class BrokerConnection:
         fut = asyncio.get_event_loop().create_future()
         async with self._lock:  # order registration with the write
             self._pending.append((hdr.correlation_id, fut))
-            self._writer.write(_SIZE.pack(len(frame)) + frame)
+            # writelines joins once in the transport — no intermediate
+            # size+head+body concat of MB-scale produce frames here
+            self._writer.writelines(
+                (_SIZE.pack(len(head) + len(body)), head, body)
+            )
             await self._writer.drain()
         # belt-and-braces: if the read loop died while we drained, our
         # future was in _pending and is already failed; this catches

@@ -234,7 +234,13 @@ class KafkaServer:
                     own = x509.load_pem_x509_certificate(f.read())
                 self._own_cert_der = own.public_bytes(Encoding.DER)
         self._server = await asyncio.start_server(
-            self._on_conn, cfg.kafka_host, cfg.kafka_port, ssl=ssl_ctx
+            self._on_conn,
+            cfg.kafka_host,
+            cfg.kafka_port,
+            ssl=ssl_ctx,
+            # default 64 KiB stream high-water drowns MB-sized produce
+            # frames in pause/resume churn (~15% of a produce round)
+            limit=1 << 21,
         )
         self.port = self._server.sockets[0].getsockname()[1]
 
@@ -436,8 +442,12 @@ class KafkaServer:
             handler = self._handlers.get(hdr.api_key)
             if handler is None:
                 raise _CloseConnection(b"")
-            token = CURRENT_PRINCIPAL.set(ctx.principal)
-            itoken = CURRENT_INTERNAL.set(ctx.internal)
+            # anonymous non-internal connections match the contextvar
+            # defaults — skip two set/reset pairs on the hot path
+            has_identity = ctx.principal is not None or ctx.internal
+            if has_identity:
+                token = CURRENT_PRINCIPAL.set(ctx.principal)
+                itoken = CURRENT_INTERNAL.set(ctx.internal)
             t0 = asyncio.get_event_loop().time()
             try:
                 resp = await handler(hdr, req)
@@ -447,8 +457,9 @@ class KafkaServer:
                 )
                 raise
             finally:
-                CURRENT_PRINCIPAL.reset(token)
-                CURRENT_INTERNAL.reset(itoken)
+                if has_identity:
+                    CURRENT_PRINCIPAL.reset(token)
+                    CURRENT_INTERNAL.reset(itoken)
                 self._req_counter.inc(api=api.name)
                 elapsed = asyncio.get_event_loop().time() - t0
                 self._latency_hist.observe(elapsed)
@@ -791,9 +802,19 @@ class KafkaServer:
             # response base_offset is the FIRST batch's offset either way
             entries: list[tuple] = []
             try:
-                parser = IOBufParser(bytes(p.records))
+                # memoryview straight from the request frame: the
+                # parser walks it in place and from_kafka_wire copies
+                # only the body out — one fewer full-payload memcpy
+                parser = IOBufParser(p.records)
+                prev_enqueued = None
                 while parser.bytes_left() > 0:
                     batch = RecordBatch.from_kafka_wire(parser, verify=True)
+                    # order guard: the PREVIOUS batch must be cached in
+                    # FIFO order before this one dispatches. Awaiting
+                    # lazily (instead of after every replicate) makes
+                    # the common single-batch partition shield-free.
+                    if prev_enqueued is not None:
+                        await asyncio.shield(prev_enqueued)
                     try:
                         ps = await partition.replicate_in_stages(
                             batch, acks=acks
@@ -802,9 +823,7 @@ class KafkaServer:
                         entries.append(("dup", dup.base_offset))
                         continue
                     entries.append(("ps", ps))
-                    # order guard: batch cached in FIFO order before
-                    # the next one dispatches
-                    await asyncio.shield(ps.enqueued)
+                    prev_enqueued = ps.enqueued
             except Exception as e:
                 for kind, v in entries:
                     if kind == "ps":

@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 
 from ..models.record import RecordBatch
+from . import file_sanitizer
 from .batch_cache import BatchCache, BatchCacheIndex
 from .segment import Segment
 
@@ -254,7 +255,21 @@ class Log:
             term = batch.header.term if batch.header.term >= 0 else 0
         batch.header.base_offset = base
         batch.header.term = term
-        batch.finalize_crcs()
+        # the body crc (Kafka formula) covers attrs..records only —
+        # rewriting base_offset/term invalidates just the header crc.
+        # Callers hand over finalized batches (builder.build() and the
+        # produce adapter both verify/set the body crc), so skipping
+        # the full-body recompute here removes one of the two 100+ MB/s
+        # CRC passes from the hot append path. Under the file sanitizer
+        # (debug builds) the contract is enforced AT the faulty call
+        # site instead of surfacing as a distant recovery CRC mismatch.
+        if file_sanitizer.enabled() and batch.header.crc != batch.compute_crc():
+            raise AssertionError(
+                "log.append requires a finalized batch (stale body crc); "
+                "call finalize_crcs() after building the body"
+            )
+        batch.header.size_bytes = batch.size_bytes()
+        batch.header.header_crc = batch.header.compute_header_crc()
 
         seg = self._active_segment(term)
         seg.append(batch)

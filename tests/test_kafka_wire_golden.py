@@ -593,14 +593,21 @@ def test_decode_field_exact(name, api, version, direction, fields, golden):
 def test_corpus_frozen():
     """The golden bytes are also frozen on disk: a change to either the
     spec-builder above or the corpus files must be deliberate (set
-    RP_WIRE_CORPUS_WRITE=1 to regenerate)."""
-    os.makedirs(CORPUS, exist_ok=True)
+    RP_WIRE_CORPUS_WRITE=1 to regenerate). A MISSING file fails — if it
+    silently regenerated, a builder edit plus a lost file would defeat
+    the two-party drift guard."""
     regen = os.environ.get("RP_WIRE_CORPUS_WRITE")
+    if regen:
+        os.makedirs(CORPUS, exist_ok=True)
     for name, _api, _v, _d, _f, golden in VECTORS:
         path = os.path.join(CORPUS, f"{name}.bin")
-        if regen or not os.path.exists(path):
+        if regen:
             with open(path, "wb") as f:
                 f.write(golden)
+        assert os.path.exists(path), (
+            f"corpus file missing: {name}.bin (RP_WIRE_CORPUS_WRITE=1 "
+            "to create deliberately)"
+        )
         with open(path, "rb") as f:
             assert f.read() == golden, f"corpus drift: {name}"
 
