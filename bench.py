@@ -519,6 +519,295 @@ def bench_broker() -> dict:
     return asyncio.run(_broker_async())
 
 
+# --------------------------------------------- 3-broker acks=all (config #3)
+async def _cluster(tmp: str, n: int, **cfg_extra):
+    """N full brokers in one process: loopback internal RPC, real
+    kafka TCP listeners (the §4.2 in-process fixture, bench-sized)."""
+    from redpanda_tpu.app import Broker, BrokerConfig
+    from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+    net = LoopbackNetwork()
+    members = list(range(n))
+    brokers = [
+        Broker(
+            BrokerConfig(
+                node_id=i,
+                data_dir=os.path.join(tmp, f"n{i}"),
+                members=members,
+                enable_admin=False,
+                housekeeping_interval_s=0,
+                **cfg_extra,
+            ),
+            loopback=net,
+        )
+        for i in members
+    ]
+    for b in brokers:
+        await b.start()
+    addrs = {b.node_id: b.kafka_advertised for b in brokers}
+    for b in brokers:
+        b.config.peer_kafka_addresses = addrs
+    await brokers[0].wait_controller_leader()
+    return brokers
+
+
+async def _replicated_async() -> dict:
+    """BASELINE.md benchmark config #3: 3 brokers, acks=all replicated
+    produce over >=1k partitions — the raft append_entries hot path
+    under load (consensus.cc:1727). Whole-system single-core: all three
+    brokers AND the load generators share one core, and every byte is
+    appended+fsynced on three replicas."""
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.models.record import RecordBatchBuilder
+
+    n_partitions = int(os.environ.get("BENCH_REPL_PARTITIONS", "1024"))
+    n_producers = 4
+    batch_records = 64
+    record_bytes = 1024
+    duration_s = 4.0
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = tempfile.mkdtemp(prefix="rp_bench_", dir=shm)
+    brokers = []
+    client = None
+    try:
+        brokers = await _cluster(tmp, 3)
+        client = KafkaClient([b.kafka_advertised for b in brokers])
+        await client.create_topic(
+            "repl", partitions=n_partitions, replication_factor=3
+        )
+        payload = os.urandom(record_bytes - 16)
+        builder = RecordBatchBuilder()
+        for i in range(batch_records):
+            builder.add(payload, key=b"k%012d" % i)
+        wire = builder.build().to_kafka_wire()
+        # wait until every partition has an elected leader
+        deadline = time.monotonic() + 120.0
+        pid_probe = 0
+        while pid_probe < n_partitions:
+            try:
+                await client.produce_wire("repl", pid_probe, wire, acks=-1)
+                pid_probe += max(1, n_partitions // 16)  # sparse probe
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.25)
+        lat_ms: list[float] = []
+        sent = 0
+        t_end = time.perf_counter() + duration_s
+
+        async def producer(idx: int) -> None:
+            nonlocal sent
+            c = KafkaClient([b.kafka_advertised for b in brokers])
+            pid = idx * (n_partitions // n_producers)
+            try:
+                while time.perf_counter() < t_end:
+                    t0 = time.perf_counter()
+                    await c.produce_wire("repl", pid, wire, acks=-1)
+                    lat_ms.append((time.perf_counter() - t0) * 1e3)
+                    sent += batch_records * record_bytes
+                    pid = (pid + 1) % n_partitions
+            finally:
+                await c.close()
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(producer(i) for i in range(n_producers)))
+        mbps = sent / (time.perf_counter() - t0) / 1e6
+        return {
+            "metric": "replicated_produce_mbps_3brokers_1k_partitions",
+            "value": round(mbps, 1),
+            "unit": "MB/s",
+            # reference floor: 600 MB/s on 3x 24-core brokers (acks=all)
+            "vs_baseline": round(mbps / 600.0, 3),
+            "partitions": n_partitions,
+            "replication_factor": 3,
+            "acks": -1,
+            "produce_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+            "produce_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+            "cores": 1,
+        }
+    finally:
+        if client is not None:
+            try:
+                await client.close()
+            except Exception:
+                pass
+        for b in brokers:
+            try:
+                await b.stop()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_replicated() -> dict:
+    return asyncio.run(_replicated_async())
+
+
+# ------------------------------------------------- OMB-shaped mix (config #5)
+async def _omb_async() -> dict:
+    """BASELINE.md benchmark config #5: OMB release-smoke shape scaled
+    to this host — 1 topic x 100 partitions, 1 KB records compressed
+    with zstd, RF=3 acks=all, concurrent producers AND consumers, plus
+    a sampling consumer measuring publish->consume e2e latency from
+    timestamps embedded in the records."""
+    from redpanda_tpu.compression import CompressionType
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.models.record import RecordBatchBuilder
+
+    n_partitions = 100
+    n_producers = 3
+    n_consumers = 2
+    batch_records = 64
+    record_bytes = 1024
+    duration_s = 4.0
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = tempfile.mkdtemp(prefix="rp_bench_", dir=shm)
+    brokers = []
+    clients: list = []
+    try:
+        brokers = await _cluster(tmp, 3)
+        boot = KafkaClient([b.kafka_advertised for b in brokers])
+        clients.append(boot)
+        await boot.create_topic(
+            "omb", partitions=n_partitions, replication_factor=3
+        )
+        # per-record random payloads: a batch of 64 COPIES of one
+        # block zstd-compresses ~50:1 and the bench stops measuring
+        # IO; unique random payloads are incompressible (OMB's default
+        # payload shape), so wire bytes ~= logical bytes on both sides
+        payloads = [
+            os.urandom(record_bytes - 24) for _ in range(batch_records)
+        ]
+        payload = payloads[0]
+        # leaders settle (sparse probe, as in config #3)
+        deadline = time.monotonic() + 120.0
+        probe = RecordBatchBuilder()
+        # ts=0.0 prefix so the e2e sampler deterministically skips it
+        probe.add(b"\x00" * 8 + payload, key=b"probe")
+        probe_wire = probe.build().to_kafka_wire()
+        pid_probe = 0
+        while pid_probe < n_partitions:
+            try:
+                await boot.produce_wire("omb", pid_probe, probe_wire, acks=-1)
+                pid_probe += 10
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.25)
+
+        sent = 0
+        e2e_ms: list[float] = []
+        t_end = time.perf_counter() + duration_s
+
+        def build_batch() -> bytes:
+            # zstd per OMB config #5; the send timestamp rides in each
+            # record value so consumers measure publish->consume e2e
+            b = RecordBatchBuilder(compression=CompressionType.zstd)
+            ts = struct_pack_ts()
+            for i in range(batch_records):
+                b.add(ts + payloads[i], key=b"k%06d" % i)
+            return b.build().to_kafka_wire()
+
+        import struct as _struct
+
+        def struct_pack_ts() -> bytes:
+            return _struct.pack("<d", time.time())
+
+        async def producer(idx: int) -> None:
+            nonlocal sent
+            c = KafkaClient([b.kafka_advertised for b in brokers])
+            clients.append(c)
+            pid = idx * (n_partitions // n_producers)
+            while time.perf_counter() < t_end:
+                await c.produce_wire("omb", pid, build_batch(), acks=-1)
+                sent += batch_records * record_bytes
+                pid = (pid + 1) % n_partitions
+
+        read = 0
+
+        async def consumer(idx: int) -> None:
+            nonlocal read
+            c = KafkaClient([b.kafka_advertised for b in brokers])
+            clients.append(c)
+            positions = {
+                p: 0
+                for p in range(idx, n_partitions, n_consumers)
+            }
+            while time.perf_counter() < t_end:
+                moved = False
+                for pid in positions:
+                    chunk, nxt = await c.fetch_raw(
+                        "omb", pid, positions[pid], max_bytes=1 << 20,
+                        max_wait_ms=10,
+                    )
+                    if nxt != positions[pid]:
+                        positions[pid] = nxt
+                        read += len(chunk)
+                        moved = True
+                    if time.perf_counter() >= t_end:
+                        break
+                if not moved:
+                    await asyncio.sleep(0.01)
+
+        async def sampler() -> None:
+            # decoded consumption of partition 0: publish->consume e2e
+            c = KafkaClient([b.kafka_advertised for b in brokers])
+            clients.append(c)
+            pos = 0
+            while time.perf_counter() < t_end:
+                recs = await c.fetch("omb", 0, pos, max_wait_ms=50)
+                now = time.time()
+                for off, _k, v in recs:
+                    if v is not None and len(v) >= 8:
+                        (ts,) = _struct.unpack("<d", v[:8])
+                        # plausibility window: probe rows carry ts=0
+                        if 0 <= now - ts < 60:
+                            e2e_ms.append((now - ts) * 1e3)
+                    pos = off + 1
+                if not recs:
+                    await asyncio.sleep(0.01)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(
+            *(producer(i) for i in range(n_producers)),
+            *(consumer(i) for i in range(n_consumers)),
+            sampler(),
+        )
+        el = time.perf_counter() - t0
+        out = {
+            "metric": "omb_mixed_produce_mbps_100_partitions",
+            "value": round(sent / el / 1e6, 1),
+            "unit": "MB/s",
+            # reference smoke floor: >=600 MB/s on 3x24-core + clients
+            "vs_baseline": round(sent / el / 1e6 / 600.0, 3),
+            "consume_mbps": round(read / el / 1e6, 1),
+            "compression": "zstd",
+            "partitions": n_partitions,
+            "replication_factor": 3,
+            "cores": 1,
+        }
+        if e2e_ms:
+            out["e2e_p50_ms"] = round(float(np.percentile(e2e_ms, 50)), 2)
+            out["e2e_p95_ms"] = round(float(np.percentile(e2e_ms, 95)), 2)
+        return out
+    finally:
+        for c in clients:
+            try:
+                await c.close()
+            except Exception:
+                pass
+        for b in brokers:
+            try:
+                await b.stop()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_omb() -> dict:
+    return asyncio.run(_omb_async())
+
+
 BENCHES = {
     "quorum": bench_quorum,
     "live_tick": bench_live_tick,
@@ -526,6 +815,8 @@ BENCHES = {
     "device_lz4": bench_device_lz4,
     "codec": bench_codec,
     "broker": bench_broker,
+    "replicated": bench_replicated,
+    "omb": bench_omb,
 }
 
 
@@ -571,6 +862,10 @@ def main() -> None:
                 2400,
             ),
             ("broker", {}, 600),
+            # BASELINE.md configs #3 and #5 (3 in-process brokers on one
+            # core; setup of 1k x RF3 raft groups dominates the budget)
+            ("replicated", {}, 2400),
+            ("omb", {}, 1200),
         ]
         for name, env_extra, tmo in runs:
             bench_name = name.split("_50k")[0]

@@ -86,6 +86,20 @@ class AdminServer(HttpServer):
         r("GET", r"/v1/features", self._features)
         r("GET", r"/v1/loggers", self._get_loggers)
         r("PUT", r"/v1/loggers/([\w.\-]+)", self._set_log_level)
+        # -- r3 additions toward admin_server.cc route parity ----------
+        r("GET", r"/v1/usage", self._usage)
+        r("GET", r"/v1/partitions", self._list_partitions)
+        r("GET", r"/v1/cluster/partition_balancer/status",
+          self._balancer_status)
+        r("POST", r"/v1/cluster/partition_balancer/cancel",
+          self._balancer_cancel)
+        r("GET", r"/v1/raft/recovery/status", self._recovery_status)
+        r("GET", r"/v1/debug/blocked_reactor", self._blocked_reactor)
+        r("POST", r"/v1/debug/cpu_profiler", self._cpu_profile)
+        r("GET", r"/v1/shadow_indexing/manifest/([^/]+)/(\d+)",
+          self._si_manifest)
+        r("GET", r"/v1/cloud_storage/status/([^/]+)/(\d+)",
+          self._cloud_status)
         r("GET", r"/metrics", self._metrics)
 
     async def _ready(self, _m, _q, _b):
@@ -500,6 +514,239 @@ class AdminServer(HttpServer):
     async def _cluster_stats(self, _m, _q, _b):
         """Aggregated cluster/node stats (metrics_reporter analog)."""
         return self.broker.stats_reporter.report()
+
+    # -- r3 additions toward admin_server.cc route parity --------------
+    async def _usage(self, _m, _q, _b):
+        """Usage accounting (admin_server.cc usage/ + kvstore usage
+        keyspace intent): bytes/requests served plus on-disk footprint."""
+        b = self.broker
+        disk = 0
+        partitions = 0
+        for ntp, p in b.partition_manager.partitions().items():
+            partitions += 1
+            disk += p.log.size_bytes()
+        counters = {}
+        for name, m in b.metrics._metrics.items():
+            if name.endswith(("_requests_total", "_bytes_total")) and hasattr(
+                m, "_values"
+            ):
+                counters[name] = sum(m._values.values())
+        return {
+            "node_id": b.node_id,
+            "partitions": partitions,
+            "log_bytes_on_disk": disk,
+            "counters": counters,
+        }
+
+    async def _list_partitions(self, _m, _q, _b):
+        """All partitions hosted by this node (admin partitions list)."""
+        out = []
+        for ntp, p in self.broker.partition_manager.partitions().items():
+            offs = p.log.offsets()
+            out.append(
+                {
+                    "ns": ntp.ns,
+                    "topic": ntp.topic,
+                    "partition_id": ntp.partition,
+                    "raft_group_id": p.group_id,
+                    "is_leader": p.is_leader,
+                    "start_offset": offs.start_offset,
+                    "dirty_offset": offs.dirty_offset,
+                    "committed_offset": offs.committed_offset,
+                }
+            )
+        return out
+
+    async def _balancer_status(self, _m, _q, _b):
+        """partition_balancer_backend status (admin_server.cc
+        get_partition_balancer_status)."""
+        ctrl = self.broker.controller
+        moves = [
+            {
+                "ns": ntp.ns,
+                "topic": ntp.topic,
+                "partition": ntp.partition,
+                "previous_replicas": old,
+            }
+            for ntp, old in ctrl.topic_table.updates_in_progress.items()
+        ]
+        return {
+            "status": "in_progress" if moves else "ready",
+            "partitions_pending_force_recovery_count": 0,
+            "current_reassignments_count": len(moves),
+            "reassignments": moves,
+            "leader_balancer_enabled": ctrl.leader_balancer_enabled,
+            "partition_balancer_enabled": ctrl.partition_balancer_enabled,
+        }
+
+    async def _balancer_cancel(self, _m, _q, _b):
+        """Cancel all in-flight replica moves by restoring the previous
+        assignment (admin_server.cc cancel_all_partitions_reconfigs)."""
+        ctrl = self.broker.controller
+        cancelled = []
+        for ntp, old in list(ctrl.topic_table.updates_in_progress.items()):
+            try:
+                await ctrl.move_partition_replicas(
+                    ntp.topic, ntp.partition, list(old), ns=ntp.ns
+                )
+                cancelled.append(f"{ntp.ns}/{ntp.topic}/{ntp.partition}")
+            except Exception as e:  # a finished move loses the race: fine
+                logger.info("balancer cancel %s skipped: %s", ntp, e)
+        return {"cancelled": cancelled}
+
+    async def _recovery_status(self, _m, _q, _b):
+        """Raft catch-up status + node-wide throttle accounting
+        (recovery_throttle.h observability)."""
+        gm = self.broker.group_manager
+        recovering = []
+        for c in gm.groups():
+            if c.role.name != "LEADER":
+                continue
+            for peer in c.peers():
+                slot = c._slot_map.get(peer)
+                if slot is None:
+                    continue
+                match = int(c.arrays.match_index[c.row, slot])
+                dirty = c.dirty_offset()
+                if match < dirty:
+                    recovering.append(
+                        {
+                            "group": c.group_id,
+                            "follower": peer,
+                            "match_offset": match,
+                            "leader_dirty_offset": dirty,
+                            "lag": dirty - match,
+                        }
+                    )
+        t = gm.recovery_throttle
+        return {
+            "recovering": recovering,
+            "throttle_rate_bytes_s": t._bucket.rate,
+            "throttled_seconds_total": round(t.throttled_s, 3),
+        }
+
+    async def _blocked_reactor(self, _m, _q, _b):
+        """Event-loop stall probe (the reference's blocked-reactor
+        notifications): measures scheduling delay of an immediate
+        wakeup a few times and reports the worst."""
+        loop = asyncio.get_event_loop()
+        worst = 0.0
+        for _ in range(5):
+            t0 = loop.time()
+            await asyncio.sleep(0)
+            worst = max(worst, loop.time() - t0)
+        return {
+            "max_scheduling_delay_ms": round(worst * 1e3, 3),
+            "threshold_ms": 25.0,
+            "blocked": worst * 1e3 > 25.0,
+        }
+
+    async def _cpu_profile(self, _m, q, _b):
+        """Sampling wall-clock profile (admin_server.cc cpu_profiler
+        routes). Samples the SUSPENDED stack of every asyncio task plus
+        every non-loop thread for `seconds` (default 1) and returns
+        collapsed frames by count. Sampling from the loop itself cannot
+        observe a CPU-bound stall mid-callback (the sampler only runs
+        when the loop yields) — use /v1/debug/blocked_reactor to DETECT
+        stalls; this endpoint attributes where tasks spend wall time."""
+        import sys
+        import threading
+        import traceback
+
+        try:
+            seconds = float((q or {}).get("seconds", "1"))
+        except ValueError:
+            raise HttpError(400, "seconds must be a number") from None
+        seconds = min(max(seconds, 0.05), 10.0)
+        interval = 0.01
+        counts: dict[str, int] = {}
+        loop_thread = threading.get_ident()
+        me = asyncio.current_task()
+        end = asyncio.get_event_loop().time() + seconds
+
+        def collapse(frames) -> str:
+            return ";".join(
+                f"{f.name}@{f.filename.rsplit('/', 1)[-1]}:{f.lineno}"
+                for f in frames[-6:]
+            )
+
+        while asyncio.get_event_loop().time() < end:
+            for task in asyncio.all_tasks():
+                if task is me or task.done():
+                    continue
+                stack = task.get_stack(limit=6)
+                if not stack:
+                    continue
+                key = "task:" + collapse(
+                    [f for fr in stack for f in traceback.extract_stack(fr)]
+                )
+                counts[key] = counts.get(key, 0) + 1
+            for tid, frame in sys._current_frames().items():
+                if tid == loop_thread:
+                    continue  # the loop thread's frame is this sampler
+                key = "thread:" + collapse(traceback.extract_stack(frame))
+                counts[key] = counts.get(key, 0) + 1
+            await asyncio.sleep(interval)
+        top = sorted(counts.items(), key=lambda kv: -kv[1])[:50]
+        return {
+            "seconds": seconds,
+            "samples": sum(counts.values()),
+            "frames": [{"stack": k, "count": v} for k, v in top],
+        }
+
+    def _partition_or_404(self, ns: str, topic: str, pid: int):
+        from ..models.fundamental import NTP
+
+        p = self.broker.partition_manager.get(NTP(ns, topic, pid))
+        if p is None:
+            raise HttpError(404, "partition not found on this node")
+        return p
+
+    async def _si_manifest(self, m, _q, _b):
+        """Archived-range manifest (shadow_indexing admin routes)."""
+        topic, pid = m.group(1), int(m.group(2))
+        p = self._partition_or_404("kafka", topic, pid)
+        manifest = p.cloud_manifest()
+        if manifest is None:
+            raise HttpError(404, "no archived data for partition")
+        return {
+            "ns": manifest.ns,
+            "topic": manifest.topic,
+            "partition": int(manifest.partition),
+            "revision": int(manifest.revision),
+            "segments": [
+                {
+                    "name": s.name,
+                    "base_offset": int(s.base_offset),
+                    "last_offset": int(s.last_offset),
+                    "term": int(s.term),
+                    "size_bytes": int(s.size_bytes),
+                }
+                for s in manifest.segments
+            ],
+        }
+
+    async def _cloud_status(self, m, _q, _b):
+        """Per-partition tiered-storage status (admin cloud_storage
+        status route)."""
+        topic, pid = m.group(1), int(m.group(2))
+        p = self._partition_or_404("kafka", topic, pid)
+        offs = p.log.offsets()
+        st = p.archival
+        return {
+            "cloud_storage_mode": (
+                "full" if st.segments else "disabled_or_empty"
+            ),
+            "local_log_start_offset": offs.start_offset,
+            "local_log_last_offset": offs.dirty_offset,
+            "cloud_log_segment_count": len(st.segments),
+            "cloud_log_start_offset": (
+                int(st.segments[0].base_offset) if st.segments else -1
+            ),
+            "cloud_log_last_offset": (
+                int(st.segments[-1].last_offset) if st.segments else -1
+            ),
+        }
 
     async def _transforms(self, _m, _q, _b):
         """Per-transform per-partition fiber status (coproc status)."""

@@ -323,6 +323,12 @@ class Broker:
 
         cfg.bind("producer_id_expiration_ms", set_producer_expiry)
 
+        # node-wide raft recovery budget (ref raft_learner_recovery_rate)
+        cfg.bind(
+            "raft_learner_recovery_rate",
+            lambda v: self.group_manager.recovery_throttle.set_rate(v),
+        )
+
     def _register_probes(self) -> None:
         """Scrape-time gauges over live subsystem state (the probe
         objects of raft/probe.cc and kafka server probes, pull-based)."""

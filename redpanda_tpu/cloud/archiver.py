@@ -20,7 +20,12 @@ import logging
 from typing import TYPE_CHECKING, Callable, Optional
 
 from ..cluster import archival_stm
-from ..models.record import RecordBatchBuilder, RecordBatchType
+from ..models.record import (
+    HEADER_SIZE,
+    RecordBatchBuilder,
+    RecordBatchHeader,
+    RecordBatchType,
+)
 from ..raft.consensus import NotLeaderError, ReplicateTimeout
 from .manifest import PartitionManifest, SegmentMeta
 from .object_store import ObjectStore, RetryingStore, StoreError
@@ -224,8 +229,8 @@ class NtpArchiver:
         for seg in list(log._segments[:-1]):  # never the active tail
             if seg.dirty_offset < seg.base_offset:
                 continue
-            if seg.base_offset <= self.archived_upto:
-                continue
+            if seg.dirty_offset <= self.archived_upto:
+                continue  # fully archived already
             if seg.dirty_offset > boundary:
                 break  # in offset order: later segments are above too
             try:
@@ -234,6 +239,33 @@ class NtpArchiver:
             except OSError:
                 break
             base = seg.base_offset
+            if base <= self.archived_upto:
+                # the archived boundary lands INSIDE this segment: a
+                # previous leader's segment layout differed (layouts are
+                # per-replica; only BATCH boundaries are raft-aligned),
+                # or a local merge re-cut them. Skipping the segment
+                # would silently drop (archived_upto, dirty] from the
+                # archive — the gap chaos caught. Slice the upload at
+                # the first unarchived batch instead
+                # (archival_policy.cc's offset-aligned candidate cut).
+                pos = 0
+                sliced = None
+                while pos + HEADER_SIZE <= len(data):
+                    header = RecordBatchHeader.unpack(
+                        data[pos : pos + HEADER_SIZE]
+                    )
+                    if header.size_bytes < HEADER_SIZE:
+                        break
+                    if header.base_offset > self.archived_upto:
+                        sliced = (header.base_offset, data[pos:])
+                        break
+                    pos += header.size_bytes
+                if sliced is None:
+                    # nothing decodable past the boundary: STOP the
+                    # pass — uploading later segments over this hole
+                    # would commit a permanent archive gap
+                    break
+                base, data = sliced
             # filtered batches strictly below the segment base: lets a
             # remote reader re-derive every batch's kafka offset by
             # walking the segment (manifest.py delta_offset contract)

@@ -145,6 +145,30 @@ def default_properties() -> list[Property]:
             "Per-client fetch throughput quota (0 = unlimited)",
             _non_negative,
         ),
+        Property(
+            "kafka_throughput_limit_node_in_bps",
+            "int",
+            0,
+            "Node-wide ingress cap shared by ALL clients (snc quota; "
+            "0 = unlimited)",
+            _non_negative,
+        ),
+        Property(
+            "kafka_throughput_limit_node_out_bps",
+            "int",
+            0,
+            "Node-wide egress cap shared by ALL clients (snc quota; "
+            "0 = unlimited)",
+            _non_negative,
+        ),
+        Property(
+            "raft_learner_recovery_rate",
+            "int",
+            64 * 1024 * 1024,
+            "Node-wide raft catch-up/recovery rate budget shared by "
+            "every lagging group (bytes/s)",
+            _positive,
+        ),
     ]
 
 

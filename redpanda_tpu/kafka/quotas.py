@@ -1,9 +1,14 @@
-"""Per-client throughput quotas.
+"""Per-client + shard-wide (node) throughput quotas.
 
 Reference: src/v/kafka/server/quota_manager.{h,cc}
 (record_produce_tp_and_throttle / record_fetch_tp, per-client-id token
-buckets, throttle_time_ms surfaced in responses). Rates come from the
-replicated cluster config and apply live; rate 0 means unlimited.
+buckets, throttle_time_ms surfaced in responses) and
+snc_quota_manager.h:36 (the shard/node-wide ingress/egress balancer:
+one bucket per direction shared by ALL clients, so aggregate node
+throughput is bounded regardless of client-id cardinality). Rates come
+from the replicated cluster config and apply live; rate 0 means
+unlimited. The effective throttle is the max of the per-client and
+node-wide delays.
 """
 
 from __future__ import annotations
@@ -24,6 +29,8 @@ class QuotaManager:
         # (kind, client_id) -> (bucket, last_used)
         self._buckets: dict[tuple[str, str], tuple[TokenBucket, float]] = {}
         self._last_gc = 0.0
+        # snc (shard/node-wide) buckets, one per direction
+        self._node: dict[str, TokenBucket] = {}
 
     def _rate(self, kind: str) -> float:
         key = (
@@ -51,20 +58,50 @@ class QuotaManager:
         self._buckets[key] = (b, now)
         return b
 
+    def _node_rate(self, kind: str) -> float:
+        key = (
+            "kafka_throughput_limit_node_in_bps"
+            if kind == "produce"
+            else "kafka_throughput_limit_node_out_bps"
+        )
+        try:
+            return float(self._cfg.get(key))
+        except Exception:
+            return 0.0
+
+    def _node_throttle(self, kind: str, nbytes: int, now: float) -> float:
+        """snc_quota_manager analog: one shared bucket per direction;
+        returns the delay in seconds (0 = unlimited/within quota)."""
+        rate = self._node_rate(kind)
+        if rate <= 0:
+            return 0.0
+        b = self._node.get(kind)
+        if b is None:
+            b = self._node[kind] = TokenBucket(rate, burst=rate, now=now)
+        else:
+            b.rate = rate  # live config rebind
+            b.burst = rate
+        b.record(nbytes, now)
+        return b.throttle_delay_s(now)
+
     def record_and_throttle(
         self, kind: str, client_id: Optional[str], nbytes: int
     ) -> int:
         """Account traffic; returns throttle_time_ms for the response
-        (0 when unlimited or within quota)."""
-        rate = self._rate(kind)
-        if rate <= 0:
-            return 0
+        (0 when unlimited or within quota). The node-wide (snc) bucket
+        always accounts; the per-client bucket only when configured —
+        the response carries the max of the two delays."""
         now = asyncio.get_event_loop().time()
-        b = self._bucket(kind, client_id or "", rate, now)
-        b.record(nbytes, now)
-        delay = b.throttle_delay_s(now)
-        if len(self._buckets) > 10_000:
-            self._gc(now)
+        node_delay = self._node_throttle(kind, nbytes, now)
+        rate = self._rate(kind)
+        client_delay = 0.0
+        if rate > 0:
+            b = self._bucket(kind, client_id or "", rate, now)
+            b.record(nbytes, now)
+            client_delay = b.throttle_delay_s(now)
+            if len(self._buckets) > 10_000:
+                self._gc(now)
+        delay = max(node_delay, client_delay)
         return min(int(delay * 1000), _MAX_THROTTLE_MS)
 
     def _gc(self, now: float) -> None:

@@ -10,8 +10,10 @@ writer won the slot — exactly the seq_writer protocol.
 
 Compatibility checking implements the Avro-record structural subset
 (field add/remove with defaults, recursive type equality) for
-schemaType=AVRO; JSON/PROTOBUF schemas support NONE and exact-equality
-levels only (documented limitation vs the reference's full resolvers).
+schemaType=AVRO and structural PROTOBUF checks over an in-tree
+descriptor parser (protobuf_compat.py — wire-kind, label, oneof and
+message-removal rules per protobuf.cc); JSON schemas support NONE and
+exact-equality levels only.
 """
 
 from __future__ import annotations
@@ -42,13 +44,24 @@ LEVELS = {
 
 def canonicalize(schema: str, schema_type: str) -> str:
     """Canonical text for dedupe: parsed-and-redumped JSON when the
-    schema is JSON-shaped (AVRO/JSON), verbatim otherwise."""
+    schema is JSON-shaped (AVRO/JSON); PROTOBUF is parse-validated
+    (protobuf.cc compiles descriptors at registration) and kept
+    verbatim."""
     if schema_type in ("AVRO", "JSON"):
         try:
             return json.dumps(json.loads(schema), sort_keys=True)
         except (json.JSONDecodeError, ValueError):
             raise HttpError(
                 422, f"invalid {schema_type} schema", 42201
+            ) from None
+    if schema_type == "PROTOBUF":
+        from . import protobuf_compat
+
+        try:
+            protobuf_compat.parse_proto(schema)
+        except protobuf_compat.ProtoError as e:
+            raise HttpError(
+                422, f"invalid PROTOBUF schema: {e}", 42201
             ) from None
     return schema
 
@@ -114,12 +127,29 @@ def compatible(level: str, new: dict, olds: list[dict]) -> bool:
     check = olds if level.endswith("_TRANSITIVE") else olds[:1]
 
     def one(old: dict) -> bool:
-        if new["type"] != "AVRO" or old["type"] != "AVRO":
-            # non-AVRO: only exact equality is known-safe here
+        if new["type"] == "PROTOBUF" and old["type"] == "PROTOBUF":
+            from . import protobuf_compat
+
+            try:
+                back = not protobuf_compat.check_backward(
+                    new["canonical"], old["canonical"]
+                )
+                fwd = not protobuf_compat.check_backward(
+                    old["canonical"], new["canonical"]
+                )
+            except protobuf_compat.ProtoError:
+                # a legacy version that predates parse validation (or
+                # uses syntax beyond the subset parser): fall back to
+                # the only known-safe check rather than erroring the
+                # whole subject
+                return new["canonical"] == old["canonical"]
+        elif new["type"] != "AVRO" or old["type"] != "AVRO":
+            # JSON (and mixed types): only exact equality is known-safe
             return new["canonical"] == old["canonical"]
-        n, o = json.loads(new["canonical"]), json.loads(old["canonical"])
-        back = _reader_can_read(n, o)
-        fwd = _reader_can_read(o, n)
+        else:
+            n, o = json.loads(new["canonical"]), json.loads(old["canonical"])
+            back = _reader_can_read(n, o)
+            fwd = _reader_can_read(o, n)
         if level.startswith("BACKWARD"):
             return back
         if level.startswith("FORWARD"):

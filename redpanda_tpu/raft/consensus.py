@@ -83,6 +83,7 @@ class Consensus:
         arrays: ShardGroupArrays,
         send: SendFn,
         election_timeout_s: float = 0.3,
+        recovery_throttle=None,
     ):
         self.group_id = group_id
         self.node_id = node_id
@@ -92,6 +93,10 @@ class Consensus:
         self.arrays = arrays
         self._send = send
         self._election_timeout = election_timeout_s
+        # node-wide recovery rate/memory budget shared by every group
+        # (raft/recovery.py; ref recovery_throttle.h) — None in unit
+        # fixtures that build Consensus directly
+        self.recovery_throttle = recovery_throttle
 
         self.row = arrays.alloc_row()
         self._role = Role.FOLLOWER
@@ -936,6 +941,7 @@ class Consensus:
         if lock.locked():
             return  # a fiber is already driving this follower
         async with lock:
+            rounds = 0
             while (
                 not self._closed
                 and self.role == Role.LEADER
@@ -948,8 +954,16 @@ class Consensus:
                     int(self.arrays.match_index[self.row, slot]),
                     int(self.arrays.flushed_index[self.row, slot]),
                 )
-                if not await self._dispatch_append(peer):
+                # round 0 is NORMAL replication (the batcher ships each
+                # flush round through this fiber): never throttled. A
+                # follower still behind after a full 1 MiB round is in
+                # genuine recovery — only then does the node-wide
+                # budget apply (recovery_throttle.h's learner seam).
+                if not await self._dispatch_append(
+                    peer, recovering=rounds > 0
+                ):
                     return
+                rounds += 1
                 slot = self._slot_map.get(peer)
                 if slot is None:
                     return
@@ -970,9 +984,13 @@ class Consensus:
         flushed = int(self.arrays.flushed_index[self.row, slot])
         return match < self.dirty_offset() or flushed < match
 
-    async def _dispatch_append(self, peer: int) -> bool:
+    async def _dispatch_append(
+        self, peer: int, recovering: bool = False
+    ) -> bool:
         """One append_entries round to one follower. Returns False to
-        stop the catch-up fiber (rpc error / stepped down)."""
+        stop the catch-up fiber (rpc error / stepped down).
+        `recovering` routes the round through the node-wide recovery
+        throttle; the normal replication path never sets it."""
         row = self.row
         slot = self._slot_map[peer]
         term = self.term
@@ -1001,7 +1019,40 @@ class Consensus:
         prev_term = self.term_at(prev) if prev >= 0 else -1
         if prev_term is None:
             prev_term = -1
+        throttle = self.recovery_throttle if recovering else None
+        if throttle is not None:
+            # hold a memory-quota slot while the read range is in
+            # flight, and pay the node-wide recovery rate for the bytes
+            # (ref recovery_throttle.h, recovery_memory_quota.cc)
+            async with throttle.dispatch_slot():
+                batches = (
+                    self.log.read(next_idx, max_bytes=1 << 20)
+                    if next_idx <= offs.dirty_offset
+                    else []
+                )
+                if batches:
+                    await throttle.throttle(
+                        sum(b.size_bytes() for b in batches)
+                    )
+                return await self._dispatch_append_send(
+                    peer, row, slot, term, next_idx, prev, prev_term, batches
+                )
         batches = self.log.read(next_idx, max_bytes=1 << 20) if next_idx <= offs.dirty_offset else []
+        return await self._dispatch_append_send(
+            peer, row, slot, term, next_idx, prev, prev_term, batches
+        )
+
+    async def _dispatch_append_send(
+        self, peer, row, slot, term, next_idx, prev, prev_term, batches
+    ) -> bool:
+        # the throttled path awaits (semaphore + rate debt) between the
+        # caller's slot capture and this send: revalidate against
+        # reconfiguration/step-down that may have happened meanwhile
+        if self._closed or self.role != Role.LEADER or self.term != term:
+            return False
+        slot = self._slot_map.get(peer)
+        if slot is None:
+            return False
         seq = int(self.arrays.next_seq[row, slot]) + 1
         self.arrays.next_seq[row, slot] = seq
         req = rt.AppendEntriesRequest(

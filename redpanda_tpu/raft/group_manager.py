@@ -38,6 +38,11 @@ class GroupManager:
         self.kvstore = kvstore or KvStore(os.path.join(data_dir, "kvstore"))
         self._owns_kvstore = kvstore is None
         self.arrays = ShardGroupArrays()
+        # node-wide recovery rate + memory budget shared by all groups
+        # (raft/recovery.py; ref recovery_throttle.h, group_manager.h:47)
+        from .recovery import RecoveryThrottle
+
+        self.recovery_throttle = RecoveryThrottle()
         self.heartbeat_manager = HeartbeatManager(
             node_id, send, interval_s=heartbeat_interval_s
         )
@@ -89,6 +94,7 @@ class GroupManager:
             arrays=self.arrays,
             send=self._send,
             election_timeout_s=election_timeout_s or self._election_timeout,
+            recovery_throttle=self.recovery_throttle,
         )
         self._groups[group_id] = c
         self.registry_epoch += 1

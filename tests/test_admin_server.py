@@ -313,3 +313,60 @@ async def _features(tmp_path):
 
 def test_features(tmp_path):
     asyncio.run(_features(tmp_path))
+
+
+async def _r3_routes(tmp_path):
+    """r3 route additions: usage, partitions list, balancer status,
+    recovery status, blocked reactor, cpu profiler (admin_server.cc
+    route-parity work)."""
+    async with cluster(tmp_path, n=3) as brokers:
+        b = brokers[0]
+        client = KafkaClient([x.kafka_advertised for x in brokers])
+        await client.create_topic("adm", partitions=2, replication_factor=3)
+        await client.produce("adm", 0, [(b"k", b"v" * 100)])
+        await client.close()
+        addr = b.admin.address
+
+        st, usage = await http(addr, "GET", "/v1/usage")
+        assert st == 200 and usage["partitions"] >= 2
+        assert usage["log_bytes_on_disk"] > 0
+
+        st, parts = await http(addr, "GET", "/v1/partitions")
+        assert st == 200
+        assert any(p["topic"] == "adm" for p in parts)
+        row = next(p for p in parts if p["topic"] == "adm")
+        assert {"raft_group_id", "is_leader", "dirty_offset"} <= set(row)
+
+        st, bal = await http(
+            addr, "GET", "/v1/cluster/partition_balancer/status"
+        )
+        assert st == 200 and bal["status"] in ("ready", "in_progress")
+        st, cancelled = await http(
+            addr, "POST", "/v1/cluster/partition_balancer/cancel"
+        )
+        assert st == 200 and cancelled["cancelled"] == []
+
+        st, rec = await http(addr, "GET", "/v1/raft/recovery/status")
+        assert st == 200
+        assert rec["throttle_rate_bytes_s"] > 0
+        assert isinstance(rec["recovering"], list)
+
+        st, blocked = await http(addr, "GET", "/v1/debug/blocked_reactor")
+        assert st == 200 and "max_scheduling_delay_ms" in blocked
+
+        st, prof = await http(
+            addr, "POST", "/v1/debug/cpu_profiler?seconds=0.2"
+        )
+        assert st == 200 and prof["samples"] > 0 and prof["frames"]
+
+        # no archived data yet: shadow-indexing routes answer 404
+        st, _ = await http(
+            addr, "GET", "/v1/shadow_indexing/manifest/adm/0"
+        )
+        assert st == 404
+        st, cs = await http(addr, "GET", "/v1/cloud_storage/status/adm/0")
+        assert st == 200 and cs["cloud_log_segment_count"] == 0
+
+
+def test_r3_routes(tmp_path):
+    asyncio.run(_r3_routes(tmp_path))

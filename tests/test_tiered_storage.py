@@ -411,3 +411,70 @@ async def _cloud_retention(tmp_path):
 
 def test_cloud_retention(tmp_path):
     asyncio.run(_cloud_retention(tmp_path))
+
+
+async def _boundary_spanning_segment(tmp_path):
+    """Regression (chaos-found): when the archived boundary lands
+    INSIDE a local segment — leadership moved between replicas with
+    different segment layouts, or a local merge re-cut them — the
+    archiver must upload the unarchived SUFFIX sliced at the batch
+    boundary, not skip the segment (which left a hole like raft
+    167-168 missing between manifest entries (160,166) and (169,173))."""
+    from redpanda_tpu.storage.compaction import merge_adjacent
+
+    store = MemoryObjectStore()
+    async with tiered_broker(tmp_path, store) as b:
+        client = KafkaClient([b.kafka_advertised])
+        await client.create_topic(
+            "bs",
+            partitions=1,
+            replication_factor=1,
+            configs={
+                "redpanda.remote.write": "true",
+                "redpanda.remote.read": "true",
+                "segment.bytes": "400",
+            },
+        )
+        await _produce_n(client, "bs", 10)
+        p = b.partition_manager.get(kafka_ntp("bs", 0))
+        p.log.flush()
+        assert p.log.segment_count() >= 3
+        # archive ONLY the first closed segment by capping the pass at
+        # its dirty offset (simulates the previous leader's progress)
+        # pass 1: archive every closed segment
+        await b.archival.run_once()
+        upto_before = p.archiver.archived_upto
+        assert upto_before >= 0
+
+        # produce more, then MERGE two closed segments so a single
+        # local segment now spans the archived boundary
+        await _produce_n(client, "bs", 2, start=10)
+        p.log.flush()
+        merged = merge_adjacent(p.log, max_bytes=1 << 20)
+        spanning = [
+            s
+            for s in p.log._segments
+            if s.base_offset <= upto_before < s.dirty_offset
+        ]
+        assert merged > 0 or spanning, "setup failed to span the boundary"
+
+        await b.archival.run_once()
+        m = p.archiver.manifest
+        # no gaps: every segment starts right after the previous ends
+        last = None
+        for s in m.segments:
+            if last is not None:
+                assert int(s.base_offset) == last + 1, (
+                    f"archive gap: ...{last} then {int(s.base_offset)}..."
+                )
+            last = int(s.last_offset)
+        assert m.archived_upto > upto_before  # suffix got archived
+        # and the whole history reads back across the seam
+        b.storage.log_mgr.housekeeping()
+        got = await client.fetch("bs", 0, 0, max_bytes=1 << 22)
+        assert [k for _o, k, _v in got] == [b"k%d" % i for i in range(12)]
+        await client.close()
+
+
+def test_archiver_slices_boundary_spanning_segment(tmp_path):
+    asyncio.run(_boundary_spanning_segment(tmp_path))
