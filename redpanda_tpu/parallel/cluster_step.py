@@ -52,7 +52,15 @@ class ClusterState(NamedTuple):
     fol_dirty: jax.Array    # [G, RF-1] i64
     fol_flushed: jax.Array  # [G, RF-1] i64
     fol_commit: jax.Array   # [G, RF-1] i64
-    fol_term: jax.Array     # [G, RF-1] i64 highest leader term seen
+    fol_term: jax.Array     # [G, RF-1] i64 highest APPEND-path term seen
+    # highest term this mirror VOTED in (voted_for bookkeeping). Kept
+    # SEPARATE from fol_term: in raft, granting a vote adopts the term
+    # for election purposes but does NOT truncate the log — truncation
+    # happens when the new-term leader's APPEND conflicts. Folding
+    # votes into fol_term consumed that term-bump signal and left
+    # divergent suffixes untruncated after a voted election (caught by
+    # the model-vs-broker differential, tests/test_ici_differential.py).
+    voted_term: jax.Array   # [G, RF-1] i64
     # leader-side first retained log offset (snapshot boundary + 1):
     # retention advances it up to commit+1; a follower whose mirror
     # fell below it cannot be served appends and must install the
@@ -72,6 +80,7 @@ def make_cluster_state(num_groups: int, replica_slots: int = 8) -> ClusterState:
         neg,
         neg,
         neg,
+        jnp.zeros(shape, jnp.int64),
         jnp.zeros(shape, jnp.int64),
         jnp.zeros(num_groups, jnp.int64),
     )
@@ -126,8 +135,14 @@ def cluster_tick(
             recv[:, 3],
         )
         # 3. term gate (do_append_entries term check, consensus.cc:1752):
-        # heartbeats from a stale term are rejected wholesale
-        accept = r_term >= fol_term[:, j]
+        # heartbeats from a stale term are rejected wholesale. The gate
+        # includes the VOTE lane — granting a vote at term T bumps
+        # currentTerm in raft, so older-term leaders are refused — while
+        # new_term (the truncation trigger) keys on the APPEND lane
+        # alone (voting never truncates; the first higher-term append
+        # does).
+        cur_term = jnp.maximum(fol_term[:, j], state.voted_term[:, j])
+        accept = r_term >= cur_term
         new_term = r_term > fol_term[:, j]
         fol_term = fol_term.at[:, j].max(r_term)
         # follower accepts the append. Same term: the mirror only
@@ -190,7 +205,8 @@ def cluster_tick(
     total_installs = jax.lax.psum(installs, axis)
     return (
         ClusterState(
-            leader, fol_dirty, fol_flushed, fol_commit, fol_term, state.log_start
+            leader, fol_dirty, fol_flushed, fol_commit, fol_term,
+            state.voted_term, state.log_start
         ),
         total,
         total_installs,
@@ -230,6 +246,7 @@ def election_round(
     j = candidate_hop - 1
     leader = state.leader
     fol_term = state.fol_term
+    voted_term = state.voted_term
 
     # candidate_mask is HOME-block aligned (like `elected`): ship it to
     # the candidate device (home+hop), where the campaigning mirror
@@ -237,7 +254,7 @@ def election_round(
     to_cand = [(i, (i + candidate_hop) % n) for i in range(n)]
     mask_at_cand = jax.lax.ppermute(candidate_mask, axis, to_cand)
 
-    cand_term = fol_term[:, j] + 1
+    cand_term = jnp.maximum(fol_term[:, j], voted_term[:, j]) + 1
     cand_dirty = state.fol_dirty[:, j]
     payload = jnp.stack(
         [mask_at_cand.astype(jnp.int64), cand_term, cand_dirty], axis=-1
@@ -258,19 +275,25 @@ def election_round(
             my_term = leader.term
             my_dirty = leader.match_index[:, 0]
         else:
-            my_term = fol_term[:, h - 1]
+            my_term = jnp.maximum(
+                fol_term[:, h - 1], voted_term[:, h - 1]
+            )
             my_dirty = state.fol_dirty[:, h - 1]
         log_ok = r_dirty >= my_dirty
         grant = is_cand & (r_term > my_term) & log_ok
-        # one vote per term (voted_for): granting ADOPTS the candidate
-        # term, so a later same-term candidate (another hop) is refused
+        # one vote per term (voted_for): granting adopts the candidate
+        # term into the VOTE lane only — a later same-term candidate is
+        # refused, but the APPEND-path term (fol_term) stays put so the
+        # winner's first heartbeat still triggers the new-term
+        # truncation of divergent mirrors (raft grants votes without
+        # touching the log)
         if h == 0:
             leader = leader._replace(
                 term=jnp.maximum(leader.term, jnp.where(grant, r_term, 0)),
                 is_leader=leader.is_leader & ~grant,
             )
         else:
-            fol_term = fol_term.at[:, h - 1].max(
+            voted_term = voted_term.at[:, h - 1].max(
                 jnp.where(grant, r_term, -1)
             )
         back = [(i, (i - (h - candidate_hop)) % n) for i in range(n)]
@@ -279,9 +302,13 @@ def election_round(
         )
 
     elected_at_cand = mask_at_cand & (grants >= (RF // 2 + 1))
-    # the winner records its own term (its next heartbeat carries it)
+    # the winner records its own term (its next heartbeat carries it):
+    # its mirror IS the new leader log, so the append-path term moves
     fol_term = fol_term.at[:, j].max(
         jnp.where(elected_at_cand, cand_term, -1)
+    )
+    voted_term = voted_term.at[:, j].max(
+        jnp.where(mask_at_cand, cand_term, -1)
     )
     # report election results at the HOME block positions
     home_shift = [(i, (i - candidate_hop) % n) for i in range(n)]
@@ -295,7 +322,9 @@ def election_round(
         term=jnp.maximum(leader.term, jnp.where(elected, observed_term, 0)),
     )
     return (
-        state._replace(leader=new_leader, fol_term=fol_term),
+        state._replace(
+            leader=new_leader, fol_term=fol_term, voted_term=voted_term
+        ),
         elected,
         jnp.where(elected, observed_term, -1),
     )
@@ -316,6 +345,7 @@ def _cluster_specs(mesh: Mesh):
         fol_flushed=spec,
         fol_commit=spec,
         fol_term=spec,
+        voted_term=spec,
         log_start=spec,
     )
     return spec, state_specs

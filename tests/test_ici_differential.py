@@ -1,0 +1,231 @@
+"""Differential test: the ICI array model vs the broker's raft.
+
+VERDICT r2 weak #4: `parallel/cluster_step.py` re-implements
+leader/follower/election/truncation semantics as array programs,
+disjoint from `raft/consensus.py` — two codebases claiming the same
+protocol. This module drives ONE scripted schedule through BOTH and
+asserts identical semantic outcomes (commit/term/truncation), so a
+drift between them fails a test instead of staying invisible.
+
+The schedule (one raft group, 3 replicas):
+  A. leader appends 6 entries, full replication round
+     -> outcome: term unchanged, 6 entries committed cluster-wide
+  B. leader appends 2 more that never replicate (divergent suffix),
+     then dies; a follower with only the committed prefix campaigns
+     -> outcome: elected at term+1, new leader's log holds exactly the
+        6 committed entries (log_ok admitted it; divergence excluded)
+  C. new leader appends 2 entries; the deposed leader rejoins
+     -> outcome: 8 entries committed everywhere, every replica's log
+        identical, the divergent suffix REPLACED by the new entries
+
+Offsets are compared as DATA-ENTRY COUNTS (the real raft interleaves
+configuration batches the model doesn't have).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from redpanda_tpu.models.record import RecordBatchBuilder, RecordBatchType
+
+from test_raft import RaftCluster, run  # noqa: F401
+
+
+def _batch(tag: bytes):
+    b = RecordBatchBuilder()
+    b.add(tag, key=tag)
+    return b.build()
+
+
+# ---------------------------------------------------------------- model
+def model_outcomes() -> list[tuple]:
+    """Run the schedule through the ICI cluster model on a virtual
+    mesh; emit the phase outcome tuples."""
+    import jax
+    import jax.numpy as jnp
+
+    from redpanda_tpu.parallel import (
+        cluster_tick_sharded,
+        election_round_sharded,
+        make_cluster_state,
+        make_mesh,
+    )
+    from redpanda_tpu.parallel.mesh import group_sharding
+
+    mesh = make_mesh(8)
+    g = 8
+    state = make_cluster_state(g)
+    sharding = group_sharding(mesh)
+    put = lambda a: jax.device_put(a, sharding)
+    state = jax.tree.map(put, state)
+    tick = cluster_tick_sharded(mesh)
+    none = put(jnp.full(g, -1, jnp.int64))
+    outcomes: list[tuple] = []
+    term0 = int(np.asarray(state.leader.term)[0])
+    commit0 = int(np.asarray(state.leader.commit_index)[0])
+
+    # A: append entries up to offset 5 (6 entries), replicate + settle
+    state, _, _ = tick(state, put(jnp.full(g, 5, jnp.int64)))
+    state, _, _ = tick(state, none)
+    term_a = int(np.asarray(state.leader.term)[0])
+    commit_a = int(np.asarray(state.leader.commit_index)[0])
+    outcomes.append(("A", term_a - term0, commit_a - commit0))
+
+    # B: divergent suffix on the (about to die) leader, then election
+    # by the hop-1 follower holding only the committed prefix
+    state = state._replace(
+        leader=state.leader._replace(
+            match_index=state.leader.match_index.at[:, 0].set(7),
+            flushed_index=state.leader.flushed_index.at[:, 0].set(7),
+        )
+    )
+    elect = election_round_sharded(mesh, candidate_hop=1)
+    state, elected, terms = elect(state, put(jnp.ones(g, bool)))
+    won = bool(np.asarray(elected).all())
+    term_b = int(np.asarray(terms)[0])
+    # the new leader's log is its (hop-1) mirror: committed prefix only
+    new_leader_dirty = int(np.asarray(state.fol_dirty)[0, 0])
+    outcomes.append(
+        ("B", won, term_b - term0, new_leader_dirty - commit0)
+    )
+
+    # C: leadership handoff is host bookkeeping (the model's documented
+    # seam): seat the winner's state into the home leader lane at the
+    # new term. The REJOINED OLD LEADER becomes a follower mirror
+    # carrying its divergent suffix (dirty 9 > the new leader's 5);
+    # the new term's first heartbeat must truncate it — the vote lane
+    # split keeps the append-path term bump intact for exactly this.
+    state = state._replace(
+        leader=state.leader._replace(
+            is_leader=put(jnp.ones(g, bool)),
+            term=put(jnp.full(g, term_b, jnp.int64)),
+            match_index=state.leader.match_index.at[:, 0].set(
+                new_leader_dirty
+            ),
+            flushed_index=state.leader.flushed_index.at[:, 0].set(
+                new_leader_dirty
+            ),
+        ),
+        # the winner occupies the hop-1 lane (its fol_term already moved
+        # to the new term when it won); the REJOINED OLD LEADER maps to
+        # the hop-2 lane, which only GRANTED a vote — its voted_term
+        # moved but its append-path fol_term did not, so the new-term
+        # heartbeat still reads as a term bump there and truncates
+        fol_dirty=state.fol_dirty.at[:, 1].set(9),
+        fol_flushed=state.fol_flushed.at[:, 1].set(9),
+    )
+    state = jax.tree.map(put, state)
+    # heartbeat at the new term truncates the divergent mirror...
+    state, _, _ = tick(state, none)
+    assert int(np.asarray(state.fol_dirty)[0, 1]) == new_leader_dirty, (
+        "divergent mirror not truncated on the new term"
+    )
+    # ...then the new leader appends 2 entries (offsets 6,7) and they
+    # commit cluster-wide
+    state, _, _ = tick(state, put(jnp.full(g, 7, jnp.int64)))
+    state, _, _ = tick(state, none)
+    commit_c = int(np.asarray(state.leader.commit_index)[0])
+    fd = np.asarray(state.fol_dirty)[0]
+    dirty_c = int(np.asarray(state.leader.match_index)[0, 0])
+    logs_equal = bool((fd == dirty_c).all())
+    outcomes.append(("C", commit_c - commit0, logs_equal))
+    return outcomes
+
+
+# ----------------------------------------------------------------- real
+async def real_outcomes(tmp_path) -> list[tuple]:
+    """The same schedule through three REAL raft nodes over loopback,
+    with scripted (non-timer) elections."""
+    cluster = RaftCluster(tmp_path, n_nodes=3)
+    # huge timers: every election in this test is scripted
+    await cluster.start(election_timeout=3600.0, heartbeat=3600.0)
+    await cluster.create_group()
+    outcomes: list[tuple] = []
+
+    def consensus(nid):
+        return cluster.consensus(nid)
+
+    async def hb_ticks(rounds=3, nodes=None):
+        for _ in range(rounds):
+            for nid in nodes or cluster.nodes:
+                await cluster.nodes[nid].heartbeat_manager.tick()
+            await asyncio.sleep(0)
+
+    def data_records(c, upto=None):
+        """Data records at-or-below `upto` (default commit), config
+        batches excluded — the model has no config entries."""
+        limit = c.commit_index if upto is None else upto
+        out = []
+        for b in c.log.read(0, upto=limit, max_bytes=1 << 30):
+            if b.header.base_offset > limit:
+                break
+            if b.header.type == RecordBatchType.raft_data:
+                for r in b.records():
+                    out.append(bytes(r.key or b""))
+        return out
+
+    # scripted initial election: node 1 campaigns
+    c1 = consensus(1)
+    assert await c1.dispatch_vote()
+    term0 = c1.term
+    commit0 = len(data_records(c1))
+
+    # A: 6 entries, acks=-1, settle heartbeats
+    for i in range(6):
+        await c1.replicate(_batch(b"a%d" % i), acks=-1)
+    await hb_ticks()
+    committed_everywhere = [
+        len(data_records(consensus(n))) for n in (1, 2, 3)
+    ]
+    assert committed_everywhere == [6, 6, 6], committed_everywhere
+    outcomes.append(("A", c1.term - term0, 6 - commit0))
+
+    # B: divergent suffix on the leader (local appends that never
+    # replicate), leader dies, follower campaigns
+    cluster.net.isolate(1)
+    for i in range(2):
+        # acks=1: local append only; catch-up to isolated peers fails
+        await c1.replicate(_batch(b"b%d" % i), acks=1)
+    assert c1.dirty_offset() >= 7
+    c2 = consensus(2)
+    won = await c2.dispatch_vote()
+    new_leader_data = len(data_records(c2, upto=c2.dirty_offset()))
+    outcomes.append(("B", won, c2.term - term0, new_leader_data - commit0))
+
+    # C: new leader appends 2; old leader rejoins and must truncate
+    for i in range(2):
+        await c2.replicate(_batch(b"c%d" % i), acks=-1)
+    cluster.net.heal(1)
+    deadline = asyncio.get_event_loop().time() + 20.0
+    want = [b"a%d" % i for i in range(6)] + [b"c0", b"c1"]
+    while True:
+        await hb_ticks(1)
+        logs = [
+            data_records(consensus(n), upto=consensus(n).dirty_offset())
+            for n in (1, 2, 3)
+        ]
+        commits = [len(data_records(consensus(n))) for n in (1, 2, 3)]
+        if logs == [want] * 3 and commits == [8, 8, 8]:
+            break
+        if asyncio.get_event_loop().time() > deadline:
+            raise AssertionError(
+                f"never converged: logs={[len(l) for l in logs]} "
+                f"commits={commits} want={len(want)}"
+            )
+        await asyncio.sleep(0.02)
+    logs_equal = logs[0] == logs[1] == logs[2]
+    assert b"b0" not in logs[0], "divergent suffix survived truncation"
+    outcomes.append(("C", commits[0] - commit0, logs_equal))
+    await cluster.stop()
+    return outcomes
+
+
+def test_model_and_broker_raft_agree(tmp_path):
+    model = model_outcomes()
+    real = run(real_outcomes(tmp_path))
+    assert model == real, f"\nmodel: {model}\nreal:  {real}"
+    # and the outcomes themselves are the protocol's promises
+    assert model[0] == ("A", 0, 6)
+    assert model[1] == ("B", True, 1, 6)
+    assert model[2] == ("C", 8, True)

@@ -570,22 +570,32 @@ class TestClusterElection:
         assert np.all(np.asarray(won1))
         assert np.all(np.asarray(t1) == 1)
         # a STALE hop-2 candidate that never heard of the election
-        # (its own term record forced back to 0) campaigns at the SAME
-        # term 1: every voter already adopted term 1 when granting, so
-        # it gets only its self-vote and loses everywhere
+        # (both its append-path and vote records forced back to 0)
+        # campaigns at the SAME term 1: every voter's voted_term
+        # already adopted term 1 when granting, so it gets only its
+        # self-vote and loses everywhere
         state = state._replace(
             fol_term=jax.device_put(
                 jnp.asarray(state.fol_term).at[:, 1].set(0), sharding
-            )
+            ),
+            voted_term=jax.device_put(
+                jnp.asarray(state.voted_term).at[:, 1].set(0), sharding
+            ),
         )
         state, won2, _t2 = election_round_sharded(mesh, 2)(state, mask)
         assert not np.any(np.asarray(won2)), "two leaders at one term"
-        # once it LEARNS term 1, its next candidacy runs at term 2 and
-        # wins legitimately — elections stay live
+        # once it LEARNS term 1 through the APPEND path, its next
+        # candidacy runs at term 2 and wins legitimately — elections
+        # stay live. (Reset the vote lane too: the failed candidacy
+        # self-recorded term 1 there, which would mask the append-path
+        # learning this step exists to exercise.)
         state = state._replace(
             fol_term=jax.device_put(
                 jnp.asarray(state.fol_term).at[:, 1].set(1), sharding
-            )
+            ),
+            voted_term=jax.device_put(
+                jnp.asarray(state.voted_term).at[:, 1].set(0), sharding
+            ),
         )
         state, won3, t3 = election_round_sharded(mesh, 2)(state, mask)
         assert np.all(np.asarray(won3))
