@@ -243,21 +243,33 @@ def bench_crc() -> dict:
     lens = np.full(rows, size, dtype=np.uint64)
     total_bytes = rows * size
 
-    d = jax.device_put(jnp.asarray(mat))
+    # DISTINCT settled buffers, per-call blocked: the axon tunnel
+    # defers uploads to first use and can memoize repeated
+    # (executable, buffer) runs — same-buffer loops measure artifacts
+    ds = [
+        jax.device_put(
+            jnp.asarray(rng.integers(0, 256, size=(rows, size), dtype=np.uint8))
+        )
+        for _ in range(5)
+    ]
     l = jax.device_put(jnp.asarray(lens))
-    jax.block_until_ready(crc32c_device(d, l))  # compile
-    iters = 30
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = crc32c_device(d, l)
-    jax.block_until_ready(out)
-    dev_s = (time.perf_counter() - t0) / iters
-    dev_gbps = total_bytes / dev_s / 1e9
+    jax.block_until_ready([x.sum() for x in ds])  # force the uploads
+    jax.block_until_ready(crc32c_device(ds[0], l))  # compile
+    times = []
+    for d in ds:
+        t0 = time.perf_counter()
+        jax.block_until_ready(crc32c_device(d, l))
+        times.append(time.perf_counter() - t0)
+    dev_gbps = total_bytes / min(times) / 1e9
 
+    e2e_iters = 4
+    e2e_mats = [
+        rng.integers(0, 256, size=(rows, size), dtype=np.uint8)
+        for _ in range(e2e_iters)
+    ]
     t0 = time.perf_counter()
-    e2e_iters = 5
-    for _ in range(e2e_iters):
-        out = crc32c_device(jax.device_put(mat), l)
+    for m in e2e_mats:  # fresh content per call (measurement policy)
+        out = crc32c_device(jax.device_put(m), l)
         jax.block_until_ready(out)
     e2e_gbps = total_bytes / ((time.perf_counter() - t0) / e2e_iters) / 1e9
 
@@ -275,6 +287,123 @@ def bench_crc() -> dict:
         "vs_baseline": round(dev_gbps / host_gbps, 2),
         "host_gbps": round(host_gbps, 2),
         "e2e_gbps": round(e2e_gbps, 2),
+    }
+
+
+def bench_fused() -> dict:
+    """North-star #1 as ONE program: fused device CRC32C + LZ4 vs the
+    host doing BOTH passes (native crc32c + liblz4).
+
+    Methodology note (hard-won): the axon tunnel (a) defers uploads to
+    first use — naive "device-resident" loops time the wire, and
+    (b) appears to memoize repeated (executable, buffer) executions —
+    r2's 56 GB/s device-LZ4 figure was that artifact. Here:
+      - resident: DISTINCT pre-uploaded matrices, settled by dependent
+        reductions, timed per-call blocked — the rate a locally
+        attached chip's pipeline sees once transfer is overlapped;
+      - e2e: staging + upload + compute + download per call, fresh
+        data — bound by the tunnel's ~MB/s uplink on this host.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from redpanda_tpu.compression import lz4_codec
+    from redpanda_tpu.ops.fused import PREFIX, _fused, crc_lz4_fused
+    from redpanda_tpu.ops.lz4 import CELL
+    from redpanda_tpu.utils import crc as host_crc
+
+    n_rows, body = 256, 32 * 1024
+    n = 512
+    while n < body:
+        n *= 2
+    crc_w = ((PREFIX + n + 511) // 512) * 512
+    width = max(PREFIX + n + CELL, crc_w)
+    rng = np.random.default_rng(3)
+    prefixes = [bytes(rng.integers(0, 256, 40, np.uint8)) for _ in range(n_rows)]
+    total_bytes = n_rows * (body + 40)
+
+    def mk_bodies(seed):
+        out = []
+        for i in range(n_rows):
+            if i % 2:
+                out.append(
+                    np.random.default_rng(seed * 997 + i)
+                    .integers(0, 256, body)
+                    .astype(np.uint8)
+                    .tobytes()
+                )
+            else:
+                pat = b"redpanda%d" % (seed * 1000 + i)
+                out.append((pat * (body // len(pat) + 1))[:body])
+        return out
+
+    def mk_mat(seed):
+        m = np.zeros((n_rows, width), np.uint8)
+        for i, b in enumerate(mk_bodies(seed)):
+            m[i, :PREFIX] = np.frombuffer(prefixes[i], np.uint8)
+            m[i, PREFIX : PREFIX + body] = np.frombuffer(b, np.uint8)
+        return m
+
+    # -- resident (runs FIRST: nothing else queued on the tunnel) -----
+    mats = [jnp.asarray(mk_mat(10 + s)) for s in range(4)]
+    blens = jnp.asarray(np.full(n_rows, body, np.int32))
+    jax.block_until_ready([m.sum() for m in mats])  # force the uploads
+    jax.block_until_ready(_fused(mats[0], blens, n))  # compile
+    res_times = []
+    for d in mats:
+        t0 = time.perf_counter()
+        jax.block_until_ready(_fused(d, blens, n))
+        res_times.append(time.perf_counter() - t0)
+    resident_gbps = total_bytes / min(res_times) / 1e9
+
+    # -- correctness + e2e (fresh data through the full wrapper) ------
+    bodies = mk_bodies(1)
+    crcs, blocks = crc_lz4_fused(prefixes, bodies)
+    for p, b, c, blk in zip(prefixes[:8], bodies[:8], crcs[:8], blocks[:8]):
+        assert int(c) == host_crc.crc32c(b, host_crc.crc32c(p))
+        if len(blk) < len(b):
+            assert lz4_codec.decompress_block(blk, len(b)) == b
+    e2e_times = []
+    for s in range(3):
+        bs = mk_bodies(100 + s)
+        t0 = time.perf_counter()
+        crc_lz4_fused(prefixes, bs)
+        e2e_times.append(time.perf_counter() - t0)
+    e2e_gbps = total_bytes / min(e2e_times) / 1e9
+
+    # -- host both passes ---------------------------------------------
+    stride = body + 40
+    mat = np.zeros((n_rows, stride), np.uint8)
+    lens = np.zeros(n_rows, np.uint64)
+    for i, (p, b) in enumerate(zip(prefixes, bodies)):
+        mat[i, :40] = np.frombuffer(p, np.uint8)
+        mat[i, 40 : 40 + len(b)] = np.frombuffer(b, np.uint8)
+        lens[i] = 40 + len(b)
+    host_times = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        host_crc.crc32c_batch(mat, lens)
+        for b in bodies:
+            lz4_codec.compress_block(b)
+        host_times.append(time.perf_counter() - t0)
+    host_gbps = total_bytes / min(host_times) / 1e9
+
+    return {
+        "metric": "crc_lz4_fused_resident_gbps",
+        "value": round(resident_gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(resident_gbps / host_gbps, 3),
+        "e2e_gbps": round(e2e_gbps, 4),
+        "host_both_gbps": round(host_gbps, 3),
+        "rows": n_rows,
+        "row_bytes": body,
+        "note": (
+            "fresh data per timing (tunnel memoization/deferred-upload "
+            "artifacts defeated); e2e is tunnel-uplink-bound on this "
+            "host, so the default codec stays host-side — "
+            "RP_CODEC_BACKEND=device opts in for locally attached chips"
+        ),
     }
 
 
@@ -299,14 +428,27 @@ def bench_device_lz4() -> dict:
     db = jnp.asarray(batch)
     total = B * N
 
-    out, out_len = _compress_chunks(db, valid, N)  # compile
+    # distinct settled buffers, per-call blocked (see bench_fused's
+    # methodology note: same-buffer loops measured tunnel artifacts)
+    rng_l = np.random.default_rng(9)
+    alts = []
+    alt_rows = []
+    for s in range(4):
+        m = batch.copy()
+        # perturb each row so no (executable, buffer) pair repeats
+        m[:, :64] = rng_l.integers(0, 256, (B, 64), dtype=np.uint8)
+        alt_rows.append(m[0, :N].tobytes())
+        alts.append(jnp.asarray(m))
+    jax.block_until_ready([x.sum() for x in alts])
+    out, out_len = _compress_chunks(alts[0], valid, N)  # compile
     jax.block_until_ready(out)
-    iters = 30
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out, out_len = _compress_chunks(db, valid, N)
-    jax.block_until_ready(out)
-    dev_gbps = total / ((time.perf_counter() - t0) / iters) / 1e9
+    times = []
+    for dbx in alts:
+        t0 = time.perf_counter()
+        out, out_len = _compress_chunks(dbx, valid, N)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    dev_gbps = total / min(times) / 1e9
 
     host_iters = 5
     t0 = time.perf_counter()
@@ -316,7 +458,7 @@ def bench_device_lz4() -> dict:
     host_gbps = total / ((time.perf_counter() - t0) / host_iters) / 1e9
 
     dev_c = np.asarray(out)[0, : int(np.asarray(out_len)[0])].tobytes()
-    assert lz4_codec.decompress_block(dev_c, N) == buf
+    assert lz4_codec.decompress_block(dev_c, N) == alt_rows[-1]
     return {
         "metric": "lz4_compress_device_gbps",
         "value": round(dev_gbps, 2),
@@ -813,6 +955,7 @@ BENCHES = {
     "live_tick": bench_live_tick,
     "crc": bench_crc,
     "device_lz4": bench_device_lz4,
+    "fused": bench_fused,
     "codec": bench_codec,
     "broker": bench_broker,
     "replicated": bench_replicated,
@@ -844,6 +987,7 @@ def main() -> None:
         runs = [
             ("crc", {}, 600),
             ("device_lz4", {}, 600),
+            ("fused", {}, 600),
             ("codec", {}, 600),
             ("live_tick", {}, 600),
             # the flagship LIVE gate (VERDICT r2 #1): a real 50k-group

@@ -67,28 +67,48 @@ def decompress_block(data: bytes, uncompressed_size: int) -> bytes:
     return out.raw[:n]
 
 
-def compress_frame(data: bytes) -> bytes:
-    """LZ4 frame: independent 4MB blocks, content checksum, no block
-    checksums, no content size (matches common client defaults)."""
+def _write_frame(bd_code: int, pairs) -> bytes:
+    """Shared LZ4 frame writer: v1, block-independent, content
+    checksum, no block checksums/content size. `pairs` yields
+    (raw_chunk, compressed_block); a block that did not shrink is
+    stored raw with the high bit set."""
     out = bytearray()
     out += struct.pack("<I", _MAGIC)
-    flg = (1 << 6) | (1 << 5) | (1 << 2)  # v1, block-independent, content-checksum
-    bd = 7 << 4  # 4 MB max block
-    desc = bytes([flg, bd])
-    hc = (xxh32(desc) >> 8) & 0xFF
-    out += desc + bytes([hc])
-    for off in range(0, len(data), _MAX_BLOCK):
-        chunk = data[off : off + _MAX_BLOCK]
-        comp = compress_block(chunk)
-        if len(comp) >= len(chunk):
-            out += struct.pack("<I", len(chunk) | 0x80000000)
-            out += chunk
+    flg = (1 << 6) | (1 << 5) | (1 << 2)
+    desc = bytes([flg, bd_code << 4])
+    out += desc + bytes([(xxh32(desc) >> 8) & 0xFF])
+    content = bytearray()
+    for raw, comp in pairs:
+        content += raw
+        if len(comp) >= len(raw):
+            out += struct.pack("<I", len(raw) | 0x80000000)
+            out += raw
         else:
             out += struct.pack("<I", len(comp))
             out += comp
     out += struct.pack("<I", 0)  # end mark
-    out += struct.pack("<I", xxh32(data))
+    out += struct.pack("<I", xxh32(bytes(content)))
     return bytes(out)
+
+
+def compress_frame(data: bytes) -> bytes:
+    """LZ4 frame: independent 4MB blocks (matches client defaults)."""
+    return _write_frame(
+        7,  # 4 MB max block
+        (
+            (data[off : off + _MAX_BLOCK], compress_block(data[off : off + _MAX_BLOCK]))
+            for off in range(0, len(data), _MAX_BLOCK)
+        ),
+    )
+
+
+def frame_from_blocks(
+    blocks: "list[bytes]", raw_chunks: "list[bytes]"
+) -> bytes:
+    """Assemble an LZ4 frame from PRE-COMPRESSED 64 KiB-max blocks
+    (the device kernel's output) plus their raw chunks. Wire-compatible
+    with decompress_frame and any client."""
+    return _write_frame(4, zip(raw_chunks, blocks))  # 64 KiB max block
 
 
 def decompress_frame(data: bytes) -> bytes:

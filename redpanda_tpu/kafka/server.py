@@ -31,6 +31,7 @@ from ..cluster.producer_state import (
     ProducerFenced,
 )
 from ..models.fundamental import NTP, DEFAULT_NS, TopicNamespace, kafka_ntp
+from ..compression import CompressionType
 from ..models.record import CrcMismatch, RecordBatch
 from ..raft.consensus import NotLeaderError, ReplicateTimeout
 from ..security.acl import AclOperation, AclResourceType
@@ -800,6 +801,23 @@ class KafkaServer:
             # request-order entries: ("dup", offset) for already-applied
             # retries, ("ps", stages) for in-flight batches — the
             # response base_offset is the FIRST batch's offset either way
+            # compression.type topic config: "producer" (default) keeps
+            # the client's codec; a concrete codec makes the BROKER
+            # recompress uncompressed batches (real Kafka semantics).
+            # The lz4 case can take the fused device CRC+LZ4 kernel
+            # behind RP_CODEC_BACKEND=device (models/record.recompressed)
+            ctype_cfg = None
+            md = self.broker.controller.topic_table.get(
+                TopicNamespace(DEFAULT_NS, topic)
+            )
+            if md is not None:
+                want = (md.config.get("compression.type") or "").lower()
+                ctype_cfg = {
+                    "gzip": CompressionType.gzip,
+                    "snappy": CompressionType.snappy,
+                    "lz4": CompressionType.lz4,
+                    "zstd": CompressionType.zstd,
+                }.get(want)
             entries: list[tuple] = []
             try:
                 # memoryview straight from the request frame: the
@@ -808,7 +826,22 @@ class KafkaServer:
                 parser = IOBufParser(p.records)
                 prev_enqueued = None
                 while parser.bytes_left() > 0:
-                    batch = RecordBatch.from_kafka_wire(parser, verify=True)
+                    # when recompressing, CRC verification folds into
+                    # the same pass (device: literally one program)
+                    recompress = (
+                        ctype_cfg is not None
+                        and parser.bytes_left() > 57  # header floor
+                    )
+                    batch = RecordBatch.from_kafka_wire(
+                        parser, verify=not recompress
+                    )
+                    if recompress:
+                        # recompressed() verifies the wire crc in the
+                        # same pass, transcodes codec mismatches, and
+                        # no-ops when the codec already matches
+                        batch = batch.recompressed(
+                            ctype_cfg, verify_crc=batch.header.crc
+                        )
                     # order guard: the PREVIOUS batch must be cached in
                     # FIFO order before this one dispatches. Awaiting
                     # lazily (instead of after every replicate) makes

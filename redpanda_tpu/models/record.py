@@ -382,6 +382,81 @@ class RecordBatch:
         header.header_crc = header.compute_header_crc()
         return batch
 
+    # -- broker-side recompression (compression.type topic config) ----
+    def recompressed(
+        self, ctype: "CompressionType", verify_crc: int | None = None
+    ) -> "RecordBatch":
+        """A copy of this (uncompressed) batch with the records section
+        compressed as `ctype` — the broker-side recompression real
+        Kafka performs when a topic sets compression.type and the
+        producer sent uncompressed data.
+
+        Behind the registry gate (RP_CODEC_BACKEND=device) an LZ4 body
+        <= 64 KiB takes the FUSED device kernel: ONE upload yields the
+        Kafka CRC (validated against `verify_crc`, replacing the host
+        verify pass) AND the compressed block — the BASELINE.md
+        north-star #1 'CRC32c + compress' path. Everything else runs
+        the host codec registry. The device call is synchronous on the
+        event loop — the gate is meant for LOCALLY ATTACHED chips
+        (~ms round trip); over the axon tunnel the host path wins and
+        stays the default (bench.py crc_lz4_fused methodology note)."""
+        import os
+
+        if self.header.compression == ctype:
+            return self
+        if self.header.compression != CompressionType.none:
+            # producer used a DIFFERENT codec than the topic demands:
+            # verify, decompress, then fall through to recompression
+            # (Kafka's LogValidator deep-recompresses on codec mismatch)
+            if verify_crc is not None and self.compute_crc() != (
+                verify_crc & 0xFFFFFFFF
+            ):
+                raise CrcMismatch(
+                    f"kafka batch crc mismatch: wire={verify_crc:#x}"
+                )
+            plain = dataclasses.replace(
+                self.header, attrs=self.header.attrs & ~_COMPRESSION_MASK
+            )
+            return RecordBatch(
+                plain, self._records_body()
+            ).recompressed(ctype)
+        body = self.body if isinstance(self.body, bytes) else bytes(self.body)
+        frame = None
+        if (
+            ctype == CompressionType.lz4
+            and len(body) <= 65536
+            and os.environ.get("RP_CODEC_BACKEND") == "device"
+        ):
+            from ..compression import lz4_codec
+            from ..ops.fused import crc_lz4_fused
+
+            crcs, blocks = crc_lz4_fused(
+                [self.header.crc_prefix()], [body]
+            )
+            if verify_crc is not None and int(crcs[0]) != (
+                verify_crc & 0xFFFFFFFF
+            ):
+                raise CrcMismatch(
+                    f"kafka batch crc mismatch (device): "
+                    f"wire={verify_crc:#x} computed={int(crcs[0]):#x}"
+                )
+            frame = lz4_codec.frame_from_blocks([blocks[0]], [body])
+        else:
+            if verify_crc is not None and self.compute_crc() != (
+                verify_crc & 0xFFFFFFFF
+            ):
+                raise CrcMismatch(
+                    f"kafka batch crc mismatch: wire={verify_crc:#x}"
+                )
+            frame = compression_mod.compress(body, ctype)
+        header = dataclasses.replace(
+            self.header,
+            attrs=(self.header.attrs & ~_COMPRESSION_MASK) | int(ctype),
+        )
+        out = RecordBatch(header, frame)
+        out.header.size_bytes = out.size_bytes()
+        return out.finalize_crcs()
+
     # -- records access ---------------------------------------------
     def _records_body(self) -> bytes:
         data = self.body

@@ -1,0 +1,93 @@
+"""Fused device CRC32C + LZ4 over record-batch bodies: ONE upload.
+
+The round-2 lesson (BENCH_r02): each kernel alone wins device-resident
+but loses end-to-end because the host->device copy dominates. Fusing
+validation and compression into one program amortizes that single
+upload across BOTH ops — the host must otherwise run two full passes
+(crc ~8 GB/s native + lz4 ~1.6 GB/s liblz4), so the combined host
+throughput is ~1.3 GB/s while the fused device path pays one transfer.
+
+Row layout ([B, PREFIX + n + CELL] uint8, zero-padded):
+
+    [ crc_prefix (40 B) | records body (n bucket) | CELL guard ]
+
+The Kafka batch CRC covers crc_prefix||body (model/record.h:398), so
+the CRC scan reads the row head; LZ4 compresses the body slice only.
+Reference: BASELINE.md north-star #1 ("CRC32c + compress"),
+src/v/compression/compression.h:21 registry gating.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .crc32c import crc32c_device
+from .lz4 import CELL, _compress_chunks, out_bound
+
+PREFIX = 40  # models/record.py _CRC_PREFIX packed size
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _fused(data: jax.Array, body_len: jax.Array, n: int):
+    """data [B, PREFIX + n + CELL] uint8; body_len int32[B].
+    Returns (crc uint32[B] over prefix||body, lz4 blocks + lengths)."""
+    # CRC slice: width PREFIX+n rounded up to the 512-byte fold chunk —
+    # the matrix is allocated with that slack, zero-padded
+    crc_w = ((PREFIX + n + 511) // 512) * 512
+    crc = crc32c_device(
+        data[:, :crc_w], (body_len + PREFIX).astype(jnp.int64)
+    )
+    # barrier: without it XLA fuses the crc path's 512-chunk relayout
+    # into the lz4 slice's consumers and the combined program runs
+    # ~1000x slower (measured: 8.5 s vs ~1 ms for this shape). The
+    # barrier materializes the body slice once, then both kernels run
+    # at their standalone speeds off the single upload.
+    body = jax.lax.optimization_barrier(
+        data[:, PREFIX : PREFIX + n + CELL]
+    )
+    out, out_len = _compress_chunks(body, body_len, n)
+    return crc, out, out_len
+
+
+def crc_lz4_fused(
+    prefixes: "list[bytes]", bodies: "list[bytes | np.ndarray]"
+) -> tuple[np.ndarray, list[bytes]]:
+    """One device pass: per-row Kafka CRC (over prefix||body) and the
+    body compressed into standard LZ4 blocks. Bodies must be <= 64 KiB
+    (the device parser's cell-grid bound); callers chunk larger bodies
+    and assemble multi-block frames host-side."""
+    assert len(prefixes) == len(bodies)
+    if not bodies:
+        return np.empty(0, np.uint32), []
+    arrs = [
+        np.frombuffer(b, np.uint8) if isinstance(b, (bytes, memoryview)) else b
+        for b in bodies
+    ]
+    longest = max(a.size for a in arrs)
+    if longest > 65536:
+        raise ValueError("fused lz4 bodies must be <= 64 KiB")
+    n = 512  # floor keeps the crc fold width 512-aligned
+    while n < longest:
+        n *= 2
+    crc_w = ((PREFIX + n + 511) // 512) * 512
+    width = max(PREFIX + n + CELL, crc_w)
+    batch = np.zeros((len(arrs), width), np.uint8)
+    body_len = np.empty(len(arrs), np.int32)
+    for i, (p, a) in enumerate(zip(prefixes, arrs)):
+        assert len(p) == PREFIX, f"prefix must be {PREFIX} bytes"
+        batch[i, :PREFIX] = np.frombuffer(p, np.uint8)
+        batch[i, PREFIX : PREFIX + a.size] = a
+        body_len[i] = a.size
+    crc, out, out_len = _fused(
+        jnp.asarray(batch), jnp.asarray(body_len), n
+    )
+    crc = np.asarray(crc)
+    out = np.asarray(out)
+    out_len = np.asarray(out_len)
+    assert int(out_len.max()) <= out_bound(n)
+    blocks = [out[i, : out_len[i]].tobytes() for i in range(len(arrs))]
+    return crc, blocks
