@@ -67,19 +67,22 @@ def decompress_block(data: bytes, uncompressed_size: int) -> bytes:
     return out.raw[:n]
 
 
-def _write_frame(bd_code: int, pairs) -> bytes:
+def _write_frame(bd_code: int, pairs, content: bytes | None = None) -> bytes:
     """Shared LZ4 frame writer: v1, block-independent, content
     checksum, no block checksums/content size. `pairs` yields
     (raw_chunk, compressed_block); a block that did not shrink is
-    stored raw with the high bit set."""
+    stored raw with the high bit set. Pass `content` when the caller
+    already holds the contiguous payload (skips re-joining chunks for
+    the checksum)."""
     out = bytearray()
     out += struct.pack("<I", _MAGIC)
     flg = (1 << 6) | (1 << 5) | (1 << 2)
     desc = bytes([flg, bd_code << 4])
     out += desc + bytes([(xxh32(desc) >> 8) & 0xFF])
-    content = bytearray()
+    chunks = [] if content is None else None
     for raw, comp in pairs:
-        content += raw
+        if chunks is not None:
+            chunks.append(raw)
         if len(comp) >= len(raw):
             out += struct.pack("<I", len(raw) | 0x80000000)
             out += raw
@@ -87,7 +90,9 @@ def _write_frame(bd_code: int, pairs) -> bytes:
             out += struct.pack("<I", len(comp))
             out += comp
     out += struct.pack("<I", 0)  # end mark
-    out += struct.pack("<I", xxh32(bytes(content)))
+    out += struct.pack(
+        "<I", xxh32(content if content is not None else b"".join(chunks))
+    )
     return bytes(out)
 
 
@@ -99,6 +104,7 @@ def compress_frame(data: bytes) -> bytes:
             (data[off : off + _MAX_BLOCK], compress_block(data[off : off + _MAX_BLOCK]))
             for off in range(0, len(data), _MAX_BLOCK)
         ),
+        content=data,
     )
 
 

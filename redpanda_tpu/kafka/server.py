@@ -817,6 +817,9 @@ class KafkaServer:
                     "snappy": CompressionType.snappy,
                     "lz4": CompressionType.lz4,
                     "zstd": CompressionType.zstd,
+                    # valid Kafka value: force broker-side decompression
+                    "uncompressed": CompressionType.none,
+                    "none": CompressionType.none,
                 }.get(want)
             entries: list[tuple] = []
             try:

@@ -403,6 +403,14 @@ class RecordBatch:
         import os
 
         if self.header.compression == ctype:
+            # nothing to transcode — but the caller delegated CRC
+            # verification here, so it must still happen
+            if verify_crc is not None and self.compute_crc() != (
+                verify_crc & 0xFFFFFFFF
+            ):
+                raise CrcMismatch(
+                    f"kafka batch crc mismatch: wire={verify_crc:#x}"
+                )
             return self
         if self.header.compression != CompressionType.none:
             # producer used a DIFFERENT codec than the topic demands:
@@ -414,12 +422,15 @@ class RecordBatch:
                 raise CrcMismatch(
                     f"kafka batch crc mismatch: wire={verify_crc:#x}"
                 )
-            plain = dataclasses.replace(
+            plain_hdr = dataclasses.replace(
                 self.header, attrs=self.header.attrs & ~_COMPRESSION_MASK
             )
-            return RecordBatch(
-                plain, self._records_body()
-            ).recompressed(ctype)
+            plain = RecordBatch(plain_hdr, self._records_body())
+            plain.header.size_bytes = plain.size_bytes()
+            plain.finalize_crcs()
+            if ctype == CompressionType.none:
+                return plain  # compression.type=uncompressed
+            return plain.recompressed(ctype)
         body = self.body if isinstance(self.body, bytes) else bytes(self.body)
         frame = None
         if (

@@ -241,6 +241,7 @@ def merge_adjacent(log, max_bytes: int) -> int:
                     f.write(batch.serialize())
             f.flush()
             os.fsync(f.fileno())
+        log.invalidate_readers()
         a._release_handles()
         b._release_handles()
         os.replace(tmp, a._path)
@@ -272,6 +273,8 @@ def compact_log(log, max_offset: int, visible=None) -> dict[str, int]:
     of the last pass; a pass with no newly-closed segment below
     `max_offset` is free (no read, no decode) — the steady-state cost
     of the housekeeping timer on an idle log is one list scan."""
+    # compaction rewrites move bytes under any positioned readers
+    log.invalidate_readers()
     if getattr(log, "_compacted_upto", None) is None:
         log._compacted_upto = -1
     closed = [

@@ -248,12 +248,28 @@ class Segment:
         self, start_offset: int, max_bytes: int = 1 << 30
     ) -> list[RecordBatch]:
         """Batches whose range intersects [start_offset, dirty]."""
+        return self.read_batches_pos(start_offset, max_bytes)[0]
+
+    def read_batches_pos(
+        self,
+        start_offset: int,
+        max_bytes: int = 1 << 30,
+        pos: int | None = None,
+    ) -> tuple[list[RecordBatch], list[int]]:
+        """(batches, file_pos_after_each) for [start_offset, dirty].
+        `pos` is an exact file position of the batch containing
+        start_offset — a positioned reader resuming where its last
+        poll ended (readers_cache.h:31) skips the sparse-index
+        scan-forward. The per-batch end positions let the Log cache a
+        resume point at EVERY batch boundary of the window."""
         if self._file is not None:
             self._file.flush()
         out: list[RecordBatch] = []
+        ends: list[int] = []
         consumed = 0
         fd = self._read_fd()
-        pos = self.lower_bound_pos(start_offset)
+        if pos is None:
+            pos = self.lower_bound_pos(start_offset)
         while consumed < max_bytes:
             hdr_bytes = os.pread(fd, HEADER_SIZE, pos)
             if len(hdr_bytes) < HEADER_SIZE:
@@ -266,8 +282,9 @@ class Segment:
             if header.last_offset < start_offset:
                 continue
             out.append(RecordBatch(header, body))
+            ends.append(pos)
             consumed += header.size_bytes
-        return out
+        return out, ends
 
     def timequery(self, ts: int) -> int | None:
         """First indexed offset with timestamp >= ts (sparse — callers

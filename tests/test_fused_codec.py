@@ -181,3 +181,53 @@ def test_codec_mismatch_transcoded(tmp_path):
                 assert [(k, v) for _o, k, v in got] == recs
 
     asyncio.run(main())
+
+
+def test_matching_codec_still_crc_verified():
+    """A batch already in the topic's codec must STILL be rejected on a
+    corrupt wire CRC (the server delegates verification here)."""
+    b = RecordBatchBuilder(compression=CompressionType.lz4)
+    for i in range(5):
+        b.add(b"v%d" % i * 50, key=b"k%d" % i)
+    batch = b.build()
+    assert batch.recompressed(
+        CompressionType.lz4, verify_crc=batch.header.crc
+    ) is batch
+    with pytest.raises(CrcMismatch):
+        batch.recompressed(CompressionType.lz4, verify_crc=batch.header.crc ^ 1)
+
+
+def test_uncompressed_config_forces_decompression(tmp_path):
+    """compression.type=uncompressed decompresses producer batches
+    (LogValidator semantics)."""
+
+    async def main():
+        async with broker_cluster(tmp_path, 1) as brokers:
+            async with client_for(brokers) as client:
+                await client.create_topic(
+                    "unc", partitions=1, replication_factor=1,
+                    configs={"compression.type": "uncompressed"},
+                )
+                b = RecordBatchBuilder(compression=CompressionType.gzip)
+                recs = [(b"k%d" % i, b"v%d" % i * 30) for i in range(10)]
+                for k, v in recs:
+                    b.add(v, key=k)
+                await client.produce_wire(
+                    "unc", 0, b.build().to_kafka_wire(), acks=-1
+                )
+                from redpanda_tpu.models.fundamental import kafka_ntp
+
+                p = brokers[0].partition_manager.get(kafka_ntp("unc", 0))
+                stored = [
+                    bt
+                    for bt in p.log.read(0, max_bytes=1 << 24)
+                    if bt.header.type.name == "raft_data"
+                ]
+                assert all(
+                    bt.header.compression == CompressionType.none
+                    for bt in stored
+                )
+                got = await client.fetch("unc", 0, 0, max_wait_ms=300)
+                assert [(k, v) for _o, k, v in got] == recs
+
+    asyncio.run(main())
