@@ -614,6 +614,8 @@ async def _broker_async() -> dict:
         await asyncio.gather(*(producer(i) for i in range(n_producers)))
         produce_s = time.perf_counter() - t_start
         produce_mbps = sent_bytes / produce_s / 1e6
+        if not lat_ms:
+            lat_ms = [-1.0]  # contended run with zero completed rounds
 
         # consumer sweep: read everything back through the fetch path
         # (raw wire — per-record decode is client-machine work)
@@ -763,8 +765,14 @@ async def _replicated_async() -> dict:
             "partitions": n_partitions,
             "replication_factor": 3,
             "acks": -1,
-            "produce_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
-            "produce_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+            # a machine-contended run can complete zero rounds in the
+            # window: report -1 rather than crash the whole bench line
+            "produce_p50_ms": (
+                round(float(np.percentile(lat_ms, 50)), 2) if lat_ms else -1
+            ),
+            "produce_p99_ms": (
+                round(float(np.percentile(lat_ms, 99)), 2) if lat_ms else -1
+            ),
             "cores": 1,
         }
     finally:

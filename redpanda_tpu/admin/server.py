@@ -52,6 +52,8 @@ class AdminServer(HttpServer):
         r("GET", r"/v1/brokers", self._brokers)
         r("POST", r"/v1/brokers/(\d+)/decommission", self._decommission)
         r("POST", r"/v1/brokers/(\d+)/recommission", self._recommission)
+        r("PUT", r"/v1/brokers/(\d+)/maintenance", self._maintenance_on)
+        r("DELETE", r"/v1/brokers/(\d+)/maintenance", self._maintenance_off)
         r("GET", r"/v1/cluster/health_overview", self._health)
         r("GET", r"/v1/cluster/stats", self._cluster_stats)
         r("GET", r"/v1/cluster_config", self._get_config)
@@ -135,6 +137,24 @@ class AdminServer(HttpServer):
 
     async def _recommission(self, m, _q, _b):
         await self.broker.controller.recommission_node(int(m.group(1)))
+        return None
+
+    async def _maintenance_on(self, m, _q, _b):
+        from ..cluster.controller import TopicError
+
+        try:
+            await self.broker.controller.set_maintenance(int(m.group(1)), True)
+        except TopicError as e:
+            raise HttpError(400, e.message) from None
+        return None
+
+    async def _maintenance_off(self, m, _q, _b):
+        from ..cluster.controller import TopicError
+
+        try:
+            await self.broker.controller.set_maintenance(int(m.group(1)), False)
+        except TopicError as e:
+            raise HttpError(400, e.message) from None
         return None
 
     async def _health(self, _m, _q, _b):

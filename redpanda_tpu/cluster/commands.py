@@ -34,6 +34,7 @@ class CmdType(enum.IntEnum):
     finish_move = 15
     feature_update = 16
     migration_done = 17
+    set_maintenance = 18
 
 
 class PartitionAssignmentE(serde.Envelope):
@@ -175,6 +176,13 @@ class RecommissionNodeCmd(serde.Envelope):
     SERDE_FIELDS = [("node_id", serde.i32)]
 
 
+class SetMaintenanceCmd(serde.Envelope):
+    """Enable/disable maintenance mode (maintenance_mode_cmd):
+    leadership drains off the node, balancers mute it, replicas stay."""
+
+    SERDE_FIELDS = [("node_id", serde.i32), ("on", serde.boolean)]
+
+
 class MoveReplicasCmd(serde.Envelope):
     """Reassign one partition's replica set (move_partition_replicas_cmd).
     Applies to the topic table immediately; the raft group's joint
@@ -242,6 +250,7 @@ CMD_CLASSES = {
     CmdType.finish_move: FinishMoveCmd,
     CmdType.feature_update: FeatureUpdateCmd,
     CmdType.migration_done: MigrationDoneCmd,
+    CmdType.set_maintenance: SetMaintenanceCmd,
 }
 
 

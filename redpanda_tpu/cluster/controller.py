@@ -180,6 +180,21 @@ class ControllerStm(StateMachine):
                 self._c.members_table.apply_state(
                     int(cmd.node_id), MembershipState.active
                 )
+            elif cmd_type == CmdType.set_maintenance:
+                ep = self._c.members_table.get(int(cmd.node_id))
+                if cmd.on:
+                    self._c.members_table.apply_state(
+                        int(cmd.node_id), MembershipState.maintenance
+                    )
+                elif (
+                    ep is not None
+                    and ep.state == MembershipState.maintenance
+                ):
+                    # off only leaves MAINTENANCE: it must never cancel
+                    # an in-progress decommission (draining)
+                    self._c.members_table.apply_state(
+                        int(cmd.node_id), MembershipState.active
+                    )
             elif cmd_type == CmdType.feature_update:
                 self._c.features.apply(
                     cmd.name, cmd.state, int(cmd.cluster_version)
@@ -513,7 +528,7 @@ class Controller:
                     partitions,
                     replication_factor,
                     next_group,
-                    exclude=self._draining_nodes(),
+                    exclude=self._muted_nodes(),
                 )
             except AllocationError as e:
                 raise TopicError("invalid_replication_factor", str(e)) from None
@@ -708,6 +723,24 @@ class Controller:
             CmdType.decommission_node, DecommissionNodeCmd(node_id=node_id)
         )
 
+    async def set_maintenance(self, node_id: int, on: bool) -> None:
+        """Maintenance mode (members_manager maintenance_mode_cmd):
+        replicated flag; the leader's maintenance pass then transfers
+        leaderships away and the balancers mute the node. Replicas
+        stay — disable restores normal placement with zero movement."""
+        from .commands import SetMaintenanceCmd
+
+        ep = self.members_table.get(node_id)
+        if ep is None:
+            raise TopicError("broker_not_available", f"node {node_id} unknown")
+        if on and ep.state == MembershipState.draining:
+            raise TopicError(
+                "invalid_request", f"node {node_id} is decommissioning"
+            )
+        await self.replicate_cmd(
+            CmdType.set_maintenance, SetMaintenanceCmd(node_id=node_id, on=on)
+        )
+
     async def recommission_node(self, node_id: int) -> None:
         await self.replicate_cmd(
             CmdType.recommission_node, RecommissionNodeCmd(node_id=node_id)
@@ -854,7 +887,7 @@ class Controller:
                     add,
                     md.replication_factor,
                     next_group,
-                    exclude=self._draining_nodes(),
+                    exclude=self._muted_nodes(),
                 )
             except AllocationError as e:
                 raise TopicError("invalid_replication_factor", str(e)) from None
@@ -983,6 +1016,7 @@ class Controller:
                 self._move_repair_pass()
                 self._maybe_snapshot()
                 if self.is_leader:
+                    await self._maintenance_pass()
                     await self._feature_pass()
                     await self._migration_pass()
                     await self._drain_pass()
@@ -1128,6 +1162,17 @@ class Controller:
                 return
             await asyncio.sleep(0.1)
 
+    def _muted_nodes(self) -> set[int]:
+        """Nodes no leadership or new replicas should land on:
+        decommissioning (draining) plus maintenance."""
+        return {
+            nid
+            for nid in self.members_table.node_ids()
+            if (ep := self.members_table.get(nid)) is not None
+            and ep.state
+            in (MembershipState.draining, MembershipState.maintenance)
+        }
+
     def _draining_nodes(self) -> set[int]:
         return {
             nid
@@ -1227,9 +1272,9 @@ class Controller:
         if not self.leader_balancer_enabled or self.leaders_table is None:
             return
         alive = set(self.members_table.node_ids())
-        draining = self._draining_nodes()
+        muted = self._muted_nodes()
         counts: dict[int, int] = {
-            n: 0 for n in alive if n not in draining
+            n: 0 for n in alive if n not in muted
         }
         led: dict[int, list] = {n: [] for n in counts}
         for tp_ns, md in self.topic_table.topics().items():
@@ -1293,7 +1338,7 @@ class Controller:
         except Exception:
             pass
 
-    async def _partition_balance_pass(self) -> None:
+    async def _partition_balance_pass(self) -> None:  # muted-aware
         """Leader-only: even out REPLICA counts across active members
         (cluster/partition_balancer_backend.cc, count-based subset).
         When the most-loaded node holds 2+ more replicas than the
@@ -1308,7 +1353,7 @@ class Controller:
             # commands, so EVERY controller leader sees it — the local
             # converge-task dict only exists on hosting nodes)
             return
-        draining = self._draining_nodes()
+        draining = self._muted_nodes()  # decommissioning OR maintenance
         active = [
             n
             for n in self.members_table.node_ids()
@@ -1359,6 +1404,58 @@ class Controller:
                 )
             return
 
+    async def _maintenance_pass(self) -> None:
+        """Leader-only: transfer ONE leadership per pass off each
+        maintenance-mode node (drain_manager.cc leadership drain —
+        replicas stay put, unlike decommission's replica moves)."""
+        maint = {
+            nid
+            for nid in self.members_table.node_ids()
+            if (ep := self.members_table.get(nid)) is not None
+            and ep.state == MembershipState.maintenance
+        }
+        if not maint or self.leaders_table is None:
+            return
+        from ..raft import types as rt
+
+        muted = self._muted_nodes()
+        transferred: set[int] = set()
+        for tp_ns, md in self.topic_table.topics().items():
+            for a in md.assignments.values():
+                ntp = NTP(tp_ns.ns, tp_ns.topic, a.partition)
+                local = self._pm.get(ntp)
+                if local is not None and local.consensus.leader_id is not None:
+                    leader = int(local.consensus.leader_id)
+                else:
+                    leader = self.leaders_table.get(ntp)
+                if leader not in maint or leader in transferred:
+                    continue
+                targets = [r for r in a.replicas if r not in muted]
+                for target in targets:
+                    # try each candidate: a single dead replica must
+                    # not block the drain when a healthy one exists
+                    try:
+                        if leader == self.node_id:
+                            p = self._pm.get(ntp)
+                            if p is None or not p.consensus.is_leader():
+                                break
+                            await p.consensus.transfer_leadership(target)
+                        else:
+                            req = rt.TransferLeadershipRequest(
+                                group=a.group, target=target
+                            ).encode()
+                            await self._send(
+                                leader, rt.TRANSFER_LEADERSHIP, req, 5.0
+                            )
+                        transferred.add(leader)
+                        break
+                    except Exception:
+                        logger.info(
+                            "maintenance drain: transfer %s %d->%d failed",
+                            ntp, leader, target,
+                        )
+                        continue
+
     async def _drain_pass(self) -> None:
         """Leader-only: move replicas off draining nodes, one partition
         per draining node per pass (members_backend.cc incremental
@@ -1379,7 +1476,8 @@ class Controller:
                     if nid not in a.replicas:
                         continue
                     repl = self.allocator.pick_replacement(
-                        a.replicas, exclude=set(draining)
+                        a.replicas,
+                        exclude=set(draining) | self._muted_nodes(),
                     )
                     if repl is None:
                         continue  # this partition is stuck; try others

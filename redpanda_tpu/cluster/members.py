@@ -16,7 +16,11 @@ from typing import Optional
 
 class MembershipState(enum.Enum):
     active = "active"
-    draining = "draining"
+    draining = "draining"  # decommission: replicas move off, then removal
+    # maintenance (members_manager.h maintenance mode): leaderships
+    # drain off and the balancers won't place new ones, but replicas
+    # STAY — the node returns with a disable, no data movement
+    maintenance = "maintenance"
 
 
 @dataclasses.dataclass(slots=True)

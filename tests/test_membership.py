@@ -394,3 +394,101 @@ async def _partition_balancer(tmp_path):
 
 def test_partition_balancer(tmp_path):
     asyncio.run(_partition_balancer(tmp_path))
+
+
+def test_maintenance_mode_drains_leadership_keeps_replicas(tmp_path):
+    """Maintenance mode (ref drain_manager.cc + maintenance_mode_cmd):
+    leaderships transfer away and the balancer mutes the node, but its
+    replicas stay; disabling restores normal placement."""
+    import asyncio
+
+    from test_admin_server import cluster, http
+
+    async def main():
+        async with cluster(tmp_path, n=3) as brokers:
+            from redpanda_tpu.cluster.members import MembershipState
+            from redpanda_tpu.kafka.client import KafkaClient
+            from redpanda_tpu.models.fundamental import kafka_ntp
+
+            client = KafkaClient([b.kafka_advertised for b in brokers])
+            await client.create_topic("mt", partitions=6,
+                                      replication_factor=3)
+            # every partition elects a leader
+            ntps = [kafka_ntp("mt", p) for p in range(6)]
+
+            def leaders():
+                out = {}
+                for ntp in ntps:
+                    for b in brokers:
+                        part = b.partition_manager.get(ntp)
+                        if part is not None and part.is_leader:
+                            out[ntp] = b.node_id
+                return out
+
+            deadline = asyncio.get_event_loop().time() + 15
+            while len(leaders()) < 6:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.1)
+
+            # pick a node that leads something; put it in maintenance
+            victim = next(iter(leaders().values()))
+            # self-registration is async at startup: wait for the
+            # victim's RegisterNodeCmd to commit before flipping state
+            deadline = asyncio.get_event_loop().time() + 15
+            while brokers[0].controller.members_table.get(victim) is None:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.1)
+            st, _ = await http(
+                brokers[0].admin.address, "PUT",
+                f"/v1/brokers/{victim}/maintenance",
+            )
+            assert st in (200, 204)
+            # replicated state converges + leaderships drain off
+            deadline = asyncio.get_event_loop().time() + 30
+            while True:
+                led = leaders()
+                state_ok = all(
+                    b.controller.members_table.get(victim).state
+                    == MembershipState.maintenance
+                    for b in brokers
+                )
+                if (
+                    state_ok
+                    and len(led) == 6
+                    and victim not in led.values()
+                ):
+                    break
+                assert asyncio.get_event_loop().time() < deadline, (
+                    f"leaderships never drained: {led}"
+                )
+                await asyncio.sleep(0.2)
+            # replicas STAYED on the victim (no data movement)
+            assert all(
+                brokers[victim].partition_manager.get(ntp) is not None
+                for ntp in ntps
+            )
+            # writes keep flowing during maintenance
+            await client.produce("mt", 0, [(b"k", b"v")])
+            # status surfaces on the brokers endpoint
+            st, body = await http(brokers[0].admin.address, "GET", "/v1/brokers")
+            row = next(
+                r for r in body["brokers"] if r["node_id"] == victim
+            )
+            assert row["membership_status"] == "maintenance"
+
+            # disable: node becomes eligible again
+            st, _ = await http(
+                brokers[0].admin.address, "DELETE",
+                f"/v1/brokers/{victim}/maintenance",
+            )
+            assert st in (200, 204)
+            deadline = asyncio.get_event_loop().time() + 15
+            while (
+                brokers[0].controller.members_table.get(victim).state
+                != MembershipState.active
+            ):
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.1)
+            await client.close()
+
+    asyncio.run(main())
