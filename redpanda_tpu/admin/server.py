@@ -139,23 +139,20 @@ class AdminServer(HttpServer):
         await self.broker.controller.recommission_node(int(m.group(1)))
         return None
 
-    async def _maintenance_on(self, m, _q, _b):
+    async def _set_maintenance(self, m, on: bool):
         from ..cluster.controller import TopicError
 
         try:
-            await self.broker.controller.set_maintenance(int(m.group(1)), True)
+            await self.broker.controller.set_maintenance(int(m.group(1)), on)
         except TopicError as e:
             raise HttpError(400, e.message) from None
         return None
+
+    async def _maintenance_on(self, m, _q, _b):
+        return await self._set_maintenance(m, True)
 
     async def _maintenance_off(self, m, _q, _b):
-        from ..cluster.controller import TopicError
-
-        try:
-            await self.broker.controller.set_maintenance(int(m.group(1)), False)
-        except TopicError as e:
-            raise HttpError(400, e.message) from None
-        return None
+        return await self._set_maintenance(m, False)
 
     async def _health(self, _m, _q, _b):
         rep = self.broker.health_monitor.report()

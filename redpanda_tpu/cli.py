@@ -15,7 +15,7 @@ Command families:
     cluster  health | info | config-get | config-set | metadata
     acl      create | list | delete
     user     create | delete
-    broker   decommission | recommission
+    broker   decommission | recommission | maintenance | resume
     partition move | transfer-leader
 """
 
@@ -392,6 +392,12 @@ async def cmd_broker(args) -> None:
     elif args.action == "recommission":
         _admin(args, "POST", f"/v1/brokers/{args.id}/recommission")
         print(f"recommissioned node {args.id}")
+    elif args.action == "maintenance":
+        _admin(args, "PUT", f"/v1/brokers/{args.id}/maintenance")
+        print(f"node {args.id} entering maintenance (leadership drains)")
+    elif args.action == "resume":
+        _admin(args, "DELETE", f"/v1/brokers/{args.id}/maintenance")
+        print(f"node {args.id} leaving maintenance")
 
 
 async def cmd_partition(args) -> None:
@@ -629,7 +635,10 @@ def build_parser() -> argparse.ArgumentParser:
     u.set_defaults(fn=cmd_user)
 
     b = sub.add_parser("broker")
-    b.add_argument("action", choices=["decommission", "recommission"])
+    b.add_argument(
+        "action",
+        choices=["decommission", "recommission", "maintenance", "resume"],
+    )
     b.add_argument("id", type=int)
     b.set_defaults(fn=cmd_broker)
 

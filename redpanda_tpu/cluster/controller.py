@@ -177,15 +177,24 @@ class ControllerStm(StateMachine):
                     int(cmd.node_id), MembershipState.draining
                 )
             elif cmd_type == CmdType.recommission_node:
-                self._c.members_table.apply_state(
-                    int(cmd.node_id), MembershipState.active
-                )
+                ep = self._c.members_table.get(int(cmd.node_id))
+                if ep is not None and ep.state == MembershipState.draining:
+                    # recommission cancels a DECOMMISSION only; it must
+                    # not clear maintenance through the wrong command
+                    self._c.members_table.apply_state(
+                        int(cmd.node_id), MembershipState.active
+                    )
             elif cmd_type == CmdType.set_maintenance:
                 ep = self._c.members_table.get(int(cmd.node_id))
                 if cmd.on:
-                    self._c.members_table.apply_state(
-                        int(cmd.node_id), MembershipState.maintenance
-                    )
+                    # the STM is the authoritative guard (the API-side
+                    # check runs on a possibly-stale follower view):
+                    # maintenance must never overwrite an in-progress
+                    # decommission
+                    if ep is None or ep.state != MembershipState.draining:
+                        self._c.members_table.apply_state(
+                            int(cmd.node_id), MembershipState.maintenance
+                        )
                 elif (
                     ep is not None
                     and ep.state == MembershipState.maintenance
@@ -530,8 +539,22 @@ class Controller:
                     next_group,
                     exclude=self._muted_nodes(),
                 )
-            except AllocationError as e:
-                raise TopicError("invalid_replication_factor", str(e)) from None
+            except AllocationError:
+                # maintenance is a SOFT preference (replicas may stay on
+                # such nodes): when the cluster is too small to avoid
+                # them — RF == cluster size during a rolling restart —
+                # retry excluding only decommissioning nodes
+                try:
+                    assignments = self.allocator.allocate(
+                        partitions,
+                        replication_factor,
+                        next_group,
+                        exclude=self._draining_nodes(),
+                    )
+                except AllocationError as e:
+                    raise TopicError(
+                        "invalid_replication_factor", str(e)
+                    ) from None
             self._local_next_group = next_group + partitions
             cmd = CreateTopicCmd(
                 ns=ns,
@@ -889,8 +912,19 @@ class Controller:
                     next_group,
                     exclude=self._muted_nodes(),
                 )
-            except AllocationError as e:
-                raise TopicError("invalid_replication_factor", str(e)) from None
+            except AllocationError:
+                # soft maintenance mute: same fallback as create_topic
+                try:
+                    assignments = self.allocator.allocate(
+                        add,
+                        md.replication_factor,
+                        next_group,
+                        exclude=self._draining_nodes(),
+                    )
+                except AllocationError as e:
+                    raise TopicError(
+                        "invalid_replication_factor", str(e)
+                    ) from None
             self._local_next_group = next_group + add
             cmd = CreatePartitionsCmd(
                 ns=ns,
@@ -1467,6 +1501,7 @@ class Controller:
         ]
         if not draining:
             return
+        muted = self._muted_nodes()  # supersets draining; computed once
         for nid in draining:
             moved = False
             for tp_ns, md in list(self.topic_table.topics().items()):
@@ -1476,8 +1511,7 @@ class Controller:
                     if nid not in a.replicas:
                         continue
                     repl = self.allocator.pick_replacement(
-                        a.replicas,
-                        exclude=set(draining) | self._muted_nodes(),
+                        a.replicas, exclude=muted
                     )
                     if repl is None:
                         continue  # this partition is stuck; try others

@@ -492,3 +492,68 @@ def test_maintenance_mode_drains_leadership_keeps_replicas(tmp_path):
             await client.close()
 
     asyncio.run(main())
+
+
+def test_maintenance_guards(tmp_path):
+    """STM-side invariants: maintenance never overwrites a decommission,
+    recommission never clears maintenance, and topic creation falls
+    back to soft-muting when RF needs every node."""
+    import asyncio
+
+    from test_admin_server import cluster
+
+    async def main():
+        async with cluster(tmp_path, n=3) as brokers:
+            c0 = brokers[0].controller
+            deadline = asyncio.get_event_loop().time() + 15
+            while any(
+                c0.members_table.get(n) is None for n in (0, 1, 2)
+            ):
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.1)
+            from redpanda_tpu.cluster.members import MembershipState
+
+            # RF == cluster size still creatable during maintenance
+            await c0.set_maintenance(2, True)
+            await _wait_state(brokers, 2, MembershipState.maintenance)
+            await c0.create_topic("soft", partitions=1, replication_factor=3)
+            md = c0.topic_table.get(
+                __import__("redpanda_tpu.models.fundamental",
+                           fromlist=["TopicNamespace"]).TopicNamespace(
+                    "kafka", "soft"
+                )
+            )
+            assert md is not None and len(md.assignments[0].replicas) == 3
+
+            # recommission must NOT clear maintenance
+            await c0.recommission_node(2)
+            await asyncio.sleep(0.3)
+            assert (
+                c0.members_table.get(2).state == MembershipState.maintenance
+            )
+            await c0.set_maintenance(2, False)
+            await _wait_state(brokers, 2, MembershipState.active)
+
+            # maintenance must NOT overwrite draining
+            await c0.decommission_node(2)
+            await _wait_state(brokers, 2, MembershipState.draining)
+            # route the enable through a FOLLOWER view (the stale-view
+            # race the STM guard closes)
+            try:
+                await brokers[1].controller.set_maintenance(2, True)
+            except Exception:
+                pass
+            await asyncio.sleep(0.3)
+            assert (
+                c0.members_table.get(2).state == MembershipState.draining
+            )
+
+    async def _wait_state(brokers, nid, state):
+        import asyncio
+
+        deadline = asyncio.get_event_loop().time() + 15
+        while brokers[0].controller.members_table.get(nid).state != state:
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.1)
+
+    asyncio.run(main())

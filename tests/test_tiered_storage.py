@@ -174,6 +174,14 @@ async def _recovery(tmp_path):
     # the object store
     async with tiered_broker(tmp_path / "fresh", store) as b2:
         await b2.recover_topic_from_cloud("rt")
+        # the controller backend materializes the partition from the
+        # replicated delta asynchronously: wait, don't race it
+        deadline = asyncio.get_event_loop().time() + 15.0
+        while b2.partition_manager.get(kafka_ntp("rt", 0)) is None:
+            assert asyncio.get_event_loop().time() < deadline, (
+                "recovered partition never materialized"
+            )
+            await asyncio.sleep(0.05)
         p2 = b2.partition_manager.get(kafka_ntp("rt", 0))
         assert p2 is not None
 
