@@ -90,6 +90,10 @@ class AdminServer(HttpServer):
         r("PUT", r"/v1/loggers/([\w.\-]+)", self._set_log_level)
         # -- r3 additions toward admin_server.cc route parity ----------
         r("GET", r"/v1/usage", self._usage)
+        r("GET", r"/v1/brokers/(\d+)", self._broker_detail)
+        r("GET", r"/v1/node_config", self._node_config)
+        r("GET", r"/v1/raft/(\d+)/status", self._raft_status)
+        r("GET", r"/v1/transactions", self._transactions)
         r("GET", r"/v1/partitions", self._list_partitions)
         r("GET", r"/v1/cluster/partition_balancer/status",
           self._balancer_status)
@@ -533,6 +537,107 @@ class AdminServer(HttpServer):
         return self.broker.stats_reporter.report()
 
     # -- r3 additions toward admin_server.cc route parity --------------
+    async def _broker_detail(self, m, _q, _b):
+        """Single-broker view (admin_server.cc get_broker)."""
+        nid = int(m.group(1))
+        ctrl = self.broker.controller
+        ep = ctrl.members_table.get(nid)
+        if ep is None and nid not in ctrl.members_table:
+            raise HttpError(404, f"unknown broker {nid}")
+        leads = sum(
+            1
+            for p in self.broker.partition_manager.partitions().values()
+            if p.is_leader
+        ) if nid == self.broker.node_id else None
+        return {
+            "node_id": nid,
+            "membership_status": ep.state.value if ep else "unregistered",
+            "is_alive": self.broker.node_status.is_alive(nid),
+            "internal_rpc": list(ep.rpc_addr) if ep else None,
+            "kafka_api": list(ep.kafka_addr) if ep else None,
+            "rack": (ep.rack or None) if ep else None,
+            "logical_version": ep.logical_version if ep else None,
+            "local_leaderships": leads,
+        }
+
+    async def _node_config(self, _m, _q, _b):
+        """This node's effective BrokerConfig (node_config admin view);
+        secret-bearing fields are never included."""
+        import dataclasses as _dc
+
+        cfg = self.broker.config
+        redact = {
+            "kafka_tls_key",
+            "superusers",
+            "cloud_storage_access_key",
+            "cloud_storage_secret_key",
+        }
+        out = {}
+        for f in _dc.fields(cfg):
+            if f.name in redact:
+                continue
+            v = getattr(cfg, f.name)
+            if isinstance(v, (str, int, float, bool, type(None), list)):
+                out[f.name] = v
+            elif isinstance(v, dict):
+                out[f.name] = {str(k): str(x) for k, x in v.items()}
+        return out
+
+    async def _raft_status(self, m, _q, _b):
+        """Per-group raft state on this node (raft admin routes /
+        debug partition view)."""
+        gid = int(m.group(1))
+        c = self.broker.group_manager.get(gid)
+        if c is None:
+            raise HttpError(404, f"group {gid} not on this node")
+        offs = c.log.offsets()
+        return {
+            "group": gid,
+            "role": c.role.name,
+            "term": c.term,
+            "leader_id": c.leader_id,
+            "commit_index": c.commit_index,
+            "dirty_offset": offs.dirty_offset,
+            "flushed_offset": offs.committed_offset,
+            "log_start": offs.start_offset,
+            "snapshot_index": c.snapshot_index,
+            "voters": list(c.config.voters),
+            "learners": list(c.config.learners),
+            "joint": c.config.is_joint(),
+        }
+
+    async def _transactions(self, _m, _q, _b):
+        """Transactional-id registry over the tx partitions this
+        broker LEADS (admin_server.cc get_all_transactions), through
+        the coordinator's replay-aware listing — a fresh broker
+        hydrates from the tx log instead of answering from an empty
+        cache."""
+        tx = getattr(self.broker, "tx_coordinator", None)
+        if tx is None:
+            return {"transactions": [], "complete": True}
+        metas, complete = await tx.list_local_txs()
+        return {
+            "complete": complete,
+            "transactions": [
+                {
+                    "transactional_id": meta.tx_id,
+                    "producer_id": meta.pid,
+                    "producer_epoch": meta.epoch,
+                    "status": meta.status,
+                    "timeout_ms": meta.timeout_ms,
+                    "partitions": [
+                        f"{n.ns}/{n.topic}/{n.partition}"
+                        for n in sorted(
+                            meta.partitions,
+                            key=lambda n: (n.ns, n.topic, n.partition),
+                        )
+                    ],
+                    "groups": sorted(meta.groups),
+                }
+                for meta in metas
+            ],
+        }
+
     async def _usage(self, _m, _q, _b):
         """Usage accounting (admin_server.cc usage/ + kvstore usage
         keyspace intent): bytes/requests served plus on-disk footprint."""

@@ -370,3 +370,53 @@ async def _r3_routes(tmp_path):
 
 def test_r3_routes(tmp_path):
     asyncio.run(_r3_routes(tmp_path))
+
+
+async def _r3b_routes(tmp_path):
+    """Broker detail, node config, raft group status, transactions."""
+    async with cluster(tmp_path, n=3) as brokers:
+        b = brokers[0]
+        client = KafkaClient([x.kafka_advertised for x in brokers])
+        await client.create_topic("ad2", partitions=1, replication_factor=3)
+        await client.produce("ad2", 0, [(b"k", b"v")])
+        addr = b.admin.address
+
+        # broker detail (wait for self-registration)
+        deadline = asyncio.get_event_loop().time() + 15
+        while b.controller.members_table.get(0) is None:
+            assert asyncio.get_event_loop().time() < deadline
+            await asyncio.sleep(0.1)
+        st, det = await http(addr, "GET", "/v1/brokers/0")
+        assert st == 200 and det["node_id"] == 0
+        assert det["membership_status"] == "active"
+        st, _ = await http(addr, "GET", "/v1/brokers/99")
+        assert st == 404
+
+        st, cfg = await http(addr, "GET", "/v1/node_config")
+        assert st == 200 and cfg["node_id"] == 0
+        for secret in (
+            "kafka_tls_key",
+            "cloud_storage_access_key",
+            "cloud_storage_secret_key",
+        ):
+            assert secret not in cfg  # secrets redacted
+
+        ntp = kafka_ntp("ad2", 0)
+        gid = b.controller.topic_table.group_of(ntp)
+        st, rs = await http(addr, "GET", f"/v1/raft/{gid}/status")
+        assert st == 200
+        assert rs["group"] == gid and rs["role"] in (
+            "LEADER", "FOLLOWER", "CANDIDATE",
+        )
+        assert set(rs["voters"]) == {0, 1, 2}
+        st, _ = await http(addr, "GET", "/v1/raft/999999/status")
+        assert st == 404
+
+        st, txs = await http(addr, "GET", "/v1/transactions")
+        assert st == 200 and isinstance(txs["transactions"], list)
+        assert txs["complete"] is True
+        await client.close()
+
+
+def test_r3b_routes(tmp_path):
+    asyncio.run(_r3b_routes(tmp_path))
