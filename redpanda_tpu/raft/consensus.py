@@ -34,7 +34,7 @@ from ..models.record import RecordBatch, RecordBatchBuilder, RecordBatchType
 from ..models.consensus_state import SELF_SLOT
 from ..models.fundamental import NO_OFFSET
 from ..storage import snapshot as snapfmt
-from ..storage.kvstore import KeySpace, KvStore
+from ..storage.kvstore import KeySpace, KvStore, KvStoreClosed
 from ..storage.log import Log
 from ..utils import serde
 from . import quorum_scalar as qs
@@ -160,9 +160,17 @@ class Consensus:
             self.config = GroupConfiguration.decode(raw)
 
     def _persist_config(self) -> None:
-        self._kvstore.put(
-            KeySpace.consensus, self._config_key(), self.config.encode()
-        )
+        try:
+            self._kvstore.put(
+                KeySpace.consensus, self._config_key(), self.config.encode()
+            )
+        except KvStoreClosed:
+            # append racing shutdown: the kvstore copy is a cache — the
+            # config is re-derived from the log's config batches at
+            # boot (_hydrate_config_history), so skipping is safe; a
+            # closed store outside shutdown is a real bug
+            if not self._closed:
+                raise
 
     def _observe_append(self, batch: RecordBatch) -> None:
         """Log-append hook: raft requires configs take effect the
@@ -254,6 +262,9 @@ class Consensus:
             self._voted_for = st.voted_for if st.voted_for >= 0 else None
 
     def _persist_vote_state(self) -> None:
+        # NOTE: persistence failures MUST propagate — handle_vote must
+        # never reply granted for a vote that was not made durable
+        # (one-vote-per-term is exactly what the persistence protects)
         st = _VoteState(
             term=int(self.term),
             voted_for=self._voted_for if self._voted_for is not None else -1,
@@ -550,7 +561,14 @@ class Consensus:
             self.arrays.term[row] = self.term + 1
             term = self.term
             self._voted_for = self.node_id
-            self._persist_vote_state()
+            try:
+                self._persist_vote_state()
+            except KvStoreClosed:
+                # our OWN candidacy racing broker shutdown: abort before
+                # any RPC goes out (nothing was granted to anyone).
+                # handle_vote deliberately has no such catch — a voter
+                # that cannot persist must error, not grant.
+                return False
             offs = self.log.offsets()
             req = rt.VoteRequest(
                 group=self.group_id,

@@ -50,6 +50,10 @@ def _encode_entry(ks: int, key: bytes, value: bytes | None) -> bytes:
     return _ENTRY_HDR.pack(crc32c(body), len(body)) + body
 
 
+class KvStoreClosed(RuntimeError):
+    """Write attempted after close() (shutdown-racing fibers)."""
+
+
 class KvStore:
     """Synchronous core; the shard runtime calls it from its executor."""
 
@@ -137,11 +141,19 @@ class KvStore:
 
     def put(self, ks: KeySpace, key: bytes, value: bytes) -> None:
         with self._lock:
+            if self._wal.closed:
+                # fibers racing a shutdown (election loops persisting
+                # vote state while the broker stops) must fail with a
+                # clear signal, not "write to closed file" noise — and
+                # the in-memory map must NOT diverge from the WAL
+                raise KvStoreClosed("kvstore is closed")
             self._map[(int(ks), key)] = value
             self._append_wal(_encode_entry(int(ks), key, value))
 
     def remove(self, ks: KeySpace, key: bytes) -> None:
         with self._lock:
+            if self._wal.closed:
+                raise KvStoreClosed("kvstore is closed")
             self._map.pop((int(ks), key), None)
             self._append_wal(_encode_entry(int(ks), key, None))
 
