@@ -300,12 +300,18 @@ class ShardGroupArrays:
             last_seq=jnp.asarray(self.last_seq),
         )
 
-    # device wins once the state no longer fits a few host cache lines
-    # and the transfer amortizes; below this row count the vectorized
-    # numpy fold (identical math, differentially tested) is faster than
-    # shipping the SoA to the device every tick. Overridable for tests
-    # and benches via RP_QUORUM_BACKEND=host|device.
-    DEVICE_THRESHOLD_ROWS = 16_384
+    # MEASURED, not asserted (tools/measure_quorum_crossover.py,
+    # report in bench_profiles/quorum_crossover.txt): on the axon
+    # tunnel the device full-fold loses at EVERY tested size — the
+    # per-tick SoA re-upload is transfer-bound (0.5 ms host vs 460 ms
+    # device at 1k groups; 54 ms vs 5.7 s at 128k). The host fold is
+    # therefore the DEFAULT everywhere; RP_QUORUM_BACKEND=device opts
+    # in for locally attached chips, where the resident-kernel rates
+    # apply and this threshold is the guidance for when the transfer
+    # amortizes. The math is differentially tested identical either
+    # way, and steady-state ticks skip the fold entirely (incremental
+    # sweep).
+    DEVICE_THRESHOLD_ROWS = 16_384  # resident-chip guidance only
 
     def _backend(self) -> str:
         import os
@@ -313,7 +319,7 @@ class ShardGroupArrays:
         forced = os.environ.get("RP_QUORUM_BACKEND")
         if forced in ("host", "device"):
             return forced
-        return "device" if self._cap > self.DEVICE_THRESHOLD_ROWS else "host"
+        return "host"
 
     @staticmethod
     def _masked_quorum_np(
@@ -464,9 +470,11 @@ class ShardGroupArrays:
         seqs: np.ndarray,
     ) -> np.ndarray:
         """Fold a reply batch + advance every group's commit in ONE
-        call. Dispatches to the vectorized host fold below
-        DEVICE_THRESHOLD_ROWS (see _backend) and to the compiled
-        device program above it. Returns rows whose commit advanced.
+        call. The HOST fold is the default at every size (measured:
+        the device full-fold is transfer-bound on this link — see
+        _backend); RP_QUORUM_BACKEND=device routes to the compiled
+        device program for locally attached chips. Returns rows whose
+        commit advanced.
 
         The reply batch is padded to power-of-two buckets so XLA
         compiles a handful of shapes total, not one per reply count;

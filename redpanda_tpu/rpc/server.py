@@ -95,8 +95,11 @@ class RpcServer:
         self.dispatcher.register(service)
 
     async def start(self) -> None:
+        # 2 MiB stream high-water: append_entries/recovery rounds ship
+        # ~1 MiB payloads; the 64 KiB default drowns them in
+        # pause/resume churn (same fix as the kafka listener)
         self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port
+            self._handle_conn, self.host, self.port, limit=1 << 21
         )
         self.port = self._server.sockets[0].getsockname()[1]
 

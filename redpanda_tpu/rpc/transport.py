@@ -54,7 +54,7 @@ class TcpTransport:
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port
+            self.host, self.port, limit=1 << 21
         )
         self._reader_task = asyncio.ensure_future(self._read_loop())
 
