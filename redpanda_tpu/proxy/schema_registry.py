@@ -10,10 +10,12 @@ writer won the slot — exactly the seq_writer protocol.
 
 Compatibility checking implements the Avro-record structural subset
 (field add/remove with defaults, recursive type equality) for
-schemaType=AVRO and structural PROTOBUF checks over an in-tree
-descriptor parser (protobuf_compat.py — wire-kind, label, oneof and
-message-removal rules per protobuf.cc); JSON schemas support NONE and
-exact-equality levels only.
+schemaType=AVRO, structural PROTOBUF checks over an in-tree descriptor
+parser (protobuf_compat.py — wire-kind, label, oneof and
+message-removal rules per protobuf.cc), and JSON Schema
+permissiveness-subset checks (json_compat.py — type/enum/bound
+narrowing, required additions, closed additionalProperties; exotic
+keywords fail closed to equality).
 """
 
 from __future__ import annotations
@@ -143,8 +145,20 @@ def compatible(level: str, new: dict, olds: list[dict]) -> bool:
                 # the only known-safe check rather than erroring the
                 # whole subject
                 return new["canonical"] == old["canonical"]
+        elif new["type"] == "JSON" and old["type"] == "JSON":
+            from . import json_compat
+
+            try:
+                n = json.loads(new["canonical"])
+                o = json.loads(old["canonical"])
+                back = not json_compat.check_backward(n, o)
+                fwd = not json_compat.check_backward(o, n)
+            except (json.JSONDecodeError, json_compat.JsonCompatError):
+                # parses as JSON but is not schema-shaped: equality is
+                # the only known-safe check (protobuf-branch pattern)
+                return new["canonical"] == old["canonical"]
         elif new["type"] != "AVRO" or old["type"] != "AVRO":
-            # JSON (and mixed types): only exact equality is known-safe
+            # mixed schema types: only exact equality is known-safe
             return new["canonical"] == old["canonical"]
         else:
             n, o = json.loads(new["canonical"]), json.loads(old["canonical"])
