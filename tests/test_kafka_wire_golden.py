@@ -140,6 +140,54 @@ VECTORS = [
         + arr([i16(0) + i16(0) + i16(9), i16(18) + i16(0) + i16(3)]),
     ),
     (
+        "api_versions_resp_v3_flex",
+        API_VERSIONS, 3, "response",
+        {
+            "error_code": 0,
+            "api_keys": [
+                {"api_key": 0, "min_version": 0, "max_version": 9},
+            ],
+            "throttle_time_ms": 5,
+        },
+        i16(0)
+        + carr([i16(0) + i16(0) + i16(9) + TAG0])
+        + i32(5)
+        + TAG0,
+    ),
+    (
+        "produce_resp_v9_flex",
+        PRODUCE, 9, "response",
+        {
+            "responses": [
+                {
+                    "name": "t",
+                    "partition_responses": [
+                        {
+                            "index": 1,
+                            "error_code": 0,
+                            "base_offset": 77,
+                            "log_append_time_ms": -1,
+                            "log_start_offset": 0,
+                            "record_errors": [],
+                            "error_message": None,
+                        }
+                    ],
+                }
+            ],
+            "throttle_time_ms": 0,
+        },
+        carr([
+            cs("t")
+            + carr([
+                i32(1) + i16(0) + i64(77) + i64(-1) + i64(0)
+                + carr([]) + cs(None) + TAG0
+            ])
+            + TAG0
+        ])
+        + i32(0)
+        + TAG0,
+    ),
+    (
         "metadata_req_v1_null_topics",
         METADATA, 1, "request",
         {"topics": None},
