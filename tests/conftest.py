@@ -25,3 +25,67 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# -- timing-sensitive retry (1-core full-suite interference) -----------
+# This environment has ONE core; the full suite's load occasionally
+# pushes a timing-sensitive multi-broker test past its election/ack
+# windows (each passes in isolation and on idle runs). Tests marked
+# `timing` get exactly one quiet retry after a short drain, so a single
+# scheduling hiccup doesn't fail an -x run; a real regression still
+# fails twice and surfaces.
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "timing: timing-sensitive on the 1-core host; retried once",
+    )
+
+
+def pytest_runtest_protocol(item, nextitem):
+    # (pytest-rerunfailures would express this as @pytest.mark.flaky,
+    # but no packages can be installed in this environment)
+    if item.get_closest_marker("timing") is None:
+        return None
+    import time
+
+    from _pytest.runner import runtestprotocol
+
+    item.ihook.pytest_runtest_logstart(
+        nodeid=item.nodeid, location=item.location
+    )
+    reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    call_failed = any(r.failed for r in reports if r.when == "call")
+    other_failed = any(r.failed for r in reports if r.when != "call")
+    if call_failed and not other_failed:
+        # ONLY a clean call-phase failure earns the quiet retry; a
+        # setup/teardown error is a real resource problem and must
+        # surface unretried
+        first_repr = "\n".join(
+            str(r.longrepr) for r in reports if r.failed
+        )[:4000]
+        time.sleep(1.5)  # let queued loop work drain before the retry
+        reports = runtestprotocol(item, nextitem=nextitem, log=False)
+        # the first attempt's traceback must not vanish: on a green
+        # retry it is the only record of what flaked (and keeps chronic
+        # flakiness countable); on a second failure the two attempts
+        # may have failed DIFFERENTLY and both reprs matter
+        import pytest as _pytest
+
+        verdict = (
+            "first attempt ALSO failed (second repr reported normally)"
+            if any(r.failed for r in reports)
+            else "retry absorbed a call-phase failure"
+        )
+        item.warn(
+            _pytest.PytestWarning(
+                f"timing retry: {verdict}; first attempt:\n{first_repr}"
+            )
+        )
+    for r in reports:
+        item.ihook.pytest_runtest_logreport(report=r)
+    item.ihook.pytest_runtest_logfinish(
+        nodeid=item.nodeid, location=item.location
+    )
+    return True

@@ -154,6 +154,7 @@ async def _admin_surface(tmp_path):
         assert st == 204
 
 
+@pytest.mark.timing
 def test_admin_surface(tmp_path):
     asyncio.run(_admin_surface(tmp_path))
 

@@ -41,6 +41,7 @@ def test_chaos_mixed_faults(tmp_path):
     assert stats["acked"] > 10, stats
 
 
+@pytest.mark.timing
 @pytest.mark.parametrize("seed", [404, 1717])
 def test_chaos_tiered_storage(tmp_path, seed):
     """Faults while archival + retention churn: acked data must stay

@@ -67,6 +67,7 @@ def test_tx_commit_single(tmp_path):
     asyncio.run(_commit_roundtrip(tmp_path, 1))
 
 
+@pytest.mark.timing
 def test_tx_commit_rf3(tmp_path):
     asyncio.run(_commit_roundtrip(tmp_path, 3))
 

@@ -165,6 +165,7 @@ def test_empty_follower_recovers_via_install_snapshot(tmp_path):
     run(main())
 
 
+@pytest.mark.timing
 def test_install_snapshot_discards_divergent_follower_suffix(tmp_path):
     async def main():
         cluster = RaftCluster(tmp_path, n_nodes=3)

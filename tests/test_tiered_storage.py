@@ -201,6 +201,7 @@ async def _recovery(tmp_path):
         await client.close()
 
 
+@pytest.mark.timing
 def test_topic_recovery_from_cloud(tmp_path):
     asyncio.run(_recovery(tmp_path))
 

@@ -7,6 +7,8 @@ don't wedge the stream.
 
 import asyncio
 
+import pytest
+
 from redpanda_tpu.kafka.client import KafkaClient
 from redpanda_tpu.transforms import TransformSpec
 
@@ -261,5 +263,6 @@ async def _failover_continuity(tmp_path):
             assert owner not in owners and len(owners) >= 1
 
 
+@pytest.mark.timing
 def test_transform_failover_continuity(tmp_path):
     asyncio.run(_failover_continuity(tmp_path))
