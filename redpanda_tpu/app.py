@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
+import os
 from typing import Optional
 
 from .cluster import (
@@ -112,6 +113,11 @@ class BrokerConfig:
     cloud_storage_tls: bool = False
     # archival upload pass cadence; <= 0 disables the timer
     archival_interval_s: float = 1.0
+    # disk-backed chunk cache for remote reads (cache_service.cc
+    # cloud_storage_cache_size); 0 disables the disk cache (falls back
+    # to a small in-memory whole-segment LRU)
+    cloud_storage_cache_size_bytes: int = 1 << 30
+    cloud_storage_cache_chunk_size: int = 1 << 20
     # cluster stats report cadence (metrics_reporter analog); <= 0 off
     stats_interval_s: float = 900.0
     # advertise an older feature level (mixed-version upgrade testing;
@@ -264,6 +270,7 @@ class Broker:
         self.scheduler = FairScheduler()
         self.archival = None
         self.remote_reader = None
+        self.cloud_cache = None
         if self.object_store is not None:
             from .cloud import ArchivalService, RemoteReader
             from .cloud.object_store import RetryingStore
@@ -275,7 +282,19 @@ class Broker:
                 interval_s=config.archival_interval_s,
                 sched_group=self.scheduler.group("archival"),
             )
-            self.remote_reader = RemoteReader(RetryingStore(self.object_store))
+            cache = None
+            if config.cloud_storage_cache_size_bytes > 0:
+                from .cloud.cache_service import CloudCache
+
+                cache = CloudCache(
+                    os.path.join(config.data_dir, "cloud_storage_cache"),
+                    max_bytes=config.cloud_storage_cache_size_bytes,
+                    chunk_size=config.cloud_storage_cache_chunk_size,
+                )
+            self.cloud_cache = cache
+            self.remote_reader = RemoteReader(
+                RetryingStore(self.object_store), cache=cache
+            )
             self.controller.on_partition_added = self._maybe_recover_partition
         self._bind_cluster_config()
         self.pandaproxy = None

@@ -145,6 +145,21 @@ class AbsObjectStore:
             raise StoreError(f"abs get {key}: HTTP {status}")
         return body
 
+    async def get_range(self, key: str, start: int, end: int) -> bytes:
+        """Ranged blob GET via x-ms-range (chunk hydration path)."""
+        status, body = await self._request(
+            "GET",
+            self._blob_path(key),
+            extra={"x-ms-range": f"bytes={start}-{end - 1}"},
+        )
+        if status == 404:
+            raise StoreError(f"abs get {key}: not found")
+        if status not in (200, 206):
+            raise StoreError(f"abs get {key} range: HTTP {status}")
+        if status == 200:
+            return body[start:end]
+        return body
+
     async def exists(self, key: str) -> bool:
         status, _ = await self._request("HEAD", self._blob_path(key))
         if status == 200:

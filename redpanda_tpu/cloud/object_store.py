@@ -27,6 +27,8 @@ class ObjectStore(Protocol):
 
     async def get(self, key: str) -> bytes: ...
 
+    async def get_range(self, key: str, start: int, end: int) -> bytes: ...
+
     async def exists(self, key: str) -> bool: ...
 
     async def list(self, prefix: str) -> list[str]: ...
@@ -60,6 +62,13 @@ class MemoryObjectStore:
         if key not in self._data:
             raise StoreError(f"no such key: {key}")
         return self._data[key]
+
+    async def get_range(self, key: str, start: int, end: int) -> bytes:
+        self._maybe_fail()
+        self.get_count += 1
+        if key not in self._data:
+            raise StoreError(f"no such key: {key}")
+        return self._data[key][start:end]
 
     async def exists(self, key: str) -> bool:
         return key in self._data
@@ -100,6 +109,14 @@ class FilesystemObjectStore:
         try:
             with open(path, "rb") as f:
                 return f.read()
+        except FileNotFoundError:
+            raise StoreError(f"no such key: {key}") from None
+
+    async def get_range(self, key: str, start: int, end: int) -> bytes:
+        try:
+            with open(self._path(key), "rb") as f:
+                f.seek(start)
+                return f.read(end - start)
         except FileNotFoundError:
             raise StoreError(f"no such key: {key}") from None
 
@@ -178,6 +195,14 @@ class RetryingStore:
 
     async def get(self, key: str) -> bytes:
         return await self._retry(self._inner.get, key)
+
+    async def get_range(self, key: str, start: int, end: int) -> bytes:
+        ranged = getattr(self._inner, "get_range", None)
+        if ranged is None:
+            # store without range support: fetch whole, slice (correct,
+            # just not bandwidth-optimal)
+            return (await self._retry(self._inner.get, key))[start:end]
+        return await self._retry(ranged, key, start, end)
 
     async def exists(self, key: str) -> bool:
         return await self._retry(self._inner.exists, key)

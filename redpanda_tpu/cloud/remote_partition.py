@@ -1,14 +1,19 @@
 """Read path over archived segments.
 
 Reference: src/v/cloud_storage/remote_partition.{h,cc} +
-remote_segment.{h,cc} (hydrate segment → serve reader) and
-materialized_segments.h (bounded cache of hydrated segments).
+remote_segment.{h,cc} (hydrate segment → serve reader),
+materialized_segments.h (bounded cache of hydrated segments), and
+remote_segment_index.{h,cc} (sparse offset→file-position samples so a
+mid-segment read need not scan from byte 0).
 
 A fetch below the local log start locates the covering segment via the
-manifest (kafka-space bisect using per-segment delta_offset), downloads
-it through a bytes-bounded LRU, and walks its batches re-deriving each
-batch's kafka offset exactly like the local offset translator would —
-filtered (non-data) batches advance the running delta.
+manifest (kafka-space bisect using per-segment delta_offset), hydrates
+only the CHUNKS the scan touches through the disk-backed CloudCache
+(cache_service.{h,cc}), and walks batches re-deriving each batch's
+kafka offset exactly like the local offset translator would — filtered
+(non-data) batches advance the running delta. Each scan deposits
+sparse (kafka_base, file_pos, delta) samples; later reads start from
+the closest sample at-or-before the target instead of byte 0.
 """
 
 from __future__ import annotations
@@ -18,32 +23,89 @@ from collections import OrderedDict
 from typing import Optional
 
 from ..models.record import HEADER_SIZE, RecordBatch, RecordBatchHeader, RecordBatchType
+from .cache_service import CloudCache
 from .manifest import PartitionManifest, SegmentMeta
 from .object_store import ObjectStore, StoreError
 
+INDEX_STRIDE = 128 << 10  # one sample per ~128KiB of segment scanned
+
+
+VIEW_WINDOW = 256 << 10
+
+
+class _SegmentView:
+    """Lazy byte window over one archived segment: reads pull chunks
+    through the CloudCache (or, with no cache, a whole-object LRU).
+    A window of the most recent VIEW_WINDOW bytes is memoized so the
+    sequential batch walk (two small reads per batch) costs one cache
+    access per window, not per read."""
+
+    def __init__(self, reader: "RemoteReader", key: str, size: int):
+        self._r = reader
+        self.key = key
+        self.size = size
+        self._win_start = 0
+        self._win = b""
+
+    async def read(self, pos: int, n: int) -> bytes:
+        if pos >= self.size:
+            return b""
+        end = min(pos + n, self.size)
+        ws = self._win_start
+        if not (ws <= pos and end <= ws + len(self._win)):
+            win_end = min(max(end, pos + VIEW_WINDOW), self.size)
+            self._win = await self._r._read_range(
+                self.key, pos, win_end, self.size
+            )
+            self._win_start = ws = pos
+        off = pos - ws
+        return self._win[off : off + (end - pos)]
+
 
 class RemoteReader:
-    def __init__(self, store: ObjectStore, cache_max_bytes: int = 32 << 20):
+    def __init__(
+        self,
+        store: ObjectStore,
+        cache: Optional[CloudCache] = None,
+        cache_max_bytes: int = 32 << 20,
+    ):
         self.store = store
-        self._cache: OrderedDict[str, bytes] = OrderedDict()
-        self._cache_bytes = 0
-        self._cache_max = cache_max_bytes
+        self.cache = cache
+        # fallback when no disk cache is configured: whole-object LRU
+        self._mem: OrderedDict[str, bytes] = OrderedDict()
+        self._mem_bytes = 0
+        self._mem_max = cache_max_bytes
         self.hydrations = 0
+        # remote_segment_index: key -> sorted [(kafka_base, pos, delta)]
+        self._seg_index: OrderedDict[str, list[tuple[int, int, int]]] = (
+            OrderedDict()
+        )
 
-    # -- segment hydration (remote_segment.cc) ------------------------
-    async def _hydrate(self, key: str) -> bytes:
-        data = self._cache.get(key)
-        if data is not None:
-            self._cache.move_to_end(key)
-            return data
-        data = await self.store.get(key)
-        self.hydrations += 1
-        self._cache[key] = data
-        self._cache_bytes += len(data)
-        while self._cache_bytes > self._cache_max and len(self._cache) > 1:
-            _k, evicted = self._cache.popitem(last=False)
-            self._cache_bytes -= len(evicted)
-        return data
+    # -- hydration ----------------------------------------------------
+    async def _read_range(
+        self, key: str, start: int, end: int, size: int
+    ) -> bytes:
+        if self.cache is not None:
+
+            async def fetch(lo: int, hi: int) -> bytes:
+                # RetryingStore.get_range handles stores without native
+                # range support (whole get + slice)
+                self.hydrations += 1
+                return await self.store.get_range(key, lo, hi)
+
+            return await self.cache.read(key, start, end, size, fetch)
+        data = self._mem.get(key)
+        if data is None:
+            data = await self.store.get(key)
+            self.hydrations += 1
+            self._mem[key] = data
+            self._mem_bytes += len(data)
+            while self._mem_bytes > self._mem_max and len(self._mem) > 1:
+                _k, ev = self._mem.popitem(last=False)
+                self._mem_bytes -= len(ev)
+        else:
+            self._mem.move_to_end(key)
+        return data[start:end]
 
     # -- kafka-space location -----------------------------------------
     @staticmethod
@@ -67,6 +129,48 @@ class RemoteReader:
             return None
         return manifest.segments[i]
 
+    # -- sparse index (remote_segment_index.{h,cc}) -------------------
+    def _index_seek(self, key: str, kafka_offset: int) -> tuple[int, int] | None:
+        """(pos, delta) of the closest indexed batch at-or-before the
+        target kafka offset, or None to scan from the start."""
+        samples = self._seg_index.get(key)
+        if not samples:
+            return None
+        i = bisect.bisect_right(samples, (kafka_offset, 1 << 62, 0)) - 1
+        if i < 0:
+            return None
+        _k, pos, delta = samples[i]
+        return pos, delta
+
+    def _index_add(self, key: str, kbase: int, pos: int, delta: int) -> None:
+        samples = self._seg_index.setdefault(key, [])
+        ent = (kbase, pos, delta)
+        i = bisect.bisect_left(samples, ent)
+        if i < len(samples) and samples[i] == ent:
+            return
+        # stride-gate: keep the index sparse
+        if samples and i > 0 and pos - samples[i - 1][1] < INDEX_STRIDE:
+            return
+        samples.insert(i, ent)
+        self._seg_index.move_to_end(key)
+        while len(self._seg_index) > 256:
+            self._seg_index.popitem(last=False)
+
+    async def invalidate(self, key: str) -> None:
+        """Forget a segment (re-uploaded or merged away): sparse index,
+        in-memory LRU, AND the disk chunk cache — stale chunks under a
+        reused key would otherwise serve old bytes. A read already in
+        flight may re-cache old chunks after this returns; callers that
+        reuse keys must tolerate one CRC-failed read before retry (the
+        archiver avoids the race by never reusing segment keys within
+        a term)."""
+        self._seg_index.pop(key, None)
+        data = self._mem.pop(key, None)
+        if data is not None:
+            self._mem_bytes -= len(data)
+        if self.cache is not None:
+            await self.cache.invalidate(key)
+
     # -- read ---------------------------------------------------------
     async def read_kafka(
         self,
@@ -82,17 +186,27 @@ class RemoteReader:
         consumed = 0
         meta = self.find_segment(manifest, kafka_offset)
         while meta is not None and consumed < max_bytes:
-            try:
-                data = await self._hydrate(manifest.segment_key(meta))
-            except StoreError:
-                break
+            key = manifest.segment_key(meta)
+            view = _SegmentView(self, key, int(meta.size_bytes))
             delta = int(meta.delta_offset)
             pos = 0
-            while pos + HEADER_SIZE <= len(data) and consumed < max_bytes:
-                header = RecordBatchHeader.unpack(data[pos : pos + HEADER_SIZE])
+            seek = self._index_seek(key, kafka_offset)
+            if seek is not None:
+                pos, delta = seek
+            last_sample_pos = pos
+            hydration_failed = False
+            while pos + HEADER_SIZE <= view.size and consumed < max_bytes:
+                try:
+                    hdr_bytes = await view.read(pos, HEADER_SIZE)
+                except StoreError:
+                    hydration_failed = True
+                    break
+                if len(hdr_bytes) < HEADER_SIZE:
+                    break
+                header = RecordBatchHeader.unpack(hdr_bytes)
                 if (
                     header.size_bytes < HEADER_SIZE
-                    or pos + header.size_bytes > len(data)
+                    or pos + header.size_bytes > view.size
                 ):
                     break
                 if header.type != RecordBatchType.raft_data:
@@ -101,19 +215,37 @@ class RemoteReader:
                     continue
                 kbase = header.base_offset - delta
                 klast = kbase + header.last_offset_delta
+                if pos - last_sample_pos >= INDEX_STRIDE or pos == 0:
+                    self._index_add(key, kbase, pos, delta)
+                    last_sample_pos = pos
                 if upto_kafka is not None and kbase >= upto_kafka:
                     return out
                 if klast >= kafka_offset:
-                    batch = RecordBatch(
-                        header, data[pos + HEADER_SIZE : pos + header.size_bytes]
-                    )
+                    try:
+                        body = await view.read(
+                            pos + HEADER_SIZE, header.size_bytes - HEADER_SIZE
+                        )
+                    except StoreError:
+                        hydration_failed = True
+                        break
+                    if len(body) != header.size_bytes - HEADER_SIZE:
+                        # object shorter than the manifest promised
+                        # (truncated upload): partial results, like a
+                        # short header read — not a CRC error
+                        hydration_failed = True
+                        break
+                    batch = RecordBatch(header, body)
                     if not batch.verify_crc():
+                        # corruption, not unavailability: surface it
                         raise StoreError(
-                            f"archived batch CRC mismatch at {header.base_offset}"
+                            f"archived batch CRC mismatch at "
+                            f"{header.base_offset}"
                         )
                     out.append((kbase, batch))
                     consumed += header.size_bytes
                 pos += header.size_bytes
+            if hydration_failed:
+                break
             # next segment in offset order
             idx = manifest.segments.index(meta)
             meta = (

@@ -95,10 +95,12 @@ class S3ObjectStore:
         await self._http.close()
 
     async def _request(
-        self, method: str, path: str, body: bytes = b""
+        self, method: str, path: str, body: bytes = b"", extra=None
     ) -> tuple[int, bytes]:
         creds = await self._creds.get()
         headers = {"host": f"{self._http.host}:{self._http.port}"}
+        if extra:
+            headers.update(extra)
         signed = sign_request(
             creds.access_key,
             creds.secret_key,
@@ -136,6 +138,24 @@ class S3ObjectStore:
             raise StoreError(f"s3 get {key}: not found")
         if status != 200:
             raise StoreError(f"s3 get {key}: HTTP {status}")
+        return body
+
+    async def get_range(self, key: str, start: int, end: int) -> bytes:
+        """RFC 9110 ranged GET (chunk hydration path; the reference's
+        remote_segment chunk API issues the same Range requests). The
+        Range header participates in the sigv4 canonical headers."""
+        status, body = await self._request(
+            "GET",
+            self._key_path(key),
+            extra={"range": f"bytes={start}-{end - 1}"},
+        )
+        if status == 404:
+            raise StoreError(f"s3 get {key}: not found")
+        if status not in (200, 206):
+            raise StoreError(f"s3 get {key} range: HTTP {status}")
+        if status == 200:
+            # server ignored the Range header: slice locally
+            return body[start:end]
         return body
 
     async def exists(self, key: str) -> bool:

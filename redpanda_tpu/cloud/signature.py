@@ -107,7 +107,7 @@ def sign_request(
     signed = sorted(
         (k.lower(), " ".join(v.split()))
         for k, v in out.items()
-        if k.lower() in ("host", "content-type")
+        if k.lower() in ("host", "content-type", "range")
         or k.lower().startswith("x-amz-")
     )
     sig, names = _signature(

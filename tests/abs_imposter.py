@@ -113,6 +113,18 @@ class AbsImposter:
         if method == "GET" and blob:
             if blob not in self.blobs:
                 return 404, {}, b""
+            rng = headers.get("x-ms-range", "")
+            if rng.startswith("bytes="):
+                lo, _, hi = rng[6:].partition("-")
+                data = self.blobs[blob]
+                s, e = int(lo), min(int(hi), len(data) - 1)
+                if s >= len(data):
+                    return 416, {}, b""
+                return (
+                    206,
+                    {"content-range": f"bytes {s}-{e}/{len(data)}"},
+                    data[s : e + 1],
+                )
             return 200, {}, self.blobs[blob]
         if method == "HEAD" and blob:
             if blob not in self.blobs:

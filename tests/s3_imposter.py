@@ -136,6 +136,18 @@ class S3Imposter:
         if method == "GET" and key:
             if key not in self.objects:
                 return 404, {}, b"<Error><Code>NoSuchKey</Code></Error>"
+            rng = headers.get("range", "")
+            if rng.startswith("bytes="):
+                lo, _, hi = rng[6:].partition("-")
+                obj = self.objects[key]
+                s, e = int(lo), min(int(hi), len(obj) - 1)
+                if s >= len(obj):
+                    return 416, {}, b""
+                return (
+                    206,
+                    {"content-range": f"bytes {s}-{e}/{len(obj)}"},
+                    obj[s : e + 1],
+                )
             return 200, {}, self.objects[key]
         if method == "HEAD" and key:
             if key not in self.objects:
