@@ -118,6 +118,11 @@ class BrokerConfig:
     # to a small in-memory whole-segment LRU)
     cloud_storage_cache_size_bytes: int = 1 << 30
     cloud_storage_cache_chunk_size: int = 1 << 20
+    # adjacent-segment merging (archival housekeeping): archived
+    # segments smaller than min are merged into objects up to target;
+    # 0 disables (opt-in, like cloud_storage_enable_segment_merging)
+    cloud_storage_segment_merge_min_bytes: int = 0
+    cloud_storage_segment_merge_target_bytes: int = 16 << 20
     # cluster stats report cadence (metrics_reporter analog); <= 0 off
     stats_interval_s: float = 900.0
     # advertise an older feature level (mixed-version upgrade testing;
@@ -281,6 +286,10 @@ class Broker:
                 topic_table=self.controller.topic_table,
                 interval_s=config.archival_interval_s,
                 sched_group=self.scheduler.group("archival"),
+                merge_min_bytes=config.cloud_storage_segment_merge_min_bytes,
+                merge_target_bytes=(
+                    config.cloud_storage_segment_merge_target_bytes
+                ),
             )
             cache = None
             if config.cloud_storage_cache_size_bytes > 0:
@@ -295,6 +304,7 @@ class Broker:
             self.remote_reader = RemoteReader(
                 RetryingStore(self.object_store), cache=cache
             )
+            self.archival.on_replaced = self.remote_reader.invalidate
             self.controller.on_partition_added = self._maybe_recover_partition
         self._bind_cluster_config()
         self.pandaproxy = None

@@ -51,6 +51,9 @@ class NtpArchiver:
     def __init__(self, partition: "Partition", store: ObjectStore):
         self.partition = partition
         self.store = store
+        # async callback(key) invoked after a replaced segment object is
+        # deleted (remote-reader cache hygiene); set by ArchivalService
+        self.on_replaced: Optional[Callable] = None
         # store-manifest fallback for remote reads before the stm has
         # state (e.g. topic recovery attach before the seed snapshot
         # restores); the property below prefers replicated state
@@ -207,6 +210,124 @@ class NtpArchiver:
             new_start,
         )
 
+    async def housekeeping_pass(
+        self, min_bytes: int, target_bytes: int
+    ) -> int:
+        """Merge ONE run of small adjacent archived segments into a
+        single object (archival/adjacent_segment_merger.cc selection +
+        segment_reupload.cc reupload): many tiny objects make remote
+        reads and manifest scans expensive, so housekeeping compacts
+        them. Bounded to one merge per pass — housekeeping shares the
+        loop with uploads. Ordering: merged object is PUT before the
+        REPLACE commits, old objects are deleted only after the
+        truncated manifest is exported (module upload-before-publish
+        invariant); a crash at any point leaves only orphans, never a
+        manifest entry without its object. Returns merges done (0/1)."""
+        p = self.partition
+        if min_bytes <= 0 or not p.consensus.is_leader():
+            return 0
+        stm = p.archival
+        stm.apply_committed(p.consensus.commit_index)
+        segs = stm.segments
+        i = 0
+        while i < len(segs) - 1:
+            if int(segs[i].size_bytes) >= min_bytes:
+                i += 1
+                continue
+            j = i
+            total = 0
+            while (
+                j < len(segs)
+                and int(segs[j].size_bytes) < min_bytes
+                and total + int(segs[j].size_bytes) <= target_bytes
+                and (
+                    j == i
+                    or int(segs[j].base_offset)
+                    == int(segs[j - 1].last_offset) + 1
+                )
+            ):
+                total += int(segs[j].size_bytes)
+                j += 1
+            run = segs[i:j]
+            if len(run) < 2:
+                i = max(j, i + 1)
+                continue
+            if await self._merge_run(run):
+                return 1
+            # failed run (corrupt object, store hiccup): keep scanning
+            # so one bad run can't livelock merging for the partition
+            i = max(j, i + 1)
+        return 0
+
+    async def _merge_run(self, run: list[SegmentMeta]) -> int:
+        p = self.partition
+        ntp = p.ntp
+        prefix = PartitionManifest.prefix(ntp.ns, ntp.topic, ntp.partition)
+        datas = []
+        try:
+            for m in run:
+                data = await self.store.get(f"{prefix}/{m.name}")
+                if len(data) != int(m.size_bytes):
+                    logger.warning(
+                        "%s: merge aborted: %s is %d bytes, manifest "
+                        "says %d",
+                        ntp,
+                        m.name,
+                        len(data),
+                        m.size_bytes,
+                    )
+                    return 0
+                datas.append(data)
+        except StoreError as e:
+            logger.warning("%s: merge download failed: %s", ntp, e)
+            return 0
+        first, last = run[0], run[-1]
+        merged = SegmentMeta(
+            base_offset=first.base_offset,
+            last_offset=last.last_offset,
+            term=last.term,
+            size_bytes=sum(len(d) for d in datas),
+            base_timestamp=first.base_timestamp,
+            max_timestamp=max(int(m.max_timestamp) for m in run),
+            delta_offset=first.delta_offset,
+            delta_offset_end=last.delta_offset_end,
+            # never collides with a replaced key (those are base-term);
+            # a re-run of the same merge recreates the same name with
+            # identical content, so the orphan window is idempotent
+            name_hint=(
+                f"{first.base_offset}-{last.last_offset}-{last.term}.m.seg"
+            ),
+        )
+        try:
+            await self.store.put(f"{prefix}/{merged.name}", b"".join(datas))
+            await self._replicate_cmd(archival_stm.REPLACE, merged.encode())
+            self.partition.archival.apply_committed(
+                p.consensus.commit_index
+            )
+            await self._export_manifest()
+        except (StoreError, NotLeaderError, ReplicateTimeout) as e:
+            logger.warning("%s: segment merge failed: %s", ntp, e)
+            return 0
+        for m in run:
+            key = f"{prefix}/{m.name}"
+            try:
+                await self.store.delete(key)
+            except StoreError as e:
+                logger.warning(
+                    "%s: failed to delete merged-away %s: %s", ntp, m.name, e
+                )
+            if self.on_replaced is not None:
+                await self.on_replaced(key)
+        logger.info(
+            "%s: merged %d archived segments [%d,%d] into %s",
+            ntp,
+            len(run),
+            int(first.base_offset),
+            int(last.last_offset),
+            merged.name,
+        )
+        return 1
+
     async def upload_pass(self) -> int:
         """One archival round: upload every closed segment whose range
         is fully committed+flushed and above the archived boundary, in
@@ -329,7 +450,15 @@ class ArchivalService:
         topic_table,  # cluster.topic_table.TopicTable
         interval_s: float = 1.0,
         sched_group=None,  # resource_mgmt.SchedulingGroup | None
+        merge_min_bytes: int = 0,  # 0 disables adjacent-segment merging
+        merge_target_bytes: int = 16 << 20,
     ):
+        self.merge_min_bytes = merge_min_bytes
+        self.merge_target_bytes = merge_target_bytes
+        self.merges = 0
+        # async callback(key): invalidate remote-reader caches for a
+        # deleted object key (set by the broker)
+        self.on_replaced: Optional[Callable] = None
         self.store = RetryingStore(store)
         self._partitions = partitions
         self._topic_table = topic_table
@@ -389,7 +518,15 @@ class ArchivalService:
 
             async def unit(ntp=ntp, p=p) -> int:
                 await self._ensure_topic_manifest(ntp.tp_ns)
-                return await self.archiver_for(p).upload_pass()
+                a = self.archiver_for(p)
+                a.on_replaced = self.on_replaced
+                n = await a.upload_pass()
+                # merges are counted separately: callers assert on
+                # upload counts
+                self.merges += await a.housekeeping_pass(
+                    self.merge_min_bytes, self.merge_target_bytes
+                )
+                return n
 
             # one partition's upload pass = one unit through the
             # archival scheduling group (when wired): uploads share the

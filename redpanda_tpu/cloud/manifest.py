@@ -17,6 +17,11 @@ from ..utils import serde
 class SegmentMeta(serde.Envelope):
     """One uploaded segment (partition_manifest.h segment_meta)."""
 
+    # v2 appends name_hint; compat stays 1, so v1 readers accept v2
+    # blobs and skip the tail via the envelope size (decode fills
+    # SERDE_DEFAULTS for the missing field when reading v1 blobs)
+    SERDE_VERSION = 2
+
     SERDE_FIELDS = [
         ("base_offset", serde.i64),  # raft space
         ("last_offset", serde.i64),  # raft space, inclusive
@@ -30,11 +35,17 @@ class SegmentMeta(serde.Envelope):
         # delta through the segment's LAST offset — seeds the offset
         # translator when a partition is recovered from the manifest
         ("delta_offset_end", serde.i64),
+        # merged segments carry an explicit object name so the merged
+        # object NEVER collides with the key of a segment it replaced
+        # (adjacent_segment_merger.cc); "" = derive from base/term
+        ("name_hint", serde.string),
     ]
+
+    SERDE_DEFAULTS = {"name_hint": ""}
 
     @property
     def name(self) -> str:
-        return f"{self.base_offset}-{self.term}.seg"
+        return self.name_hint or f"{self.base_offset}-{self.term}.seg"
 
 
 class PartitionManifest(serde.Envelope):

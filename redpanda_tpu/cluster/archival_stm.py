@@ -36,6 +36,11 @@ RESET = b"reset"
 # drop archived segments entirely below a raft offset (cloud retention:
 # the bucket must not grow forever; value = 8-byte LE new start offset)
 TRUNCATE = b"truncate"
+# replace a contiguous run of archived segments with one merged segment
+# (adjacent_segment_merger/segment_reupload); value = merged
+# SegmentMeta.encode(). Applies ONLY when the merged range exactly
+# spans existing entries — stale or replayed commands no-op.
+REPLACE = b"replace"
 
 
 class _ArchivalStateE(serde.Envelope):
@@ -94,6 +99,35 @@ class ArchivalState:
                 if m.archived_upto > self.archived_upto:
                     self.segments = list(m.segments)
                     self.revision = int(m.revision)
+            elif key == REPLACE and value:
+                merged = SegmentMeta.decode(value)
+                base = int(merged.base_offset)
+                last = int(merged.last_offset)
+                i = next(
+                    (
+                        k
+                        for k, s_ in enumerate(self.segments)
+                        if int(s_.base_offset) == base
+                    ),
+                    None,
+                )
+                if i is None:
+                    return
+                j = i
+                while (
+                    j < len(self.segments)
+                    and int(self.segments[j].last_offset) < last
+                ):
+                    j += 1
+                if (
+                    j >= len(self.segments)
+                    or int(self.segments[j].last_offset) != last
+                ):
+                    return  # range doesn't align with entry boundaries
+                if j == i and self.segments[i].name == merged.name:
+                    return  # replay: already replaced
+                self.segments[i : j + 1] = [merged]
+                self.revision += 1
             elif key == TRUNCATE and value:
                 new_start = int.from_bytes(value, "little", signed=True)
                 before = len(self.segments)

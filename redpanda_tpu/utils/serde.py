@@ -219,7 +219,14 @@ class Envelope:
     def __init__(self, **kwargs: Any):
         names = [n for n, _ in self.SERDE_FIELDS]
         for name in names:
-            setattr(self, name, kwargs.pop(name))
+            if name in kwargs:
+                setattr(self, name, kwargs.pop(name))
+            elif name in self.SERDE_DEFAULTS:
+                # evolved trailing field: constructor parity with the
+                # decode-side default
+                setattr(self, name, self.SERDE_DEFAULTS[name])
+            else:
+                raise TypeError(f"missing field: {name}")
         if kwargs:
             raise TypeError(f"unknown fields: {sorted(kwargs)}")
 
