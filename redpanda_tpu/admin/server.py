@@ -83,6 +83,9 @@ class AdminServer(HttpServer):
         r("POST", r"/v1/debug/fault_injection", self._fault_injection)
         r("DELETE", r"/v1/debug/fault_injection", self._fault_clear)
         r("POST", r"/v1/debug/self_test", self._self_test)
+        r("POST", r"/v1/debug/self_test/start", self._self_test_start)
+        r("POST", r"/v1/debug/self_test/stop", self._self_test_stop)
+        r("GET", r"/v1/debug/self_test/status", self._self_test_status)
         r("GET", r"/v1/debug/scheduler", self._scheduler_stats)
         r("GET", r"/v1/transforms", self._transforms)
         r("GET", r"/v1/features", self._features)
@@ -405,85 +408,46 @@ class AdminServer(HttpServer):
         honey_badger.clear()
         return None
 
-    async def _self_test(self, _m, _q, body):
-        """Disk + network micro-benchmarks on THIS node (reference:
-        cluster/self_test — diskcheck/netcheck run via the admin API).
-        Sized small so the probe itself doesn't disturb a live broker."""
-        import asyncio
-        import os
-        import time
+    async def _self_test_start(self, _m, _q, body):
+        """Start the distributed self-test on every member (reference
+        cluster/self_test_frontend — POST /v1/debug/self_test/start)."""
+        payload = self._json_body(body)
+        return await self.broker.self_test.start(
+            disk_mb=max(1, min(int(payload.get("disk_mb", 16)), 256)),
+            net_mb=max(1, min(int(payload.get("net_mb", 8)), 256)),
+            nodes=payload.get("nodes"),
+        )
 
-        import secrets
+    async def _self_test_stop(self, _m, _q, _body):
+        return await self.broker.self_test.stop()
+
+    async def _self_test_status(self, _m, _q, _body):
+        return await self.broker.self_test.status()
+
+    async def _self_test(self, _m, _q, body):
+        """Synchronous LOCAL disk+network probe on this node (the
+        original single-node form of cluster/self_test). Delegates to
+        the same SelfTestBackend checks the distributed path runs, so
+        there is one implementation of each benchmark."""
+        import asyncio
 
         payload = self._json_body(body)
-        size_mb = min(int(payload.get("disk_mb", 16)), 256)
-        results: dict = {"node_id": self.broker.node_id}
-
-        # diskcheck: sequential write+fsync then read-back on data_dir
-        # (unique name — concurrent probes must not share a file; the
-        # finally guarantees no orphan even on ENOSPC mid-write)
-        path = os.path.join(
-            self.broker.config.data_dir,
-            f".self_test.{secrets.token_hex(6)}.tmp",
-        )
-        block = os.urandom(1 << 20)
+        size_mb = max(1, min(int(payload.get("disk_mb", 16)), 256))
+        backend = self.broker.self_test_backend
         loop = asyncio.get_event_loop()
-
-        def disk() -> dict:
-            try:
-                t0 = time.perf_counter()
-                with open(path, "wb") as f:
-                    for _ in range(size_mb):
-                        f.write(block)
-                    f.flush()
-                    os.fsync(f.fileno())
-                w = time.perf_counter() - t0
-                t0 = time.perf_counter()
-                with open(path, "rb") as f:
-                    while f.read(1 << 20):
-                        pass
-                r = time.perf_counter() - t0
-            finally:
-                try:
-                    os.remove(path)
-                except OSError:
-                    pass
-            return {
-                "write_mbps": round(size_mb / w, 1),
-                "read_mbps": round(size_mb / r, 1),
-                "size_mb": size_mb,
-            }
-
-        results["disk"] = await loop.run_in_executor(None, disk)
-
-        # netcheck: concurrent per-peer RTT sampling — dead peers cost
-        # ONE timeout for the whole check, not one each
-        from ..cluster.node_status import NODE_PING, _Ping
-
-        req = _Ping(node_id=self.broker.node_id).encode()
-
-        async def probe(peer: int) -> tuple[str, dict]:
-            samples = []
-            for _ in range(5):
-                t0 = time.perf_counter()
-                try:
-                    await self.broker.send_rpc(peer, NODE_PING, req, 2.0)
-                except Exception:
-                    return str(peer), {"error": "unreachable"}
-                samples.append((time.perf_counter() - t0) * 1e3)
-            return str(peer), {
-                "rtt_ms_min": round(min(samples), 3),
-                "rtt_ms_avg": round(sum(samples) / len(samples), 3),
-            }
-
+        results: dict = {"node_id": self.broker.node_id}
+        results["disk"] = await loop.run_in_executor(
+            None, backend._diskcheck, size_mb
+        )
         peers = [
             p
             for p in self.broker.controller.members
             if p != self.broker.node_id
         ]
-        results["network"] = dict(
-            await asyncio.gather(*(probe(p) for p in peers))
+        probes = await asyncio.gather(
+            *(backend._netcheck_peer(p, 1) for p in peers)
         )
+        results["network"] = {str(p): r for p, r in zip(peers, probes)}
         return results
 
     async def _features(self, _m, _q, _b):

@@ -254,6 +254,25 @@ class Broker:
             interval_s=config.node_status_interval_s,
         )
         self.node_status_service = NodeStatusService(config.node_id)
+        from .cluster.self_test import (
+            SelfTestBackend,
+            SelfTestFrontend,
+            SelfTestService,
+        )
+
+        self.self_test_backend = SelfTestBackend(
+            config.node_id,
+            config.data_dir,
+            send,
+            peers=lambda: self.controller.members,
+        )
+        self.self_test = SelfTestFrontend(
+            config.node_id,
+            self.self_test_backend,
+            send,
+            members=lambda: self.controller.members,
+        )
+        self._self_test_service = SelfTestService(self.self_test_backend)
         self.health_monitor = HealthMonitor(self)
         from .cluster.stats_reporter import StatsReporter
 
@@ -486,6 +505,7 @@ class Broker:
             self.metadata_dissemination.service,
             self.tx_coordinator.service,
             self.node_status_service,
+            self._self_test_service,
         ):
             if self._rpc_server is not None:
                 self._rpc_server.register(svc)
@@ -596,6 +616,7 @@ class Broker:
                 pass
             self._join_task = None
         await self.node_status.stop()
+        await self.self_test_backend.stop()
         await self.transforms.stop()
         await self.stats_reporter.stop()
         if self.pandaproxy is not None:

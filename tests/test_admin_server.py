@@ -294,6 +294,67 @@ def test_self_test(tmp_path):
     asyncio.run(_self_test(tmp_path))
 
 
+async def _self_test_distributed(tmp_path):
+    """Cluster-wide start/status/stop (self_test_frontend/backend over
+    internal RPC): any node coordinates, every node runs, reports
+    aggregate, double-start conflicts, stop cancels."""
+    async with cluster(tmp_path, n=3) as brokers:
+        addr = brokers[0].admin.address
+        st, body = await http(
+            addr, "POST", "/v1/debug/self_test/start",
+            {"disk_mb": 2, "net_mb": 1},
+        )
+        assert st == 200, body
+        test_id = body["test_id"]
+        assert all(n["ok"] for n in body["nodes"].values()), body
+
+        # a second start while the first still runs must report
+        # per-node conflicts (the 2MB disk check cannot finish between
+        # the two back-to-back requests)
+        st, body2 = await http(
+            addr, "POST", "/v1/debug/self_test/start", {"disk_mb": 2}
+        )
+        conflicts = [n for n in body2["nodes"].values() if not n["ok"]]
+        assert conflicts, body2
+        assert all("already running" in n["error"] for n in conflicts)
+
+        for _ in range(200):
+            st, status = await http(addr, "GET", "/v1/debug/self_test/status")
+            assert st == 200
+            if all(n["status"] == "idle" for n in status):
+                break
+            await asyncio.sleep(0.05)
+        assert {n["node_id"] for n in status} == {0, 1, 2}
+        # whichever test ran LAST on each node, its report is complete
+        for n in status:
+            rep = n["report"]
+            assert rep["disk"]["write_mbps"] > 0
+            others = {str(p) for p in (0, 1, 2) if p != n["node_id"]}
+            assert set(rep["network"]) == others
+            for peer in others:
+                assert rep["network"][peer]["throughput_mbps"] > 0
+
+        # stop on an idle cluster is a clean no-op
+        st, body = await http(addr, "POST", "/v1/debug/self_test/stop")
+        assert st == 200
+        assert all(n["ok"] for n in body.values())
+
+        # a FOLLOWER-coordinated run works too (state is per-backend)
+        st, body = await http(
+            brokers[1].admin.address, "POST", "/v1/debug/self_test/start",
+            {"disk_mb": 1, "net_mb": 1},
+        )
+        assert st == 200 and body["test_id"] != test_id
+        st, body = await http(
+            brokers[1].admin.address, "POST", "/v1/debug/self_test/stop"
+        )
+        assert st == 200
+
+
+def test_self_test_distributed(tmp_path):
+    asyncio.run(_self_test_distributed(tmp_path))
+
+
 async def _features(tmp_path):
     async with cluster(tmp_path, n=3) as brokers:
         # activation needs every member registered + the leader's pass
