@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import signal
 import socket
 import sys
@@ -93,16 +94,71 @@ def node_id_from_hostname() -> int:
     return int(tail)
 
 
+def _stable_node_uuid(data_dir: str) -> str:
+    """Node identity that survives restarts (cluster_discovery.cc keeps
+    it in the kvstore; a file is equivalent for the pre-start phase)."""
+    import secrets
+
+    os.makedirs(data_dir, exist_ok=True)
+    path = os.path.join(data_dir, "node_uuid")
+    try:
+        with open(path) as f:
+            got = f.read().strip()
+            if got:
+                return got
+    except OSError:
+        pass
+    uuid = secrets.token_hex(16)
+    with open(path, "w") as f:
+        f.write(uuid)
+    return uuid
+
+
+async def _discover_node_id(
+    peers: dict[int, tuple[str, int]], data_dir: str
+) -> int:
+    """Ask the seeds for this node's reserved id (idempotent: keyed by
+    the stable node uuid) before the broker is constructed."""
+    from .cluster.controller import discover_node_id
+    from .rpc.transport import TcpTransport
+
+    transports = {i: TcpTransport(h, p) for i, (h, p) in peers.items()}
+
+    async def send(node, method_id, payload, timeout):
+        t = transports[node]
+        if not t.is_connected():
+            await t.connect()
+        return await t.call(method_id, payload, timeout)
+
+    try:
+        return await discover_node_id(
+            send, list(peers), _stable_node_uuid(data_dir), timeout=60.0
+        )
+    finally:
+        for t in transports.values():
+            try:
+                await t.close()
+            except Exception:
+                pass
+
+
 def build_config(args) -> BrokerConfig:
     node_id = (
         node_id_from_hostname() if args.node_id_from_hostname else args.node_id
     )
-    if node_id is None:
-        raise SystemExit("--node-id or --node-id-from-hostname required")
     peers: dict[int, tuple[str, int]] = {}
     for i, hp in enumerate(s for s in args.seeds.split(",") if s):
         host, _, port = hp.partition(":")
         peers[i] = (host, int(port or 33145))
+    if node_id is None:
+        if not peers:
+            raise SystemExit(
+                "--node-id, --node-id-from-hostname, or --seeds (for "
+                "automatic id assignment) required"
+            )
+        # id-less scale-out node: reserve an id through the seeds
+        node_id = asyncio.run(_discover_node_id(peers, args.data_dir))
+        print(f"assigned node id {node_id} (reserved via seeds)")
     members = sorted(peers) if peers else [node_id]
     if node_id in peers:
         # this node's own listener binds the configured port; its seed

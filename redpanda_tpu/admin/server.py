@@ -82,6 +82,7 @@ class AdminServer(HttpServer):
         r("DELETE", r"/v1/security/users/([^/]+)", self._delete_user)
         r("POST", r"/v1/debug/fault_injection", self._fault_injection)
         r("DELETE", r"/v1/debug/fault_injection", self._fault_clear)
+        r("GET", r"/v1/cluster/uuid", self._cluster_uuid)
         r("POST", r"/v1/debug/self_test", self._self_test)
         r("POST", r"/v1/debug/self_test/start", self._self_test_start)
         r("POST", r"/v1/debug/self_test/stop", self._self_test_stop)
@@ -408,6 +409,11 @@ class AdminServer(HttpServer):
         honey_badger.clear()
         return None
 
+    async def _cluster_uuid(self, _m, _q, _b):
+        """Cluster UUID from genesis (bootstrap_backend; GET
+        /v1/cluster/uuid). Empty until the first leader bootstraps."""
+        return {"cluster_uuid": self.broker.controller.cluster_uuid}
+
     async def _self_test_start(self, _m, _q, body):
         """Start the distributed self-test on every member (reference
         cluster/self_test_frontend — POST /v1/debug/self_test/start)."""
@@ -433,6 +439,7 @@ class AdminServer(HttpServer):
 
         payload = self._json_body(body)
         size_mb = max(1, min(int(payload.get("disk_mb", 16)), 256))
+        net_mb = max(1, min(int(payload.get("net_mb", 1)), 256))
         backend = self.broker.self_test_backend
         loop = asyncio.get_event_loop()
         results: dict = {"node_id": self.broker.node_id}
@@ -445,7 +452,7 @@ class AdminServer(HttpServer):
             if p != self.broker.node_id
         ]
         probes = await asyncio.gather(
-            *(backend._netcheck_peer(p, 1) for p in peers)
+            *(backend._netcheck_peer(p, net_mb) for p in peers)
         )
         results["network"] = {str(p): r for p, r in zip(peers, probes)}
         return results

@@ -35,6 +35,31 @@ class CmdType(enum.IntEnum):
     feature_update = 16
     migration_done = 17
     set_maintenance = 18
+    bootstrap_cluster = 19
+    reserve_node_id = 20
+
+
+class BootstrapClusterCmd(serde.Envelope):
+    """One-shot cluster genesis (reference: cluster/bootstrap_backend.cc
+    apply of bootstrap_cluster_cmd): the first raft0 leader replicates
+    the cluster UUID; first write wins, replays no-op."""
+
+    SERDE_FIELDS = [
+        ("cluster_uuid", serde.string),
+        ("founding_nodes", serde.vector(serde.i32)),
+    ]
+
+
+class ReserveNodeIdCmd(serde.Envelope):
+    """node_uuid -> node_id reservation (members_manager.cc
+    apply_update of add_node_cmd's id allocation): a node without a
+    configured id presents its stable node UUID; retries are idempotent
+    because the mapping is keyed by UUID."""
+
+    SERDE_FIELDS = [
+        ("node_uuid", serde.string),
+        ("node_id", serde.i32),
+    ]
 
 
 class PartitionAssignmentE(serde.Envelope):
@@ -148,7 +173,7 @@ class RegisterNodeCmd(serde.Envelope):
     members_manager.cc apply_update of add_node_cmd /
     update_node_cfg_cmd — one idempotent upsert here)."""
 
-    SERDE_VERSION = 2  # v2 appended rack
+    SERDE_VERSION = 3  # v2 appended rack; v3 cluster_uuid
     SERDE_FIELDS = [
         ("node_id", serde.i32),
         ("rpc_host", serde.string),
@@ -161,8 +186,12 @@ class RegisterNodeCmd(serde.Envelope):
         # across members, so features activate only when every node can
         # serve them
         ("logical_version", serde.i32),
+        # the cluster UUID the joiner believes it is joining; "" =
+        # unknown (fresh node). A non-empty mismatch is rejected so a
+        # node cannot accidentally join the wrong cluster.
+        ("cluster_uuid", serde.string),
     ]
-    SERDE_DEFAULTS = {"rack": "", "logical_version": 1}
+    SERDE_DEFAULTS = {"rack": "", "logical_version": 1, "cluster_uuid": ""}
 
 
 class DecommissionNodeCmd(serde.Envelope):
@@ -251,6 +280,8 @@ CMD_CLASSES = {
     CmdType.feature_update: FeatureUpdateCmd,
     CmdType.migration_done: MigrationDoneCmd,
     CmdType.set_maintenance: SetMaintenanceCmd,
+    CmdType.bootstrap_cluster: BootstrapClusterCmd,
+    CmdType.reserve_node_id: ReserveNodeIdCmd,
 }
 
 

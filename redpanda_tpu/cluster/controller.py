@@ -32,6 +32,8 @@ from ..security import AclStore, Authorizer, CredentialStore
 from ..security.acl import AclBinding, AclBindingE, AclFilter
 from ..security.scram import decode_credential
 from .commands import (
+    BootstrapClusterCmd,
+    ReserveNodeIdCmd,
     AllocateProducerIdCmd,
     CmdType,
     ConfigSetCmd,
@@ -69,6 +71,7 @@ DELETE_TOPIC = 201
 ALLOCATE_PRODUCER_ID = 202
 REPLICATE_CMD = 203  # generic leader-routed controller command
 JOIN_NODE = 204  # node join: register endpoints + add as raft0 voter
+ASSIGN_NODE_ID = 205  # bootstrap: node_uuid -> reserved node id
 
 
 class TopicError(Exception):
@@ -210,6 +213,23 @@ class ControllerStm(StateMachine):
                 )
             elif cmd_type == CmdType.migration_done:
                 self._c.migrations_done.add(cmd.name)
+            elif cmd_type == CmdType.bootstrap_cluster:
+                # first write wins: genesis happens exactly once
+                if not self._c.cluster_uuid:
+                    self._c.cluster_uuid = str(cmd.cluster_uuid)
+            elif cmd_type == CmdType.reserve_node_id:
+                uuid_ = str(cmd.node_uuid)
+                if uuid_ not in self._c.node_uuid_map:
+                    nid = int(cmd.node_id)
+                    taken = set(
+                        self._c.members_table.node_ids()
+                    ) | set(self._c.node_uuid_map.values())
+                    if nid in taken:
+                        # two leaders (or two in-flight reservations)
+                        # raced to the same id: remap deterministically
+                        # — every replica computes the same next-free
+                        nid = max(taken, default=-1) + 1
+                    self._c.node_uuid_map[uuid_] = nid
             elif cmd_type == CmdType.move_replicas:
                 md = self.topic_table.get(TopicNamespace(cmd.ns, cmd.topic))
                 if md is not None:
@@ -303,6 +323,21 @@ class ControllerService(Service):
                 code="not_controller", message="", revision=-1
             ).encode()
 
+    @method(ASSIGN_NODE_ID)
+    async def assign_node_id(self, payload: bytes) -> bytes:
+        node_uuid = payload.decode("utf-8", "replace")
+        try:
+            nid = await self._controller.assign_node_id_local(node_uuid)
+            return _TopicReply(code="", message="", revision=nid).encode()
+        except NotLeaderError:
+            return _TopicReply(
+                code="not_controller", message="", revision=-1
+            ).encode()
+        except Exception as e:
+            return _TopicReply(
+                code="error", message=str(e), revision=-1
+            ).encode()
+
     @method(JOIN_NODE)
     async def join_node(self, payload: bytes) -> bytes:
         cmd = RegisterNodeCmd.decode(payload)
@@ -377,6 +412,11 @@ class Controller:
         # dissemination-fed PartitionLeadersTable after construction)
         self.leaders_table = None
         self._balance_ticks = 0
+        # cluster genesis state (bootstrap_backend): "" until the first
+        # leader replicates the UUID; node_uuid -> reserved node id
+        self.cluster_uuid = ""
+        self.node_uuid_map: dict[str, int] = {}
+        self._reserve_lock = asyncio.Lock()
         self.leader_balancer_enabled = True
         self.partition_balancer_enabled = True
         self._closed = False
@@ -650,6 +690,45 @@ class Controller:
             raise TopicError(reply.code, reply.message)
 
     # -- membership frontends ------------------------------------------
+    async def _bootstrap_pass(self) -> None:
+        """Replicate the cluster UUID once (cluster_discovery.cc
+        create_cluster: the first raft0 leader performs genesis)."""
+        if self.cluster_uuid:
+            return
+        import secrets as _secrets
+
+        cmd = BootstrapClusterCmd(
+            cluster_uuid=_secrets.token_hex(16),
+            founding_nodes=list(self.seeds),
+        )
+        try:
+            await self.replicate_cmd_local(CmdType.bootstrap_cluster, cmd)
+        except Exception:
+            return  # lost leadership / timeout: the next tick retries
+
+    async def assign_node_id_local(self, node_uuid: str) -> int:
+        """Reserve a node id for a stable node UUID (members_manager
+        id allocation). Idempotent: a retry with the same UUID gets
+        the same id."""
+        if self.consensus is None or not self.is_leader:
+            raise NotLeaderError(self.leader_id)
+        async with self._reserve_lock:  # concurrent uuids must not
+            # read the same `taken` set and race to one id
+            existing = self.node_uuid_map.get(node_uuid)
+            if existing is not None:
+                return existing
+            taken = set(self.members_table.node_ids()) | set(
+                self.node_uuid_map.values()
+            )
+            nid = max(taken, default=-1) + 1
+            await self.replicate_cmd_local(
+                CmdType.reserve_node_id,
+                ReserveNodeIdCmd(node_uuid=node_uuid, node_id=nid),
+            )
+            # the STM mapping is authoritative: a cross-leader race is
+            # resolved by its deterministic remap on apply
+            return self.node_uuid_map.get(node_uuid, nid)
+
     async def join_node_local(self, cmd: RegisterNodeCmd) -> int:
         """Leader side of a node join (members_manager.cc
         handle_join_request): replicate the registration, then add the
@@ -665,6 +744,14 @@ class Controller:
                 "invalid_request",
                 f"node {cmd.node_id} build version {cmd.logical_version} "
                 f"< active cluster version {self.features.cluster_version}",
+            )
+        joiner_uuid = str(getattr(cmd, "cluster_uuid", "") or "")
+        if joiner_uuid and self.cluster_uuid and joiner_uuid != self.cluster_uuid:
+            # wrong-cluster guard (cluster_discovery.cc UUID check)
+            raise TopicError(
+                "invalid_cluster",
+                f"node {cmd.node_id} believes cluster "
+                f"{joiner_uuid[:8]}…, this is {self.cluster_uuid[:8]}…",
             )
         base = await self.replicate_cmd_local(CmdType.register_node, cmd)
         nid = int(cmd.node_id)
@@ -699,6 +786,7 @@ class Controller:
                 if self._logical_version_override is not None
                 else LATEST_LOGICAL_VERSION
             ),
+            cluster_uuid=self.cluster_uuid,
         )
         deadline = asyncio.get_event_loop().time() + timeout
         payload = cmd.encode()
@@ -1050,6 +1138,7 @@ class Controller:
                 self._move_repair_pass()
                 self._maybe_snapshot()
                 if self.is_leader:
+                    await self._bootstrap_pass()
                     await self._maintenance_pass()
                     await self._feature_pass()
                     await self._migration_pass()
@@ -1542,3 +1631,33 @@ class Controller:
                     self.cluster_config.get("default_topic_retention_ms")
                 )
         return out
+
+
+async def discover_node_id(
+    send,  # async (node, method, payload, timeout) -> bytes
+    seeds: list[int],
+    node_uuid: str,
+    timeout: float = 15.0,
+) -> int:
+    """Pre-start node-id discovery (cluster_discovery.cc): a node
+    configured without an id asks the seeds for its reservation before
+    constructing the broker. Retries around leadership placement; the
+    reservation is idempotent (keyed by node_uuid)."""
+    import asyncio as _asyncio
+
+    deadline = _asyncio.get_event_loop().time() + timeout
+    payload = node_uuid.encode()
+    last = "no seed reachable"
+    while _asyncio.get_event_loop().time() < deadline:
+        for seed in seeds:
+            try:
+                raw = await send(seed, ASSIGN_NODE_ID, payload, 5.0)
+            except Exception as e:
+                last = f"seed {seed}: {e}"
+                continue
+            reply = _TopicReply.decode(raw)
+            if reply.code == "" and reply.revision >= 0:
+                return int(reply.revision)
+            last = str(reply.code)
+        await _asyncio.sleep(0.1)
+    raise TimeoutError(f"node-id discovery failed: {last}")

@@ -102,7 +102,13 @@ class ControllerSnapshotE(serde.Envelope):
         ("features", serde.mapping(serde.string, serde.string)),
         ("cluster_version", serde.i64),
         ("migrations", serde.vector(serde.string)),
+        # v2: cluster genesis (bootstrap_backend state)
+        ("cluster_uuid", serde.string),
+        ("node_uuid_map", serde.mapping(serde.string, serde.i32)),
     ]
+
+    SERDE_VERSION = 2
+    SERDE_DEFAULTS = {"cluster_uuid": "", "node_uuid_map": {}}
 
 
 class ControllerSnapshotter:
@@ -205,6 +211,8 @@ class ControllerSnapshotter:
             features=dict(c.features._state),
             cluster_version=int(c.features.cluster_version),
             migrations=sorted(c.migrations_done),
+            cluster_uuid=c.cluster_uuid,
+            node_uuid_map=dict(c.node_uuid_map),
         ).encode()
 
     # -- restore ------------------------------------------------------
@@ -278,6 +286,11 @@ class ControllerSnapshotter:
             c.features.cluster_version, int(snap.cluster_version)
         )
         c.migrations_done.update(snap.migrations)
+        c.cluster_uuid = str(snap.cluster_uuid)
+        c.node_uuid_map.clear()
+        c.node_uuid_map.update(
+            {str(k): int(v) for k, v in dict(snap.node_uuid_map).items()}
+        )
         # the allocator is derived state: rebuild from members + topics
         alloc = c.allocator
         for m in snap.members:

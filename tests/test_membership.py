@@ -557,3 +557,82 @@ def test_maintenance_guards(tmp_path):
             await asyncio.sleep(0.1)
 
     asyncio.run(main())
+
+
+async def _cluster_bootstrap(tmp_path):
+    """Cluster genesis (bootstrap_backend/cluster_discovery): the first
+    leader replicates a cluster UUID exactly once; every member
+    converges on it; node-id reservations are idempotent and survive
+    controller snapshots; wrong-UUID joins are rejected."""
+    from redpanda_tpu.cluster.commands import RegisterNodeCmd
+    from redpanda_tpu.cluster.controller import TopicError, discover_node_id
+    from redpanda_tpu.cluster.features import LATEST_LOGICAL_VERSION
+
+    async with seed_cluster(tmp_path, n=3) as (net, brokers):
+        # genesis: all nodes converge on ONE non-empty uuid
+        await wait_until(
+            lambda: all(b.controller.cluster_uuid for b in brokers),
+            msg="cluster uuid replicated",
+        )
+        uuids = {b.controller.cluster_uuid for b in brokers}
+        assert len(uuids) == 1
+        (uuid,) = uuids
+        assert len(uuid) == 32
+
+        # id discovery through any node (routed to the leader by retry)
+        send = brokers[0].send_rpc
+        nid_a = await discover_node_id(send, [0, 1, 2], "uuid-aaa")
+        nid_b = await discover_node_id(send, [0, 1, 2], "uuid-bbb")
+        assert nid_a != nid_b
+        assert nid_a not in (0, 1, 2) and nid_b not in (0, 1, 2)
+        # retry with the same node uuid: same reservation
+        assert await discover_node_id(send, [0, 1, 2], "uuid-aaa") == nid_a
+
+        # wrong-cluster join rejected
+        leader = next(b for b in brokers if b.controller.is_leader)
+        cmd = RegisterNodeCmd(
+            node_id=99,
+            rpc_host="h", rpc_port=1, kafka_host="h", kafka_port=2,
+            rack="", logical_version=LATEST_LOGICAL_VERSION,
+            cluster_uuid="f" * 32,
+        )
+        try:
+            await leader.controller.join_node_local(cmd)
+            raise AssertionError("wrong-uuid join was accepted")
+        except TopicError as e:
+            assert e.code == "invalid_cluster"
+
+        # matching uuid joins fine
+        cmd2 = RegisterNodeCmd(
+            node_id=7,
+            rpc_host="h", rpc_port=1, kafka_host="h", kafka_port=2,
+            rack="", logical_version=LATEST_LOGICAL_VERSION,
+            cluster_uuid=uuid,
+        )
+        await leader.controller.join_node_local(cmd2)
+        assert 7 in leader.controller.members
+
+        # snapshot round-trip carries genesis state
+        from redpanda_tpu.cluster.controller_snapshot import (
+            ControllerSnapshotter,
+        )
+
+        snapper = ControllerSnapshotter(leader.controller)
+        blob = snapper.capture_snapshot(
+            leader.controller.consensus.commit_index
+        )
+        other = brokers[1] if brokers[1] is not leader else brokers[2]
+        # decode-only check against the envelope (restore on a live
+        # controller is exercised by the controller-snapshot suite)
+        from redpanda_tpu.cluster.controller_snapshot import (
+            ControllerSnapshotE,
+        )
+
+        snap = ControllerSnapshotE.decode(blob)
+        assert str(snap.cluster_uuid) == uuid
+        m = {str(k): int(v) for k, v in dict(snap.node_uuid_map).items()}
+        assert m["uuid-aaa"] == nid_a and m["uuid-bbb"] == nid_b
+
+
+def test_cluster_bootstrap(tmp_path):
+    asyncio.run(_cluster_bootstrap(tmp_path))

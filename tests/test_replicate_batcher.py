@@ -188,7 +188,14 @@ def test_produce_pipelining_overlaps_rounds(tmp_path):
         try:
             await clients[0].create_topic("pp", partitions=1)
             ntp = NTP("kafka", "pp", 0)
-            part = b.partition_manager.get(ntp)
+            # topic creation returns when the controller command
+            # commits; the partition materializes asynchronously
+            for _ in range(100):
+                part = b.partition_manager.get(ntp)
+                if part is not None:
+                    break
+                await asyncio.sleep(0.02)
+            assert part is not None, "partition never materialized"
             rounds_before = part.consensus._batcher.flush_rounds
 
             n = 40
