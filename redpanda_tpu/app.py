@@ -506,6 +506,7 @@ class Broker:
             self.tx_coordinator.service,
             self.node_status_service,
             self._self_test_service,
+            self.controller.barrier,
         ):
             if self._rpc_server is not None:
                 self._rpc_server.register(svc)

@@ -392,6 +392,16 @@ class Controller:
         self.migrations_done: set[str] = set()
         # advertise an older feature level (mixed-version test seam)
         self._logical_version_override: int | None = None
+        from .feature_barrier import FeatureBarrier
+
+        self.barrier = FeatureBarrier(
+            node_id, send, members=lambda: self.members
+        )
+        # followers enter feature-activation barriers implicitly when
+        # their build speaks the required version
+        self.barrier.register_auto_enter(
+            "feature:", self._feature_barrier_ready
+        )
         from ..config import ClusterConfig
 
         self.cluster_config = ClusterConfig()
@@ -412,6 +422,7 @@ class Controller:
         # dissemination-fed PartitionLeadersTable after construction)
         self.leaders_table = None
         self._balance_ticks = 0
+        self._barrier_defer_until = 0.0
         # cluster genesis state (bootstrap_backend): "" until the first
         # leader replicates the UUID; node_uuid -> reserved node id
         self.cluster_uuid = ""
@@ -781,11 +792,7 @@ class Controller:
             # override = mixed-version testing seam (the reference's
             # redpanda_installer runs real old builds; here the build
             # ADVERTISES an older feature level instead)
-            logical_version=(
-                self._logical_version_override
-                if self._logical_version_override is not None
-                else LATEST_LOGICAL_VERSION
-            ),
+            logical_version=self.local_logical_version,
             cluster_uuid=self.cluster_uuid,
         )
         deadline = asyncio.get_event_loop().time() + timeout
@@ -1325,6 +1332,24 @@ class Controller:
                     self._converge_move(ntp, a.group, list(a.replicas))
                 )
 
+    @property
+    def local_logical_version(self) -> int:
+        """The feature level this node advertises (override = the
+        mixed-version test seam)."""
+        return (
+            self._logical_version_override
+            if self._logical_version_override is not None
+            else LATEST_LOGICAL_VERSION
+        )
+
+    def _feature_barrier_ready(self, tag: str) -> bool:
+        """Auto-enter predicate for feature:<name>:<version> tags."""
+        try:
+            need = int(tag.rsplit(":", 1)[1])
+        except (IndexError, ValueError):
+            return False
+        return self.local_logical_version >= need
+
     async def _feature_pass(self) -> None:
         """Leader-only: activate features the whole membership now
         supports (feature_manager.cc maybe_update_active_version). The
@@ -1339,7 +1364,24 @@ class Controller:
         if not pending:
             return
         cluster_version = min(versions)
+        now = asyncio.get_event_loop().time()
+        if now < self._barrier_defer_until:
+            return  # a recent incomplete barrier: don't stall every tick
         for f in pending:
+            # rendezvous BEFORE activating (feature_barrier): the
+            # version table proves members advertised support at
+            # registration; the barrier proves they are alive and
+            # ready NOW. A down node defers activation to a later pass.
+            tag = f"feature:{f.name}:{f.required_version}"
+            if not await self.barrier.enter(tag, timeout=1.5):
+                self._barrier_defer_until = (
+                    asyncio.get_event_loop().time() + 5.0
+                )
+                logger.info(
+                    "feature_manager: barrier %s incomplete; deferring",
+                    tag,
+                )
+                return
             try:
                 await self.replicate_cmd_local(
                     CmdType.feature_update,
