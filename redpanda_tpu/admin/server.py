@@ -111,6 +111,85 @@ class AdminServer(HttpServer):
         r("GET", r"/v1/cloud_storage/status/([^/]+)/(\d+)",
           self._cloud_status)
         r("GET", r"/metrics", self._metrics)
+        # -- r4 additions toward admin_server.cc route parity ----------
+        r(
+            "POST",
+            r"/v1/partitions/([^/]+)/([^/]+)/(\d+)/replicas",
+            self._move_replicas,  # reference-shaped alias of move
+        )
+        r("GET", r"/v1/partitions/local_summary", self._partitions_summary)
+        r("GET", r"/v1/partitions/reconfigurations", self._reconfigurations)
+        r("GET", r"/v1/partitions/([^/]+)/([^/]+)", self._topic_partitions)
+        r(
+            "POST",
+            r"/v1/partitions/([^/]+)/([^/]+)/(\d+)/cancel_reconfiguration",
+            self._cancel_reconfiguration,
+        )
+        r(
+            "POST",
+            r"/v1/partitions/([^/]+)/([^/]+)/(\d+)"
+            r"/unclean_abort_reconfiguration",
+            self._cancel_reconfiguration,  # no separate force path: the
+            # cancel restores the previous set either way
+        )
+        r(
+            "POST",
+            r"/v1/cluster/cancel_reconfigurations",
+            self._cancel_all_reconfigurations,
+        )
+        r(
+            "POST",
+            r"/v1/brokers/(\d+)/cancel_partition_moves",
+            self._cancel_broker_moves,
+        )
+        r("POST", r"/v1/partitions/rebalance", self._rebalance)
+        r("GET", r"/v1/cluster_config/status", self._config_status)
+        r("GET", r"/v1/cluster_view", self._cluster_view)
+        r("GET", r"/v1/debug/controller_status", self._controller_status)
+        r("GET", r"/v1/debug/is_node_isolated", self._is_node_isolated)
+        r(
+            "GET",
+            r"/v1/debug/partition_leaders_table",
+            self._leaders_table,
+        )
+        r("GET", r"/v1/debug/peer_status/(\d+)", self._peer_status)
+        r("POST", r"/v1/debug/reset_leaders", self._reset_leaders)
+        r("GET", r"/v1/debug/cloud_storage_usage", self._cloud_usage)
+        r("GET", r"/v1/maintenance", self._local_maintenance)
+        r("PUT", r"/v1/features/license", self._put_license)
+        r("GET", r"/v1/features/license", self._get_license)
+        r("PUT", r"/v1/features/([\w]+)", self._put_feature)
+        r(
+            "GET",
+            r"/v1/cloud_storage/manifest/([^/]+)/(\d+)",
+            self._si_manifest,  # reference-shaped alias
+        )
+        r(
+            "POST",
+            r"/v1/cloud_storage/automated_recovery",
+            self._automated_recovery,
+        )
+        r(
+            "POST",
+            r"/v1/cloud_storage/sync_local_state/([^/]+)/(\d+)",
+            self._sync_local_state,
+        )
+        r(
+            "POST",
+            r"/v1/debug/refresh_disk_health_info",
+            self._refresh_disk_health,
+        )
+        r(
+            "GET",
+            r"/v1/debug/blocked_reactor_notify_ms",
+            self._get_blocked_reactor_ms,
+        )
+        r(
+            "PUT",
+            r"/v1/debug/blocked_reactor_notify_ms",
+            self._put_blocked_reactor_ms,
+        )
+        r("POST", r"/v1/debug/restart_service", self._restart_service)
 
     async def _ready(self, _m, _q, _b):
         return {"status": "ready" if self.broker._started else "booting"}
@@ -718,6 +797,361 @@ class AdminServer(HttpServer):
             "throttled_seconds_total": round(t.throttled_s, 3),
         }
 
+    blocked_reactor_notify_ms = 25.0
+
+    async def _partitions_summary(self, _m, _q, _b):
+        """Local partition counts (partition_api.cc local_summary)."""
+        pm = self.broker.partition_manager
+        total = leaders = leaderless = 0
+        for _ntp, p in pm.partitions().items():
+            total += 1
+            if p.consensus.is_leader():
+                leaders += 1
+            elif p.consensus.leader_id is None:
+                leaderless += 1
+        return {"count": total, "leaders": leaders, "leaderless": leaderless}
+
+    async def _reconfigurations(self, _m, _q, _b):
+        """In-flight replica moves (ListPartitionReassignments view)."""
+        ctrl = self.broker.controller
+        out = []
+        for ntp, previous in ctrl.topic_table.updates_in_progress.items():
+            md = ctrl.topic_table.get(ntp.tp_ns)
+            current = (
+                md.assignments[ntp.partition].replicas
+                if md is not None and ntp.partition in md.assignments
+                else []
+            )
+            out.append(
+                {
+                    "ns": ntp.ns,
+                    "topic": ntp.topic,
+                    "partition": ntp.partition,
+                    "previous_replicas": list(previous),
+                    "current_replicas": list(current),
+                }
+            )
+        return out
+
+    async def _topic_partitions(self, m, _q, _b):
+        from ..models.fundamental import TopicNamespace
+
+        ns, topic = m.group(1), m.group(2)
+        md = self.broker.controller.topic_table.get(
+            TopicNamespace(ns, topic)
+        )
+        if md is None:
+            raise HttpError(404, f"no topic {ns}/{topic}")
+        out = []
+        for pid in sorted(md.assignments):
+            a = md.assignments[pid]
+            from ..models.fundamental import NTP
+
+            leader = self.broker.leaders.get(NTP(ns, topic, pid))
+            out.append(
+                {
+                    "ns": ns,
+                    "topic": topic,
+                    "partition_id": pid,
+                    "replicas": list(a.replicas),
+                    "leader_id": leader,
+                }
+            )
+        return out
+
+    async def _cancel_reconfiguration(self, m, _q, _b):
+        """Restore the pre-move replica set (cancel_partition_move)."""
+        from ..cluster.controller import TopicError
+        from ..models.fundamental import NTP
+
+        ns, topic, pid = m.group(1), m.group(2), int(m.group(3))
+        ntp = NTP(ns, topic, pid)
+        ctrl = self.broker.controller
+        previous = ctrl.topic_table.updates_in_progress.get(ntp)
+        if previous is None:
+            raise HttpError(404, f"no reconfiguration in flight for {ntp}")
+        try:
+            await ctrl.move_partition_replicas(
+                topic, pid, list(previous), ns=ns
+            )
+        except TopicError as e:
+            raise HttpError(400, f"{e.code}: {e.message}") from None
+        return None
+
+    async def _cancel_all_reconfigurations(self, _m, _q, _b):
+        from ..cluster.controller import TopicError
+
+        ctrl = self.broker.controller
+        cancelled = []
+        for ntp, previous in list(
+            ctrl.topic_table.updates_in_progress.items()
+        ):
+            try:
+                await ctrl.move_partition_replicas(
+                    ntp.topic, ntp.partition, list(previous), ns=ntp.ns
+                )
+                cancelled.append(str(ntp))
+            except TopicError:
+                pass
+        return {"cancelled": cancelled}
+
+    async def _cancel_broker_moves(self, m, _q, _b):
+        """Cancel every in-flight move ADDING replicas to this broker
+        (brokers/{id}/cancel_partition_moves)."""
+        from ..cluster.controller import TopicError
+
+        nid = int(m.group(1))
+        ctrl = self.broker.controller
+        cancelled = []
+        for ntp, previous in list(
+            ctrl.topic_table.updates_in_progress.items()
+        ):
+            md = ctrl.topic_table.get(ntp.tp_ns)
+            current = (
+                md.assignments[ntp.partition].replicas
+                if md is not None and ntp.partition in md.assignments
+                else []
+            )
+            if nid in current and nid not in previous:
+                try:
+                    await ctrl.move_partition_replicas(
+                        ntp.topic, ntp.partition, list(previous), ns=ntp.ns
+                    )
+                    cancelled.append(str(ntp))
+                except TopicError:
+                    pass
+        return {"cancelled": cancelled}
+
+    async def _rebalance(self, _m, _q, _b):
+        """Run one on-demand balancer pass (partitions/rebalance)."""
+        ctrl = self.broker.controller
+        if not ctrl.is_leader:
+            raise HttpError(400, "not the controller leader")
+        await ctrl._leader_balance_pass()
+        await ctrl._partition_balance_pass()
+        return None
+
+    async def _config_status(self, _m, _q, _b):
+        """Per-node config application status (cluster_config/status):
+        every node applies replicated config at the same version, so
+        the status reports the shared version per member."""
+        ctrl = self.broker.controller
+        v = ctrl.cluster_config.version
+        return [
+            {
+                "node_id": nid,
+                "restart": False,
+                "config_version": v,
+                "invalid": [],
+                "unknown": [],
+            }
+            for nid in ctrl.members_table.node_ids()
+        ]
+
+    async def _cluster_view(self, _m, _q, _b):
+        brokers = await self._brokers(None, None, None)
+        return {
+            "version": self.broker.controller.topic_table.revision,
+            "brokers": brokers["brokers"],
+        }
+
+    async def _controller_status(self, _m, _q, _b):
+        c = self.broker.controller.consensus
+        if c is None:
+            return {"started": False}
+        return {
+            "started": True,
+            "leader_id": c.leader_id,
+            "term": c.term,
+            "commit_index": c.commit_index,
+            "dirty_offset": c.log.offsets().dirty_offset,
+        }
+
+    async def _is_node_isolated(self, _m, _q, _b):
+        """True when this node can reach NO other member
+        (debug/is_node_isolated)."""
+        ns = self.broker.node_status
+        others = [
+            n
+            for n in self.broker.controller.members
+            if n != self.broker.node_id
+        ]
+        return bool(others) and not any(ns.is_alive(n) for n in others)
+
+    async def _leaders_table(self, _m, _q, _b):
+        out = []
+        for ntp, leader in self.broker.leaders.items():
+            out.append(
+                {
+                    "ns": ntp.ns,
+                    "topic": ntp.topic,
+                    "partition_id": ntp.partition,
+                    "leader": leader,
+                }
+            )
+        return out
+
+    async def _peer_status(self, m, _q, _b):
+        import asyncio
+
+        nid = int(m.group(1))
+        ns = self.broker.node_status
+        seen = ns.last_seen.get(nid)
+        now = asyncio.get_event_loop().time()
+        return {
+            "since_last_status_ms": (
+                round((now - seen) * 1e3, 1) if seen is not None else None
+            ),
+            "is_alive": ns.is_alive(nid),
+        }
+
+    async def _reset_leaders(self, _m, _q, _b):
+        """Drop leadership hints; they repopulate via dissemination
+        (debug/reset_leaders)."""
+        self.broker.leaders.clear()
+        return None
+
+    async def _cloud_usage(self, _m, _q, _b):
+        """Bytes this cluster accounts in the object store, from the
+        replicated archival metadata (debug/cloud_storage_usage)."""
+        total = 0
+        segments = 0
+        for _ntp, p in self.broker.partition_manager.partitions().items():
+            stm = getattr(p, "archival", None)
+            if stm is None:
+                continue
+            stm.apply_committed(p.consensus.commit_index)
+            for seg in stm.segments:
+                total += int(seg.size_bytes)
+                segments += 1
+        return {"total_size_bytes": total, "segments": segments}
+
+    async def _local_maintenance(self, _m, _q, _b):
+        """THIS node's maintenance status (GET /v1/maintenance)."""
+        ctrl = self.broker.controller
+        ep = ctrl.members_table.get(self.broker.node_id)
+        from ..cluster.members import MembershipState
+
+        draining = (
+            ep is not None and ep.state == MembershipState.maintenance
+        )
+        pm = self.broker.partition_manager
+        leaders = sum(
+            1
+            for _ntp, p in pm.partitions().items()
+            if p.consensus.is_leader()
+        )
+        return {
+            "node_id": self.broker.node_id,
+            "draining": draining,
+            "finished": draining and leaders == 0,
+            "partitions_with_leadership": leaders,
+        }
+
+    async def _put_feature(self, m, _q, body):
+        """Administratively set a feature state (PUT
+        /v1/features/{name}; feature_manager set_feature_state)."""
+        from ..cluster.commands import CmdType, FeatureUpdateCmd
+        from ..cluster.features import FEATURES
+
+        name = m.group(1)
+        if name not in {f.name for f in FEATURES}:
+            raise HttpError(404, f"unknown feature {name}")
+        payload = self._json_body(body)
+        state = payload.get("state")
+        if state not in ("active", "disabled"):
+            raise HttpError(400, "state must be 'active' or 'disabled'")
+        ctrl = self.broker.controller
+        await ctrl.replicate_cmd(
+            CmdType.feature_update,
+            FeatureUpdateCmd(
+                name=name,
+                state=state,
+                cluster_version=ctrl.features.cluster_version,
+            ),
+        )
+        return None
+
+    async def _get_license(self, _m, _q, _b):
+        raw = self.broker.controller.cluster_config.get("cluster_license")
+        return {"loaded": bool(raw), "license": {"raw": raw} if raw else None}
+
+    async def _put_license(self, _m, _q, body):
+        if not body:
+            raise HttpError(400, "license body required")
+        await self.broker.controller.set_cluster_config(
+            {"cluster_license": body.decode("utf-8", "replace").strip()}
+        )
+        return None
+
+    async def _automated_recovery(self, _m, _q, body):
+        """Recreate topics from uploaded manifests (cloud_storage
+        automated_recovery)."""
+        payload = self._json_body(body)
+        topic = payload.get("topic")
+        if not topic:
+            raise HttpError(400, "topic required")
+        if self.broker.archival is None:
+            raise HttpError(400, "tiered storage is not configured")
+        try:
+            await self.broker.recover_topic_from_cloud(
+                str(topic), ns=str(payload.get("ns", "kafka"))
+            )
+        except Exception as e:
+            raise HttpError(400, f"recovery failed: {e}") from None
+        return {"topic": topic, "status": "recovery started"}
+
+    async def _sync_local_state(self, m, _q, _b):
+        """Force the archiver to re-sync its view from the store
+        manifest (cloud_storage/sync_local_state)."""
+        from ..models.fundamental import kafka_ntp
+
+        topic, pid = m.group(1), int(m.group(2))
+        p = self.broker.partition_manager.get(kafka_ntp(topic, pid))
+        if p is None or getattr(p, "archiver", None) is None:
+            raise HttpError(404, f"no archived partition {topic}/{pid}")
+        p.archiver._synced_term = -1
+        await p.archiver._sync_from_store()
+        return None
+
+    async def _refresh_disk_health(self, _m, _q, _b):
+        import shutil as _shutil
+
+        du = _shutil.disk_usage(self.broker.config.data_dir)
+        return {
+            "total_bytes": du.total,
+            "free_bytes": du.free,
+            "used_ratio": round(1 - du.free / du.total, 4),
+        }
+
+    async def _get_blocked_reactor_ms(self, _m, _q, _b):
+        return {"blocked_reactor_notify_ms": self.blocked_reactor_notify_ms}
+
+    async def _put_blocked_reactor_ms(self, _m, q, _b):
+        try:
+            self.blocked_reactor_notify_ms = float((q or {}).get("v", ""))
+        except ValueError:
+            raise HttpError(400, "query param v=<ms> required") from None
+        return None
+
+    async def _restart_service(self, _m, q, _b):
+        """Restart a named subsystem loop (debug/restart_service)."""
+        name = (q or {}).get("service", "")
+        if name == "archival":
+            if self.broker.archival is None:
+                raise HttpError(400, "archival not configured")
+            await self.broker.archival.stop()
+            self.broker.archival.store._chain.reset()
+            await self.broker.archival.start()
+        elif name == "transforms":
+            await self.broker.transforms.stop()
+            await self.broker.transforms.start()
+        else:
+            raise HttpError(
+                400, "service must be 'archival' or 'transforms'"
+            )
+        return None
+
     async def _blocked_reactor(self, _m, _q, _b):
         """Event-loop stall probe (the reference's blocked-reactor
         notifications): measures scheduling delay of an immediate
@@ -728,10 +1162,11 @@ class AdminServer(HttpServer):
             t0 = loop.time()
             await asyncio.sleep(0)
             worst = max(worst, loop.time() - t0)
+        t = self.blocked_reactor_notify_ms
         return {
             "max_scheduling_delay_ms": round(worst * 1e3, 3),
-            "threshold_ms": 25.0,
-            "blocked": worst * 1e3 > 25.0,
+            "threshold_ms": t,
+            "blocked": worst * 1e3 > t,
         }
 
     async def _cpu_profile(self, _m, q, _b):

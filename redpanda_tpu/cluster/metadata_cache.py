@@ -28,6 +28,14 @@ class PartitionLeadersTable:
     def get(self, ntp: NTP) -> int | None:
         return self._leaders.get(ntp)
 
+    def items(self):
+        return list(self._leaders.items())
+
+    def clear(self) -> None:
+        """Admin debug/reset_leaders: hints repopulate via
+        dissemination + local raft callbacks."""
+        self._leaders.clear()
+
 
 class MetadataCache:
     def __init__(

@@ -66,6 +66,12 @@ class RetryChainNode:
         """Cancels every node in the tree (root abort source)."""
         self._abort.set()
 
+    def reset(self) -> None:
+        """Re-arm an aborted root (admin service restart): children
+        created AFTER the reset run normally; in-flight children that
+        already observed the abort stay cancelled."""
+        self._abort = asyncio.Event()
+
     @property
     def aborted(self) -> bool:
         return self._abort.is_set()
