@@ -1,0 +1,108 @@
+"""Profile the 50k-group live heartbeat tick (VERDICT r3 item #2).
+
+Reuses bench._live_tick_async's fixture but cProfiles the steady tick
+loop and prints a per-phase breakdown. Run:
+    python bench_profiles/profile_tick.py [n_groups]
+"""
+
+import asyncio
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+async def main(n_groups: int) -> None:
+    import tempfile, shutil
+    from redpanda_tpu.raft.group_manager import GroupManager
+    from redpanda_tpu.rpc.loopback import LoopbackNetwork, LoopbackTransport
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = tempfile.mkdtemp(prefix="rp_prof_", dir=shm)
+    net = LoopbackNetwork()
+
+    def sender(src):
+        async def send(dst, method_id, payload, timeout):
+            t = LoopbackTransport(net, src, dst)
+            return await t.call(method_id, payload, timeout)
+
+        return send
+
+    gms = {}
+    try:
+        for nid in (0, 1):
+            gm = GroupManager(
+                node_id=nid,
+                data_dir=os.path.join(tmp, f"node_{nid}"),
+                send=sender(nid),
+                election_timeout_s=3600.0,
+                heartbeat_interval_s=3600.0,
+            )
+            net.register(nid, gm.service)
+            gms[nid] = gm
+            await gm.start()
+        voters = [0, 1]
+        t0 = time.monotonic()
+        for gid in range(1, n_groups + 1):
+            for gm in gms.values():
+                await gm.create_group(gid, voters)
+        print(f"setup: created {n_groups} groups x2 in {time.monotonic()-t0:.1f}s", flush=True)
+        leaders = []
+        for gid in range(1, n_groups + 1):
+            c = gms[0].get(gid)
+            c.arrays.term[c.row] = 0
+            c._become_leader()
+            leaders.append(c)
+        hb = gms[0].heartbeat_manager
+        deadline = time.monotonic() + 120.0
+        while any(c.commit_index < c.term_start for c in leaders):
+            await hb.tick()
+            if time.monotonic() > deadline:
+                raise TimeoutError("followers never caught up")
+            await asyncio.sleep(0)
+        import gc
+
+        gc.collect()
+        gc.freeze()
+        for _ in range(3):
+            await hb.tick()
+
+        times = []
+        pr = cProfile.Profile()
+        pr.enable()
+        for _ in range(40):
+            t0 = time.perf_counter()
+            await hb.tick()
+            times.append((time.perf_counter() - t0) * 1e3)
+        pr.disable()
+        print("tick ms:", [round(t, 2) for t in times], flush=True)
+        print(
+            f"p50={np.percentile(times,50):.2f} p99={np.percentile(times,99):.2f}",
+            flush=True,
+        )
+        s = io.StringIO()
+        pstats.Stats(pr, stream=s).sort_stats("tottime").print_stats(45)
+        out = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            f"tick_{n_groups}_cprofile.txt",
+        )
+        open(out, "w").write(s.getvalue())
+        print("saved", out, flush=True)
+    finally:
+        for gm in gms.values():
+            try:
+                await gm.stop()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 50000
+    asyncio.run(main(n))

@@ -1073,14 +1073,29 @@ class AdminServer(HttpServer):
         return None
 
     async def _get_license(self, _m, _q, _b):
-        raw = self.broker.controller.cluster_config.get("cluster_license")
-        return {"loaded": bool(raw), "license": {"raw": raw} if raw else None}
+        """License properties + enterprise violations
+        (GET /v1/features/license; security/license.h properties)."""
+        status = self.broker.license.status()
+        status["violations"] = self.broker.license.violations(
+            self.broker.enterprise_features_in_use()
+        )
+        return status
 
     async def _put_license(self, _m, _q, body):
+        """Validate (signature/schema/expiry) BEFORE replicating — a bad
+        key must never enter the replicated config
+        (admin_server.cc put_license)."""
+        from ..security.license import LicenseError
+
         if not body:
             raise HttpError(400, "license body required")
+        raw = body.decode("utf-8", "replace").strip()
+        try:
+            self.broker.license.validate(raw)
+        except LicenseError as e:
+            raise HttpError(400, f"invalid license: {e}") from None
         await self.broker.controller.set_cluster_config(
-            {"cluster_license": body.decode("utf-8", "replace").strip()}
+            {"cluster_license": raw}
         )
         return None
 

@@ -208,6 +208,32 @@ class Broker:
             send,
         )
         self.controller.authorizer.superusers = set(config.superusers or [])
+        # license state follows the replicated cluster config on every
+        # node (feature_manager license propagation); an invalid stored
+        # value logs rather than wedging config replay
+        from .security.license import LicenseService
+
+        self.license = LicenseService()
+
+        def _on_license(raw) -> None:
+            raw = (raw or "").strip()
+            if not raw:
+                self.license.clear()
+                return
+            try:
+                # allow_expired: a restarted node must keep reporting an
+                # expired license rather than silently dropping it
+                lic = self.license.load(raw, allow_expired=True)
+                logging.getLogger("app").info(
+                    "cluster license loaded: org=%s type=%s",
+                    lic.organization, lic.type_name,
+                )
+            except Exception as e:
+                logging.getLogger("app").warning(
+                    "stored cluster license rejected: %s", e
+                )
+
+        self.controller.cluster_config.bind("cluster_license", _on_license)
         self.oidc = None
         _oidc_fields = (
             config.oidc_issuer,
@@ -477,6 +503,19 @@ class Broker:
                 ntp,
                 manifest.archived_upto,
             )
+
+    def enterprise_features_in_use(self) -> list[str]:
+        """Enterprise features this broker currently has configured —
+        input to the license violation report (feature_manager's
+        enterprise feature snapshot)."""
+        used: list[str] = []
+        if self.archival is not None:
+            used.append("tiered_storage")
+        if self.oidc is not None:
+            used.append("oidc")
+        if getattr(self, "gssapi", None) is not None:
+            used.append("gssapi")
+        return used
 
     def _rpc_addr_of(self, node_id: int) -> tuple[str, int]:
         """Peer RPC address: replicated members table first (dynamic

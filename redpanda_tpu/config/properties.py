@@ -76,6 +76,12 @@ def default_properties() -> list[Property]:
     configuration.cc registry, trimmed to implemented subsystems)."""
     return [
         Property(
+            "cluster_license",
+            "string",
+            "",
+            "Enterprise license key (validated on PUT; empty = unlicensed)",
+        ),
+        Property(
             "log_compaction_interval_s",
             "float",
             10.0,
