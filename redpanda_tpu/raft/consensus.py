@@ -36,7 +36,7 @@ from ..models.fundamental import NO_OFFSET
 from ..storage import snapshot as snapfmt
 from ..storage.kvstore import KeySpace, KvStore, KvStoreClosed
 from ..storage.log import Log
-from ..utils import serde
+from ..utils import serde, spans
 from . import quorum_scalar as qs
 from . import types as rt
 from .configuration import GroupConfiguration
@@ -731,8 +731,9 @@ class Consensus:
     ) -> rt.AppendEntriesReply:
         """Follower-side append path (consensus.cc:1734 do_append_entries),
         serialized per group (append_entries_buffer analog)."""
-        async with self._append_lock:
-            return await self._do_append_entries(req)
+        with spans.span("follower.append_total"):
+            async with self._append_lock:
+                return await self._do_append_entries(req)
 
     def _reply(self, status: int, seq: int) -> rt.AppendEntriesReply:
         return rt.AppendEntriesReply(
@@ -812,7 +813,8 @@ class Consensus:
             appended = True
             last_new_entry = batch.header.last_offset
         if appended or req.flush:
-            flushed = self.log.flush()
+            with spans.span("follower.flush"):
+                flushed = self.log.flush()
             new_offs = self.log.offsets()
             self.arrays.match_index[row, SELF_SLOT] = new_offs.dirty_offset
             self.arrays.flushed_index[row, SELF_SLOT] = flushed
@@ -959,42 +961,60 @@ class Consensus:
         if lock.locked():
             return  # a fiber is already driving this follower
         async with lock:
-            rounds = 0
-            while (
-                not self._closed
-                and self.role == Role.LEADER
-                and self._follower_needs_data(peer)
+            spans.add("catchup.enter", 1.0)
+            # while this fiber drives the follower, the batched
+            # heartbeat skips its slot (consensus::suppress_heartbeats):
+            # every dispatch carries term/commit anyway, and a tick-time
+            # task spawn per in-flight group is pure overhead
+            sup_slot = self._slot_map.get(peer)
+            sup_row = self.row
+            if sup_slot is not None:
+                self.arrays.hb_suppress[sup_row, sup_slot] += 1
+            try:
+                await self._catch_up_locked(peer)
+            finally:
+                if sup_slot is not None:
+                    self.arrays.hb_suppress[sup_row, sup_slot] -= 1
+
+    async def _catch_up_locked(self, peer: int) -> None:
+        rounds = 0
+        while (
+            not self._closed
+            and self.role == Role.LEADER
+            and self._follower_needs_data(peer)
+        ):
+            slot = self._slot_map.get(peer)
+            if slot is None:
+                return  # peer left the configuration
+            before = (
+                int(self.arrays.match_index[self.row, slot]),
+                int(self.arrays.flushed_index[self.row, slot]),
+            )
+            # round 0 is NORMAL replication (the batcher ships each
+            # flush round through this fiber): never throttled. A
+            # follower still behind after a full 1 MiB round is in
+            # genuine recovery — only then does the node-wide
+            # budget apply (recovery_throttle.h's learner seam).
+            if not await self._dispatch_append(
+                peer, recovering=rounds > 0
             ):
-                slot = self._slot_map.get(peer)
-                if slot is None:
-                    return  # peer left the configuration
-                before = (
-                    int(self.arrays.match_index[self.row, slot]),
-                    int(self.arrays.flushed_index[self.row, slot]),
-                )
-                # round 0 is NORMAL replication (the batcher ships each
-                # flush round through this fiber): never throttled. A
-                # follower still behind after a full 1 MiB round is in
-                # genuine recovery — only then does the node-wide
-                # budget apply (recovery_throttle.h's learner seam).
-                if not await self._dispatch_append(
-                    peer, recovering=rounds > 0
-                ):
-                    return
-                rounds += 1
-                slot = self._slot_map.get(peer)
-                if slot is None:
-                    return
-                after = (
-                    int(self.arrays.match_index[self.row, slot]),
-                    int(self.arrays.flushed_index[self.row, slot]),
-                )
-                if after <= before:
-                    # no forward progress this round (mismatch backoff,
-                    # reordered reply, stuck follower): yield — a hot
-                    # retry loop here monopolizes the event loop with
-                    # full-size append payloads (recovery_stm backoff)
-                    await asyncio.sleep(0.02)
+                return
+            rounds += 1
+            if rounds > 1:
+                spans.add("catchup.extra_round", 1.0)
+            slot = self._slot_map.get(peer)
+            if slot is None:
+                return
+            after = (
+                int(self.arrays.match_index[self.row, slot]),
+                int(self.arrays.flushed_index[self.row, slot]),
+            )
+            if after <= before:
+                # no forward progress this round (mismatch backoff,
+                # reordered reply, stuck follower): yield — a hot
+                # retry loop here monopolizes the event loop with
+                # full-size append payloads (recovery_stm backoff)
+                await asyncio.sleep(0.02)
 
     def _follower_needs_data(self, peer: int) -> bool:
         slot = self._slot_map[peer]
@@ -1055,7 +1075,8 @@ class Consensus:
                 return await self._dispatch_append_send(
                     peer, row, slot, term, next_idx, prev, prev_term, batches
                 )
-        batches = self.log.read(next_idx, max_bytes=1 << 20) if next_idx <= offs.dirty_offset else []
+        with spans.span("leader.read"):
+            batches = self.log.read(next_idx, max_bytes=1 << 20) if next_idx <= offs.dirty_offset else []
         return await self._dispatch_append_send(
             peer, row, slot, term, next_idx, prev, prev_term, batches
         )
@@ -1073,20 +1094,28 @@ class Consensus:
             return False
         seq = int(self.arrays.next_seq[row, slot]) + 1
         self.arrays.next_seq[row, slot] = seq
-        req = rt.AppendEntriesRequest(
-            group=self.group_id,
-            node_id=self.node_id,
-            target_node_id=peer,
-            term=term,
-            prev_log_index=prev,
-            prev_log_term=prev_term,
-            commit_index=self.commit_index,
-            seq=seq,
-            flush=True,
-            batches=[b.serialize() for b in batches],
-        ).encode()
+        with spans.span("leader.encode"):
+            req = rt.AppendEntriesRequest(
+                group=self.group_id,
+                node_id=self.node_id,
+                target_node_id=peer,
+                term=term,
+                prev_log_index=prev,
+                prev_log_term=prev_term,
+                commit_index=self.commit_index,
+                seq=seq,
+                flush=True,
+                batches=[b.serialize() for b in batches],
+            ).encode()
+        if spans.ENABLED:
+            spans.add(
+                "leader.rpc_empty" if not batches else "leader.rpc_data", 1.0
+            )
+            if self.group_id == 0:
+                spans.add("leader.rpc_g0", 1.0)
         try:
-            raw = await self._send(peer, rt.APPEND_ENTRIES, req, 5.0)
+            with spans.span("leader.rpc"):
+                raw = await self._send(peer, rt.APPEND_ENTRIES, req, 5.0)
             rep = rt.AppendEntriesReply.decode(raw)
         except Exception:
             return False

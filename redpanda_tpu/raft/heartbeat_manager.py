@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Awaitable, Callable, Optional
 
 import numpy as np
@@ -33,6 +34,7 @@ import numpy as np
 from . import types as rt
 from .consensus import Consensus, Role
 from ..models.consensus_state import SELF_SLOT
+from ..utils import spans
 
 logger = logging.getLogger("raft.heartbeat")
 
@@ -127,7 +129,8 @@ class HeartbeatManager:
     async def _loop(self) -> None:
         while not self._closed:
             try:
-                await self.tick()
+                with spans.span("hb.tick"):
+                    await self.tick()
             except Exception:
                 logger.exception("heartbeat tick failed")
             await asyncio.sleep(self.interval)
@@ -154,9 +157,47 @@ class HeartbeatManager:
         arrays = next(iter(self._groups.values())).arrays
 
         # vector build per peer (build_heartbeats analog): seqs, prevs,
-        # terms, commits and prev-terms in a handful of gathers
-        sent: dict[int, tuple[_PeerPlan, np.ndarray, np.ndarray]] = {}
+        # terms, commits and prev-terms in a handful of gathers.
+        # Suppression (consensus::suppress_heartbeats semantics): slots
+        # with a live append/catch-up fiber are skipped — every dispatch
+        # already carries term/commit — so under full produce load the
+        # tick covers only the idle groups and its cost tracks the idle
+        # set, not the partition count. The moment a fiber exits, its
+        # slot re-enters the beat + lag scan: the recovery-fallback
+        # role of the tick is unchanged.
+        sent: dict[int, tuple] = {}
+        t_build = time.perf_counter() if spans.ENABLED else 0.0
         for peer, p in plan.items():
+            suppress = arrays.hb_suppress[p.rows, p.slots] > 0
+            if suppress.any():
+                keep = ~suppress
+                if not keep.any():
+                    continue  # every group talked via appends: no beat
+                keep_idx = np.flatnonzero(keep)
+                rows = p.rows[keep]
+                slots = p.slots[keep]
+                gids = p.gids_arr[keep]
+                arrays.next_seq[rows, slots] += 1
+                seqs = arrays.next_seq[rows, slots]
+                prevs = arrays.match_index[rows, slots]
+                prev_terms, known = arrays.term_at_batch(rows, prevs)
+                if not known.all():
+                    for i in np.flatnonzero(~known):
+                        c = p.cons[int(keep_idx[i])]
+                        t = c.term_at(int(prevs[i]))
+                        prev_terms[i] = t if t is not None else -1
+                msg = rt.HeartbeatRequest(
+                    node_id=self.node_id,
+                    target_node_id=peer,
+                    groups=gids,
+                    terms=arrays.term[rows],
+                    prev_log_indices=prevs,
+                    prev_log_terms=prev_terms,
+                    commit_indices=arrays.commit_index[rows],
+                    seqs=seqs,
+                ).encode()
+                sent[peer] = (p, prevs, seqs, msg, rows, slots, gids, keep_idx)
+                continue
             arrays.next_seq[p.rows, p.slots] += 1
             seqs = arrays.next_seq[p.rows, p.slots]
             prevs = arrays.match_index[p.rows, p.slots]
@@ -202,7 +243,12 @@ class HeartbeatManager:
                     arrays.tb_epoch,
                     msg[: len(msg) - 8 * len(p.gids)],
                 )
-            sent[peer] = (p, prevs, seqs, msg)
+            sent[peer] = (
+                p, prevs, seqs, msg, p.rows, p.slots, p.gids_arr, None
+            )
+
+        if spans.ENABLED:
+            spans.add("hb.build", time.perf_counter() - t_build)
 
         async def one_node(peer: int, msg: bytes):
             try:
@@ -211,9 +257,14 @@ class HeartbeatManager:
             except Exception:
                 return peer, None
 
+        t_send = time.perf_counter() if spans.ENABLED else 0.0
         results = await asyncio.gather(
             *(one_node(peer, entry[3]) for peer, entry in sent.items())
         )
+        t_fold = 0.0
+        if spans.ENABLED:
+            spans.add("hb.send_wait", time.perf_counter() - t_send)
+            t_fold = time.perf_counter()
 
         # fold: flatten every successful reply into one batch
         rows_acc: list[np.ndarray] = []
@@ -227,54 +278,56 @@ class HeartbeatManager:
             entry = sent.get(peer)
             if entry is None:
                 continue
-            p, prevs, seqs, _msg = entry
+            p, prevs, seqs, _msg, rows, slots, gids, keep_idx = entry
             # steady-state reply: byte-identical to the last all-SUCCESS
             # reply except the echoed seq vector — fold only the seq
             # guard and skip decode + the full min/mask pass. The skip
             # is sound only if the LEADER's own state also sat still:
             # a local append/fsync between ticks (flush-clamp release)
-            # or a config change must take the full fold.
-            n = len(p.gids)
+            # or a config change must take the full fold. Subset sends
+            # (suppression active) never take or arm this cache.
+            n = len(gids)
             seq_lo = len(raw) - (4 + n) - 8 * n
             rc = p.reply_cache
             if (
-                rc is not None
+                keep_idx is None
+                and rc is not None
                 and self._plan is plan
                 and len(raw) == rc[2]
                 and raw[:seq_lo] == rc[0]
                 and raw[seq_lo + 8 * n :] == rc[1]
                 and not arrays.quorum_dirty.any()
                 and np.array_equal(
-                    arrays.match_index[p.rows, SELF_SLOT],
-                    arrays._folded_self_m[p.rows],
+                    arrays.match_index[rows, SELF_SLOT],
+                    arrays._folded_self_m[rows],
                 )
                 and np.array_equal(
-                    arrays.flushed_index[p.rows, SELF_SLOT],
-                    arrays._folded_self_f[p.rows],
+                    arrays.flushed_index[rows, SELF_SLOT],
+                    arrays._folded_self_f[rows],
                 )
             ):
                 r_seqs = np.frombuffer(
                     raw[seq_lo : seq_lo + 8 * n], "<q"
                 ).astype(np.int64, copy=False)
                 np.maximum.at(
-                    arrays.last_seq, (p.rows, p.slots), r_seqs
+                    arrays.last_seq, (rows, slots), r_seqs
                 )
                 continue
             reply = rt.HeartbeatReply.decode(raw)
             r_groups = np.asarray(reply.groups, np.int64)
             statuses = np.asarray(reply.statuses, np.int64)
-            # the fast path indexes through the plan's row/slot vectors,
+            # the fast path indexes through the send's row/slot vectors,
             # which is only sound while the plan is still current — a
             # topology change during the RPC gather (reconfig moving a
             # peer to a different slot) sends stragglers down the
             # per-entry path with fresh slot lookups
             aligned = (
                 self._plan is plan
-                and len(r_groups) == len(p.gids_arr)
-                and bool((r_groups == p.gids_arr).all())
+                and len(r_groups) == n
+                and bool((r_groups == gids).all())
             )
             if aligned:
-                still_leader = arrays.is_leader[p.rows]
+                still_leader = arrays.is_leader[rows]
                 ok = (statuses == rt.AppendEntriesReply.SUCCESS) & still_leader
                 if ok.any():
                     # heartbeat SUCCESS only proves the follower
@@ -283,8 +336,8 @@ class HeartbeatManager:
                         np.asarray(reply.last_dirty, np.int64), prevs
                     )
                     f = np.minimum(np.asarray(reply.last_flushed, np.int64), d)
-                    rows_acc.append(p.rows[ok])
-                    slots_acc.append(p.slots[ok])
+                    rows_acc.append(rows[ok])
+                    slots_acc.append(slots[ok])
                     dirty_acc.append(d[ok])
                     flushed_acc.append(f[ok])
                     seqs_acc.append(np.asarray(reply.seqs, np.int64)[ok])
@@ -292,11 +345,13 @@ class HeartbeatManager:
                     (statuses != rt.AppendEntriesReply.SUCCESS) & still_leader
                 )
                 for i in bad:
-                    self._handle_failure(p.cons[int(i)], peer, reply, int(i))
-                # only an all-SUCCESS reply may arm the byte-splice fast
-                # path: FAILURE rows have per-tick side effects (match
-                # rewind, catch-up spawns) that a skip would suppress
-                if len(bad) == 0 and bool(ok.all()):
+                    ci = int(i) if keep_idx is None else int(keep_idx[i])
+                    self._handle_failure(p.cons[ci], peer, reply, int(i))
+                # only a full-batch all-SUCCESS reply may arm the
+                # byte-splice fast path: FAILURE rows have per-tick side
+                # effects (match rewind, catch-up spawns) a skip would
+                # suppress, and subset replies don't cover the plan
+                if keep_idx is None and len(bad) == 0 and bool(ok.all()):
                     p.reply_cache = (
                         raw[:seq_lo], raw[seq_lo + 8 * n :], len(raw)
                     )
@@ -304,8 +359,13 @@ class HeartbeatManager:
                     p.reply_cache = None
             else:
                 # misaligned reply (defensive): per-entry slow path
+                pos_by_gid = (
+                    p.pos_by_gid
+                    if keep_idx is None
+                    else {int(g): i for i, g in enumerate(gids)}
+                )
                 for i, gid in enumerate(reply.groups):
-                    pos = p.pos_by_gid.get(gid)
+                    pos = pos_by_gid.get(gid)
                     c = self._groups.get(gid)
                     if pos is None or c is None or c.role != Role.LEADER:
                         continue
@@ -336,8 +396,17 @@ class HeartbeatManager:
                 c = self._by_row.get(int(r))
                 if c is not None:
                     c.on_batched_commit_advance()
+        t_scan = 0.0
+        if spans.ENABLED:
+            spans.add("hb.fold", time.perf_counter() - t_fold)
+            t_scan = time.perf_counter()
         # recovery: schedule catch-up for lagging followers, found with
-        # one vector compare per peer (match/flushed vs leader dirty)
+        # one vector compare per peer (match/flushed vs leader dirty).
+        # Slots with a live fiber are excluded — their lag is in-flight
+        # replication that fiber is already driving, and spawning a
+        # task per group per tick for them is pure overhead (the spawn
+        # would bounce off the peer lock anyway).
+        n_spawned = 0
         for peer, p in plan.items():
             lag = (
                 arrays.is_leader[p.rows]
@@ -351,11 +420,17 @@ class HeartbeatManager:
                         < arrays.match_index[p.rows, p.slots]
                     )
                 )
+                & (arrays.hb_suppress[p.rows, p.slots] == 0)
             )
             for i in np.flatnonzero(lag):
                 c = p.cons[int(i)]
                 if c.role == Role.LEADER:
                     c._spawn(c._catch_up(peer))
+                    n_spawned += 1
+        if spans.ENABLED:
+            spans.add("hb.scan", time.perf_counter() - t_scan)
+            if n_spawned:
+                spans.add("hb.spawned", float(n_spawned))
 
     def _handle_failure(
         self, c: Consensus, peer: int, reply: rt.HeartbeatReply, i: int

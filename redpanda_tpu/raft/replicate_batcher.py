@@ -31,6 +31,7 @@ from typing import TYPE_CHECKING, Optional
 
 from ..models.record import RecordBatch, RecordBatchBuilder
 from ..models.consensus_state import SELF_SLOT
+from ..utils import spans
 
 if TYPE_CHECKING:  # pragma: no cover
     from .consensus import Consensus
@@ -163,14 +164,17 @@ class ReplicateBatcher:
         row = c.row
         round_last = -1
         appended: list[_Item] = []
-        for it in items:
-            it.base, it.last = c.log.append(it.batch, term=term)
-            round_last = it.last
-            if it.acks == 0 and not it.stages.done.done():
-                it.stages.done.set_result((it.base, it.last))
-            appended.append(it)
+        with spans.span("batcher.append"):
+            for it in items:
+                it.base, it.last = c.log.append(it.batch, term=term)
+                round_last = it.last
+                if it.acks == 0 and not it.stages.done.done():
+                    it.stages.done.set_result((it.base, it.last))
+                appended.append(it)
+        spans.add("batcher.round_items", float(len(items)))
         self.flush_rounds += 1
-        flushed = await c.log.flush_async()
+        with spans.span("batcher.fsync"):
+            flushed = await c.log.flush_async()
         # leadership may have moved while the fsync ran
         if c._closed or c.role != Role.LEADER or c.term != term:
             exc = NotLeaderError(c.leader_id)
@@ -213,6 +217,7 @@ class ReplicateBatcher:
         c = self._c
         loop = asyncio.get_event_loop()
         deadline = loop.time() + self._quorum_timeout
+        q_t0 = loop.time()
         while c.commit_index < round_last:
             exc: Optional[BaseException] = None
             if c._closed:
@@ -233,6 +238,7 @@ class ReplicateBatcher:
                 await asyncio.wait_for(ev.wait(), deadline - loop.time())
             except asyncio.TimeoutError:
                 continue
+        spans.add("batcher.quorum_wait", loop.time() - q_t0)
         for it in items:
             if it.stages.done.done():
                 continue

@@ -109,6 +109,13 @@ class ShardGroupArrays:
         # term-boundary mirror version: callers caching term_at_batch
         # answers (heartbeat build/check paths) invalidate on change
         self.tb_epoch = 0
+        # count of live append/catch-up fibers per follower slot — the
+        # heartbeat manager suppresses beats to slots a fiber is
+        # actively driving (consensus::suppress_heartbeats /
+        # heartbeat_manager.cc needs_heartbeat). A counter, not a
+        # timestamp: suppression lifts the moment the fiber exits, so
+        # the tick's recovery-fallback role is preserved exactly.
+        self.hb_suppress = np.zeros((g, r), np.int32)
 
     # -- row lifecycle ------------------------------------------------
     def alloc_row(self) -> int:
@@ -148,6 +155,7 @@ class ShardGroupArrays:
         self.quorum_dirty[row] = True
         self._folded_self_m[row] = I64_MIN
         self._folded_self_f[row] = I64_MIN
+        self.hb_suppress[row] = 0
 
     def _grow(self) -> None:
         old = self._cap
@@ -175,6 +183,7 @@ class ShardGroupArrays:
             "quorum_dirty",
             "_folded_self_m",
             "_folded_self_f",
+            "hb_suppress",
         ):
             arr = getattr(self, name)
             shape = (new,) + arr.shape[1:]
