@@ -34,6 +34,7 @@ async def main(n_partitions: int, duration_s: float, tag: str) -> None:
     n_producers = 4
     batch_records = 64
     record_bytes = 1024
+    acks = int(os.environ.get("RP_PROF_ACKS", "-1"))
     shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
     tmp = tempfile.mkdtemp(prefix="rp_prof_", dir=shm)
     brokers = []
@@ -96,7 +97,7 @@ async def main(n_partitions: int, duration_s: float, tag: str) -> None:
             try:
                 while time.perf_counter() < t_end:
                     t0 = time.perf_counter()
-                    await c.produce_wire("repl", pid, wire, acks=-1)
+                    await c.produce_wire("repl", pid, wire, acks=acks)
                     lat_ms.append((time.perf_counter() - t0) * 1e3)
                     sent[0] += batch_records * record_bytes
                     pid = (pid + 1) % n_partitions
@@ -104,6 +105,13 @@ async def main(n_partitions: int, duration_s: float, tag: str) -> None:
                 await c.close()
 
         use_profile = os.environ.get("RP_PROF_CPROFILE", "0") == "1"
+        use_sampler = os.environ.get("RP_PROF_SAMPLE", "0") == "1"
+        sampler = None
+        if use_sampler:
+            from sampler import Sampler
+
+            sampler = Sampler()
+            sampler.start()
         pr = cProfile.Profile()
         t0 = time.perf_counter()
         if use_profile:
@@ -112,6 +120,9 @@ async def main(n_partitions: int, duration_s: float, tag: str) -> None:
         if use_profile:
             pr.disable()
         wall = time.perf_counter() - t0
+        if sampler is not None:
+            sampler.stop()
+            print(sampler.report(35), flush=True)
         gc.callbacks.remove(gc_cb)
 
         mbps = sent[0] / wall / 1e6

@@ -1,0 +1,57 @@
+"""Signal-based sampling profiler (py-spy is not in this image and
+cProfile's tracing overhead collapses the 1-core broker workload to
+~zero throughput — r4 measured 4 rounds/2s under cProfile vs ~1800
+without). SIGPROF fires on CPU time, the handler walks the current
+frame stack; aggregate cost is ~0.1% at 5 ms intervals and the
+workload runs at full speed.
+
+Usage:
+    from sampler import Sampler
+    s = Sampler(); s.start()
+    ... workload ...
+    s.stop(); print(s.report(25))
+"""
+
+from __future__ import annotations
+
+import collections
+import signal
+import sys
+
+
+class Sampler:
+    def __init__(self, interval_s: float = 0.005):
+        self.interval = interval_s
+        self.samples: collections.Counter = collections.Counter()
+        self.total = 0
+        self._old = None
+
+    def _handler(self, signum, frame):
+        self.total += 1
+        # leaf-ward attribution: innermost 3 frames name the hot spot
+        parts = []
+        f = frame
+        depth = 0
+        while f is not None and depth < 3:
+            co = f.f_code
+            fn = co.co_filename
+            short = fn[fn.rfind("/", 0, fn.rfind("/")) + 1 :]
+            parts.append(f"{short}:{co.co_name}:{f.f_lineno}")
+            f = f.f_back
+            depth += 1
+        self.samples[" < ".join(parts)] += 1
+
+    def start(self) -> None:
+        self._old = signal.signal(signal.SIGPROF, self._handler)
+        signal.setitimer(signal.ITIMER_PROF, self.interval, self.interval)
+
+    def stop(self) -> None:
+        signal.setitimer(signal.ITIMER_PROF, 0, 0)
+        if self._old is not None:
+            signal.signal(signal.SIGPROF, self._old)
+
+    def report(self, top: int = 30) -> str:
+        out = [f"samples: {self.total} ({self.total * self.interval:.1f}s CPU)"]
+        for stack, n in self.samples.most_common(top):
+            out.append(f"{n:>6} {100*n/max(1,self.total):5.1f}%  {stack}")
+        return "\n".join(out)

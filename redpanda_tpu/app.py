@@ -106,6 +106,13 @@ class BrokerConfig:
     # default is the test/vendor key whose SIGNING half ships in
     # tests/data/ — a production deployment MUST set this)
     license_public_key_file: Optional[str] = None
+    # SASL/GSSAPI (Kerberos): service principal this broker accepts
+    # tickets for, and a JSON keytab file
+    # ([{"principal": ..., "password"|"key_hex": ..., "etype": 18}]);
+    # both set => the GSSAPI mechanism is offered on the kafka listener
+    gssapi_principal: Optional[str] = None
+    gssapi_keytab_file: Optional[str] = None
+    gssapi_principal_mapping_rules: Optional[list] = None
     # tiered storage: directory backing the filesystem object store
     # (cloud_storage_enabled + bucket analog); None disables tiering
     # unless an object store is injected on the Broker directly
@@ -274,6 +281,40 @@ class Broker:
                     jwks=jwks,
                     principal_claim=config.oidc_principal_claim,
                 )
+            )
+        self.gssapi = None
+        if bool(config.gssapi_principal) != bool(config.gssapi_keytab_file):
+            raise ValueError(
+                "GSSAPI config incomplete: gssapi_principal and "
+                "gssapi_keytab_file must both be set"
+            )
+        if config.gssapi_principal:
+            import json as _json
+
+            from .security import krb5 as _krb5
+            from .security.gssapi_authenticator import GssapiAuthenticator
+
+            keytab = _krb5.Keytab()
+            with open(config.gssapi_keytab_file) as f:
+                for entry in _json.load(f):
+                    etype = int(entry.get("etype", _krb5.AES256_CTS_HMAC_SHA1))
+                    if "key_hex" in entry:
+                        keytab.add(
+                            _krb5.ServiceKey(
+                                entry["principal"],
+                                bytes.fromhex(entry["key_hex"]),
+                                etype,
+                                int(entry.get("kvno", 1)),
+                            )
+                        )
+                    else:
+                        keytab.add_password(
+                            entry["principal"], entry["password"], etype=etype
+                        )
+            self.gssapi = GssapiAuthenticator(
+                keytab,
+                config.gssapi_principal,
+                principal_mapping_rules=config.gssapi_principal_mapping_rules,
             )
         self.controller.logical_version_override = config.logical_version
         self.leaders = PartitionLeadersTable()
@@ -537,7 +578,7 @@ class Broker:
             used.append("tiered_storage")
         if self.oidc is not None:
             used.append("oidc")
-        if getattr(self, "gssapi", None) is not None:
+        if self.gssapi is not None:
             used.append("gssapi")
         return used
 

@@ -51,12 +51,14 @@ class BrokerConnection:
         client_id: str,
         sasl: tuple[str, str, str] | None = None,  # (user, password, mechanism)
         ssl=None,  # ssl.SSLContext for TLS/mTLS listeners
+        gssapi=None,  # security.gssapi_authenticator.GssapiClient
     ):
         self.host = host
         self.port = port
         self._client_id = client_id
         self._sasl = sasl
         self._ssl = ssl
+        self._gssapi = gssapi
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._corr = itertools.count(1)
@@ -79,8 +81,34 @@ class BrokerConnection:
         self.api_versions = {
             k.api_key: (k.min_version, k.max_version) for k in resp.api_keys
         }
-        if self._sasl is not None:
+        if self._gssapi is not None:
+            await self._authenticate_gssapi()
+        elif self._sasl is not None:
             await self._authenticate(*self._sasl)
+
+    async def _authenticate_gssapi(self) -> None:
+        """SASL/GSSAPI (RFC 4752): AP-REQ -> AP-REP -> empty -> wrap
+        offer -> wrap choice, over SaslHandshake + SaslAuthenticate."""
+        from .protocol.admin_apis import SASL_AUTHENTICATE, SASL_HANDSHAKE
+
+        resp = await self.request(
+            SASL_HANDSHAKE, Msg(mechanism="GSSAPI"), version=1
+        )
+        if resp.error_code != 0:
+            raise KafkaClientError(resp.error_code, "sasl_handshake")
+
+        async def step(payload: bytes) -> bytes:
+            r = await self.request(
+                SASL_AUTHENTICATE, Msg(auth_bytes=payload), version=1
+            )
+            if r.error_code != 0:
+                raise KafkaClientError(r.error_code, "gssapi auth")
+            return bytes(r.auth_bytes)
+
+        ap_rep = await step(self._gssapi.initial_token())
+        self._gssapi.verify_ap_rep(ap_rep)
+        offer = await step(b"")
+        await step(self._gssapi.negotiate(offer))
 
     async def _authenticate(
         self, user: str, password: str, mechanism: str
@@ -291,11 +319,16 @@ class KafkaClient:
         client_id: str = "redpanda-tpu-client",
         sasl: tuple[str, str, str] | None = None,  # (user, password, mechanism)
         ssl=None,  # ssl.SSLContext (security.tls.client_context)
+        # zero-arg factory returning a fresh GssapiClient per broker
+        # connection (each AP-REQ must be unique — the broker's replay
+        # cache rejects a reused authenticator)
+        gssapi_factory=None,
     ):
         self._bootstrap = list(bootstrap)
         self._client_id = client_id
         self._sasl = sasl
         self._ssl = ssl
+        self._gssapi_factory = gssapi_factory
         self._conns: dict[tuple[str, int], BrokerConnection] = {}
         self._conn_locks: dict[tuple[str, int], asyncio.Lock] = {}
         self._brokers: dict[int, tuple[str, int]] = {}
@@ -322,6 +355,11 @@ class KafkaClient:
                 conn = BrokerConnection(
                     addr[0], addr[1], self._client_id, sasl=self._sasl,
                     ssl=self._ssl,
+                    gssapi=(
+                        self._gssapi_factory()
+                        if self._gssapi_factory is not None
+                        else None
+                    ),
                 )
                 await conn.connect()
                 self._conns[addr] = conn

@@ -519,6 +519,8 @@ class KafkaServer:
         supported = list(MECHANISMS)
         if self.broker.oidc is not None:
             supported.append(oidc_mod.SASL_MECHANISM)
+        if self.broker.gssapi is not None:
+            supported.append("GSSAPI")
         if req.mechanism not in supported:
             return Msg(
                 error_code=int(ErrorCode.unsupported_sasl_mechanism),
@@ -527,6 +529,8 @@ class KafkaServer:
         ctx.mechanism = req.mechanism
         if req.mechanism == oidc_mod.SASL_MECHANISM:
             ctx.scram = oidc_mod.OauthBearerExchange(self.broker.oidc)
+        elif req.mechanism == "GSSAPI":
+            ctx.scram = self.broker.gssapi.new_exchange()
         else:
             ctx.scram = ScramServerExchange(
                 self.broker.controller.credentials, req.mechanism
@@ -536,6 +540,7 @@ class KafkaServer:
     def handle_sasl_authenticate(
         self, ctx: ConnectionContext, hdr: RequestHeader, req: Msg
     ) -> Msg:
+        from ..security.gssapi_authenticator import GssapiError
         from ..security.oidc import OidcError
         from ..security.scram import ScramError
 
@@ -550,7 +555,15 @@ class KafkaServer:
         if ctx.scram is None:
             return err(int(ErrorCode.illegal_sasl_state), "handshake first")
         try:
-            if ctx.scram.state == "start":
+            if hasattr(ctx.scram, "step"):
+                # multi-round mechanisms (GSSAPI) drive themselves via
+                # a generic step() until done
+                if ctx.scram.done:
+                    return err(
+                        int(ErrorCode.illegal_sasl_state), "exchange complete"
+                    )
+                out = ctx.scram.step(bytes(req.auth_bytes))
+            elif ctx.scram.state == "start":
                 out = ctx.scram.handle_client_first(bytes(req.auth_bytes))
             elif ctx.scram.state == "sent-first":
                 out = ctx.scram.handle_client_final(bytes(req.auth_bytes))
@@ -558,7 +571,7 @@ class KafkaServer:
                 return err(
                     int(ErrorCode.illegal_sasl_state), "exchange complete"
                 )
-        except (ScramError, OidcError) as e:
+        except (ScramError, OidcError, GssapiError) as e:
             logger.info("sasl authentication failed: %s", e)
             return err(int(ErrorCode.sasl_authentication_failed), str(e))
         except Exception as e:

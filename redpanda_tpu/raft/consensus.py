@@ -107,7 +107,6 @@ class Consensus:
         self._peer_locks: dict[int, asyncio.Lock] = {}
         self._commit_event = asyncio.Event()
         self._leadership_waiters: list[asyncio.Event] = []
-        self._timer_task: Optional[asyncio.Task] = None
         self._bg_tasks: set[asyncio.Task] = set()
         self._append_lock = asyncio.Lock()  # append_entries_buffer analog
         self._vote_lock = asyncio.Lock()
@@ -404,15 +403,19 @@ class Consensus:
         if last_term > self.term:
             self.arrays.term[row] = last_term
         self._last_heartbeat = asyncio.get_event_loop().time()
-        self._timer_task = asyncio.ensure_future(self._election_loop())
+        # election scheduling is node-batched: the GroupManager sweeper
+        # scans the el_* lanes (one task per NODE, not per group) and
+        # calls try_election() on expiry — see group_manager.py
+        self.arrays.el_timeout[row] = self._election_timeout
+        self.arrays.el_jitter[row] = random.random()
+        self.arrays.last_el[row] = 0.0
 
     async def stop(self) -> None:
         self._closed = True
         await self._batcher.stop()
-        for t in [self._timer_task, *self._bg_tasks]:
-            if t is not None:
-                t.cancel()
-        tasks = [t for t in [self._timer_task, *self._bg_tasks] if t is not None]
+        for t in self._bg_tasks:
+            t.cancel()
+        tasks = list(self._bg_tasks)
         if tasks:
             await asyncio.gather(*tasks, return_exceptions=True)
         if self._observe_append in self.log.on_append:
@@ -496,22 +499,22 @@ class Consensus:
         return self.log.get_term(offset)
 
     # ------------------------------------------------------- elections
-    async def _election_loop(self) -> None:
-        while not self._closed:
-            timeout = self._election_timeout * (1.0 + random.random())
-            await asyncio.sleep(timeout)
-            if self._closed or self.role == Role.LEADER:
-                continue
-            now = asyncio.get_event_loop().time()
-            if now - self._last_heartbeat < self._election_timeout:
-                continue
-            if not self.config.is_voter(self.node_id):
-                continue
-            try:
-                if await self.dispatch_prevote():
-                    await self.dispatch_vote()
-            except Exception:
-                logger.exception("g%d: election round failed", self.group_id)
+    async def try_election(self) -> None:
+        """One election attempt — fired by the node-level sweeper when
+        this group's randomized deadline expired (semantics of the old
+        per-group timer loop, minus 1-task-per-group overhead)."""
+        if self._closed or self.role == Role.LEADER:
+            return
+        now = asyncio.get_event_loop().time()
+        if now - self._last_heartbeat < self._election_timeout:
+            return
+        if not self.config.is_voter(self.node_id):
+            return
+        try:
+            if await self.dispatch_prevote():
+                await self.dispatch_vote()
+        except Exception:
+            logger.exception("g%d: election round failed", self.group_id)
 
     async def dispatch_prevote(self) -> bool:
         """Prevote round (prevote_stm.cc): ask voters whether a REAL

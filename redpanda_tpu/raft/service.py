@@ -260,6 +260,17 @@ class RaftService(Service):
             self._reply_cache.pop(sender, None)
         return out
 
+    @method(rt.APPEND_ENTRIES_BATCH)
+    async def append_entries_batch(self, payload: bytes) -> bytes:
+        """Many groups' appends in one frame (append_aggregator): one
+        sequential pass — with coalesced/inline fsync each per-group
+        handler rarely suspends, so no per-group task spawn is needed —
+        and one multiplexed reply."""
+        replies: list[bytes] = []
+        for item in rt.decode_multi(payload):
+            replies.append(await self.append_entries(item))
+        return rt.encode_multi(replies)
+
     @method(rt.INSTALL_SNAPSHOT)
     async def install_snapshot(self, payload: bytes) -> bytes:
         req = rt.InstallSnapshotRequest.decode(payload)

@@ -18,6 +18,34 @@ HEARTBEAT = 102
 INSTALL_SNAPSHOT = 103
 TIMEOUT_NOW = 104
 TRANSFER_LEADERSHIP = 105
+# many groups' append_entries multiplexed in one frame per peer node
+# (append_entries_buffer.{h,cc} applied ACROSS groups: one RPC, one
+# follower pass, one reply — per-call overhead O(1) in group count)
+APPEND_ENTRIES_BATCH = 106
+
+
+def encode_multi(payloads: list[bytes]) -> bytes:
+    """Length-prefixed concatenation for APPEND_ENTRIES_BATCH: each
+    item is an opaque AppendEntriesRequest/Reply frame."""
+    parts = [len(payloads).to_bytes(4, "little")]
+    for p in payloads:
+        parts.append(len(p).to_bytes(4, "little"))
+        parts.append(p)
+    return b"".join(parts)
+
+
+def decode_multi(raw: bytes) -> list[bytes]:
+    n = int.from_bytes(raw[:4], "little")
+    out: list[bytes] = []
+    pos = 4
+    for _ in range(n):
+        ln = int.from_bytes(raw[pos : pos + 4], "little")
+        pos += 4
+        out.append(raw[pos : pos + ln])
+        pos += ln
+    if pos != len(raw):
+        raise ValueError("trailing bytes in append batch frame")
+    return out
 
 
 class VoteRequest(serde.Envelope):

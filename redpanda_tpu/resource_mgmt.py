@@ -252,7 +252,11 @@ class MemoryGovernor:
         gen0_threshold: int = 50_000,
         gen1_threshold: int = 20,
         gen2_threshold: int = 100,
-        refreeze_interval_s: float = 0.0,  # 0 = never re-freeze
+        # periodic collect+freeze: objects settling AFTER start (e.g.
+        # partitions materialized post-boot) join the frozen graph at a
+        # deliberate, bounded cadence instead of being full-scanned by
+        # every eventual gen2 pass. 0 disables.
+        refreeze_interval_s: float = 300.0,
     ):
         self.gen0_threshold = gen0_threshold
         self.gen1_threshold = gen1_threshold
@@ -300,15 +304,6 @@ class MemoryGovernor:
         gc.freeze()
         if self.refreeze_interval_s > 0:
             self._task = asyncio.ensure_future(self._refreeze_loop())
-
-    def started_late(self) -> None:
-        """Freeze again after late initialization (e.g. a broker that
-        finished materializing partitions after start())."""
-        import gc
-
-        if self._refs > 0:
-            gc.collect()
-            gc.freeze()
 
     async def _refreeze_loop(self) -> None:
         import gc

@@ -57,18 +57,24 @@ class GssapiAuthenticator:
             if "@" in service_principal
             else ""
         )
-        self._replay: dict[tuple, float] = {}
+        # two-generation replay cache: the current and previous window
+        # together cover every authenticator the clock-skew check can
+        # still accept, rotation is O(1), and memory is bounded by two
+        # windows of auth traffic (rd_req replay cache analog; no
+        # per-auth full-dict rebuilds under sustained load)
+        self._replay_cur: set[tuple] = set()
+        self._replay_prev: set[tuple] = set()
+        self._replay_rotated = 0.0
 
     def check_replay(self, key: tuple, now: float) -> bool:
         """True if fresh (and records it); False on replay."""
-        horizon = now - 2 * self.clock_skew_s
-        if len(self._replay) > 4096:
-            self._replay = {
-                k: t for k, t in self._replay.items() if t >= horizon
-            }
-        if key in self._replay:
+        if now - self._replay_rotated > 2 * self.clock_skew_s:
+            self._replay_prev = self._replay_cur
+            self._replay_cur = set()
+            self._replay_rotated = now
+        if key in self._replay_cur or key in self._replay_prev:
             return False
-        self._replay[key] = now
+        self._replay_cur.add(key)
         return True
 
     def new_exchange(self) -> "GssapiServerExchange":

@@ -201,20 +201,23 @@ class Segment:
         return self.stable_offset
 
     async def flush_async(self) -> int:
-        """fsync on an executor thread so the event loop keeps
-        accepting appends while the disk syncs (segment_appender.cc
-        background flush). Only bytes pushed to the OS before the fsync
-        are counted: the stable offset advances to the dirty offset
-        captured at call time, never past it."""
+        """fsync off the event loop so it keeps accepting appends
+        while the disk syncs (segment_appender.cc background flush),
+        coalesced ACROSS segments: concurrent flush rounds from many
+        raft groups share one executor round trip
+        (storage.flush_coalescer). Only bytes pushed to the OS before
+        the fsync are counted: the stable offset advances to the dirty
+        offset captured at call time, never past it."""
+        from .flush_coalescer import FlushCoalescer
+
         if self.stable_offset >= self.dirty_offset and self._file is None:
             return self.stable_offset  # nothing unsynced: skip a reopen
         f = self._wfile()
         f.flush()  # python buffer → OS (loop thread, cheap)
         target = self.dirty_offset
-        loop = asyncio.get_event_loop()
         self._pins += 1  # hold the fileno against FD_BUDGET eviction
         try:
-            await loop.run_in_executor(None, os.fsync, f.fileno())
+            await FlushCoalescer.get().fsync(f.fileno())
         finally:
             self._pins -= 1
         self.stable_offset = max(self.stable_offset, target)

@@ -137,6 +137,14 @@ async def _admin_surface(tmp_path):
             {"username": "op", "password": "pw"},
         )
         assert st == 204
+        # the 204 proves QUORUM commit; a specific follower applies on
+        # its next commit-carrying beat/append — poll briefly
+        deadline = asyncio.get_event_loop().time() + 3
+        while (
+            not brokers[2].controller.credentials.contains("op")
+            and asyncio.get_event_loop().time() < deadline
+        ):
+            await asyncio.sleep(0.02)
         assert brokers[2].controller.credentials.contains("op")
         st, _ = await http(addr, "DELETE", "/v1/security/users/op")
         assert st == 204
