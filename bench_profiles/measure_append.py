@@ -1,0 +1,155 @@
+"""In-situ wall timing of the sync leaf functions on the replicated
+hot path — sampling attribution is biased for C-heavy lines (SIGPROF
+delivery defers across C calls), so this wraps the suspects directly
+and reports true wall shares."""
+
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class T:
+    __slots__ = ("name", "n", "tot")
+
+    def __init__(self, name):
+        self.name = name
+        self.n = 0
+        self.tot = 0.0
+
+
+TIMERS: list[T] = []
+
+
+def wrap(obj, attr, name=None):
+    fn = getattr(obj, attr)
+    t = T(name or f"{obj.__name__}.{attr}")
+    TIMERS.append(t)
+
+    def timed(*a, **kw):
+        t0 = time.perf_counter()
+        try:
+            return fn(*a, **kw)
+        finally:
+            t.tot += time.perf_counter() - t0
+            t.n += 1
+
+    setattr(obj, attr, timed)
+    return t
+
+
+def wrap_cls(cls, attr, name=None):
+    fn = getattr(cls, attr)
+    t = T(name or f"{cls.__name__}.{attr}")
+    TIMERS.append(t)
+
+    def timed(self, *a, **kw):
+        t0 = time.perf_counter()
+        try:
+            return fn(self, *a, **kw)
+        finally:
+            t.tot += time.perf_counter() - t0
+            t.n += 1
+
+    setattr(cls, attr, timed)
+    return t
+
+
+def main() -> None:
+    import tempfile
+    import shutil
+
+    from redpanda_tpu.storage import segment as seg_mod
+    from redpanda_tpu.storage.batch_cache import BatchCacheIndex
+    from redpanda_tpu.models import record as rec_mod
+    from redpanda_tpu.raft import types as rt
+    from redpanda_tpu.raft.consensus import Consensus
+    from redpanda_tpu.storage.log import Log
+
+    wrap_cls(seg_mod.Segment, "append", "segment.append")
+    wrap_cls(BatchCacheIndex, "put", "batch_cache.put")
+    wrap_cls(rec_mod.RecordBatch, "serialize", "record.serialize")
+    wrap(rec_mod.RecordBatch, "deserialize", "record.deserialize")
+    wrap(rt.AppendEntriesRequest, "decode", "aer.decode")
+    wrap_cls(rt.AppendEntriesRequest, "encode", "aer.encode")
+    wrap_cls(rt.AppendEntriesReply, "encode", "rep.encode")
+    wrap(rt.AppendEntriesReply, "decode", "rep.decode")
+    wrap_cls(Log, "offsets", "log.offsets")
+    wrap_cls(Consensus, "handle_append_entries_sync", "follower.handle") if hasattr(
+        Consensus, "handle_append_entries_sync"
+    ) else None
+
+    async def run():
+        import bench
+        from redpanda_tpu.kafka.client import KafkaClient
+        from redpanda_tpu.models.record import RecordBatchBuilder
+
+        shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+        tmp = tempfile.mkdtemp(prefix="rp_meas_", dir=shm)
+        brokers = []
+        try:
+            brokers = await bench._cluster(tmp, 3)
+            client = KafkaClient([b.kafka_advertised for b in brokers])
+            n_partitions = 1024
+            await client.create_topic(
+                "repl", partitions=n_partitions, replication_factor=3
+            )
+            payload = os.urandom(1008)
+            b = RecordBatchBuilder()
+            for i in range(64):
+                b.add(payload, key=b"k%012d" % i)
+            wire = b.build().to_kafka_wire()
+            deadline = time.monotonic() + 120.0
+            pid = 0
+            while pid < n_partitions:
+                try:
+                    await client.produce_wire("repl", pid, wire, acks=-1)
+                    pid += max(1, n_partitions // 16)
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise
+                    await asyncio.sleep(0.25)
+            for t in TIMERS:
+                t.n = 0
+                t.tot = 0.0
+            t_end = time.perf_counter() + 6.0
+            sent = 0
+
+            async def producer(idx):
+                nonlocal sent
+                c = KafkaClient([x.kafka_advertised for x in brokers])
+                p = idx * (n_partitions // 4)
+                try:
+                    while time.perf_counter() < t_end:
+                        await c.produce_wire("repl", p, wire, acks=-1)
+                        sent += 64 * 1024
+                        p = (p + 1) % n_partitions
+                finally:
+                    await c.close()
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*(producer(i) for i in range(4)))
+            el = time.perf_counter() - t0
+            print(f"mbps={sent/el/1e6:.1f} window={el:.1f}s")
+            for t in sorted(TIMERS, key=lambda x: -x.tot):
+                if t.n:
+                    print(
+                        f"{t.name:<22} {100*t.tot/el:5.1f}%  n={t.n:<7} "
+                        f"mean={1e6*t.tot/t.n:6.1f}us"
+                    )
+            await client.close()
+        finally:
+            for br in brokers:
+                try:
+                    await br.stop()
+                except Exception:
+                    pass
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

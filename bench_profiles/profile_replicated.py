@@ -108,7 +108,12 @@ async def main(n_partitions: int, duration_s: float, tag: str) -> None:
         use_sampler = os.environ.get("RP_PROF_SAMPLE", "0") == "1"
         sampler = None
         if use_sampler:
-            from sampler import Sampler
+            if os.environ.get("RP_PROF_PHASES", "0") == "1":
+                from sampler import PhaseSampler as Sampler
+            elif os.environ.get("RP_PROF_STACKS", "0") == "1":
+                from sampler import StackSampler as Sampler
+            else:
+                from sampler import Sampler
 
             sampler = Sampler()
             sampler.start()

@@ -55,3 +55,80 @@ class Sampler:
         for stack, n in self.samples.most_common(top):
             out.append(f"{n:>6} {100*n/max(1,self.total):5.1f}%  {stack}")
         return "\n".join(out)
+
+
+class StackSampler(Sampler):
+    """Records the full folded stack per sample; report() prints
+    inclusive per-function percentages (flamegraph column view)."""
+
+    def _handler(self, signum, frame):
+        self.total += 1
+        parts = []
+        f = frame
+        while f is not None:
+            co = f.f_code
+            fn = co.co_filename
+            short = fn[fn.rfind("/", 0, fn.rfind("/")) + 1 :]
+            parts.append(f"{short}:{co.co_name}")
+            f = f.f_back
+        self.samples[tuple(parts)] += 1
+
+    def report(self, top: int = 40) -> str:
+        import collections
+
+        incl: collections.Counter = collections.Counter()
+        for stack, n in self.samples.items():
+            for fr in set(stack):
+                incl[fr] += n
+        out = [f"samples: {self.total} ({self.total * self.interval:.1f}s CPU)"]
+        out.append("-- inclusive % (function appears anywhere in stack) --")
+        for fr, n in incl.most_common(top):
+            out.append(f"{n:>6} {100*n/max(1,self.total):5.1f}%  {fr}")
+        return "\n".join(out)
+
+
+class PhaseSampler(Sampler):
+    """Buckets each sample by the outermost recognizable subsystem
+    frame instead of the innermost 3 — answers "which phase of the
+    round burns the CPU" rather than "which line"."""
+
+    MARKERS = [
+        ("_do_append_entries", "follower:append_entries"),
+        ("install_snapshot", "follower:install_snapshot"),
+        ("_flush_round", "leader:replicate_batcher"),
+        ("_dispatch_append", "leader:dispatch_append"),
+        ("_flush_rounds", "leader:append_aggregator"),
+        ("heartbeat", "raft:heartbeat"),
+        ("try_election", "raft:election"),
+        ("handle_produce", "kafka:produce_handler"),
+        ("handle_fetch", "kafka:fetch_handler"),
+        ("handle", "kafka:other_handler"),
+        ("produce_wire", "client:produce"),
+        ("write_loop", "kafka:write_loop"),
+        ("read_loop", "kafka:read_loop"),
+        ("dispatch", "rpc:dispatch"),
+        ("call", "rpc:call"),
+        ("_tick", "background:tick"),
+        ("_run_once", "asyncio:loop"),
+    ]
+
+    def _handler(self, signum, frame):
+        self.total += 1
+        names = []
+        f = frame
+        while f is not None:
+            names.append(f.f_code.co_name)
+            f = f.f_back
+        # innermost match wins: the deepest recognizable subsystem
+        # frame owns the sample (loopback RPC runs server handlers
+        # inline under the caller's stack, so outermost scanning
+        # mis-charges follower work to the leader)
+        label = None
+        for name in names:
+            for marker, lab in self.MARKERS:
+                if name == marker:
+                    label = lab
+                    break
+            if label is not None and not label.startswith("asyncio"):
+                break
+        self.samples[label or "other:" + names[0]] += 1

@@ -61,7 +61,10 @@ class BrokerConfig:
     # fallback only — the replicated members table takes precedence
     # once nodes register
     peer_kafka_addresses: Optional[dict[int, tuple[str, int]]] = None
-    election_timeout_s: float = 0.3
+    # reference default: election_timeout_ms=1500 (config.cc). The old
+    # 0.3 s default was tuned for fast tests (which all pin their own
+    # value) but storms under load when brokers share one starved core.
+    election_timeout_s: float = 1.5
     heartbeat_interval_s: float = 0.05
     # liveness ping cadence (node_status_backend); <= 0 disables
     node_status_interval_s: float = 0.5

@@ -62,6 +62,7 @@ class GroupManager:
         # one sweeper task scans the el_* SoA lanes instead of one
         # asyncio timer per group
         self._sweeper_task = None
+        self._lag_skips = 0
         self._rows_cache: tuple[int, "object"] | None = None
         self._min_el_timeout = 3600.0
 
@@ -116,7 +117,28 @@ class GroupManager:
             # a short timeout right after start isn't stuck behind one
             # long initial sleep
             interval = min(0.05, max(0.005, self._min_el_timeout / 4.0))
+            t_sleep = loop.time()
             await asyncio.sleep(interval)
+            # loop-lag compensation: if this sweep itself was starved
+            # (event loop stalled — GC, inline fsync burst, append
+            # backlog), inbound appends/heartbeats were sitting
+            # unprocessed in socket buffers, so last_hb staleness is an
+            # observer artifact, not peer death. Firing elections off a
+            # stalled observation is exactly the storm that tanks the
+            # acks=all bench; skip this pass and let the next clean
+            # sweep decide.
+            lag = loop.time() - t_sleep - interval
+            if lag > max(0.25 * self._min_el_timeout, 2.0 * interval):
+                # liveness bound: sustained lag must not suppress
+                # elections forever — a genuinely dead remote leader
+                # still has to be replaced even on a struggling node.
+                # Skipping only bursts (< ~1 timeout's worth in a row)
+                # filters stall artifacts without capping detection at
+                # worse than ~2x the configured timeout.
+                self._lag_skips += 1
+                if self._lag_skips * interval < self._min_el_timeout:
+                    continue
+            self._lag_skips = 0
             if not self._groups:
                 continue
             cache = self._rows_cache

@@ -98,9 +98,14 @@ class LoopbackTransport:
         try:
             coro = self._net.deliver(self.src, self.dst, method_id, payload)
             if timeout is not None:
-                return await asyncio.wait_for(coro, timeout)
+                # asyncio.timeout (3.11+) arms a timer on the current
+                # task instead of wrapping the coro in a new Task the
+                # way wait_for does — one Task per RPC was ~5% of the
+                # replicated-bench core
+                async with asyncio.timeout(timeout):
+                    return await coro
             return await coro
-        except asyncio.TimeoutError:
+        except TimeoutError:
             raise RpcError(Status.TIMEOUT, f"method {method_id} timed out")
 
     async def close(self) -> None:

@@ -204,6 +204,9 @@ class SerdeError(ValueError):
     pass
 
 
+_UNSET = object()  # _fast_prefix cache sentinel (None is a valid value)
+
+
 class Envelope:
     """Base for versioned wire types. Subclasses set SERDE_FIELDS (and
     optionally SERDE_VERSION / SERDE_COMPAT_VERSION) and get __init__,
@@ -215,6 +218,38 @@ class Envelope:
     # defaults for trailing fields absent in envelopes written by older
     # versions (property of appended-field evolution)
     SERDE_DEFAULTS: dict = {}
+
+    @classmethod
+    def _fast_prefix(cls):
+        """One compiled struct for the leading run of fixed-width/bool
+        fields — collapses N per-field decode lambdas into a single
+        unpack (and likewise for encode). Wire bytes are identical to
+        the per-field path (same fixed LE encodings; bool is one byte,
+        normalized to 0/1 on encode, `!= 0` on decode). Computed
+        lazily per class so dynamically-built SERDE_FIELDS still work."""
+        fast = cls.__dict__.get("_FAST_PREFIX_CACHE", _UNSET)
+        if fast is not _UNSET:
+            return fast
+        fmt = "<"
+        names: list[str] = []
+        bools: list[int] = []
+        for i, (name, t) in enumerate(cls.SERDE_FIELDS):
+            spec = t.spec
+            if spec is not None and spec[0] == "fixed":
+                fmt += spec[1][1:]  # strip the leading "<"
+            elif spec is not None and spec[0] == "bool":
+                fmt += "B"
+                bools.append(i)
+            else:
+                break
+            names.append(name)
+        fast = (
+            (struct.Struct(fmt), tuple(names), tuple(bools))
+            if len(names) >= 2
+            else None
+        )
+        cls._FAST_PREFIX_CACHE = fast
+        return fast
 
     def __init__(self, **kwargs: Any):
         names = [n for n, _ in self.SERDE_FIELDS]
@@ -231,8 +266,18 @@ class Envelope:
             raise TypeError(f"unknown fields: {sorted(kwargs)}")
 
     def encode(self) -> bytes:
-        body = bytearray()
-        for name, t in self.SERDE_FIELDS:
+        fast = self._fast_prefix()
+        if fast is not None:
+            s, names, bools = fast
+            vals = [getattr(self, n) for n in names]
+            for i in bools:
+                vals[i] = 1 if vals[i] else 0
+            body = bytearray(s.pack(*vals))
+            rest = self.SERDE_FIELDS[len(names):]
+        else:
+            body = bytearray()
+            rest = self.SERDE_FIELDS
+        for name, t in rest:
             t.encode(body, getattr(self, name))
         head = struct.pack(
             "<BBI", self.SERDE_VERSION, self.SERDE_COMPAT_VERSION, len(body)
@@ -250,7 +295,20 @@ class Envelope:
             )
         end = p.pos() + size
         obj = cls.__new__(cls)
-        for name, t in cls.SERDE_FIELDS:
+        fast = cls._fast_prefix()
+        fields = cls.SERDE_FIELDS
+        if fast is not None:
+            s, names, bools = fast
+            if end - p.pos() >= s.size:
+                vals = s.unpack(p.read(s.size))
+                i = 0
+                for n in names:
+                    setattr(obj, n, vals[i])
+                    i += 1
+                for i in bools:
+                    setattr(obj, names[i], vals[i] != 0)
+                fields = fields[len(names):]
+        for name, t in fields:
             if p.pos() >= end:
                 # older peer/log entry: fields added after its version
                 # are absent — fill declared defaults, else fail

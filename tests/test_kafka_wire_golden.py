@@ -31,20 +31,45 @@ from redpanda_tpu.kafka.protocol.apis import (
     PRODUCE,
 )
 from redpanda_tpu.kafka.protocol.admin_apis import (
+    ALTER_CONFIGS,
+    ALTER_PARTITION_REASSIGNMENTS,
+    CREATE_ACLS,
+    CREATE_PARTITIONS,
+    DELETE_ACLS,
+    DELETE_RECORDS,
+    DESCRIBE_ACLS,
+    DESCRIBE_CONFIGS,
+    DESCRIBE_LOG_DIRS,
+    DESCRIBE_PRODUCERS,
+    INCREMENTAL_ALTER_CONFIGS,
+    LIST_PARTITION_REASSIGNMENTS,
+    OFFSET_DELETE,
+    OFFSET_FOR_LEADER_EPOCH,
+    SASL_AUTHENTICATE,
     SASL_HANDSHAKE,
 )
 from redpanda_tpu.kafka.protocol.group_apis import (
+    DELETE_GROUPS,
     DELETE_TOPICS,
+    DESCRIBE_GROUPS,
     FIND_COORDINATOR,
     HEARTBEAT,
     INIT_PRODUCER_ID,
     JOIN_GROUP,
     LEAVE_GROUP,
+    LIST_GROUPS,
     OFFSET_COMMIT,
     OFFSET_FETCH,
     SYNC_GROUP,
 )
-from redpanda_tpu.kafka.protocol.tx_apis import ADD_PARTITIONS_TO_TXN
+from redpanda_tpu.kafka.protocol.tx_apis import (
+    ADD_OFFSETS_TO_TXN,
+    ADD_PARTITIONS_TO_TXN,
+    DESCRIBE_TRANSACTIONS,
+    END_TXN,
+    LIST_TRANSACTIONS,
+    TXN_OFFSET_COMMIT,
+)
 
 CORPUS = os.path.join(os.path.dirname(__file__), "corpus", "kafka_wire")
 
@@ -589,6 +614,1088 @@ VECTORS = [
         s16("txn-1") + i64(4000) + i16(0)
         + arr([s16("t") + arr([i32(0), i32(1)])]),
     ),
+    # ---- round-4 completion: every registered API pinned ------------
+    # Fetch (1) response v11: full partition shape incl. aborted txns
+    (
+        "fetch_resp_v11",
+        FETCH, 11, "response",
+        {
+            "throttle_time_ms": 0,
+            "error_code": 0,
+            "session_id": 77,
+            "responses": [
+                {
+                    "topic": "t",
+                    "partitions": [
+                        {
+                            "partition_index": 0,
+                            "error_code": 0,
+                            "high_watermark": 100,
+                            "last_stable_offset": 100,
+                            "log_start_offset": 0,
+                            "aborted_transactions": [
+                                {"producer_id": 4000, "first_offset": 50},
+                            ],
+                            "preferred_read_replica": -1,
+                            "records": _RECORDS,
+                        }
+                    ],
+                }
+            ],
+        },
+        i32(0) + i16(0) + i32(77)
+        + arr([
+            s16("t")
+            + arr([
+                i32(0) + i16(0) + i64(100) + i64(100) + i64(0)
+                + arr([i64(4000) + i64(50)])
+                + i32(-1)
+                + b32(_RECORDS)
+            ])
+        ]),
+    ),
+    # OffsetCommit (8) response v2
+    (
+        "offset_commit_resp_v2",
+        OFFSET_COMMIT, 2, "response",
+        {
+            "topics": [
+                {
+                    "name": "t",
+                    "partitions": [
+                        {"partition_index": 0, "error_code": 0},
+                    ],
+                }
+            ],
+        },
+        arr([s16("t") + arr([i32(0) + i16(0)])]),
+    ),
+    # JoinGroup (11) response v2 and v5 (group_instance_id)
+    (
+        "join_group_resp_v2",
+        JOIN_GROUP, 2, "response",
+        {
+            "throttle_time_ms": 0,
+            "error_code": 0,
+            "generation_id": 3,
+            "protocol_name": "range",
+            "leader": "m1",
+            "member_id": "m1",
+            "members": [
+                {"member_id": "m1", "metadata": b"\x01"},
+            ],
+        },
+        i32(0) + i16(0) + i32(3) + s16("range") + s16("m1") + s16("m1")
+        + arr([s16("m1") + b32(b"\x01")]),
+    ),
+    (
+        "join_group_resp_v5",
+        JOIN_GROUP, 5, "response",
+        {
+            "throttle_time_ms": 0,
+            "error_code": 0,
+            "generation_id": 3,
+            "protocol_name": "range",
+            "leader": "m1",
+            "member_id": "m2",
+            "members": [
+                {
+                    "member_id": "m1",
+                    "group_instance_id": None,
+                    "metadata": b"",
+                },
+            ],
+        },
+        i32(0) + i16(0) + i32(3) + s16("range") + s16("m1") + s16("m2")
+        + arr([s16("m1") + s16(None) + b32(b"")]),
+    ),
+    # LeaveGroup (13) response v3 (members array) and v4 flex
+    (
+        "leave_group_resp_v3",
+        LEAVE_GROUP, 3, "response",
+        {
+            "throttle_time_ms": 0,
+            "error_code": 0,
+            "members": [
+                {
+                    "member_id": "m1",
+                    "group_instance_id": None,
+                    "error_code": 0,
+                },
+            ],
+        },
+        i32(0) + i16(0) + arr([s16("m1") + s16(None) + i16(0)]),
+    ),
+    (
+        "leave_group_resp_v4_flex",
+        LEAVE_GROUP, 4, "response",
+        {
+            "throttle_time_ms": 0,
+            "error_code": 0,
+            "members": [
+                {
+                    "member_id": "m1",
+                    "group_instance_id": "i1",
+                    "error_code": 0,
+                },
+            ],
+        },
+        i32(0) + i16(0)
+        + carr([cs("m1") + cs("i1") + i16(0) + TAG0])
+        + TAG0,
+    ),
+    # SyncGroup (14) response v1
+    (
+        "sync_group_resp_v1",
+        SYNC_GROUP, 1, "response",
+        {"throttle_time_ms": 0, "error_code": 0, "assignment": b"\x05\x06"},
+        i32(0) + i16(0) + b32(b"\x05\x06"),
+    ),
+    # CreateTopics (19) response v2
+    (
+        "create_topics_resp_v2",
+        CREATE_TOPICS, 2, "response",
+        {
+            "throttle_time_ms": 0,
+            "topics": [
+                {"name": "t", "error_code": 0, "error_message": None},
+            ],
+        },
+        i32(0) + arr([s16("t") + i16(0) + s16(None)]),
+    ),
+    # DeleteTopics (20) response v1
+    (
+        "delete_topics_resp_v1",
+        DELETE_TOPICS, 1, "response",
+        {
+            "throttle_time_ms": 0,
+            "responses": [{"name": "t", "error_code": 0}],
+        },
+        i32(0) + arr([s16("t") + i16(0)]),
+    ),
+    # AddPartitionsToTxn (24) response v0
+    (
+        "add_partitions_to_txn_resp_v0",
+        ADD_PARTITIONS_TO_TXN, 0, "response",
+        {
+            "throttle_time_ms": 0,
+            "results": [
+                {
+                    "name": "t",
+                    "results": [
+                        {"partition_index": 0, "error_code": 0},
+                        {"partition_index": 1, "error_code": 0},
+                    ],
+                }
+            ],
+        },
+        i32(0)
+        + arr([
+            s16("t") + arr([i32(0) + i16(0), i32(1) + i16(0)])
+        ]),
+    ),
+    # DescribeGroups (15): v0 (minimal) and v4 (group_instance_id)
+    (
+        "describe_groups_req_v0",
+        DESCRIBE_GROUPS, 0, "request",
+        {"groups": ["g1", "g2"]},
+        arr([s16("g1"), s16("g2")]),
+    ),
+    (
+        "describe_groups_req_v4",
+        DESCRIBE_GROUPS, 4, "request",
+        {"groups": ["g1"], "include_authorized_operations": True},
+        arr([s16("g1")]) + boolean(True),
+    ),
+    (
+        "describe_groups_resp_v0",
+        DESCRIBE_GROUPS, 0, "response",
+        {
+            "groups": [
+                {
+                    "error_code": 0,
+                    "group_id": "g1",
+                    "group_state": "Stable",
+                    "protocol_type": "consumer",
+                    "protocol_data": "range",
+                    "members": [
+                        {
+                            "member_id": "m1",
+                            "client_id": "c1",
+                            "client_host": "/10.0.0.1",
+                            "member_metadata": b"\x01\x02",
+                            "member_assignment": b"\x03",
+                        }
+                    ],
+                }
+            ],
+        },
+        arr([
+            i16(0) + s16("g1") + s16("Stable") + s16("consumer")
+            + s16("range")
+            + arr([
+                s16("m1") + s16("c1") + s16("/10.0.0.1")
+                + b32(b"\x01\x02") + b32(b"\x03")
+            ])
+        ]),
+    ),
+    (
+        "describe_groups_resp_v4",
+        DESCRIBE_GROUPS, 4, "response",
+        {
+            "throttle_time_ms": 0,
+            "groups": [
+                {
+                    "error_code": 0,
+                    "group_id": "g1",
+                    "group_state": "Empty",
+                    "protocol_type": "consumer",
+                    "protocol_data": "",
+                    "members": [
+                        {
+                            "member_id": "m1",
+                            "group_instance_id": None,
+                            "client_id": "c1",
+                            "client_host": "h",
+                            "member_metadata": b"",
+                            "member_assignment": b"",
+                        }
+                    ],
+                    "authorized_operations": -2147483648,
+                }
+            ],
+        },
+        i32(0)
+        + arr([
+            i16(0) + s16("g1") + s16("Empty") + s16("consumer") + s16("")
+            + arr([
+                s16("m1") + s16(None) + s16("c1") + s16("h")
+                + b32(b"") + b32(b"")
+            ])
+            + i32(-2147483648)
+        ]),
+    ),
+    # ListGroups (16): v0 and v2
+    (
+        "list_groups_req_v0",
+        LIST_GROUPS, 0, "request",
+        {},
+        b"",
+    ),
+    (
+        "list_groups_resp_v2",
+        LIST_GROUPS, 2, "response",
+        {
+            "throttle_time_ms": 0,
+            "error_code": 0,
+            "groups": [
+                {"group_id": "g1", "protocol_type": "consumer"},
+            ],
+        },
+        i32(0) + i16(0) + arr([s16("g1") + s16("consumer")]),
+    ),
+    # DeleteRecords (21): v0 request + response
+    (
+        "delete_records_req_v0",
+        DELETE_RECORDS, 0, "request",
+        {
+            "topics": [
+                {
+                    "name": "t",
+                    "partitions": [
+                        {"partition_index": 0, "offset": 42},
+                    ],
+                }
+            ],
+            "timeout_ms": 30000,
+        },
+        arr([s16("t") + arr([i32(0) + i64(42)])]) + i32(30000),
+    ),
+    (
+        "delete_records_resp_v1",
+        DELETE_RECORDS, 1, "response",
+        {
+            "throttle_time_ms": 0,
+            "topics": [
+                {
+                    "name": "t",
+                    "partitions": [
+                        {
+                            "partition_index": 0,
+                            "low_watermark": 42,
+                            "error_code": 0,
+                        },
+                    ],
+                }
+            ],
+        },
+        i32(0) + arr([s16("t") + arr([i32(0) + i64(42) + i16(0)])]),
+    ),
+    # OffsetForLeaderEpoch (23): v0 and v2 (current_leader_epoch added)
+    (
+        "offset_for_leader_epoch_req_v0",
+        OFFSET_FOR_LEADER_EPOCH, 0, "request",
+        {
+            "topics": [
+                {
+                    "topic": "t",
+                    "partitions": [
+                        {"partition": 3, "leader_epoch": 7},
+                    ],
+                }
+            ],
+        },
+        arr([s16("t") + arr([i32(3) + i32(7)])]),
+    ),
+    (
+        "offset_for_leader_epoch_req_v2",
+        OFFSET_FOR_LEADER_EPOCH, 2, "request",
+        {
+            "topics": [
+                {
+                    "topic": "t",
+                    "partitions": [
+                        {
+                            "partition": 3,
+                            "current_leader_epoch": 9,
+                            "leader_epoch": 7,
+                        },
+                    ],
+                }
+            ],
+        },
+        arr([s16("t") + arr([i32(3) + i32(9) + i32(7)])]),
+    ),
+    (
+        "offset_for_leader_epoch_resp_v2",
+        OFFSET_FOR_LEADER_EPOCH, 2, "response",
+        {
+            "throttle_time_ms": 0,
+            "topics": [
+                {
+                    "topic": "t",
+                    "partitions": [
+                        {
+                            "error_code": 0,
+                            "partition": 3,
+                            "leader_epoch": 7,
+                            "end_offset": 1000,
+                        },
+                    ],
+                }
+            ],
+        },
+        i32(0) + arr([s16("t") + arr([i16(0) + i32(3) + i32(7) + i64(1000)])]),
+    ),
+    # AddOffsetsToTxn (25): v0 both directions
+    (
+        "add_offsets_to_txn_req_v0",
+        ADD_OFFSETS_TO_TXN, 0, "request",
+        {
+            "transactional_id": "txn-1",
+            "producer_id": 4000,
+            "producer_epoch": 1,
+            "group_id": "g1",
+        },
+        s16("txn-1") + i64(4000) + i16(1) + s16("g1"),
+    ),
+    (
+        "add_offsets_to_txn_resp_v0",
+        ADD_OFFSETS_TO_TXN, 0, "response",
+        {"throttle_time_ms": 0, "error_code": 0},
+        i32(0) + i16(0),
+    ),
+    # EndTxn (26): v1 both directions
+    (
+        "end_txn_req_v1",
+        END_TXN, 1, "request",
+        {
+            "transactional_id": "txn-1",
+            "producer_id": 4000,
+            "producer_epoch": 1,
+            "committed": True,
+        },
+        s16("txn-1") + i64(4000) + i16(1) + boolean(True),
+    ),
+    (
+        "end_txn_resp_v1",
+        END_TXN, 1, "response",
+        {"throttle_time_ms": 0, "error_code": 0},
+        i32(0) + i16(0),
+    ),
+    # TxnOffsetCommit (28): v0 and v2 (committed_leader_epoch)
+    (
+        "txn_offset_commit_req_v0",
+        TXN_OFFSET_COMMIT, 0, "request",
+        {
+            "transactional_id": "txn-1",
+            "group_id": "g1",
+            "producer_id": 4000,
+            "producer_epoch": 1,
+            "topics": [
+                {
+                    "name": "t",
+                    "partitions": [
+                        {
+                            "partition_index": 0,
+                            "committed_offset": 5,
+                            "committed_metadata": None,
+                        },
+                    ],
+                }
+            ],
+        },
+        s16("txn-1") + s16("g1") + i64(4000) + i16(1)
+        + arr([s16("t") + arr([i32(0) + i64(5) + s16(None)])]),
+    ),
+    (
+        "txn_offset_commit_req_v2",
+        TXN_OFFSET_COMMIT, 2, "request",
+        {
+            "transactional_id": "txn-1",
+            "group_id": "g1",
+            "producer_id": 4000,
+            "producer_epoch": 1,
+            "topics": [
+                {
+                    "name": "t",
+                    "partitions": [
+                        {
+                            "partition_index": 0,
+                            "committed_offset": 5,
+                            "committed_leader_epoch": 2,
+                            "committed_metadata": "meta",
+                        },
+                    ],
+                }
+            ],
+        },
+        s16("txn-1") + s16("g1") + i64(4000) + i16(1)
+        + arr([s16("t") + arr([i32(0) + i64(5) + i32(2) + s16("meta")])]),
+    ),
+    (
+        "txn_offset_commit_resp_v0",
+        TXN_OFFSET_COMMIT, 0, "response",
+        {
+            "throttle_time_ms": 0,
+            "topics": [
+                {
+                    "name": "t",
+                    "partitions": [
+                        {"partition_index": 0, "error_code": 0},
+                    ],
+                }
+            ],
+        },
+        i32(0) + arr([s16("t") + arr([i32(0) + i16(0)])]),
+    ),
+    # DescribeAcls (29): v1 (pattern_type added) both directions
+    (
+        "describe_acls_req_v1",
+        DESCRIBE_ACLS, 1, "request",
+        {
+            "resource_type_filter": 2,
+            "resource_name_filter": "t",
+            "pattern_type_filter": 3,
+            "principal_filter": None,
+            "host_filter": None,
+            "operation": 1,
+            "permission_type": 1,
+        },
+        i8(2) + s16("t") + i8(3) + s16(None) + s16(None) + i8(1) + i8(1),
+    ),
+    (
+        "describe_acls_resp_v1",
+        DESCRIBE_ACLS, 1, "response",
+        {
+            "throttle_time_ms": 0,
+            "error_code": 0,
+            "error_message": None,
+            "resources": [
+                {
+                    "resource_type": 2,
+                    "resource_name": "t",
+                    "pattern_type": 3,
+                    "acls": [
+                        {
+                            "principal": "User:alice",
+                            "host": "*",
+                            "operation": 2,
+                            "permission_type": 3,
+                        }
+                    ],
+                }
+            ],
+        },
+        i32(0) + i16(0) + s16(None)
+        + arr([
+            i8(2) + s16("t") + i8(3)
+            + arr([s16("User:alice") + s16("*") + i8(2) + i8(3)])
+        ]),
+    ),
+    # CreateAcls (30): v1 both directions
+    (
+        "create_acls_req_v1",
+        CREATE_ACLS, 1, "request",
+        {
+            "creations": [
+                {
+                    "resource_type": 2,
+                    "resource_name": "t",
+                    "resource_pattern_type": 3,
+                    "principal": "User:alice",
+                    "host": "*",
+                    "operation": 2,
+                    "permission_type": 3,
+                }
+            ],
+        },
+        arr([
+            i8(2) + s16("t") + i8(3) + s16("User:alice") + s16("*")
+            + i8(2) + i8(3)
+        ]),
+    ),
+    (
+        "create_acls_resp_v1",
+        CREATE_ACLS, 1, "response",
+        {
+            "throttle_time_ms": 0,
+            "results": [{"error_code": 0, "error_message": None}],
+        },
+        i32(0) + arr([i16(0) + s16(None)]),
+    ),
+    # DeleteAcls (31): v1 both directions
+    (
+        "delete_acls_req_v1",
+        DELETE_ACLS, 1, "request",
+        {
+            "filters": [
+                {
+                    "resource_type_filter": 2,
+                    "resource_name_filter": None,
+                    "pattern_type_filter": 1,
+                    "principal_filter": "User:bob",
+                    "host_filter": None,
+                    "operation": 1,
+                    "permission_type": 1,
+                }
+            ],
+        },
+        arr([i8(2) + s16(None) + i8(1) + s16("User:bob") + s16(None)
+             + i8(1) + i8(1)]),
+    ),
+    (
+        "delete_acls_resp_v1",
+        DELETE_ACLS, 1, "response",
+        {
+            "throttle_time_ms": 0,
+            "filter_results": [
+                {
+                    "error_code": 0,
+                    "error_message": None,
+                    "matching_acls": [
+                        {
+                            "error_code": 0,
+                            "error_message": None,
+                            "resource_type": 2,
+                            "resource_name": "t",
+                            "pattern_type": 3,
+                            "principal": "User:bob",
+                            "host": "*",
+                            "operation": 1,
+                            "permission_type": 3,
+                        }
+                    ],
+                }
+            ],
+        },
+        i32(0)
+        + arr([
+            i16(0) + s16(None)
+            + arr([
+                i16(0) + s16(None) + i8(2) + s16("t") + i8(3)
+                + s16("User:bob") + s16("*") + i8(1) + i8(3)
+            ])
+        ]),
+    ),
+    # DescribeConfigs (32): v1 (synonyms/config_source) both directions
+    (
+        "describe_configs_req_v1",
+        DESCRIBE_CONFIGS, 1, "request",
+        {
+            "resources": [
+                {
+                    "resource_type": 2,
+                    "resource_name": "t",
+                    "configuration_keys": ["retention.ms"],
+                }
+            ],
+            "include_synonyms": False,
+        },
+        arr([i8(2) + s16("t") + arr([s16("retention.ms")])])
+        + boolean(False),
+    ),
+    (
+        "describe_configs_resp_v1",
+        DESCRIBE_CONFIGS, 1, "response",
+        {
+            "throttle_time_ms": 0,
+            "results": [
+                {
+                    "error_code": 0,
+                    "error_message": None,
+                    "resource_type": 2,
+                    "resource_name": "t",
+                    "configs": [
+                        {
+                            "name": "retention.ms",
+                            "value": "604800000",
+                            "read_only": False,
+                            "config_source": 5,
+                            "is_sensitive": False,
+                            "synonyms": [],
+                        }
+                    ],
+                }
+            ],
+        },
+        i32(0)
+        + arr([
+            i16(0) + s16(None) + i8(2) + s16("t")
+            + arr([
+                s16("retention.ms") + s16("604800000") + boolean(False)
+                + i8(5) + boolean(False) + arr([])
+            ])
+        ]),
+    ),
+    # AlterConfigs (33): v0 both directions
+    (
+        "alter_configs_req_v0",
+        ALTER_CONFIGS, 0, "request",
+        {
+            "resources": [
+                {
+                    "resource_type": 2,
+                    "resource_name": "t",
+                    "configs": [
+                        {"name": "retention.ms", "value": "1000"},
+                    ],
+                }
+            ],
+            "validate_only": False,
+        },
+        arr([i8(2) + s16("t") + arr([s16("retention.ms") + s16("1000")])])
+        + boolean(False),
+    ),
+    (
+        "alter_configs_resp_v0",
+        ALTER_CONFIGS, 0, "response",
+        {
+            "throttle_time_ms": 0,
+            "responses": [
+                {
+                    "error_code": 0,
+                    "error_message": None,
+                    "resource_type": 2,
+                    "resource_name": "t",
+                }
+            ],
+        },
+        i32(0) + arr([i16(0) + s16(None) + i8(2) + s16("t")]),
+    ),
+    # DescribeLogDirs (35): v0 non-flex and v2 flex (boundary pair)
+    (
+        "describe_log_dirs_req_v0",
+        DESCRIBE_LOG_DIRS, 0, "request",
+        {
+            "topics": [{"topic": "t", "partitions": [0, 1]}],
+        },
+        arr([s16("t") + arr([i32(0), i32(1)])]),
+    ),
+    (
+        "describe_log_dirs_req_v2_flex",
+        DESCRIBE_LOG_DIRS, 2, "request",
+        {
+            "topics": [{"topic": "t", "partitions": [0]}],
+        },
+        carr([cs("t") + carr([i32(0)]) + TAG0]) + TAG0,
+    ),
+    (
+        "describe_log_dirs_resp_v2_flex",
+        DESCRIBE_LOG_DIRS, 2, "response",
+        {
+            "throttle_time_ms": 0,
+            "results": [
+                {
+                    "error_code": 0,
+                    "log_dir": "/data",
+                    "topics": [
+                        {
+                            "name": "t",
+                            "partitions": [
+                                {
+                                    "partition_index": 0,
+                                    "partition_size": 1024,
+                                    "offset_lag": 0,
+                                    "is_future_key": False,
+                                }
+                            ],
+                        }
+                    ],
+                }
+            ],
+        },
+        i32(0)
+        + carr([
+            i16(0) + cs("/data")
+            + carr([
+                cs("t")
+                + carr([i32(0) + i64(1024) + i64(0) + boolean(False) + TAG0])
+                + TAG0
+            ])
+            + TAG0
+        ])
+        + TAG0,
+    ),
+    # SaslAuthenticate (36): v1 (session_lifetime_ms) both directions
+    (
+        "sasl_authenticate_req_v1",
+        SASL_AUTHENTICATE, 1, "request",
+        {"auth_bytes": b"\x00user\x00pass"},
+        b32(b"\x00user\x00pass"),
+    ),
+    (
+        "sasl_authenticate_resp_v1",
+        SASL_AUTHENTICATE, 1, "response",
+        {
+            "error_code": 0,
+            "error_message": None,
+            "auth_bytes": b"",
+            "session_lifetime_ms": 3600000,
+        },
+        i16(0) + s16(None) + b32(b"") + i64(3600000),
+    ),
+    # CreatePartitions (37): v0 both directions
+    (
+        "create_partitions_req_v0",
+        CREATE_PARTITIONS, 0, "request",
+        {
+            "topics": [
+                {
+                    "name": "t",
+                    "count": 6,
+                    "assignments": [{"broker_ids": [1, 2]}],
+                }
+            ],
+            "timeout_ms": 30000,
+            "validate_only": False,
+        },
+        arr([s16("t") + i32(6) + arr([arr([i32(1), i32(2)])])])
+        + i32(30000) + boolean(False),
+    ),
+    (
+        "create_partitions_resp_v0",
+        CREATE_PARTITIONS, 0, "response",
+        {
+            "throttle_time_ms": 0,
+            "results": [
+                {"name": "t", "error_code": 0, "error_message": None},
+            ],
+        },
+        i32(0) + arr([s16("t") + i16(0) + s16(None)]),
+    ),
+    # DeleteGroups (42): v0 both directions
+    (
+        "delete_groups_req_v0",
+        DELETE_GROUPS, 0, "request",
+        {"groups_names": ["g1", "g2"]},
+        arr([s16("g1"), s16("g2")]),
+    ),
+    (
+        "delete_groups_resp_v0",
+        DELETE_GROUPS, 0, "response",
+        {
+            "throttle_time_ms": 0,
+            "results": [{"group_id": "g1", "error_code": 0}],
+        },
+        i32(0) + arr([s16("g1") + i16(0)]),
+    ),
+    # IncrementalAlterConfigs (44): v0 both directions
+    (
+        "incremental_alter_configs_req_v0",
+        INCREMENTAL_ALTER_CONFIGS, 0, "request",
+        {
+            "resources": [
+                {
+                    "resource_type": 2,
+                    "resource_name": "t",
+                    "configs": [
+                        {
+                            "name": "retention.ms",
+                            "config_operation": 0,
+                            "value": "1000",
+                        },
+                    ],
+                }
+            ],
+            "validate_only": False,
+        },
+        arr([
+            i8(2) + s16("t")
+            + arr([s16("retention.ms") + i8(0) + s16("1000")])
+        ])
+        + boolean(False),
+    ),
+    (
+        "incremental_alter_configs_resp_v0",
+        INCREMENTAL_ALTER_CONFIGS, 0, "response",
+        {
+            "throttle_time_ms": 0,
+            "responses": [
+                {
+                    "error_code": 0,
+                    "error_message": None,
+                    "resource_type": 2,
+                    "resource_name": "t",
+                }
+            ],
+        },
+        i32(0) + arr([i16(0) + s16(None) + i8(2) + s16("t")]),
+    ),
+    # AlterPartitionReassignments (45): flex-from-v0 both directions
+    (
+        "alter_partition_reassignments_req_v0_flex",
+        ALTER_PARTITION_REASSIGNMENTS, 0, "request",
+        {
+            "timeout_ms": 60000,
+            "topics": [
+                {
+                    "name": "t",
+                    "partitions": [
+                        {"partition_index": 0, "replicas": [1, 2, 3]},
+                    ],
+                }
+            ],
+        },
+        i32(60000)
+        + carr([
+            cs("t")
+            + carr([i32(0) + carr([i32(1), i32(2), i32(3)]) + TAG0])
+            + TAG0
+        ])
+        + TAG0,
+    ),
+    (
+        "alter_partition_reassignments_resp_v0_flex",
+        ALTER_PARTITION_REASSIGNMENTS, 0, "response",
+        {
+            "throttle_time_ms": 0,
+            "error_code": 0,
+            "error_message": None,
+            "responses": [
+                {
+                    "name": "t",
+                    "partitions": [
+                        {
+                            "partition_index": 0,
+                            "error_code": 0,
+                            "error_message": None,
+                        },
+                    ],
+                }
+            ],
+        },
+        i32(0) + i16(0) + cs(None)
+        + carr([
+            cs("t") + carr([i32(0) + i16(0) + cs(None) + TAG0]) + TAG0
+        ])
+        + TAG0,
+    ),
+    # ListPartitionReassignments (46): flex-from-v0 both directions
+    (
+        "list_partition_reassignments_req_v0_flex",
+        LIST_PARTITION_REASSIGNMENTS, 0, "request",
+        {
+            "timeout_ms": 60000,
+            "topics": None,
+        },
+        i32(60000) + carr(None) + TAG0,
+    ),
+    (
+        "list_partition_reassignments_resp_v0_flex",
+        LIST_PARTITION_REASSIGNMENTS, 0, "response",
+        {
+            "throttle_time_ms": 0,
+            "error_code": 0,
+            "error_message": None,
+            "topics": [
+                {
+                    "name": "t",
+                    "partitions": [
+                        {
+                            "partition_index": 0,
+                            "replicas": [1, 2],
+                            "adding_replicas": [3],
+                            "removing_replicas": [],
+                        },
+                    ],
+                }
+            ],
+        },
+        i32(0) + i16(0) + cs(None)
+        + carr([
+            cs("t")
+            + carr([
+                i32(0) + carr([i32(1), i32(2)]) + carr([i32(3)])
+                + carr([]) + TAG0
+            ])
+            + TAG0
+        ])
+        + TAG0,
+    ),
+    # OffsetDelete (47): v0 both directions
+    (
+        "offset_delete_req_v0",
+        OFFSET_DELETE, 0, "request",
+        {
+            "group_id": "g1",
+            "topics": [
+                {"name": "t", "partitions": [{"partition_index": 0}]},
+            ],
+        },
+        s16("g1") + arr([s16("t") + arr([i32(0)])]),
+    ),
+    (
+        "offset_delete_resp_v0",
+        OFFSET_DELETE, 0, "response",
+        {
+            "error_code": 0,
+            "throttle_time_ms": 0,
+            "topics": [
+                {
+                    "name": "t",
+                    "partitions": [
+                        {"partition_index": 0, "error_code": 0},
+                    ],
+                }
+            ],
+        },
+        i16(0) + i32(0) + arr([s16("t") + arr([i32(0) + i16(0)])]),
+    ),
+    # DescribeProducers (61): flex-from-v0 both directions
+    (
+        "describe_producers_req_v0_flex",
+        DESCRIBE_PRODUCERS, 0, "request",
+        {
+            "topics": [{"name": "t", "partition_indexes": [0]}],
+        },
+        carr([cs("t") + carr([i32(0)]) + TAG0]) + TAG0,
+    ),
+    (
+        "describe_producers_resp_v0_flex",
+        DESCRIBE_PRODUCERS, 0, "response",
+        {
+            "throttle_time_ms": 0,
+            "topics": [
+                {
+                    "name": "t",
+                    "partitions": [
+                        {
+                            "partition_index": 0,
+                            "error_code": 0,
+                            "error_message": None,
+                            "active_producers": [
+                                {
+                                    "producer_id": 4000,
+                                    "producer_epoch": 1,
+                                    "last_sequence": 10,
+                                    "last_timestamp": 1690000000000,
+                                    "coordinator_epoch": 0,
+                                    "current_txn_start_offset": -1,
+                                }
+                            ],
+                        }
+                    ],
+                }
+            ],
+        },
+        i32(0)
+        + carr([
+            cs("t")
+            + carr([
+                i32(0) + i16(0) + cs(None)
+                + carr([
+                    i64(4000) + i32(1) + i32(10) + i64(1690000000000)
+                    + i32(0) + i64(-1) + TAG0
+                ])
+                + TAG0
+            ])
+            + TAG0
+        ])
+        + TAG0,
+    ),
+    # DescribeTransactions (65): flex-from-v0 both directions
+    (
+        "describe_transactions_req_v0_flex",
+        DESCRIBE_TRANSACTIONS, 0, "request",
+        {"transactional_ids": ["txn-1"]},
+        carr([cs("txn-1")]) + TAG0,
+    ),
+    (
+        "describe_transactions_resp_v0_flex",
+        DESCRIBE_TRANSACTIONS, 0, "response",
+        {
+            "throttle_time_ms": 0,
+            "transaction_states": [
+                {
+                    "error_code": 0,
+                    "transactional_id": "txn-1",
+                    "transaction_state": "Ongoing",
+                    "transaction_timeout_ms": 60000,
+                    "transaction_start_time_ms": 1690000000000,
+                    "producer_id": 4000,
+                    "producer_epoch": 1,
+                    "topics": [
+                        {"topic": "t", "partitions": [0, 1]},
+                    ],
+                }
+            ],
+        },
+        i32(0)
+        + carr([
+            i16(0) + cs("txn-1") + cs("Ongoing") + i32(60000)
+            + i64(1690000000000) + i64(4000) + i16(1)
+            + carr([cs("t") + carr([i32(0), i32(1)]) + TAG0])
+            + TAG0
+        ])
+        + TAG0,
+    ),
+    # ListTransactions (66): flex-from-v0 both directions
+    (
+        "list_transactions_req_v0_flex",
+        LIST_TRANSACTIONS, 0, "request",
+        {"state_filters": [], "producer_id_filters": []},
+        carr([]) + carr([]) + TAG0,
+    ),
+    (
+        "list_transactions_resp_v0_flex",
+        LIST_TRANSACTIONS, 0, "response",
+        {
+            "throttle_time_ms": 0,
+            "error_code": 0,
+            "unknown_state_filters": [],
+            "transaction_states": [
+                {
+                    "transactional_id": "txn-1",
+                    "producer_id": 4000,
+                    "transaction_state": "Ongoing",
+                }
+            ],
+        },
+        i32(0) + i16(0) + carr([])
+        + carr([cs("txn-1") + i64(4000) + cs("Ongoing") + TAG0])
+        + TAG0,
+    ),
 ]
 
 
@@ -661,9 +1768,46 @@ def test_corpus_frozen():
 
 
 def test_coverage_floor():
-    """VERDICT r2 #6: ≥15 APIs, flex and non-flex both exercised."""
-    apis = {v[1].key for v in VECTORS}
-    assert len(apis) >= 15, sorted(apis)
+    """VERDICT r4 #3: EVERY registered API has golden vectors — zero
+    APIs vector-free, and every API with a request schema has a
+    request vector (responses likewise). Prints the per-API coverage
+    table the verdict asked for on failure."""
+    import redpanda_tpu.kafka.protocol.apis as _apis
+    import redpanda_tpu.kafka.protocol.admin_apis as _admin
+    import redpanda_tpu.kafka.protocol.group_apis as _group
+    import redpanda_tpu.kafka.protocol.tx_apis as _tx
+
+    registered = {}
+    for mod in (_apis, _admin, _group, _tx):
+        for v in vars(mod).values():
+            if hasattr(v, "key") and hasattr(v, "encode_request"):
+                registered[v.key] = v
+
+    cover: dict[int, dict] = {
+        k: {"name": a.name, "request": set(), "response": set()}
+        for k, a in registered.items()
+    }
+    for _name, api, version, direction, _f, _g in VECTORS:
+        cover[api.key][direction].add(version)
+
+    table = "\n".join(
+        f"{k:>3} {c['name']:<32} req={sorted(c['request'])} "
+        f"resp={sorted(c['response'])}"
+        for k, c in sorted(cover.items())
+    )
+    missing = [
+        f"{k} {c['name']}: no {d} vectors"
+        for k, c in sorted(cover.items())
+        for d in ("request", "response")
+        if not c[d]
+    ]
+    # list_groups v0-2 requests are empty-bodied at v0 (vector exists);
+    # every API must have at least one vector in EACH direction
+    assert not missing, f"vector-free APIs:\n" + "\n".join(missing) + (
+        "\n\ncoverage table:\n" + table
+    )
+    assert len(cover) >= 40, table
+    # flex and non-flex both exercised
     assert any(
         v[1].flex_since is not None and v[2] >= v[1].flex_since
         for v in VECTORS
