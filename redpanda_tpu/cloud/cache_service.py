@@ -42,6 +42,7 @@ class CloudCache:
         # per-key hydration locks: concurrent readers missing the same
         # chunks await one fetch instead of issuing duplicate GETs
         self._klocks: dict[str, asyncio.Lock] = {}
+        self._klock_refs: dict[str, int] = {}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -197,45 +198,72 @@ class CloudCache:
         klock = self._klocks.get(kh)
         if klock is None:
             klock = self._klocks[kh] = asyncio.Lock()
-        async with klock:
-            parts = []
-            for idx in range(first, last + 1):
-                data = await self._load_chunk(kh, idx)
-                if data is None:
-                    self.misses += 1
-                else:
-                    self.hits += 1
-                parts.append(data)
-            i = 0
-            while i < len(parts):
-                if parts[i] is not None:
-                    i += 1
-                    continue
-                j = i
-                while j < len(parts) and parts[j] is None:
-                    j += 1
-                lo = (first + i) * cs
-                hi = min((first + j) * cs, object_size)
-                blob = await fetch_range(lo, hi)
-                if len(blob) != hi - lo:
-                    # truncated object (manifest size_bytes > stored
-                    # size): StoreError so the remote read path degrades
-                    # per partition instead of aborting the whole fetch
-                    raise StoreError(
-                        f"ranged fetch of {key} [{lo},{hi}) returned "
-                        f"{len(blob)} bytes"
-                    )
-                for k in range(i, j):
-                    off = (k - i) * cs
-                    chunk = blob[off : off + cs]
-                    await self._store_chunk(kh, first + k, chunk)
-                    parts[k] = chunk
-                i = j
-        if not klock.locked() and len(self._klocks) > 512:
-            self._klocks.pop(kh, None)
+        # refcount the lock while ANY coroutine holds a reference:
+        # popping a lock another waiter already fetched would let a
+        # third reader mint a fresh lock for the same key and hydrate
+        # the same chunks twice (duplicate S3 range GETs)
+        self._klock_refs[kh] = self._klock_refs.get(kh, 0) + 1
+        try:
+            async with klock:
+                parts = await self._hydrate_locked(
+                    kh, key, first, last, cs, object_size, fetch_range,
+                    parts,
+                )
+        finally:
+            refs = self._klock_refs.get(kh, 1) - 1
+            if refs <= 0:
+                self._klock_refs.pop(kh, None)
+                if len(self._klocks) > 512:
+                    self._klocks.pop(kh, None)
+            else:
+                self._klock_refs[kh] = refs
         buf = b"".join(parts)  # type: ignore[arg-type]
         lo = start - first * cs
         return buf[lo : lo + (end - start)]
+
+    async def _hydrate_locked(
+        self, kh, key, first, last, cs, object_size, fetch_range, warm
+    ):
+        """Chunk hydration under the per-key lock: re-probe only the
+        chunks the lock-free pass missed (bytes already loaded there
+        stay valid even if since-evicted; an in-flight hydrator may
+        have filled the gaps while we queued), fetch+store the rest."""
+        parts = []
+        for k, idx in enumerate(range(first, last + 1)):
+            data = warm[k]
+            if data is None:
+                data = await self._load_chunk(kh, idx)
+            if data is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+            parts.append(data)
+        i = 0
+        while i < len(parts):
+            if parts[i] is not None:
+                i += 1
+                continue
+            j = i
+            while j < len(parts) and parts[j] is None:
+                j += 1
+            lo = (first + i) * cs
+            hi = min((first + j) * cs, object_size)
+            blob = await fetch_range(lo, hi)
+            if len(blob) != hi - lo:
+                # truncated object (manifest size_bytes > stored
+                # size): StoreError so the remote read path degrades
+                # per partition instead of aborting the whole fetch
+                raise StoreError(
+                    f"ranged fetch of {key} [{lo},{hi}) returned "
+                    f"{len(blob)} bytes"
+                )
+            for k in range(i, j):
+                off = (k - i) * cs
+                chunk = blob[off : off + cs]
+                await self._store_chunk(kh, first + k, chunk)
+                parts[k] = chunk
+            i = j
+        return parts
 
     async def invalidate(self, key: str) -> None:
         """Drop every chunk of `key` (segment re-uploaded/merged away)."""

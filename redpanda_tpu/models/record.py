@@ -256,13 +256,27 @@ class RecordBatchHeader:
 
 
 class RecordBatch:
-    """Header + body (records section bytes, possibly compressed)."""
+    """Header + body (records section bytes, possibly compressed).
 
-    __slots__ = ("header", "body")
+    CONTRACT: a batch handed to the storage layer (log.append /
+    log.append_exactly) must be FINALIZED — body crc already computed
+    over the current body (builder.build() and the produce adapter do
+    this; call finalize_crcs() after any manual body edit). The append
+    path rewrites only base_offset/term (header crc) and does NOT
+    recompute the body crc; a stale body crc persists to disk and
+    surfaces as a distant recovery/fetch CRC mismatch. The debug file
+    sanitizer (RP_FILE_SANITIZER=1) enforces this at the call site."""
+
+    __slots__ = ("header", "body", "finalized")
 
     def __init__(self, header: RecordBatchHeader, body: bytes):
         self.header = header
         self.body = body
+        # cheap always-on storage-contract guard: set by
+        # finalize_crcs() / deserialize (wire bytes carry valid CRCs);
+        # checked by log.append so a batch whose body was mutated after
+        # build can't persist a stale body crc silently
+        self.finalized = False
 
     # -- integrity ---------------------------------------------------
     def compute_crc(self) -> int:
@@ -278,6 +292,7 @@ class RecordBatch:
     def finalize_crcs(self) -> "RecordBatch":
         self.header.crc = self.compute_crc()
         self.header.header_crc = self.header.compute_header_crc()
+        self.finalized = True
         return self
 
     # -- sizes / offsets --------------------------------------------
@@ -308,7 +323,9 @@ class RecordBatch:
         if header.size_bytes < HEADER_SIZE:
             raise ValueError(f"corrupt size_bytes {header.size_bytes}")
         body = parser.read(header.size_bytes - HEADER_SIZE)
-        return RecordBatch(header, body)
+        b = RecordBatch(header, body)
+        b.finalized = True  # wire bytes carry the leader's computed CRCs
+        return b
 
     # -- Kafka wire framing (reference: kafka/protocol/kafka_batch_adapter) --
     def to_kafka_wire(self) -> bytes:
@@ -380,6 +397,7 @@ class RecordBatch:
             )
         header.size_bytes = batch.size_bytes()
         header.header_crc = header.compute_header_crc()
+        batch.finalized = True  # wire crc verified (or caller opted out)
         return batch
 
     # -- broker-side recompression (compression.type topic config) ----

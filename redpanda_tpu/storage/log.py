@@ -273,6 +273,15 @@ class Log:
         # CRC passes from the hot append path. Under the file sanitizer
         # (debug builds) the contract is enforced AT the faulty call
         # site instead of surfacing as a distant recovery CRC mismatch.
+        if not batch.finalized:
+            # cheap always-on guard (one attr check): builders,
+            # finalize_crcs() and the wire decoders all set the flag —
+            # an internal caller that constructed/mutated a batch by
+            # hand must finalize before it can persist a stale body crc
+            raise AssertionError(
+                "log.append requires a finalized batch (stale body crc); "
+                "call finalize_crcs() after building the body"
+            )
         if file_sanitizer.enabled() and batch.header.crc != batch.compute_crc():
             raise AssertionError(
                 "log.append requires a finalized batch (stale body crc); "
@@ -292,6 +301,11 @@ class Log:
     def append_exactly(self, batch: RecordBatch) -> tuple[int, int]:
         """Append preserving the batch's own base_offset/term (follower
         path: the leader already assigned offsets)."""
+        if not batch.finalized:
+            raise AssertionError(
+                "log.append_exactly requires a finalized batch (stale "
+                "body crc); call finalize_crcs() after building the body"
+            )
         seg = self._active_segment(batch.header.term)
         seg.append(batch)
         if self._cache_index is not None:
