@@ -209,6 +209,18 @@ async def _live_tick_async(n_groups: int) -> dict:
                 "# ticks:", [round(t, 1) for t in times], file=sys.stderr
             )
         p99 = float(np.percentile(times, 99))
+        # honesty series: the steady loop above settles onto the O(1)
+        # quiesced SAME-frame path. Production also pays the FULL
+        # vector-frame path whenever any group's state moved since the
+        # last tick — force it by bumping the mutation epoch before
+        # each tick (de-arms SAME, keeps the splice caches warm, which
+        # is exactly the active-cluster steady state).
+        full_times = []
+        for _ in range(30):
+            gms[0].arrays.touch()
+            t0 = time.perf_counter()
+            await hb.tick()
+            full_times.append((time.perf_counter() - t0) * 1e3)
         interval_ms = 50.0
         return {
             "metric": f"live_heartbeat_tick_p99_{n_groups}_groups",
@@ -217,6 +229,12 @@ async def _live_tick_async(n_groups: int) -> dict:
             "vs_baseline": round(interval_ms / p99, 3),
             "p50_ms": round(float(np.percentile(times, 50)), 3),
             "mean_ms": round(float(np.mean(times)), 3),
+            "full_frame_p99_ms": round(
+                float(np.percentile(full_times, 99)), 3
+            ),
+            "full_frame_p50_ms": round(
+                float(np.percentile(full_times, 50)), 3
+            ),
         }
     finally:
         for gm in gms.values():

@@ -101,6 +101,7 @@ class Consensus:
         self.row = arrays.alloc_row()
         self._role = Role.FOLLOWER
         arrays.is_follower[self.row] = True
+        arrays.touch()
         self._voted_for: Optional[int] = None
         self._slot_map: dict[int, int] = {}
         self._next_index: dict[int, int] = {}
@@ -145,6 +146,7 @@ class Consensus:
         heartbeat answer needs no per-group Python role check."""
         self._role = v
         self.arrays.is_follower[self.row] = v is Role.FOLLOWER
+        self.arrays.touch()
 
     # ---------------------------------------------------------- setup
     def _vote_key(self) -> bytes:
@@ -230,6 +232,7 @@ class Consensus:
         self.arrays.tb_set(self.row, bounds)
         self.arrays.log_start[self.row] = self.log.offsets().start_offset
         self.arrays.snap_index[self.row] = self._snap_index
+        self.arrays.touch()
 
     def _observe_prefix_truncate(self, _new_start: int) -> None:
         self._sync_term_bounds()
@@ -258,6 +261,7 @@ class Consensus:
         if raw is not None:
             st = _VoteState.decode(raw)
             self.arrays.term[self.row] = max(int(st.term), 0)
+            self.arrays.touch()
             self._voted_for = st.voted_for if st.voted_for >= 0 else None
 
     def _persist_vote_state(self) -> None:
@@ -308,6 +312,7 @@ class Consensus:
             self.arrays.flushed_index[row, slot] = flushed
             self.arrays.last_seq[row, slot] = last_seq
             self.arrays.next_seq[row, slot] = next_seq
+            self.arrays.touch()
             self._peer_locks.setdefault(peer, asyncio.Lock())
         # slots past the new peer set hold stale lanes: neutralize them
         for slot in range(len(peers) + 1, self.arrays.replica_slots):
@@ -346,6 +351,7 @@ class Consensus:
         self.arrays.commit_index[row] = max(
             int(self.arrays.commit_index[row]), self._snap_index
         )
+        self.arrays.touch()
         self.arrays.last_visible[row] = max(
             int(self.arrays.last_visible[row]), self._snap_index
         )
@@ -399,6 +405,7 @@ class Consensus:
         row = self.row
         self.arrays.match_index[row, SELF_SLOT] = offs.dirty_offset
         self.arrays.flushed_index[row, SELF_SLOT] = offs.committed_offset
+        self.arrays.touch()
         last_term = self.log.term_of_last_batch()
         if last_term > self.term:
             self.arrays.term[row] = last_term
@@ -562,6 +569,7 @@ class Consensus:
             self.role = Role.CANDIDATE
             self.leader_id = None
             self.arrays.term[row] = self.term + 1
+            self.arrays.touch()
             term = self.term
             self._voted_for = self.node_id
             try:
@@ -627,6 +635,7 @@ class Consensus:
         self.leader_id = self.node_id
         offs = self.log.offsets()
         self.arrays.is_leader[row] = True
+        self.arrays.touch()
         # reset follower tracking for the new term
         for peer, slot in self._slot_map.items():
             if peer == self.node_id:
@@ -645,6 +654,7 @@ class Consensus:
         flushed = self.log.flush()
         self.arrays.match_index[row, SELF_SLOT] = last
         self.arrays.flushed_index[row, SELF_SLOT] = flushed
+        self.arrays.touch()
         if self.arrays.scalar_commit_update(row):
             self._notify_commit()
         logger.info(
@@ -661,6 +671,7 @@ class Consensus:
         row = self.row
         if term > self.term:
             self.arrays.term[row] = term
+            self.arrays.touch()
             self._voted_for = None
             self._persist_vote_state()
         was_leader = self.role == Role.LEADER
@@ -812,6 +823,7 @@ class Consensus:
                 self.arrays.flushed_index[row, SELF_SLOT] = min(
                     int(self.arrays.flushed_index[row, SELF_SLOT]), base - 1
                 )
+                self.arrays.touch()
             self.log.append_exactly(batch)
             appended = True
             last_new_entry = batch.header.last_offset
@@ -821,6 +833,7 @@ class Consensus:
             new_offs = self.log.offsets()
             self.arrays.match_index[row, SELF_SLOT] = new_offs.dirty_offset
             self.arrays.flushed_index[row, SELF_SLOT] = flushed
+            self.arrays.touch()
 
         # 5. follower commit index (consensus.cc:2760-2777), capped at
         # the last entry confirmed to match the leader's log
@@ -834,6 +847,7 @@ class Consensus:
             self.arrays.last_visible[row] = max(
                 int(self.arrays.last_visible[row]), new_commit
             )
+            self.arrays.touch()
             self._notify_commit()
         return self._reply(rt.AppendEntriesReply.SUCCESS, int(req.seq))
 
@@ -887,6 +901,7 @@ class Consensus:
             self.arrays.last_visible[row] = max(
                 int(self.arrays.last_visible[row]), new_commit
             )
+            self.arrays.touch()
             self._notify_commit()
         return (self.term, self.dirty_offset(), self.flushed_offset(), seq,
                 rt.AppendEntriesReply.SUCCESS)
@@ -973,11 +988,13 @@ class Consensus:
             sup_row = self.row
             if sup_slot is not None:
                 self.arrays.hb_suppress[sup_row, sup_slot] += 1
+                self.arrays.hb_suppress_total += 1
             try:
                 await self._catch_up_locked(peer)
             finally:
                 if sup_slot is not None:
                     self.arrays.hb_suppress[sup_row, sup_slot] -= 1
+                    self.arrays.hb_suppress_total -= 1
 
     async def _catch_up_locked(self, peer: int) -> None:
         rounds = 0
@@ -1159,6 +1176,7 @@ class Consensus:
         self.arrays.match_index[row, slot] = max(
             int(self.arrays.match_index[row, slot]), dirty
         )
+        self.arrays.touch()
         self.arrays.flushed_index[row, slot] = max(
             int(self.arrays.flushed_index[row, slot]), flushed
         )
@@ -1344,6 +1362,7 @@ class Consensus:
         self.arrays.match_index[row, SELF_SLOT] = snap_idx
         self.arrays.flushed_index[row, SELF_SLOT] = snap_idx
         self.arrays.commit_index[row] = snap_idx
+        self.arrays.touch()
         self.arrays.last_visible[row] = max(
             int(self.arrays.last_visible[row]), snap_idx
         )

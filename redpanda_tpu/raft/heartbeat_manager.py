@@ -41,12 +41,15 @@ logger = logging.getLogger("raft.heartbeat")
 SendFn = Callable[[int, int, bytes, float], Awaitable[bytes]]
 
 
+_NO_SUPPRESS = np.zeros(0, bool)
+
 class _PeerPlan:
     """Precomputed build vectors for one target node."""
 
     __slots__ = (
         "rows", "slots", "gids", "gids_arr", "cons", "pos_by_gid",
         "tb_cache", "frame_cache", "reply_cache",
+        "same_epoch", "same_counter", "same_ticks", "same_crc",
     )
 
     def __init__(self, pairs: list[tuple[Consensus, int]]):
@@ -69,6 +72,15 @@ class _PeerPlan:
         # SUCCESS reply around its seq echo; a byte-equal reply needs
         # only the seq-guard fold, not a decode + full fold
         self.reply_cache: tuple | None = None
+        # quiesced SAME-frame state: armed when a spliced full frame
+        # drew a byte-identical reply with no local mutation in
+        # between; while armed AND arrays.mut_epoch is unchanged the
+        # tick sends a fixed-size HEARTBEAT_SAME instead of the
+        # O(groups) vector frame. same_crc caches (prefix_id, crc32).
+        self.same_epoch: int | None = None
+        self.same_counter = 0
+        self.same_ticks = 0
+        self.same_crc: tuple | None = None
 
     def prev_terms_cached(self, arrays, prevs: np.ndarray):
         from .shard_state import term_at_batch_cached
@@ -146,15 +158,23 @@ class HeartbeatManager:
                     per_node.setdefault(peer, []).append((c, slot))
         return {peer: _PeerPlan(pairs) for peer, pairs in per_node.items()}
 
+    # forced full-frame cadence while quiesced: bounds the staleness
+    # window of any mutation-epoch bump a writer site might miss
+    FORCE_FULL_EVERY = 64
+
     async def tick(self) -> None:
         """One sweep: vector-build per-node batches from the SoA, send
-        in parallel, fold ALL replies with one device call."""
+        in parallel, fold ALL replies with one device call. Peers whose
+        state (ours AND theirs) has been byte-stable across a full
+        exchange ride the O(1) HEARTBEAT_SAME path instead."""
         if self._plan is None:
             self._plan = self._build_plan()
         plan = self._plan
         if not plan:
             return
         arrays = next(iter(self._groups.values())).arrays
+        epoch0 = arrays.mut_epoch
+        same_sent: dict[int, bytes] = {}
 
         # vector build per peer (build_heartbeats analog): seqs, prevs,
         # terms, commits and prev-terms in a handful of gathers.
@@ -168,7 +188,25 @@ class HeartbeatManager:
         sent: dict[int, tuple] = {}
         t_build = time.perf_counter() if spans.ENABLED else 0.0
         for peer, p in plan.items():
-            suppress = arrays.hb_suppress[p.rows, p.slots] > 0
+            if (
+                p.same_epoch is not None
+                and p.same_epoch == arrays.mut_epoch
+                and arrays.hb_suppress_total == 0
+                and p.same_ticks < self.FORCE_FULL_EVERY
+            ):
+                same_sent[peer] = rt.encode_same_req(
+                    self.node_id,
+                    len(p.gids),
+                    p.same_counter + 1,
+                    p.same_crc[1],
+                )
+                continue
+            p.same_epoch = None  # full frame; fold may re-arm
+            p.same_ticks = 0
+            if arrays.hb_suppress_total:
+                suppress = arrays.hb_suppress[p.rows, p.slots] > 0
+            else:
+                suppress = _NO_SUPPRESS
             if suppress.any():
                 keep = ~suppress
                 if not keep.any():
@@ -196,7 +234,9 @@ class HeartbeatManager:
                     commit_indices=arrays.commit_index[rows],
                     seqs=seqs,
                 ).encode()
-                sent[peer] = (p, prevs, seqs, msg, rows, slots, gids, keep_idx)
+                sent[peer] = (
+                    p, prevs, seqs, msg, rows, slots, gids, keep_idx, False,
+                )
                 continue
             arrays.next_seq[p.rows, p.slots] += 1
             seqs = arrays.next_seq[p.rows, p.slots]
@@ -212,8 +252,10 @@ class HeartbeatManager:
                 and np.array_equal(commits, fc[2])
             ):
                 # steady tick: splice cached frame + fresh seq vector
+                spliced = True
                 msg = fc[4] + np.ascontiguousarray(seqs, "<q").tobytes()
             else:
+                spliced = False
                 prev_terms, known = p.prev_terms_cached(arrays, prevs)
                 if not known.all():
                     # rare laggards below the mirrored boundary window:
@@ -244,7 +286,8 @@ class HeartbeatManager:
                     msg[: len(msg) - 8 * len(p.gids)],
                 )
             sent[peer] = (
-                p, prevs, seqs, msg, p.rows, p.slots, p.gids_arr, None
+                p, prevs, seqs, msg, p.rows, p.slots, p.gids_arr, None,
+                spliced,
             )
 
         if spans.ENABLED:
@@ -257,10 +300,28 @@ class HeartbeatManager:
             except Exception:
                 return peer, None
 
+        async def one_same(peer: int, msg: bytes):
+            p = plan[peer]
+            try:
+                raw = await self._send(
+                    peer, rt.HEARTBEAT_SAME, msg, self._rpc_timeout
+                )
+                status, counter = rt.decode_same_reply(raw)
+            except Exception:
+                p.same_epoch = None
+                return
+            if status == rt.SAME_OK and counter == p.same_counter + 1:
+                p.same_counter += 1
+                p.same_ticks += 1
+            else:
+                p.same_epoch = None  # follower diverged: full next tick
+
         t_send = time.perf_counter() if spans.ENABLED else 0.0
         results = await asyncio.gather(
-            *(one_node(peer, entry[3]) for peer, entry in sent.items())
+            *(one_node(peer, entry[3]) for peer, entry in sent.items()),
+            *(one_same(peer, msg) for peer, msg in same_sent.items()),
         )
+        results = results[: len(sent)]
         t_fold = 0.0
         if spans.ENABLED:
             spans.add("hb.send_wait", time.perf_counter() - t_send)
@@ -278,7 +339,7 @@ class HeartbeatManager:
             entry = sent.get(peer)
             if entry is None:
                 continue
-            p, prevs, seqs, _msg, rows, slots, gids, keep_idx = entry
+            p, prevs, seqs, _msg, rows, slots, gids, keep_idx, spliced = entry
             # steady-state reply: byte-identical to the last all-SUCCESS
             # reply except the echoed seq vector — fold only the seq
             # guard and skip decode + the full min/mask pass. The skip
@@ -309,9 +370,24 @@ class HeartbeatManager:
                 r_seqs = np.frombuffer(
                     raw[seq_lo : seq_lo + 8 * n], "<q"
                 ).astype(np.int64, copy=False)
-                np.maximum.at(
-                    arrays.last_seq, (rows, slots), r_seqs
+                # (rows, slots) pairs are unique within one plan:
+                # gather+max+scatter beats the unbuffered ufunc.at 2x
+                arrays.last_seq[rows, slots] = np.maximum(
+                    arrays.last_seq[rows, slots], r_seqs
                 )
+                if spliced and arrays.mut_epoch == epoch0:
+                    # spliced frame + byte-identical reply + no local
+                    # mutation during the RPC: both sides are armed for
+                    # the O(1) SAME path. The crc binds to the cached
+                    # frame prefix (identity-keyed: recomputed only
+                    # when the prefix bytes object changes).
+                    prefix = p.frame_cache[4]
+                    if p.same_crc is None or p.same_crc[0] is not prefix:
+                        import zlib
+
+                        p.same_crc = (prefix, zlib.crc32(prefix))
+                    p.same_epoch = epoch0
+                    p.same_ticks = 0
                 continue
             reply = rt.HeartbeatReply.decode(raw)
             r_groups = np.asarray(reply.groups, np.int64)
@@ -408,6 +484,8 @@ class HeartbeatManager:
         # would bounce off the peer lock anyway).
         n_spawned = 0
         for peer, p in plan.items():
+            if peer in same_sent:
+                continue  # quiesced: nothing moved, nothing to scan
             lag = (
                 arrays.is_leader[p.rows]
                 & (

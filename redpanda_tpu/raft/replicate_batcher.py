@@ -187,6 +187,7 @@ class ReplicateBatcher:
         c.arrays.flushed_index[row, SELF_SLOT] = max(
             int(c.arrays.flushed_index[row, SELF_SLOT]), flushed
         )
+        c.arrays.touch()
         if c.arrays.scalar_commit_update(row):
             c._notify_commit()
         for peer in c.peers():

@@ -106,6 +106,26 @@ class ShardGroupArrays:
         self.quorum_dirty = np.zeros(g, bool)
         self._folded_self_m = np.full(g, I64_MIN, np.int64)
         self._folded_self_f = np.full(g, I64_MIN, np.int64)
+        # coarse mutation epoch over the lanes that feed heartbeat
+        # frames and replies (match/flushed/commit/term/role/log_start/
+        # snap_index): the quiesced SAME-frame heartbeat path is armed
+        # against a snapshot of this counter and de-arms on ANY bump —
+        # writers call touch() (write sites) so a steady 50k-group tick
+        # can skip every per-row gather/compare. Coarse by design:
+        # a false bump costs one full frame, a missed bump is bounded
+        # by the manager's forced-full cadence.
+        self.mut_epoch = 0
+        # node-level suppression count (sum of hb_suppress): lets the
+        # tick skip the 50k-row suppress gather when nothing is active
+        self.hb_suppress_total = 0
+        # SAME-frame liveness coverage: node id whose armed quiesced
+        # heartbeat batch covers this row (-1 = none). Written once per
+        # arming (scatter amortized over the quiesced window) so the
+        # election sweeper credits node-level SAME stamps ONLY to rows
+        # the sender's armed batch actually covers — crediting by
+        # leader_id alone would let a leader that still SAMEs *other*
+        # groups suppress elections for a group it no longer leads.
+        self.same_cover_node = np.full(g, -1, np.int64)
         # term-boundary mirror version: callers caching term_at_batch
         # answers (heartbeat build/check paths) invalidate on change
         self.tb_epoch = 0
@@ -126,6 +146,10 @@ class ShardGroupArrays:
         # timestamp: suppression lifts the moment the fiber exits, so
         # the tick's recovery-fallback role is preserved exactly.
         self.hb_suppress = np.zeros((g, r), np.int32)
+
+    def touch(self) -> None:
+        """Invalidate armed SAME-frame heartbeat state (see mut_epoch)."""
+        self.mut_epoch += 1
 
     # -- row lifecycle ------------------------------------------------
     def alloc_row(self) -> int:
@@ -169,6 +193,8 @@ class ShardGroupArrays:
         self.el_timeout[row] = 3600.0
         self.el_jitter[row] = 0.0
         self.last_el[row] = 0.0
+        self.same_cover_node[row] = -1
+        self.touch()
 
     def _grow(self) -> None:
         old = self._cap
@@ -200,6 +226,7 @@ class ShardGroupArrays:
             "el_timeout",
             "el_jitter",
             "last_el",
+            "same_cover_node",
         ):
             arr = getattr(self, name)
             shape = (new,) + arr.shape[1:]
@@ -213,6 +240,8 @@ class ShardGroupArrays:
                 "snap_index",
             ):
                 grown[old:] = NO_OFFSET
+            elif name == "same_cover_node":
+                grown[old:] = -1
             elif name == "tb_start":
                 grown[old:] = I64_MAX
             elif name in ("tb_term", "leader_id"):
@@ -301,6 +330,8 @@ class ShardGroupArrays:
             term_start=int(self.term_start[row]),
         )
         advanced = new_commit > self.commit_index[row]
+        if advanced:
+            self.touch()
         self.commit_index[row] = new_commit
         dirty = qs.leader_majority_dirty(
             replicas, leader_dirty=int(self.match_index[row, SELF_SLOT])
@@ -435,6 +466,7 @@ class ShardGroupArrays:
             self.quorum_dirty[:] = False
         if not changed_rows:
             return _EMPTY_ROWS
+        self.touch()
         rows = np.unique(np.concatenate(changed_rows))
         self._folded_self_m[rows] = self.match_index[rows, SELF_SLOT]
         self._folded_self_f[rows] = self.flushed_index[rows, SELF_SLOT]

@@ -22,6 +22,36 @@ TRANSFER_LEADERSHIP = 105
 # (append_entries_buffer.{h,cc} applied ACROSS groups: one RPC, one
 # follower pass, one reply — per-call overhead O(1) in group count)
 APPEND_ENTRIES_BATCH = 106
+# quiesced steady-state heartbeat (no reference analog; an artifact of
+# the node-batched vector design): when neither side's raft state has
+# changed since the last full exchange, a fixed-size frame replaces
+# the O(groups) vector batch. Bound to the armed full frame by a CRC
+# of its bytes (minus the per-tick seq vector) so both sides agree on
+# exactly which vectors "unchanged" refers to.
+HEARTBEAT_SAME = 107
+
+import struct as _struct
+
+_SAME_REQ = _struct.Struct("<iiqI")  # node_id, n_groups, counter, frame_crc
+_SAME_REPLY = _struct.Struct("<bq")  # status, echoed counter
+SAME_OK = 0
+SAME_NEED_FULL = 1
+
+
+def encode_same_req(node_id: int, n: int, counter: int, crc: int) -> bytes:
+    return _SAME_REQ.pack(node_id, n, counter, crc & 0xFFFFFFFF)
+
+
+def decode_same_req(raw: bytes) -> tuple[int, int, int, int]:
+    return _SAME_REQ.unpack(raw)
+
+
+def encode_same_reply(status: int, counter: int) -> bytes:
+    return _SAME_REPLY.pack(status, counter)
+
+
+def decode_same_reply(raw: bytes) -> tuple[int, int]:
+    return _SAME_REPLY.unpack(raw)
 
 
 def encode_multi(payloads: list[bytes]) -> bytes:

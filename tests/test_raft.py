@@ -493,3 +493,77 @@ def test_offset_translator_prefix_truncate_stability():
     for raft in range(6, 10):
         assert ot.to_kafka(raft) == before[raft]
         assert ot.from_kafka(before[raft]) == raft
+
+
+def test_quiesced_same_heartbeat_path(tmp_path):
+    """The O(1) HEARTBEAT_SAME path: arms after a byte-stable full
+    exchange, keeps followers' liveness fresh via node-level stamps,
+    de-arms on ANY raft mutation (leader or follower side), and the
+    forced-full cadence bounds staleness. Replication through a
+    quiesced->active->quiesced cycle stays correct."""
+
+    async def main():
+        cluster = RaftCluster(tmp_path, 2)
+        # manual ticks: disable the background drivers
+        await cluster.start(election_timeout=3600.0, heartbeat=3600.0)
+        await cluster.create_group(1)
+        c1 = cluster.consensus(1)
+        c1.arrays.term[c1.row] = 0
+        c1._become_leader()
+        hb = cluster.nodes[1].heartbeat_manager
+        follower_gm = cluster.nodes[2]
+
+        # drive to steady state: config batch replicated + committed
+        for _ in range(30):
+            await hb.tick()
+            await asyncio.sleep(0)
+            if all(
+                cluster.consensus(n).commit_index >= c1.term_start
+                for n in (1, 2)
+            ):
+                break
+        plan = hb._plan or hb._build_plan()
+        # a few more ticks: splice caches arm, then SAME arms
+        for _ in range(4):
+            await hb.tick()
+        p = next(iter(hb._plan.values()))
+        assert p.same_epoch is not None, "SAME path never armed"
+        counter0 = p.same_counter
+        await hb.tick()
+        assert p.same_counter == counter0 + 1, "SAME tick did not run"
+        # node-level liveness stamp landed on the follower
+        assert follower_gm.node_hb.get(1, 0) > 0
+
+        # mutation on the LEADER de-arms and the next exchange is full
+        b = data_batch(b"quiesce-test")
+        stages = await c1.replicate_in_stages(b.build(), acks=-1)
+        await asyncio.wait_for(stages.done, 10)
+        for _ in range(4):
+            await hb.tick()  # full frames re-settle the caches
+        assert cluster.consensus(2).commit_index >= 0
+
+        # re-arms after the churn settles
+        for _ in range(4):
+            await hb.tick()
+        assert p.same_epoch is not None, "SAME did not re-arm after churn"
+
+        # follower-side mutation (epoch bump) forces NEED_FULL exactly once
+        follower_c = cluster.consensus(2)
+        follower_c.arrays.touch()
+        before = p.same_counter
+        await hb.tick()  # SAME sent, follower answers NEED_FULL
+        assert p.same_epoch is None and p.same_counter == before
+        await hb.tick()  # full frame
+        for _ in range(3):
+            await hb.tick()
+        assert p.same_epoch is not None, "SAME did not re-arm after NEED_FULL"
+
+        # forced-full cadence: after FORCE_FULL_EVERY SAME ticks, one
+        # full frame runs even with zero mutations
+        for _ in range(hb.FORCE_FULL_EVERY + 2):
+            await hb.tick()
+        assert p.same_epoch is not None  # re-armed right after the full
+
+        await cluster.stop()
+
+    run(main())
