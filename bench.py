@@ -434,17 +434,22 @@ def bench_fused() -> dict:
     }
 
 
-def bench_device_lz4() -> dict:
-    """Device LZ4 (the codec half of north-star #1, >=10x target):
-    batched cell-parallel LZ4 block compression (ops/lz4.py) vs host
-    liblz4 on the same redpanda-like payload. Output blocks are
-    standard LZ4 — the ratio column is the device parse's cost for
-    being parallel."""
+def _bench_device_codec(
+    metric: str,
+    compress_chunks_fn,
+    host_compress,
+    decode_check,
+    finalize,
+    rng_seed: int,
+):
+    """Shared device-codec bench harness (distinct settled buffers,
+    per-call blocked — see bench_fused's methodology note: same-buffer
+    loops measure tunnel memoization, not the kernel). Both codec legs
+    run under EXACTLY this recipe so their numbers stay comparable."""
     import jax
     import jax.numpy as jnp
 
-    from redpanda_tpu.compression import lz4_codec
-    from redpanda_tpu.ops.lz4 import CELL, _compress_chunks
+    from redpanda_tpu.ops.cellparse import CELL
 
     B, N = 16, 65536
     payload = b'{"key":"user-000001","topic":"orders","seq":12345,"flag":true},'
@@ -452,27 +457,24 @@ def bench_device_lz4() -> dict:
     batch = np.zeros((B, N + CELL), np.uint8)
     batch[:, :N] = np.frombuffer(buf, np.uint8)
     valid = jnp.asarray(np.full(B, N, np.int32))
-    db = jnp.asarray(batch)
     total = B * N
 
-    # distinct settled buffers, per-call blocked (see bench_fused's
-    # methodology note: same-buffer loops measured tunnel artifacts)
-    rng_l = np.random.default_rng(9)
+    rng_l = np.random.default_rng(rng_seed)
     alts = []
     alt_rows = []
-    for s in range(4):
+    for _s in range(4):
         m = batch.copy()
         # perturb each row so no (executable, buffer) pair repeats
         m[:, :64] = rng_l.integers(0, 256, (B, 64), dtype=np.uint8)
         alt_rows.append(m[0, :N].tobytes())
         alts.append(jnp.asarray(m))
     jax.block_until_ready([x.sum() for x in alts])
-    out, out_len = _compress_chunks(alts[0], valid, N)  # compile
+    out, out_len = compress_chunks_fn(alts[0], valid, N)  # compile
     jax.block_until_ready(out)
     times = []
     for dbx in alts:
         t0 = time.perf_counter()
-        out, out_len = _compress_chunks(dbx, valid, N)
+        out, out_len = compress_chunks_fn(dbx, valid, N)
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
     dev_gbps = total / min(times) / 1e9
@@ -481,13 +483,15 @@ def bench_device_lz4() -> dict:
     t0 = time.perf_counter()
     for _ in range(host_iters):
         for _ in range(B):
-            host_c = lz4_codec.compress_block(buf)
+            host_c = host_compress(buf)
     host_gbps = total / ((time.perf_counter() - t0) / host_iters) / 1e9
 
-    dev_c = np.asarray(out)[0, : int(np.asarray(out_len)[0])].tobytes()
-    assert lz4_codec.decompress_block(dev_c, N) == alt_rows[-1]
+    dev_c = finalize(
+        N, np.asarray(out)[0, : int(np.asarray(out_len)[0])].tobytes()
+    )
+    assert decode_check(dev_c, N) == alt_rows[-1]
     return {
-        "metric": "lz4_compress_device_gbps",
+        "metric": metric,
         "value": round(dev_gbps, 2),
         "unit": "GB/s",
         "vs_baseline": round(dev_gbps / host_gbps, 2),
@@ -495,6 +499,40 @@ def bench_device_lz4() -> dict:
         "device_ratio": round(len(dev_c) / N, 4),
         "host_ratio": round(len(host_c) / N, 4),
     }
+
+
+def bench_device_snappy() -> dict:
+    """Device snappy (completes the north-star codec trio): batched
+    cell-parallel raw snappy blocks (ops/snappy.py) vs host libsnappy;
+    blocks are standard snappy — libsnappy decodes them."""
+    from redpanda_tpu.compression import snappy_codec
+    from redpanda_tpu.ops.snappy import _compress_chunks, _preamble
+
+    return _bench_device_codec(
+        "snappy_compress_device_gbps",
+        _compress_chunks,
+        snappy_codec.compress_raw,
+        lambda blk, n: snappy_codec.decompress_raw(blk),
+        lambda n, raw: _preamble(n) + raw,
+        rng_seed=21,
+    )
+
+
+def bench_device_lz4() -> dict:
+    """Device LZ4 (the codec half of north-star #1, >=10x target):
+    batched cell-parallel LZ4 block compression (ops/lz4.py) vs host
+    liblz4; output blocks are standard LZ4."""
+    from redpanda_tpu.compression import lz4_codec
+    from redpanda_tpu.ops.lz4 import _compress_chunks
+
+    return _bench_device_codec(
+        "lz4_compress_device_gbps",
+        _compress_chunks,
+        lz4_codec.compress_block,
+        lambda blk, n: lz4_codec.decompress_block(blk, n),
+        lambda n, raw: raw,
+        rng_seed=9,
+    )
 
 
 def bench_codec() -> dict:
@@ -990,6 +1028,7 @@ BENCHES = {
     "live_tick": bench_live_tick,
     "crc": bench_crc,
     "device_lz4": bench_device_lz4,
+    "device_snappy": bench_device_snappy,
     "fused": bench_fused,
     "codec": bench_codec,
     "broker": bench_broker,
@@ -1022,6 +1061,7 @@ def main() -> None:
         runs = [
             ("crc", {}, 600),
             ("device_lz4", {}, 600),
+            ("device_snappy", {}, 600),
             ("fused", {}, 600),
             ("codec", {}, 600),
             ("live_tick", {}, 600),

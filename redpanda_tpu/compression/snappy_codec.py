@@ -76,10 +76,14 @@ def decompress_raw(data: bytes) -> bytes:
     return out.raw[: n.value]
 
 
+def xerial_header() -> bytes:
+    """The snappy-java stream header (shared with the device backend,
+    which supplies its own raw blocks)."""
+    return _MAGIC + struct.pack(">ii", _DEFAULT_VERSION, _MIN_COMPAT)
+
+
 def compress_java(data: bytes) -> bytes:
-    out = bytearray()
-    out += _MAGIC
-    out += struct.pack(">ii", _DEFAULT_VERSION, _MIN_COMPAT)
+    out = bytearray(xerial_header())
     for off in range(0, len(data), _BLOCK):
         chunk = compress_raw(data[off : off + _BLOCK])
         out += struct.pack(">i", len(chunk))

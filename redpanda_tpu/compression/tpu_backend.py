@@ -71,13 +71,53 @@ def compress_many(buffers: list[bytes]) -> list[bytes]:
     return out
 
 
+# ---- snappy leg (xerial stream framing over device raw blocks) ------
+_SNAPPY_BLOCK = 32768  # snappy-java chunk convention
+
+
+def compress_snappy(data: bytes) -> bytes:
+    return compress_many_snappy([data])[0]
+
+
+def compress_many_snappy(buffers: list[bytes]) -> list[bytes]:
+    """Batch-compress buffers into snappy-java (xerial) streams whose
+    raw blocks come from ONE device program (ops/snappy.py); any
+    consumer decodes them with plain libsnappy."""
+    from . import snappy_codec
+    from ..ops.snappy import compress_chunks
+
+    plan = [
+        [
+            data[o : o + _SNAPPY_BLOCK]
+            for o in range(0, len(data), _SNAPPY_BLOCK)
+        ]
+        or [b""]
+        for data in buffers
+    ]
+    flat = [c for chunks in plan for c in chunks]
+    blocks = iter(compress_chunks(flat))
+    out = []
+    for chunks in plan:
+        body = bytearray(snappy_codec.xerial_header())
+        for _ in chunks:
+            blk = next(blocks)
+            body += struct.pack(">i", len(blk))
+            body += blk
+        out.append(bytes(body))
+    return out
+
+
 def enable() -> None:
-    """Register the device LZ4 compressor; uncompress stays host-side
-    (the emitted frames are standard, so liblz4 reads them)."""
-    from . import CompressionType, register_backend
+    """Register the device LZ4 + snappy compressors; uncompress stays
+    host-side (the emitted frames/streams are standard, so liblz4 and
+    libsnappy read them)."""
+    from . import CompressionType, register_backend, snappy_codec
 
     register_backend(
         CompressionType.lz4, compress, lz4_codec.decompress_frame
+    )
+    register_backend(
+        CompressionType.snappy, compress_snappy, snappy_codec.decompress_java
     )
 
 

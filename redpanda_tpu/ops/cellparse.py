@@ -1,0 +1,120 @@
+"""Shared cell-grid LZ77 parse for the device codecs (lz4, snappy).
+
+The parse reshapes the inherently-sequential greedy LZ77 scan into one
+decision per fixed CELL-byte cell, all dense vector work (see
+ops/lz4.py module docstring for the full derivation):
+
+  1. nearest earlier 4-gram occurrence via sort-based hash chain,
+     walked 3 deep to recover periodic matches;
+  2. window verification: a candidate is kept only if it matches from
+     its in-cell start to the cell end;
+  3. run merging: fully-matched cells continuing the previous cell's
+     match at the same offset are absorbed, so periodic data emits one
+     long sequence;
+  4. literal-run attribution via exclusive cummax.
+
+Both codecs emit (literal run | match to cell end) sequences from the
+returned per-cell vectors; only the byte-level emission differs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+CELL = 16  # parse grid: one sequence decision per CELL bytes
+_HASH_BITS = 16
+_TAIL_GUARD = 12  # no match may start near the end (LZ4 spec; safe for snappy)
+
+
+def cell_parse(d: jax.Array, v: jax.Array, n: int):
+    """d: uint8[n + CELL] zero-padded input, v: scalar valid length.
+    Returns per-cell vectors (nc = n // CELL):
+      has[nc]       — cell emits a sequence (literal run + match)
+      mstart[nc]    — match start position
+      offs[nc]      — match backward offset (>= 1)
+      mlen[nc]      — match length (covers absorbed following cells)
+      lit_start[nc] — literal-run start for this sequence
+      lit_len[nc]   — literal-run length
+      last_end      — scalar: end of the last match run (final-literal
+                      start)
+    """
+    nc = n // CELL
+    pos = jnp.arange(n, dtype=jnp.int32)
+    d32 = d.astype(jnp.uint32)
+    gram = (
+        d32[pos]
+        | (d32[pos + 1] << 8)
+        | (d32[pos + 2] << 16)
+        | (d32[pos + 3] << 24)
+    )
+    h = ((gram * jnp.uint32(2654435761)) >> (32 - _HASH_BITS)).astype(
+        jnp.int32
+    )
+    # predecessor-in-sort-order = most recent earlier same-hash pos
+    key = (h.astype(jnp.int64) << 17) | pos.astype(jnp.int64)
+    sk = jnp.sort(key)
+    sh = (sk >> 17).astype(jnp.int32)
+    sp = (sk & 0x1FFFF).astype(jnp.int32)
+    prev_ok = jnp.concatenate([jnp.zeros(1, bool), sh[1:] == sh[:-1]])
+    cand_sorted = jnp.where(prev_ok, jnp.roll(sp, 1), -1)
+    cand = jnp.zeros(n, jnp.int32).at[sp].set(cand_sorted)
+
+    cell_end = (pos // CELL + 1) * CELL
+    cap = jnp.minimum(cell_end, v) - pos
+    k = jnp.arange(CELL, dtype=jnp.int32)[None, :]
+    pk = pos[:, None] + k
+    eligible = (cap >= 4) & (cell_end <= v - _TAIL_GUARD)
+
+    def verify(q):
+        qk = jnp.clip(q[:, None] + k, 0, n - 1)
+        eq = (d[pk] == d[qk]) & (k < cap[:, None]) & (q >= 0)[:, None]
+        run = jnp.cumprod(eq.astype(jnp.int32), axis=1).sum(axis=1)
+        return (run == cap) & eligible & (q >= 0)
+
+    cand1 = cand
+    cand2 = jnp.where(cand1 >= 0, cand[jnp.clip(cand1, 0, n - 1)], -1)
+    cand3 = jnp.where(cand2 >= 0, cand[jnp.clip(cand2, 0, n - 1)], -1)
+    g1 = verify(cand1)
+    g2 = verify(cand2)
+    g3 = verify(cand3)
+    good = g1 | g2 | g3
+    cand = jnp.where(g1, cand1, jnp.where(g2, cand2, cand3))
+
+    # one sequence per cell: first in-cell position whose match runs
+    # to the cell end
+    goodc = good.reshape(nc, CELL)
+    has = goodc.any(axis=1)
+    j = jnp.argmax(goodc, axis=1).astype(jnp.int32)
+    cstart = jnp.arange(nc, dtype=jnp.int32) * CELL
+    mstart = cstart + j
+    offs = mstart - cand[mstart]
+
+    # merge runs (absorption): see module docstring
+    absorb = jnp.concatenate(
+        [
+            jnp.zeros(1, bool),
+            has[1:] & has[:-1] & (j[1:] == 0) & (offs[1:] == offs[:-1]),
+        ]
+    )
+    head = has & ~absorb
+    cell_idx = jnp.arange(nc, dtype=jnp.int32)
+    boundary = jnp.where(~absorb, cell_idx, nc)
+    next_boundary = jnp.concatenate(
+        [
+            jax.lax.cummin(boundary[::-1])[::-1][1:],
+            jnp.full(1, nc, jnp.int32),
+        ]
+    )
+    run_end = jnp.where(head, next_boundary, 0)
+    has = head
+    mlen = jnp.where(has, (run_end - cell_idx) * CELL - j, 0)
+
+    # literal-run starts: end of the previous match run
+    contrib = jnp.where(has, run_end * CELL, 0)
+    cmax = jax.lax.cummax(contrib)
+    prev_end = jnp.concatenate([jnp.zeros(1, jnp.int32), cmax[:-1]])
+    lit_start = prev_end
+    lit_len = jnp.where(has, mstart - prev_end, 0)
+    last_end = jnp.maximum(cmax[-1], 0)
+    return has, mstart, offs, mlen, lit_start, lit_len, last_end

@@ -26,7 +26,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from .crc32c import crc32c_device
-from .lz4 import CELL, _compress_chunks, out_bound
+from .cellparse import CELL
+from .lz4 import _compress_chunks, out_bound
+from .snappy import _compress_chunks as _snappy_chunks
+from .snappy import _preamble as _snappy_preamble
+from .snappy import out_bound as snappy_out_bound
 
 PREFIX = 40  # models/record.py _CRC_PREFIX packed size
 
@@ -53,6 +57,29 @@ def _fused(data: jax.Array, body_len: jax.Array, n: int):
     return crc, out, out_len
 
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def _fused_snappy(data: jax.Array, body_len: jax.Array, n: int):
+    """Same layout as _fused, snappy emission instead of LZ4."""
+    crc_w = ((PREFIX + n + 511) // 512) * 512
+    crc = crc32c_device(
+        data[:, :crc_w], (body_len + PREFIX).astype(jnp.int64)
+    )
+    body = jax.lax.optimization_barrier(
+        data[:, PREFIX : PREFIX + n + CELL]
+    )
+    out, out_len = _snappy_chunks(body, body_len, n)
+    return crc, out, out_len
+
+
+def crc_snappy_fused(
+    prefixes: "list[bytes]", bodies: "list[bytes | np.ndarray]"
+) -> tuple[np.ndarray, list[bytes]]:
+    """One device pass: per-row Kafka CRC + raw snappy blocks (the
+    snappy leg of the north-star codec trio; preamble host-side)."""
+    return _fused_entry(prefixes, bodies, _fused_snappy, snappy_out_bound,
+                        _snappy_preamble)
+
+
 def crc_lz4_fused(
     prefixes: "list[bytes]", bodies: "list[bytes | np.ndarray]"
 ) -> tuple[np.ndarray, list[bytes]]:
@@ -60,6 +87,10 @@ def crc_lz4_fused(
     body compressed into standard LZ4 blocks. Bodies must be <= 64 KiB
     (the device parser's cell-grid bound); callers chunk larger bodies
     and assemble multi-block frames host-side."""
+    return _fused_entry(prefixes, bodies, _fused, out_bound, None)
+
+
+def _fused_entry(prefixes, bodies, kernel, bound_fn, preamble_fn):
     assert len(prefixes) == len(bodies)
     if not bodies:
         return np.empty(0, np.uint32), []
@@ -69,7 +100,7 @@ def crc_lz4_fused(
     ]
     longest = max(a.size for a in arrs)
     if longest > 65536:
-        raise ValueError("fused lz4 bodies must be <= 64 KiB")
+        raise ValueError("fused codec bodies must be <= 64 KiB")
     n = 512  # floor keeps the crc fold width 512-aligned
     while n < longest:
         n *= 2
@@ -82,12 +113,17 @@ def crc_lz4_fused(
         batch[i, :PREFIX] = np.frombuffer(p, np.uint8)
         batch[i, PREFIX : PREFIX + a.size] = a
         body_len[i] = a.size
-    crc, out, out_len = _fused(
+    crc, out, out_len = kernel(
         jnp.asarray(batch), jnp.asarray(body_len), n
     )
     crc = np.asarray(crc)
     out = np.asarray(out)
     out_len = np.asarray(out_len)
-    assert int(out_len.max()) <= out_bound(n)
-    blocks = [out[i, : out_len[i]].tobytes() for i in range(len(arrs))]
+    assert int(out_len.max()) <= bound_fn(n)
+    blocks = []
+    for i in range(len(arrs)):
+        blk = out[i, : out_len[i]].tobytes()
+        if preamble_fn is not None:
+            blk = preamble_fn(int(body_len[i])) + blk
+        blocks.append(blk)
     return crc, blocks

@@ -44,9 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-CELL = 16  # parse grid: one sequence decision per CELL bytes
-_HASH_BITS = 16
-_TAIL_GUARD = 12  # no match may start in the last 12 bytes (LZ4 spec)
+from .cellparse import CELL, cell_parse
 
 
 def out_bound(n: int) -> int:
@@ -63,92 +61,9 @@ def _compress_chunks(data: jax.Array, valid: jax.Array, n: int):
     m = out_bound(n)
 
     def one(d: jax.Array, v: jax.Array):
-        pos = jnp.arange(n, dtype=jnp.int32)
-        d32 = d.astype(jnp.uint32)
-        gram = (
-            d32[pos]
-            | (d32[pos + 1] << 8)
-            | (d32[pos + 2] << 16)
-            | (d32[pos + 3] << 24)
+        has, mstart, offs, mlen, lit_start, lit_len, last_end = cell_parse(
+            d, v, n
         )
-        h = ((gram * jnp.uint32(2654435761)) >> (32 - _HASH_BITS)).astype(
-            jnp.int32
-        )
-        # predecessor-in-sort-order = most recent earlier same-hash pos
-        key = (h.astype(jnp.int64) << 17) | pos.astype(jnp.int64)
-        sk = jnp.sort(key)
-        sh = (sk >> 17).astype(jnp.int32)
-        sp = (sk & 0x1FFFF).astype(jnp.int32)
-        prev_ok = jnp.concatenate(
-            [jnp.zeros(1, bool), sh[1:] == sh[:-1]]
-        )
-        cand_sorted = jnp.where(prev_ok, jnp.roll(sp, 1), -1)
-        cand = jnp.zeros(n, jnp.int32).at[sp].set(cand_sorted)
-
-        # verify matches, capped at the owning cell's end. The sorted
-        # hash chain has depth 1 (nearest earlier occurrence); walking
-        # it twice more recovers periodic matches whose nearest
-        # occurrence is a partial repeat (e.g. "000" inside a longer
-        # key) — each hop is just another vectorized window compare.
-        cell_end = (pos // CELL + 1) * CELL
-        cap = jnp.minimum(cell_end, v) - pos
-        k = jnp.arange(CELL, dtype=jnp.int32)[None, :]
-        pk = pos[:, None] + k
-        eligible = (cap >= 4) & (cell_end <= v - _TAIL_GUARD)
-
-        def verify(q):
-            qk = jnp.clip(q[:, None] + k, 0, n - 1)
-            eq = (d[pk] == d[qk]) & (k < cap[:, None]) & (q >= 0)[:, None]
-            run = jnp.cumprod(eq.astype(jnp.int32), axis=1).sum(axis=1)
-            return (run == cap) & eligible & (q >= 0)
-
-        cand1 = cand
-        cand2 = jnp.where(cand1 >= 0, cand[jnp.clip(cand1, 0, n - 1)], -1)
-        cand3 = jnp.where(cand2 >= 0, cand[jnp.clip(cand2, 0, n - 1)], -1)
-        g1 = verify(cand1)
-        g2 = verify(cand2)
-        g3 = verify(cand3)
-        good = g1 | g2 | g3
-        cand = jnp.where(g1, cand1, jnp.where(g2, cand2, cand3))
-
-        # one sequence per cell: first in-cell position whose match
-        # runs to the cell end
-        goodc = good.reshape(nc, CELL)
-        has = goodc.any(axis=1)
-        j = jnp.argmax(goodc, axis=1).astype(jnp.int32)
-        cstart = jnp.arange(nc, dtype=jnp.int32) * CELL
-        mstart = cstart + j
-        offs = mstart - cand[mstart]
-
-        # merge runs: a fully-matched cell (j==0) continuing the
-        # previous cell's match at the same offset is absorbed into it,
-        # so periodic data emits ONE long sequence instead of one per
-        # cell (the ratio floor drops from ~4/CELL to the real entropy)
-        absorb = jnp.concatenate(
-            [
-                jnp.zeros(1, bool),
-                has[1:] & has[:-1] & (j[1:] == 0) & (offs[1:] == offs[:-1]),
-            ]
-        )
-        head = has & ~absorb
-        cell_idx = jnp.arange(nc, dtype=jnp.int32)
-        boundary = jnp.where(~absorb, cell_idx, nc)
-        next_boundary = jnp.concatenate(
-            [
-                jax.lax.cummin(boundary[::-1])[::-1][1:],
-                jnp.full(1, nc, jnp.int32),
-            ]
-        )
-        run_end = jnp.where(head, next_boundary, 0)
-        has = head
-        mlen = jnp.where(has, (run_end - cell_idx) * CELL - j, 0)
-
-        # literal-run starts: end of the previous match run
-        contrib = jnp.where(has, run_end * CELL, 0)
-        cmax = jax.lax.cummax(contrib)
-        prev_end = jnp.concatenate([jnp.zeros(1, jnp.int32), cmax[:-1]])
-        lit_start = prev_end
-        lit_len = jnp.where(has, mstart - prev_end, 0)
 
         def n_extra(length):
             return jnp.where(length >= 15, (length - 15) // 255 + 1, 0)
@@ -165,7 +80,6 @@ def _compress_chunks(data: jax.Array, valid: jax.Array, n: int):
         )
         total = starts[-1] + size[-1]
 
-        last_end = jnp.maximum(cmax[-1], 0)
         f_lit_start = last_end
         f_lit_len = jnp.maximum(v - last_end, 0)
         f_nk = n_extra(f_lit_len)

@@ -1,0 +1,163 @@
+"""Batched raw-snappy compression on device (north-star codec trio).
+
+Reference: src/v/compression/internal/snappy_java_compressor.{h,cc}
+compresses on the CPU via libsnappy one buffer at a time; here many
+independent chunks run in one XLA program, each producing a standard
+raw snappy block (decodable by snappy_uncompress / any snappy
+implementation). The snappy-java ("xerial") stream framing the Kafka
+wire uses stays host-side, exactly like the LZ4 frame wrap.
+
+The parse is the shared cell grid of ops/cellparse.py (one sequence
+decision per 16-byte cell, sort-based hash chain, run absorption).
+Emission maps each sequence to snappy elements:
+
+  [literal element]  tag (len-1)<<2 | 0, +1/+2 length bytes past 60
+  [copy elements]    2-byte-offset copies (tag&3 == 2), length <= 64
+                     each — a merged multi-cell match emits
+                     ceil(mlen/64) consecutive copies of the same
+                     offset, which is byte-valid snappy.
+
+The uncompressed-length preamble varint is prepended host-side (the
+device emits elements only). Offsets fit 16 bits because chunks are
+<= 64 KiB, mirroring the LZ4 kernel's constraint.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .cellparse import CELL, cell_parse
+
+
+def out_bound(n: int) -> int:
+    """Worst-case device output for an n-byte chunk: all-literal cells
+    plus per-sequence overhead (3-byte literal header + 3 bytes per
+    64-byte copy span per cell)."""
+    return n + (n // CELL + 1) * 6 + 64
+
+
+def _lit_extra(length):
+    """Extra length bytes after the literal tag (0 for len<=60; else
+    1 or 2 little-endian bytes of len-1; chunks <= 64 KiB need <= 2)."""
+    return jnp.where(length <= 60, 0, jnp.where(length <= 256, 1, 2))
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _compress_chunks(data: jax.Array, valid: jax.Array, n: int):
+    """data: uint8[B, n + CELL] (zero-padded), valid: int32[B].
+    Returns (out: uint8[B, out_bound(n)] WITHOUT the length preamble,
+    out_len: int32[B])."""
+    nc = n // CELL
+    m = out_bound(n)
+
+    def one(d: jax.Array, v: jax.Array):
+        has, mstart, offs, mlen, lit_start, lit_len, last_end = cell_parse(
+            d, v, n
+        )
+
+        lit_ex = _lit_extra(lit_len)
+        litsz = jnp.where(lit_len > 0, 1 + lit_ex + lit_len, 0)
+        ncop = jnp.where(has, (mlen + 63) // 64, 0)
+        size = jnp.where(has, litsz + 3 * ncop, 0)
+        starts = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(size)[:-1].astype(jnp.int32)]
+        )
+        total = starts[-1] + size[-1]
+
+        f_lit_start = last_end
+        f_lit_len = jnp.maximum(v - last_end, 0)
+        f_ex = _lit_extra(f_lit_len)
+        f_size = jnp.where(f_lit_len > 0, 1 + f_ex + f_lit_len, 0)
+        out_len = total + f_size
+
+        def lit_byte_val(length, ex, start, r):
+            # r == 0 → tag; r-1 < ex → length byte i; else literal data
+            tag = jnp.where(
+                ex == 0,
+                (length - 1) << 2,
+                jnp.where(ex == 1, 60 << 2, 61 << 2),
+            )
+            len_b = ((length - 1) >> (8 * jnp.maximum(r - 1, 0))) & 255
+            data_b = d[jnp.clip(start + r - 1 - ex, 0, n - 1)]
+            return jnp.where(
+                r == 0, tag, jnp.where(r - 1 < ex, len_b, data_b)
+            )
+
+        # ---- emission: every output byte finds its (cell, role) ----
+        o = jnp.arange(m, dtype=jnp.int32)
+        s = jnp.clip(
+            jnp.searchsorted(starts, o, side="right").astype(jnp.int32) - 1,
+            0,
+            nc - 1,
+        )
+        r = o - starts[s]
+        in_lit = r < litsz[s]
+        lit_v = lit_byte_val(lit_len[s], lit_ex[s], lit_start[s], r)
+        c = r - litsz[s]
+        ci = c // 3
+        role = c % 3
+        clen = jnp.clip(mlen[s] - 64 * ci, 1, 64)
+        off_s = offs[s]
+        copy_v = jnp.where(
+            role == 0,
+            2 | ((clen - 1) << 2),
+            jnp.where(role == 1, off_s & 255, off_s >> 8),
+        )
+        val = jnp.where(in_lit, lit_v, copy_v)
+
+        fo = o - total
+        f_val = lit_byte_val(f_lit_len, f_ex, f_lit_start, fo)
+
+        out = jnp.where(
+            o < total, val, jnp.where(o < out_len, f_val, 0)
+        ).astype(jnp.uint8)
+        return out, out_len
+
+    return jax.vmap(one)(data, valid)
+
+
+def _preamble(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def compress_chunks(chunks: list[bytes | np.ndarray]) -> list[bytes]:
+    """Compress each <= 64 KiB chunk into a standard raw snappy block
+    on device (preamble prepended host-side). Padded-bucket recipe of
+    ops/crc32c.py: one compiled program serves many sizes."""
+    if not chunks:
+        return []
+    arrs = [
+        np.frombuffer(c, np.uint8) if isinstance(c, bytes) else c
+        for c in chunks
+    ]
+    longest = max(a.size for a in arrs)
+    if longest > 65536:
+        raise ValueError("device snappy chunks must be <= 64 KiB")
+    n = 256
+    while n < longest:
+        n *= 2
+    batch = np.zeros((len(arrs), n + CELL), np.uint8)
+    valid = np.empty(len(arrs), np.int32)
+    for i, a in enumerate(arrs):
+        batch[i, : a.size] = a
+        valid[i] = a.size
+    out, out_len = _compress_chunks(jnp.asarray(batch), jnp.asarray(valid), n)
+    out = np.asarray(out)
+    out_len = np.asarray(out_len)
+    assert int(out_len.max()) <= out_bound(n), "snappy out_bound violated"
+    return [
+        _preamble(int(valid[i])) + out[i, : out_len[i]].tobytes()
+        for i in range(len(arrs))
+    ]

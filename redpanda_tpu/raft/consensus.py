@@ -448,7 +448,14 @@ class Consensus:
 
     @property
     def _last_heartbeat(self) -> float:
-        return float(self.arrays.last_hb[self.row])
+        row = self.row
+        hb = float(self.arrays.last_hb[row])
+        cover = int(self.arrays.same_cover_node[row])
+        if cover >= 0:
+            # quiesced leader: liveness arrives as node-level SAME
+            # stamps, not per-row writes
+            hb = max(hb, self.arrays.node_hb.get(cover, 0.0))
+        return hb
 
     @_last_heartbeat.setter
     def _last_heartbeat(self, v: float) -> None:

@@ -63,11 +63,7 @@ class GroupManager:
         # asyncio timer per group
         self._sweeper_task = None
         self._lag_skips = 0
-        # node-level liveness stamps from HEARTBEAT_SAME frames: the
-        # quiesced path proves the sender still leads every group of
-        # the armed batch without touching per-row last_hb; the
-        # election sweeper merges these by leader_id
-        self.node_hb: dict[int, float] = {}
+
         self._rows_cache: tuple[int, "object"] | None = None
         self._min_el_timeout = 3600.0
 
@@ -159,14 +155,14 @@ class GroupManager:
             now = loop.time()
             to = arrays.el_timeout[rows]
             last_hb = arrays.last_hb[rows]
-            if self.node_hb:
+            if arrays.node_hb:
                 # merge node-level SAME stamps — but ONLY onto rows the
                 # sender's armed batch actually covers (same_cover_node,
                 # written at arm time). Crediting by leader_id alone
                 # would let a node that still SAMEs other groups
                 # suppress elections for a group it no longer leads.
                 cover = arrays.same_cover_node[rows]
-                for lid, stamp in self.node_hb.items():
+                for lid, stamp in arrays.node_hb.items():
                     mask = cover == lid
                     if mask.any():
                         last_hb = np.maximum(

@@ -313,7 +313,7 @@ class RaftService(Service):
             or ent[2] != crc
         ):
             return rt.encode_same_reply(rt.SAME_NEED_FULL, counter)
-        self._gm.node_hb[node_id] = asyncio.get_event_loop().time()
+        arrays.node_hb[node_id] = asyncio.get_event_loop().time()
         return rt.encode_same_reply(rt.SAME_OK, counter)
 
     @method(rt.APPEND_ENTRIES_BATCH)

@@ -126,6 +126,12 @@ class ShardGroupArrays:
         # leader_id alone would let a leader that still SAMEs *other*
         # groups suppress elections for a group it no longer leads.
         self.same_cover_node = np.full(g, -1, np.int64)
+        # node-level liveness stamps from HEARTBEAT_SAME frames,
+        # merged with per-row last_hb by BOTH the election sweeper and
+        # Consensus._last_heartbeat (prevote/vote denial must see
+        # quiesced leaders as live, or an isolated node could talk a
+        # SAME-quiesced cluster into an election)
+        self.node_hb: dict[int, float] = {}
         # term-boundary mirror version: callers caching term_at_batch
         # answers (heartbeat build/check paths) invalidate on change
         self.tb_epoch = 0

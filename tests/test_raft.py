@@ -532,7 +532,7 @@ def test_quiesced_same_heartbeat_path(tmp_path):
         await hb.tick()
         assert p.same_counter == counter0 + 1, "SAME tick did not run"
         # node-level liveness stamp landed on the follower
-        assert follower_gm.node_hb.get(1, 0) > 0
+        assert follower_gm.arrays.node_hb.get(1, 0) > 0
 
         # mutation on the LEADER de-arms and the next exchange is full
         b = data_batch(b"quiesce-test")
