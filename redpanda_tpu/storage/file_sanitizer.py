@@ -111,5 +111,10 @@ class SanitizedFile:
 
 
 def wrap(raw, path: str):
-    """Wrap `raw` when the sanitizer is enabled; identity otherwise."""
-    return SanitizedFile(raw, path) if enabled() else raw
+    """Compose the active debug layers: iofaults (innermost, so the
+    sanitizer's op history sees injected outcomes) then the sanitizer.
+    Identity when neither is active."""
+    from . import iofaults
+
+    f = iofaults.wrap(raw, path)
+    return SanitizedFile(f, path) if enabled() else f

@@ -1,0 +1,201 @@
+"""Fault-injecting I/O layer for consistency testing.
+
+Reference: src/consistency-testing/iofaults/iofaults.py:20 — the
+reference runs a FUSE passthrough filesystem that injects per-op
+delays/errors under a live workload. No FUSE here; instead two seams
+cover the same fault surface in-process:
+
+  * file proxies — `file_sanitizer.wrap` routes every storage append
+    handle through `FaultyFile` while a schedule is installed, so
+    write-level rules (delay / EIO / short write) hit individual ops;
+  * a patched `os.fsync` — fd is resolved to its path via
+    /proc/self/fd, rules can delay, fail, or LIE (return success
+    without syncing), and every HONEST fsync records the file's
+    synced size.
+
+The recorded synced sizes power `simulate_power_cut(data_dir)`: every
+file under the directory is truncated to its last honestly-fsynced
+size (unsynced tail = lost page cache). Crash + power-cut + restart is
+the strongest durability probe this side of real hardware: anything
+the broker acked must survive, so a stable-offset that advances past
+a real fsync — or an fsync lie anywhere in the stack — surfaces as
+acked-data loss in the chaos validator instead of shipping.
+
+Directory-entry durability (files created but never fsynced via their
+parent dir) is NOT simulated; the power cut truncates file contents
+only.
+
+Rules match (path glob, op) and fire with probability `prob` and/or on
+every `nth` matching op, up to `count` times; the schedule's RNG is
+seeded so every chaos run replays byte-identically.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+_real_fsync = os.fsync
+
+
+@dataclass
+class Rule:
+    path_glob: str
+    op: str  # "write" | "fsync" | "flush"
+    action: str  # "delay" | "error" | "lie_fsync" | "short_write"
+    prob: float = 1.0
+    nth: int = 1  # fire on every nth matching op
+    count: int = 1 << 30  # max firings
+    delay_s: float = 0.0
+    fired: int = 0
+    seen: int = 0
+
+    def matches(self, path: str, op: str, rng: random.Random) -> bool:
+        if op != self.op or self.fired >= self.count:
+            return False
+        if not fnmatch.fnmatch(path, self.path_glob):
+            return False
+        self.seen += 1
+        if self.seen % self.nth != 0:
+            return False
+        if self.prob < 1.0 and rng.random() >= self.prob:
+            return False
+        self.fired += 1
+        return True
+
+
+@dataclass
+class FaultSchedule:
+    rules: list[Rule]
+    seed: int = 0
+    rng: random.Random = field(init=False)
+    injected: dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self.rng = random.Random(self.seed)
+
+    def act(self, path: str, op: str) -> Optional[Rule]:
+        for r in self.rules:
+            if r.matches(path, op, self.rng):
+                self.injected[r.action] = self.injected.get(r.action, 0) + 1
+                return r
+        return None
+
+
+_schedule: Optional[FaultSchedule] = None
+# path -> last honestly-fsynced size (tracked while installed)
+_synced: dict[str, int] = {}
+
+
+def active() -> bool:
+    return _schedule is not None
+
+
+def install(schedule: FaultSchedule) -> None:
+    """Install the schedule and patch os.fsync. Idempotent-ish: the
+    last installed schedule wins; synced-size tracking resets."""
+    global _schedule
+    _schedule = schedule
+    _synced.clear()
+    os.fsync = _faulty_fsync
+
+
+def clear() -> None:
+    global _schedule
+    _schedule = None
+    os.fsync = _real_fsync
+
+
+def synced_size(path: str) -> int:
+    return _synced.get(path, 0)
+
+
+def _fd_path(fd: int) -> str:
+    try:
+        return os.readlink(f"/proc/self/fd/{fd}")
+    except OSError:
+        return ""
+
+
+def _faulty_fsync(fd: int) -> None:
+    sched = _schedule
+    if sched is None:
+        _real_fsync(fd)
+        return
+    path = _fd_path(fd)
+    rule = sched.act(path, "fsync")
+    if rule is not None:
+        if rule.action == "delay":
+            time.sleep(rule.delay_s)
+        elif rule.action == "error":
+            raise OSError(5, "iofaults: injected fsync EIO", path)
+        elif rule.action == "lie_fsync":
+            # claim success, sync nothing, record nothing: the page
+            # cache keeps the tail until the next power cut
+            return
+    _real_fsync(fd)
+    try:
+        _synced[path] = os.fstat(fd).st_size
+    except OSError:
+        pass
+
+
+class FaultyFile:
+    """Write-side file proxy applying write/flush rules. Composes
+    under the sanitizer proxy (faults first, history outside)."""
+
+    def __init__(self, raw, path: str):
+        self._raw = raw
+        self._path = path
+
+    def write(self, data) -> int:
+        sched = _schedule
+        if sched is not None:
+            rule = sched.act(self._path, "write")
+            if rule is not None:
+                if rule.action == "delay":
+                    time.sleep(rule.delay_s)
+                elif rule.action == "error":
+                    raise OSError(5, "iofaults: injected write EIO", self._path)
+                elif rule.action == "short_write" and len(data) > 1:
+                    return self._raw.write(data[: len(data) // 2])
+        return self._raw.write(data)
+
+    def flush(self) -> None:
+        sched = _schedule
+        if sched is not None:
+            rule = sched.act(self._path, "flush")
+            if rule is not None and rule.action == "error":
+                raise OSError(5, "iofaults: injected flush EIO", self._path)
+        self._raw.flush()
+
+    def __getattr__(self, name):
+        return getattr(self._raw, name)
+
+
+def wrap(raw, path: str):
+    return FaultyFile(raw, path) if active() else raw
+
+
+def simulate_power_cut(data_dir: str) -> list[tuple[str, int, int]]:
+    """Truncate every file under data_dir to its last honestly-fsynced
+    size (0 if never synced). Returns [(path, old_size, new_size)] for
+    files that lost bytes. Call AFTER stopping the broker."""
+    lost = []
+    for root, _dirs, files in os.walk(data_dir):
+        for name in files:
+            path = os.path.join(root, name)
+            try:
+                cur = os.path.getsize(path)
+            except OSError:
+                continue
+            keep = min(_synced.get(path, 0), cur)
+            if keep < cur:
+                with open(path, "r+b") as f:
+                    f.truncate(keep)
+                lost.append((path, cur, keep))
+    return lost

@@ -24,7 +24,7 @@ import struct
 
 from ..models.record import HEADER_SIZE, RecordBatch, RecordBatchHeader
 from ..utils.crc import crc32c
-from . import file_sanitizer
+from . import file_sanitizer, iofaults
 
 INDEX_INTERVAL_BYTES = 32 * 1024
 
@@ -183,10 +183,18 @@ class Segment:
         hdr = h.pack()
         self._maybe_index(batch, self._size)
         f = self._wfile()
-        if file_sanitizer.enabled():
-            # sanitizer proxies need the write to flow through their
-            # op-history `write`; one concat is fine in debug builds
-            f.write(hdr + batch.body)
+        if file_sanitizer.enabled() or iofaults.active():
+            # sanitizer/iofault proxies need the write to flow through
+            # their `write`; one concat is fine in debug builds. Honor
+            # short writes (FileIO may return a partial count; the
+            # iofault short_write action deliberately does) — silently
+            # absorbing one would advance dirty_offset past a torn
+            # batch that recovery then truncates, losing acked data.
+            data = hdr + batch.body
+            n = f.write(data)
+            while n is not None and n < len(data):
+                data = data[n:]
+                n = f.write(data)
         else:
             n = os.writev(f.fileno(), (hdr, batch.body))
             if n != len(hdr) + len(batch.body):  # short write (signal/ENOSPC)

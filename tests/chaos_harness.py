@@ -185,6 +185,105 @@ async def validate(
     return stats
 
 
+async def transfer_random_leadership(
+    cluster: ChaosCluster, rng: random.Random, topic: str | None = None
+) -> bool:
+    """Pick a random led partition (optionally restricted to `topic`)
+    and hand leadership to a random peer. Shared by the fault loop and
+    the admin-ops fuzzer."""
+    for b in cluster.brokers.values():
+        parts = [
+            p
+            for p in b.partition_manager.partitions().values()
+            if p.is_leader and (topic is None or p.ntp.topic == topic)
+        ]
+        if parts:
+            p = rng.choice(parts)
+            peers = p.consensus.peers()
+            if peers:
+                with contextlib.suppress(Exception):
+                    await asyncio.wait_for(
+                        p.consensus.transfer_leadership(rng.choice(peers)),
+                        timeout=3.0,
+                    )
+            return True
+    return False
+
+
+async def admin_ops_fuzzer(
+    cluster: ChaosCluster, rng: random.Random, stop: list
+) -> dict:
+    """Randomized admin-plane churn DURING the replicated workload
+    (ref: rptest/services/admin_ops_fuzzer.py): aux-topic create/
+    delete, config alters, partition grows, leadership transfers —
+    every op either succeeds or fails with a clean client error while
+    the main topic's acked-data invariants must keep holding."""
+    counts: dict[str, int] = {}
+    aux: list[str] = []
+    n_created = 0
+    client = KafkaClient(cluster.addresses())
+    try:
+        while not stop[0]:
+            op = rng.choice(
+                ("create", "delete", "alter", "grow", "transfer", "describe")
+            )
+            counts[op] = counts.get(op, 0) + 1
+            try:
+                if op == "create":
+                    name = f"fuzz-{n_created}"
+                    n_created += 1
+                    await asyncio.wait_for(
+                        client.create_topic(
+                            name,
+                            partitions=rng.randrange(1, 3),
+                            replication_factor=3,
+                        ),
+                        timeout=3.0,
+                    )
+                    aux.append(name)
+                elif op == "delete" and aux:
+                    name = aux.pop(rng.randrange(len(aux)))
+                    await asyncio.wait_for(
+                        client.delete_topic(name), timeout=3.0
+                    )
+                elif op == "alter" and aux:
+                    name = rng.choice(aux)
+                    await asyncio.wait_for(
+                        client.alter_topic_configs(
+                            name,
+                            {
+                                "retention.ms": str(
+                                    rng.randrange(10000, 100000000)
+                                )
+                            },
+                        ),
+                        timeout=3.0,
+                    )
+                elif op == "grow" and aux:
+                    name = rng.choice(aux)
+                    await asyncio.wait_for(
+                        client.create_partitions(name, rng.randrange(2, 5)),
+                        timeout=3.0,
+                    )
+                elif op == "transfer":
+                    await transfer_random_leadership(cluster, rng)
+                elif op == "describe" and aux:
+                    await asyncio.wait_for(
+                        client.describe_configs(rng.choice(aux)), timeout=3.0
+                    )
+            except (KafkaClientError, asyncio.TimeoutError, OSError):
+                # clean failure under faults is fine; crashes are not
+                counts["errors"] = counts.get("errors", 0) + 1
+                with contextlib.suppress(Exception):
+                    await client.close()
+                client = KafkaClient(cluster.addresses())
+            await asyncio.sleep(rng.uniform(0.05, 0.2))
+    finally:
+        with contextlib.suppress(Exception):
+            await client.close()
+    return counts
+
+
 async def run_chaos(
     tmp_path,
     seed: int,
@@ -192,6 +291,7 @@ async def run_chaos(
     partitions: int = 2,
     faults=("partition", "crash", "transfer"),
     tiered: bool = False,
+    admin_ops: bool = False,
 ) -> dict:
     """`tiered=True` runs the same fault schedule against a
     remote.write topic with aggressive segment roll + retention, with
@@ -247,6 +347,12 @@ async def run_chaos(
             housekeeper = asyncio.ensure_future(_housekeep())
         producer = SeqProducer(cluster, "chaos", partitions)
         ptask = asyncio.ensure_future(producer.run())
+        fuzz_stop = [False]
+        fuzz_task = None
+        if admin_ops:
+            fuzz_task = asyncio.ensure_future(
+                admin_ops_fuzzer(cluster, random.Random(seed ^ 0x5EED), fuzz_stop)
+            )
 
         deadline = asyncio.get_event_loop().time() + duration_s
         down: int | None = None
@@ -274,16 +380,7 @@ async def run_chaos(
                 events.append(("crash", victim))
                 down = victim
             elif action == "transfer":
-                for b in cluster.brokers.values():
-                    for p in b.partition_manager.partitions().values():
-                        if p.is_leader and p.ntp.topic == "chaos":
-                            peers = p.consensus.peers()
-                            if peers:
-                                with contextlib.suppress(Exception):
-                                    await p.consensus.transfer_leadership(
-                                        rng.choice(peers)
-                                    )
-                            break
+                await transfer_random_leadership(cluster, rng, topic="chaos")
                 events.append(("transfer", -1))
 
         # heal everything, let the cluster settle, then validate
@@ -295,11 +392,20 @@ async def run_chaos(
         cluster.heal_network()
         await asyncio.sleep(1.0)
         producer.stop()
+        fuzz_stop[0] = True
         with contextlib.suppress(Exception):
             await asyncio.wait_for(ptask, timeout=5.0)
+        if fuzz_task is not None:
+            # only a hang is tolerable here: a fuzzer crash means the
+            # admin sweep silently didn't run — surface it
+            admin_counts = {}
+            with contextlib.suppress(asyncio.TimeoutError):
+                admin_counts = await asyncio.wait_for(fuzz_task, timeout=8.0)
         await asyncio.sleep(0.5)
         stats = await validate(cluster, "chaos", partitions, producer)
         stats["events"] = events
+        if fuzz_task is not None:
+            stats["admin_ops"] = admin_counts
         if tiered:
             if housekeeper is not None:
                 housekeeper.cancel()

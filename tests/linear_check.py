@@ -1,0 +1,160 @@
+"""Linearizability checking for concurrent produce/fetch histories.
+
+The chaos validator (chaos_harness.validate) checks the END state:
+acked records present, ordered, below the watermark. This module
+checks the HISTORY — invoke/complete timestamps of concurrent
+operations against the partition-log specification:
+
+  L1  acked offsets are unique per partition, and the record observed
+      at an offset is identical across every fetch (no mutation).
+  L2  real-time order: if produce A completed (acked) before produce
+      B was invoked on the same partition, then offset(A) < offset(B).
+  L3  committed visibility: a fetch invoked after an ack completed,
+      whose returned range reaches that offset, must contain it — a
+      hole is committed-data loss observed live, not just at the end.
+  L4  no fabrication: every fetched record carries the producer's
+      payload format for its sequence number.
+
+This is the offline analog of the reference's consistency-testing
+stack (src/consistency-testing/{gobekli,iofaults}): timestamps come
+from one process clock, so real-time precedence is exact.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ProduceOp:
+    pid: int
+    seq: int
+    t_invoke: float
+    t_ack: float | None = None  # None = no ack (no claims made)
+    offset: int | None = None
+
+
+@dataclass
+class FetchOp:
+    pid: int
+    from_offset: int
+    t_invoke: float
+    t_return: float
+    records: list[tuple[int, bytes, bytes]]  # (offset, key, value)
+
+
+@dataclass
+class LinearHistory:
+    produces: list[ProduceOp] = field(default_factory=list)
+    fetches: list[FetchOp] = field(default_factory=list)
+
+    def begin_produce(self, pid: int, seq: int) -> ProduceOp:
+        op = ProduceOp(pid=pid, seq=seq, t_invoke=time.monotonic())
+        self.produces.append(op)
+        return op
+
+    def ack(self, op: ProduceOp, offset: int) -> None:
+        op.t_ack = time.monotonic()
+        op.offset = offset
+
+    def record_fetch(
+        self,
+        pid: int,
+        from_offset: int,
+        t_invoke: float,
+        records: list[tuple[int, bytes, bytes]],
+    ) -> None:
+        self.fetches.append(
+            FetchOp(
+                pid=pid,
+                from_offset=from_offset,
+                t_invoke=t_invoke,
+                t_return=time.monotonic(),
+                records=records,
+            )
+        )
+
+
+def check(history: LinearHistory) -> dict:
+    """Raises AssertionError on the first violation; returns stats."""
+    acked = [p for p in history.produces if p.t_ack is not None]
+    by_pid: dict[int, list[ProduceOp]] = {}
+    for p in acked:
+        by_pid.setdefault(p.pid, []).append(p)
+
+    # L1a: unique offsets per partition
+    for pid, ops in by_pid.items():
+        offs = [p.offset for p in ops]
+        assert len(offs) == len(set(offs)), (
+            f"L1: duplicate acked offsets on p{pid}"
+        )
+
+    # L1b: every observation of an offset sees the same bytes
+    seen: dict[tuple[int, int], tuple[bytes, bytes]] = {}
+    for f in history.fetches:
+        for off, k, v in f.records:
+            prev = seen.get((f.pid, off))
+            if prev is None:
+                seen[(f.pid, off)] = (k, v)
+            else:
+                assert prev == (k, v), (
+                    f"L1: p{f.pid}@{off} mutated between fetches: "
+                    f"{prev!r} != {(k, v)!r}"
+                )
+
+    # L1c: acked record content matches what fetches observed there
+    for p in acked:
+        obs = seen.get((p.pid, p.offset))
+        if obs is not None:
+            assert obs == (b"seq-%d" % p.seq, b"payload-%d" % p.seq), (
+                f"L1: p{p.pid}@{p.offset} acked seq {p.seq} but fetches "
+                f"observed {obs!r}"
+            )
+
+    # L2: real-time produce order per partition
+    for pid, ops in by_pid.items():
+        for a in ops:
+            for b in ops:
+                if a is b:
+                    continue
+                if a.t_ack < b.t_invoke:
+                    assert a.offset < b.offset, (
+                        f"L2: p{pid}: produce seq {a.seq}@{a.offset} acked "
+                        f"before seq {b.seq}@{b.offset} was invoked, but "
+                        f"offsets are not increasing"
+                    )
+
+    # L3: committed visibility (no holes below a fetch's returned max)
+    violations = 0
+    for f in history.fetches:
+        if not f.records:
+            continue
+        max_off = max(off for off, _k, _v in f.records)
+        offs = {off for off, _k, _v in f.records}
+        for p in by_pid.get(f.pid, []):
+            if (
+                p.t_ack < f.t_invoke
+                and f.from_offset <= p.offset <= max_off
+            ):
+                assert p.offset in offs, (
+                    f"L3: p{f.pid}: fetch from {f.from_offset} returned up "
+                    f"to {max_off} but skipped acked offset {p.offset} "
+                    f"(seq {p.seq}) — committed data hole observed live"
+                )
+
+    # L4: fetched records are well-formed producer payloads
+    for (pid, off), (k, v) in seen.items():
+        assert k.startswith(b"seq-") and v.startswith(b"payload-"), (
+            f"L4: p{pid}@{off} fabricated record {k!r}/{v!r}"
+        )
+        assert k[4:] == v[8:], (
+            f"L4: p{pid}@{off} key/value sequence mismatch {k!r}/{v!r}"
+        )
+
+    return {
+        "acked": len(acked),
+        "attempts": len(history.produces),
+        "fetches": len(history.fetches),
+        "observed": len(seen),
+    }

@@ -1,0 +1,89 @@
+"""Long-running chaos soak: randomized seeds until a wall-clock budget.
+
+The CI-able 20-seed sweep lives in tests/test_chaos.py; this script is
+the unbounded version (ref: rptest/services/admin_ops_fuzzer.py run
+inside long-running availability suites). Each iteration boots a fresh
+3-broker cluster, runs faults + admin-ops fuzzing + replicated load
+for a few seconds, validates every acked record, and moves on. Any
+failure prints the SEED so the run reproduces exactly.
+
+Usage:
+    python tools/chaos_soak.py --minutes 30 [--tiered] [--duration 4]
+"""
+
+import argparse
+import asyncio
+import os
+import random
+import sys
+import tempfile
+import time
+import traceback
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+    ),
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=30.0)
+    ap.add_argument("--duration", type=float, default=4.0,
+                    help="fault window per iteration (s)")
+    ap.add_argument("--tiered", action="store_true")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="reproduce one failing iteration and exit")
+    args = ap.parse_args()
+
+    from chaos_harness import run_chaos
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+
+    def one(seed: int) -> dict:
+        with tempfile.TemporaryDirectory(prefix="soak_", dir=shm) as d:
+            return asyncio.run(
+                run_chaos(
+                    Path(d),
+                    seed=seed,
+                    duration_s=args.duration,
+                    faults=("partition", "crash", "transfer"),
+                    tiered=args.tiered,
+                    admin_ops=True,
+                )
+            )
+
+    if args.seed is not None:
+        stats = one(args.seed)
+        print(f"seed {args.seed}: OK {stats}")
+        return 0
+
+    deadline = time.monotonic() + args.minutes * 60.0
+    rng = random.Random()
+    n = fails = 0
+    while time.monotonic() < deadline:
+        seed = rng.randrange(1 << 31)
+        n += 1
+        t0 = time.monotonic()
+        try:
+            stats = one(seed)
+            print(
+                f"[{n:>4}] seed={seed:<12} ok  acked={stats['acked']:<5} "
+                f"admin={sum(stats.get('admin_ops', {}).values())} "
+                f"({time.monotonic()-t0:.1f}s)",
+                flush=True,
+            )
+        except Exception:
+            fails += 1
+            print(f"[{n:>4}] seed={seed} FAILED — repro with --seed {seed}")
+            traceback.print_exc()
+    print(f"soak done: {n} iterations, {fails} failures")
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
