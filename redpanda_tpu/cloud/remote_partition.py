@@ -121,13 +121,18 @@ class RemoteReader:
     def find_segment(
         self, manifest: PartitionManifest, kafka_offset: int
     ) -> Optional[SegmentMeta]:
-        if not manifest.segments:
+        segs = manifest.segments
+        if not segs:
             return None
-        starts = [self.kafka_start(s) for s in manifest.segments]
+        find_k = getattr(segs, "find_kafka", None)
+        if find_k is not None:
+            hit = find_k(kafka_offset)
+            return hit[1] if hit is not None else None
+        starts = [self.kafka_start(s) for s in segs]
         i = bisect.bisect_right(starts, kafka_offset) - 1
         if i < 0:
             return None
-        return manifest.segments[i]
+        return segs[i]
 
     # -- sparse index (remote_segment_index.{h,cc}) -------------------
     def _index_seek(self, key: str, kafka_offset: int) -> tuple[int, int] | None:
@@ -246,11 +251,18 @@ class RemoteReader:
                 pos += header.size_bytes
             if hydration_failed:
                 break
-            # next segment in offset order
-            idx = manifest.segments.index(meta)
+            # next segment in offset order (O(log) on the columnar
+            # store; list fallback keeps .index)
+            segs = manifest.segments
+            iob = getattr(segs, "index_of_base", None)
+            idx = (
+                iob(int(meta.base_offset))
+                if iob is not None
+                else segs.index(meta)
+            )
             meta = (
-                manifest.segments[idx + 1]
-                if idx + 1 < len(manifest.segments)
+                segs[idx + 1]
+                if idx is not None and idx + 1 < len(segs)
                 else None
             )
         return out

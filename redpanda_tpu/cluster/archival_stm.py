@@ -65,7 +65,12 @@ class ArchivalState:
     __slots__ = ("segments", "revision", "pending")
 
     def __init__(self) -> None:
-        self.segments: list[SegmentMeta] = []
+        from ..cloud.cstore import SegmentMetaStore
+
+        # columnar (delta-for) store: ~13 B/segment vs ~350 for a list
+        # of envelopes — 100k-segment manifests stay in memory
+        # (ref segment_meta_cstore.h)
+        self.segments: SegmentMetaStore = SegmentMetaStore()
         self.revision = 0
         # (command batch offset, key, value) staged at append time
         self.pending: list[tuple[int, bytes | None, bytes | None]] = []
@@ -97,7 +102,9 @@ class ArchivalState:
             elif key == RESET and value:
                 m = PartitionManifest.decode(value)
                 if m.archived_upto > self.archived_upto:
-                    self.segments = list(m.segments)
+                    from ..cloud.cstore import SegmentMetaStore
+
+                    self.segments = SegmentMetaStore(m.segments)
                     self.revision = int(m.revision)
             elif key == REPLACE and value:
                 merged = SegmentMeta.decode(value)
@@ -131,11 +138,13 @@ class ArchivalState:
             elif key == TRUNCATE and value:
                 new_start = int.from_bytes(value, "little", signed=True)
                 before = len(self.segments)
-                self.segments = [
+                from ..cloud.cstore import SegmentMetaStore
+
+                self.segments = SegmentMetaStore(
                     s
                     for s in self.segments
                     if int(s.last_offset) >= new_start
-                ]
+                )
                 if len(self.segments) != before:
                     self.revision += 1
         except Exception:

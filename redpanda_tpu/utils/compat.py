@@ -122,6 +122,11 @@ def gen_instance(cls: type, rng: random.Random, depth: int = 0):
     return cls(**kwargs)
 
 
+def _as_envelope(v: Any) -> Any:
+    to_meta = getattr(v, "to_meta", None)
+    return to_meta() if to_meta is not None else v
+
+
 def render(value: Any) -> Any:
     """JSON-able rendering of a decoded value (reference: compat's
     per-type JSON writers). Byte-level re-encoding alone cannot catch
@@ -141,6 +146,12 @@ def render(value: Any) -> Any:
         }
     if isinstance(value, (list, tuple)):
         return [render(v) for v in value]
+    from collections.abc import Sequence
+
+    if isinstance(value, Sequence) and not isinstance(value, (str, bytes)):
+        # columnar stores (cloud.cstore) render like the lists they
+        # replace; views render as their envelope form
+        return [render(_as_envelope(v)) for v in value]
     if hasattr(value, "__array__"):  # numpy: ndvector fields / scalars
         import numpy as np
 
