@@ -99,12 +99,14 @@ def _unpack_varint(buf: memoryview, n: int) -> tuple[np.ndarray, int]:
 class _Chunk:
     """Immutable packed rows: per-column delta+zigzag varint streams."""
 
-    __slots__ = ("n", "first_base", "blob", "_starts")
+    __slots__ = ("n", "first_base", "kfirst", "blob", "_starts")
 
     def __init__(self, cols: np.ndarray):
         # cols: int64[_NF, n]
         self.n = cols.shape[1]
         self.first_base = int(cols[0, 0])
+        # kafka = raft - delta (delta_offset is field index 6)
+        self.kfirst = int(cols[0, 0] - cols[6, 0])
         parts = []
         starts = [0]
         pos = 0
@@ -281,44 +283,143 @@ class SegmentMetaStore(MutableSequence):
 
     def _freeze_tail(self) -> None:
         cols = self._tail[:, : self._tail_n].copy()
+        c = _Chunk(cols)
         self._row_starts.append(self._frozen_n)
         self._frozen_n += self._tail_n
-        self._chunks.append(_Chunk(cols))
-        self._chunk_firsts.append(int(cols[0, 0]))
-        # kafka = raft - delta (delta_offset is field index 6)
-        self._chunk_kfirsts.append(int(cols[0, 0] - cols[6, 0]))
+        self._chunks.append(c)
+        self._chunk_firsts.append(c.first_base)
+        self._chunk_kfirsts.append(c.kfirst)
         self._tail_n = 0
 
     def _rebuild(self, metas: list) -> None:
         self.__init__(metas)
 
-    def __setitem__(self, i, value) -> None:
-        metas = [m.to_meta() if isinstance(m, SegmentView) else m
-                 for m in self]
-        if isinstance(i, slice):
-            metas[i] = [
-                v.to_meta() if isinstance(v, SegmentView) else v
-                for v in value
-            ]
+    def _reindex(self) -> None:
+        self._row_starts = []
+        self._chunk_firsts = []
+        self._chunk_kfirsts = []
+        pos = 0
+        for c in self._chunks:
+            self._row_starts.append(pos)
+            self._chunk_firsts.append(c.first_base)
+            self._chunk_kfirsts.append(c.kfirst)
+            pos += c.n
+        self._frozen_n = pos
+        self._cache.clear()
+
+    def _splice(self, start: int, stop: int, new_metas: list) -> None:
+        """Replace rows [start, stop) with new_metas, rebuilding only
+        the chunks that overlap the range (the archival REPLACE path
+        applies one mutation per merge command — a whole-store rebuild
+        per command is O(n^2) over a merge storm; the reference cstore
+        splices in place, delta_for.h:213)."""
+        import bisect as _b
+
+        n = len(self)
+        nch = len(self._chunks)
+        # first/last structure touched; index nch denotes the tail
+        if start >= self._frozen_n:
+            ci0 = nch
         else:
-            metas[i] = (
-                value.to_meta() if isinstance(value, SegmentView) else value
-            )
-        self._rebuild(metas)
+            ci0 = _b.bisect_right(self._row_starts, start) - 1
+        if stop <= start:
+            ci1 = ci0
+        elif stop > self._frozen_n:
+            ci1 = nch
+        else:
+            ci1 = _b.bisect_right(self._row_starts, stop - 1) - 1
+        region_start = (
+            self._frozen_n if ci0 == nch else self._row_starts[ci0]
+        )
+        region_end = (
+            n if ci1 == nch else self._row_starts[ci1] + self._chunks[ci1].n
+        )
+        metas = (
+            [self._row(j).to_meta() for j in range(region_start, start)]
+            + list(new_metas)
+            + [self._row(j).to_meta() for j in range(stop, region_end)]
+        )
+        delta = len(new_metas) - (stop - start)
+        # re-key sparse names: region names come back from the metas
+        names: dict[int, str] = {}
+        for k, v in self._names.items():
+            if k < region_start:
+                names[k] = v
+            elif k >= region_end:
+                names[k + delta] = v
+        m_arr = np.empty((_NF, len(metas)), np.int64)
+        for idx, m in enumerate(metas):
+            for f_idx, f in enumerate(_FIELDS):
+                m_arr[f_idx, idx] = int(getattr(m, f))
+            hint = getattr(m, "name_hint", "")
+            if hint:
+                names[region_start + idx] = hint
+        if ci1 == nch:
+            # tail in region: full groups freeze, remainder is the tail
+            nfreeze = (len(metas) // CHUNK) * CHUNK
+        else:
+            # tail untouched: all region rows freeze (a mid-store
+            # partial chunk is fine — decode/row math is per-chunk n)
+            nfreeze = len(metas)
+        chunks = self._chunks[:ci0]
+        for s in range(0, nfreeze, CHUNK):
+            chunks.append(_Chunk(m_arr[:, s : min(s + CHUNK, nfreeze)]))
+        if ci1 < nch:
+            chunks.extend(self._chunks[ci1 + 1 :])
+        self._chunks = chunks
+        self._names = names
+        self._reindex()
+        if ci1 == nch:
+            self._tail = np.empty((_NF, CHUNK), np.int64)
+            self._tail_n = len(metas) - nfreeze
+            self._tail[:, : self._tail_n] = m_arr[:, nfreeze:]
+        # else: tail buffer unchanged
+
+    @staticmethod
+    def _as_meta(v):
+        return v.to_meta() if isinstance(v, SegmentView) else v
+
+    def __setitem__(self, i, value) -> None:
+        if isinstance(i, slice):
+            start, stop, step = i.indices(len(self))
+            if step == 1:
+                self._splice(
+                    start, stop, [self._as_meta(v) for v in value]
+                )
+                return
+            # extended slice: rare, full rebuild is fine
+            metas = [m.to_meta() for m in self]
+            metas[i] = [self._as_meta(v) for v in value]
+            self._rebuild(metas)
+            return
+        if i < 0:
+            i += len(self)
+        if not 0 <= i < len(self):
+            raise IndexError(i)
+        self._splice(i, i + 1, [self._as_meta(value)])
 
     def __delitem__(self, i) -> None:
-        metas = [m.to_meta() if isinstance(m, SegmentView) else m
-                 for m in self]
-        del metas[i]
-        self._rebuild(metas)
+        if isinstance(i, slice):
+            start, stop, step = i.indices(len(self))
+            if step == 1:
+                self._splice(start, stop, [])
+                return
+            metas = [m.to_meta() for m in self]
+            del metas[i]
+            self._rebuild(metas)
+            return
+        if i < 0:
+            i += len(self)
+        if not 0 <= i < len(self):
+            raise IndexError(i)
+        self._splice(i, i + 1, [])
 
     def insert(self, i, value) -> None:
-        metas = [m.to_meta() if isinstance(m, SegmentView) else m
-                 for m in self]
-        metas.insert(
-            i, value.to_meta() if isinstance(value, SegmentView) else value
-        )
-        self._rebuild(metas)
+        n = len(self)
+        if i < 0:
+            i = max(0, i + n)
+        i = min(i, n)
+        self._splice(i, i, [self._as_meta(value)])
 
     def clear(self) -> None:
         self._rebuild([])

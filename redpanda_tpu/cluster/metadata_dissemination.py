@@ -76,8 +76,16 @@ class MetadataDissemination:
         self._hints: dict[NTP, tuple[int, int]] = {}
         self._task: asyncio.Task | None = None
         self._closed = False
-        # delta gossip state: ntp → (term, leader) last pushed
-        self._sent: dict[NTP, tuple[int, int]] = {}
+        # delta gossip state, PER AUDIENCE (peer node id, plus self):
+        # audience → ntp → (term, leader) last delivered. Per-peer so a
+        # restarted peer (which lost its in-memory hints) is re-pushed
+        # everything as soon as its outage is observed, and one down
+        # peer doesn't force re-pushing deltas to every healthy peer.
+        self._sent_by_peer: dict[int, dict[NTP, tuple[int, int]]] = {}
+        # peer → connection generation at last delivery: a bumped
+        # generation means the link was re-established (peer possibly
+        # restarted with empty hints) → wipe sent-state, full re-push
+        self._peer_gen: dict[int, int] = {}
         self._tick_no = 0
 
     async def start(self) -> None:
@@ -118,69 +126,85 @@ class MetadataDissemination:
     async def _tick(self) -> None:
         self._tick_no += 1
         full = self._tick_no % self.FULL_EVERY == 1
-        entries = []
-        sent = self._sent
         me = self.broker.node_id
-        led: set[NTP] = set()
+        # (term, leader=me) of every partition this broker leads now
+        led: dict[NTP, int] = {}
         for p in self.broker.partition_manager.partitions().values():
-            if not p.is_leader:
-                continue
-            term = p.consensus.term
-            led.add(p.ntp)
-            if not full and sent.get(p.ntp) == (term, me):
-                continue  # unchanged since last gossip
-            entries.append(
-                _LeaderEntry(
-                    ns=p.ntp.ns,
-                    topic=p.ntp.topic,
-                    partition=p.ntp.partition,
-                    term=term,
-                    leader=me,
-                )
-            )
-        # prune: deposed/removed partitions must not pin _sent entries
-        # (unbounded growth; a deleted-then-recreated topic landing on
-        # the same (term, leader) would otherwise be suppressed)
-        if len(sent) > len(led):
+            if p.is_leader:
+                led[p.ntp] = p.consensus.term
+        members = set(self.broker.controller.members)
+        # drop per-peer state for departed peers
+        for gone in [a for a in self._sent_by_peer if a not in members]:
+            del self._sent_by_peer[gone]
+            self._peer_gen.pop(gone, None)
+
+        def delta_for(sent: dict[NTP, tuple[int, int]]) -> list[NTP]:
+            # prune unconditionally: deposed/removed partitions must
+            # not pin entries (unbounded growth; a deleted-then-
+            # recreated topic landing on the same (term, leader) would
+            # otherwise be suppressed until the anti-entropy pass)
             for ntp in [n for n in sent if n not in led]:
                 del sent[ntp]
-        if not entries:
-            return
+            return [
+                ntp
+                for ntp, term in led.items()
+                if full or sent.get(ntp) != (term, me)
+            ]
+
         # a broker is its own gossip audience too: keeps the RAW hints
         # table consistent on the new leader itself. Client-visible
         # metadata is already correct without this (leader_of prefers
         # the hosted partition's consensus view) — this is hygiene for
         # direct `leaders` readers and debugging, not a client fix.
-        for e in entries:
-            self.apply_hint(
-                NTP(e.ns, e.topic, int(e.partition)),
-                int(e.term),
-                int(e.leader),
-            )
-        msg = _LeaderUpdate(
-            from_node=self.broker.node_id, entries=entries
-        ).encode()
-        peers = [
-            m for m in self.broker.controller.members if m != self.broker.node_id
-        ]
+        self_sent = self._sent_by_peer.setdefault(me, {})
+        for ntp in delta_for(self_sent):
+            self.apply_hint(ntp, led[ntp], me)
+            self_sent[ntp] = (led[ntp], me)
 
-        async def push(peer: int) -> bool:
+        async def push(peer: int) -> None:
+            sent = self._sent_by_peer.setdefault(peer, {})
+            gen_fn = getattr(self.broker._conn_cache, "generation", None)
+            gen = gen_fn(peer) if gen_fn is not None else 0
+            if gen != self._peer_gen.get(peer, 0):
+                # link re-established since our last delivery: the peer
+                # may have restarted and lost its hints — re-push all
+                sent.clear()
+            ntps = delta_for(sent)
+            if not ntps:
+                return
+            msg = _LeaderUpdate(
+                from_node=me,
+                entries=[
+                    _LeaderEntry(
+                        ns=ntp.ns,
+                        topic=ntp.topic,
+                        partition=ntp.partition,
+                        term=led[ntp],
+                        leader=me,
+                    )
+                    for ntp in ntps
+                ],
+            ).encode()
             try:
                 await self.broker._conn_cache.call(
                     peer, UPDATE_LEADERSHIP, msg, 1.0
                 )
-                return True
             except Exception:
-                return False  # peer down: delta retried next tick
+                # peer down or restarting: wipe its sent-state so the
+                # whole leadership set is re-pushed once it's back —
+                # a restarted peer lost its in-memory hints and must
+                # not wait for the FULL_EVERY anti-entropy pass
+                sent.clear()
+                return
+            for ntp in ntps:
+                sent[ntp] = (led[ntp], me)
+            # record the PRE-call generation: if the call itself
+            # reconnected (peer restarted, lost its hints), only this
+            # delta was delivered — the next tick must see the bumped
+            # generation and full-re-push. Cost when the reconnect was
+            # benign: one redundant full push.
+            self._peer_gen[peer] = gen
 
-        ok = True
+        peers = [m for m in members if m != me]
         if peers:
-            ok = all(await asyncio.gather(*(push(p) for p in peers)))
-        # mark entries delivered only when every peer acked: a failed
-        # push re-sends the delta next tick instead of waiting for the
-        # FULL_EVERY anti-entropy pass
-        if ok:
-            for e in entries:
-                sent[NTP(e.ns, e.topic, int(e.partition))] = (
-                    int(e.term), me,
-                )
+            await asyncio.gather(*(push(p) for p in peers))

@@ -79,6 +79,21 @@ class RaftService(Service):
         self._reply_cache.pop(sender, None)
         return cons, rows
 
+    def _arm_same_coverage(self, sender: int, arrays, rows) -> None:
+        """Liveness coverage: node-level SAME stamps from `sender`
+        credit exactly `rows`, nothing else. On re-arm, clear ONLY the
+        previous rows still attributed to this sender — after a
+        leadership migration another sender may have taken over some of
+        them, and wiping its coverage would stall their last_hb refresh
+        until its next forced-full frame (up to FORCE_FULL_EVERY ticks,
+        longer than the election timeout — a spurious election)."""
+        prev = self._same_rows.get(sender)
+        if prev is not None:
+            mine = prev[arrays.same_cover_node[prev] == sender]
+            arrays.same_cover_node[mine] = -1
+        arrays.same_cover_node[rows] = sender
+        self._same_rows[sender] = rows
+
     def _prev_terms_cached(self, sender: int, arrays, rows, prevs):
         from .shard_state import term_at_batch_cached
 
@@ -188,13 +203,7 @@ class RaftService(Service):
                         n,
                         zlib.crc32(payload[: len(payload) - 8 * n]),
                     )
-                    # liveness coverage: node-level SAME stamps credit
-                    # exactly these rows, nothing else
-                    prev = self._same_rows.get(sender)
-                    if prev is not None:
-                        arrays.same_cover_node[prev] = -1
-                    arrays.same_cover_node[c_lr] = sender
-                    self._same_rows[sender] = c_lr
+                    self._arm_same_coverage(sender, arrays, c_lr)
                 seq_bytes = np.ascontiguousarray(req.seqs, "<q").tobytes()
                 return c_prefix + seq_bytes + c_suffix
         dirty_out = np.where(avail, arrays.match_index[r, SELF_SLOT], -1)

@@ -30,6 +30,13 @@ class ConnectionCache:
     def remove(self, node_id: int) -> None:
         self._conns.pop(node_id, None)
 
+    def generation(self, node_id: int) -> int:
+        """Reconnect count for the node's link (0 = never connected).
+        A change between observations means the link was re-established
+        — the peer may have restarted and lost in-memory state."""
+        conn = self._conns.get(node_id)
+        return conn.generation if conn is not None else 0
+
     async def call(
         self,
         node_id: int,

@@ -151,6 +151,11 @@ class ReconnectTransport:
         self._fails = 0
         self._next_attempt = 0.0
         self._lock = asyncio.Lock()
+        # bumps on every successful (re)connect: consumers that push
+        # deltas over this link (metadata dissemination) watch it to
+        # detect a peer restart — a new connection means the peer may
+        # have lost in-memory state and needs a full re-push
+        self.generation = 0
 
     def is_connected(self) -> bool:
         return self._transport is not None and self._transport.is_connected()
@@ -175,6 +180,7 @@ class ReconnectTransport:
                 raise ConnectionError(f"connect failed: {e}")
             self._fails = 0
             self._transport = t
+            self.generation += 1
             return t
 
     async def call(

@@ -495,6 +495,42 @@ def test_offset_translator_prefix_truncate_stability():
         assert ot.from_kafka(before[raft]) == raft
 
 
+def test_same_rearm_preserves_other_senders_coverage():
+    """Regression (r4 advisor, medium): when sender L re-arms its SAME
+    coverage, rows that another sender C has since taken over must NOT
+    be cleared — otherwise C's coverage of a migrated row only
+    refreshes on its forced-full cadence (FORCE_FULL_EVERY ticks,
+    longer than the election timeout → spurious election)."""
+    import numpy as np
+
+    from redpanda_tpu.raft.service import RaftService
+    from redpanda_tpu.raft.shard_state import ShardGroupArrays
+
+    arrays = ShardGroupArrays(capacity=4)
+    for _ in range(4):
+        arrays.alloc_row()
+    svc = RaftService.__new__(RaftService)
+    svc._same_rows = {}
+
+    L, C = 7, 9
+    # L arms covering rows {0, 1}
+    svc._arm_same_coverage(L, arrays, np.array([0, 1], np.int64))
+    assert list(arrays.same_cover_node[:2]) == [L, L]
+    # leadership of row 0 migrates: C arms covering {0, 2}
+    svc._arm_same_coverage(C, arrays, np.array([0, 2], np.int64))
+    assert int(arrays.same_cover_node[0]) == C
+    # L re-arms covering only {1}: must not wipe C's coverage of row 0
+    svc._arm_same_coverage(L, arrays, np.array([1], np.int64))
+    assert int(arrays.same_cover_node[0]) == C, (
+        "re-arm wiped another sender's coverage"
+    )
+    assert int(arrays.same_cover_node[1]) == L
+    assert int(arrays.same_cover_node[2]) == C
+    # and rows L abandoned that are still attributed to L are cleared
+    svc._arm_same_coverage(L, arrays, np.array([3], np.int64))
+    assert int(arrays.same_cover_node[1]) == -1
+
+
 def test_quiesced_same_heartbeat_path(tmp_path):
     """The O(1) HEARTBEAT_SAME path: arms after a byte-stable full
     exchange, keeps followers' liveness fresh via node-level stamps,
