@@ -65,7 +65,10 @@ class BrokerConfig:
     # 0.3 s default was tuned for fast tests (which all pin their own
     # value) but storms under load when brokers share one starved core.
     election_timeout_s: float = 1.5
-    heartbeat_interval_s: float = 0.05
+    # reference default: raft_heartbeat_interval_ms=150
+    # (config/configuration.cc:224) — at 1k+ groups the batched sweep
+    # is ~0.6 ms/tick, so tick rate is a direct CPU tax
+    heartbeat_interval_s: float = 0.15
     # liveness ping cadence (node_status_backend); <= 0 disables
     node_status_interval_s: float = 0.5
     # register this node's endpoints with the cluster at startup (and

@@ -87,6 +87,15 @@ class MetadataDissemination:
         # restarted with empty hints) → wipe sent-state, full re-push
         self._peer_gen: dict[int, int] = {}
         self._tick_no = 0
+        # steady-state early-out: (registry_epoch, n_partitions) →
+        # (ntps, rows) map into the raft SoA, plus last tick's
+        # is_leader/term lane snapshots. When the lanes are unchanged
+        # and everything was delivered, the tick is two vector
+        # compares instead of a 1k-partition Python scan (~670 µs →
+        # ~10 µs measured at 1024 partitions).
+        self._scan_cache: tuple | None = None
+        self._lanes_prev: tuple | None = None
+        self._all_delivered = False
 
     async def start(self) -> None:
         self._task = asyncio.ensure_future(self._loop())
@@ -127,11 +136,58 @@ class MetadataDissemination:
         self._tick_no += 1
         full = self._tick_no % self.FULL_EVERY == 1
         me = self.broker.node_id
-        # (term, leader=me) of every partition this broker leads now
-        led: dict[NTP, int] = {}
-        for p in self.broker.partition_manager.partitions().values():
-            if p.is_leader:
-                led[p.ntp] = p.consensus.term
+        parts = self.broker.partition_manager.partitions()
+        gm = getattr(self.broker, "group_manager", None)
+        led: dict[NTP, int]
+        if gm is None:
+            # unit fixtures without a raft SoA: plain scan
+            led = {
+                p.ntp: p.consensus.term
+                for p in parts.values()
+                if p.is_leader
+            }
+        else:
+            # vectorized leadership scan over the raft SoA lanes
+            import numpy as np
+
+            key = (gm.registry_epoch, len(parts))
+            cache = self._scan_cache
+            if cache is None or cache[0] != key:
+                plist = list(parts.values())
+                rows = np.fromiter(
+                    (p.consensus.row for p in plist), np.int64, len(plist)
+                )
+                self._scan_cache = cache = (key, plist, rows)
+                self._lanes_prev = None
+            _, plist, rows = cache
+            arrays = gm.arrays
+            lv = arrays.is_leader[rows]
+            tv = arrays.term[rows]
+            prev = self._lanes_prev
+            if (
+                not full
+                and self._all_delivered
+                and prev is not None
+                and np.array_equal(lv, prev[0])
+                and np.array_equal(tv, prev[1])
+                # membership is part of the steady-state key: a newly
+                # joined peer has no connection yet (generation 0 ==
+                # the _peer_gen default), and only push() would dial
+                # it — without this it would starve until anti-entropy
+                and set(self.broker.controller.members)
+                == set(self._sent_by_peer) | {me}
+                and all(
+                    self._peer_gen.get(p, 0) == self._gen_of(p)
+                    for p in self.broker.controller.members
+                    if p != me
+                )
+            ):
+                return  # steady: nothing changed, everything delivered
+            self._lanes_prev = (lv, tv)
+            led = {}
+            for i in np.flatnonzero(lv):
+                p = plist[int(i)]
+                led[p.ntp] = int(tv[i])
         members = set(self.broker.controller.members)
         # drop per-peer state for departed peers
         for gone in [a for a in self._sent_by_peer if a not in members]:
@@ -161,17 +217,16 @@ class MetadataDissemination:
             self.apply_hint(ntp, led[ntp], me)
             self_sent[ntp] = (led[ntp], me)
 
-        async def push(peer: int) -> None:
+        async def push(peer: int) -> bool:
             sent = self._sent_by_peer.setdefault(peer, {})
-            gen_fn = getattr(self.broker._conn_cache, "generation", None)
-            gen = gen_fn(peer) if gen_fn is not None else 0
+            gen = self._gen_of(peer)
             if gen != self._peer_gen.get(peer, 0):
                 # link re-established since our last delivery: the peer
                 # may have restarted and lost its hints — re-push all
                 sent.clear()
             ntps = delta_for(sent)
             if not ntps:
-                return
+                return True
             msg = _LeaderUpdate(
                 from_node=me,
                 entries=[
@@ -195,7 +250,7 @@ class MetadataDissemination:
                 # a restarted peer lost its in-memory hints and must
                 # not wait for the FULL_EVERY anti-entropy pass
                 sent.clear()
-                return
+                return False
             for ntp in ntps:
                 sent[ntp] = (led[ntp], me)
             # record the PRE-call generation: if the call itself
@@ -204,7 +259,15 @@ class MetadataDissemination:
             # generation and full-re-push. Cost when the reconnect was
             # benign: one redundant full push.
             self._peer_gen[peer] = gen
+            return True
 
         peers = [m for m in members if m != me]
         if peers:
-            await asyncio.gather(*(push(p) for p in peers))
+            results = await asyncio.gather(*(push(p) for p in peers))
+            self._all_delivered = all(results)
+        else:
+            self._all_delivered = True
+
+    def _gen_of(self, peer: int) -> int:
+        gen_fn = getattr(self.broker._conn_cache, "generation", None)
+        return gen_fn(peer) if gen_fn is not None else 0

@@ -29,6 +29,7 @@ from .protocol import (
     RequestHeader,
     encode_request_header,
 )
+from .protocol import produce_fast
 
 _SIZE = struct.Struct(">i")
 
@@ -207,6 +208,27 @@ class BrokerConnection:
         throughput encode the (identical) body once so client-side
         encoding doesn't pollute the server number; normal callers use
         request()."""
+        rbody = await self.request_body(api, body, version)
+        if api.key == API_VERSIONS.key and version > 0:
+            # the broker may have replied with the v0 downgrade body
+            # (error 35 + api_keys, no throttle field), which fails to
+            # decode at the requested version — decode v0 first and
+            # only trust the requested-version decode when the reply
+            # is not a downgrade
+            try:
+                resp = api.decode_response(rbody, version)
+                if resp.error_code != int(ErrorCode.unsupported_version):
+                    return resp
+            except Exception:
+                pass
+            return api.decode_response(rbody, 0)
+        return api.decode_response(rbody, version)
+
+    async def request_body(self, api, body: bytes, version: int):
+        """Send a pre-encoded body; return the RAW response body
+        (correlation checked, response-header tags skipped) — callers
+        with a hand-rolled decoder (produce fast path) skip the
+        generic tree decode."""
         hdr = RequestHeader(api.key, version, next(self._corr), self._client_id)
         head = encode_request_header(hdr)
         if self._dead is not None:
@@ -245,21 +267,7 @@ class BrokerConnection:
 
         if response_header_version(api.key, version) >= 1:
             r.skip_tagged_fields()
-        body = payload[len(payload) - r.remaining :]
-        if api.key == API_VERSIONS.key and version > 0:
-            # the broker may have replied with the v0 downgrade body
-            # (error 35 + api_keys, no throttle field), which fails to
-            # decode at the requested version — decode v0 first and
-            # only trust the requested-version decode when the reply
-            # is not a downgrade
-            try:
-                resp = api.decode_response(body, version)
-                if resp.error_code != int(ErrorCode.unsupported_version):
-                    return resp
-            except Exception:
-                pass
-            return api.decode_response(body, 0)
-        return api.decode_response(body, version)
+        return payload[len(payload) - r.remaining :]
 
     async def close(self) -> None:
         if self._read_task is not None:
@@ -603,38 +611,55 @@ class KafkaClient:
                 topic, partition, refresh=retry.refresh
             )
             v = conn.pick_version(PRODUCE, 7)
-            req = Msg(
-                transactional_id=None,
-                acks=acks,
-                timeout_ms=timeout_ms,
-                topics=[
-                    Msg(
-                        name=topic,
-                        partitions=[Msg(index=partition, records=wire)],
-                    )
-                ],
+            flex = PRODUCE.flexible(v)
+            # hand-rolled single-topic/single-partition codec (byte-
+            # parity with the generic walker asserted by
+            # tests/test_produce_fast.py)
+            body = produce_fast.encode_request_single(
+                v, flex, None, acks, timeout_ms, topic, partition, wire
             )
+            if body is None:
+                body = PRODUCE.encode_request(
+                    Msg(
+                        transactional_id=None,
+                        acks=acks,
+                        timeout_ms=timeout_ms,
+                        topics=[
+                            Msg(
+                                name=topic,
+                                partitions=[
+                                    Msg(index=partition, records=wire)
+                                ],
+                            )
+                        ],
+                    ),
+                    v,
+                )
             if acks == 0:
                 # fire-and-forget: no response frame on the wire
                 hdr = RequestHeader(
                     PRODUCE.key, v, next(conn._corr), self._client_id
                 )
-                frame = encode_request_header(hdr) + PRODUCE.encode_request(
-                    req, v
-                )
+                frame = encode_request_header(hdr) + body
                 async with conn._lock:
                     conn._writer.write(_SIZE.pack(len(frame)) + frame)
                     await conn._writer.drain()
                 return -1
-            resp = await conn.request(PRODUCE, req, v)
-            pr = resp.responses[0].partition_responses[0]
-            if pr.error_code == int(ErrorCode.not_leader_for_partition):
+            rbody = await conn.request_body(PRODUCE, body, v)
+            fast = produce_fast.decode_response_single(rbody, v, flex)
+            if fast is not None:
+                error_code, base_offset = fast
+            else:
+                resp = PRODUCE.decode_response(rbody, v)
+                pr = resp.responses[0].partition_responses[0]
+                error_code, base_offset = pr.error_code, pr.base_offset
+            if error_code == int(ErrorCode.not_leader_for_partition):
                 continue
-            if pr.error_code != 0:
+            if error_code != 0:
                 raise KafkaClientError(
-                    pr.error_code, f"produce {topic}/{partition}"
+                    error_code, f"produce {topic}/{partition}"
                 )
-            return pr.base_offset
+            return base_offset
         raise KafkaClientError(
             int(ErrorCode.not_leader_for_partition), f"produce {topic}/{partition}"
         )

@@ -51,6 +51,7 @@ from .protocol import (
     decode_request_header,
     encode_response_header,
 )
+from .protocol import produce_fast
 from .protocol.headers import RequestHeader
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -432,9 +433,15 @@ class KafkaServer:
                 api.name, hdr.api_version, api.min_version, api.max_version,
             )
             raise _CloseConnection(b"")
-        req = api.decode_request(
-            frame[len(frame) - r.remaining :], hdr.api_version
-        )
+        body_mv = frame[len(frame) - r.remaining :]
+        if hdr.api_key == 0:  # PRODUCE: hand-rolled single-shape codec
+            req = produce_fast.decode_request(
+                body_mv, hdr.api_version, api.flexible(hdr.api_version)
+            )
+            if req is None:
+                req = api.decode_request(body_mv, hdr.api_version)
+        else:
+            req = api.decode_request(body_mv, hdr.api_version)
         if hdr.api_key == SASL_HANDSHAKE.key:
             resp = self.handle_sasl_handshake(ctx, hdr, req)
         elif hdr.api_key == SASL_AUTHENTICATE.key:
@@ -475,7 +482,7 @@ class KafkaServer:
                 head = encode_response_header(
                     hdr.api_key, hdr.api_version, hdr.correlation_id
                 )
-                return head + api.encode_response(body, hdr.api_version)
+                return head + self._encode_response(api, body, hdr.api_version)
 
             return finish()
         if resp is None:  # acks=0 produce: no response on the wire
@@ -483,7 +490,35 @@ class KafkaServer:
         head = encode_response_header(
             hdr.api_key, hdr.api_version, hdr.correlation_id
         )
-        return head + api.encode_response(resp, hdr.api_version)
+        return head + self._encode_response(api, resp, hdr.api_version)
+
+    @staticmethod
+    def _encode_response(api, msg, version: int) -> bytes:
+        if api.key == 0:  # PRODUCE: hand-rolled single-shape codec
+            try:
+                resps = msg["responses"]
+                if len(resps) == 1:
+                    prs = resps[0]["partition_responses"]
+                    pr = prs[0]
+                    if (
+                        len(prs) == 1
+                        and "record_errors" not in pr
+                        and msg.get("throttle_time_ms", 0) == 0
+                    ):
+                        fast = produce_fast.encode_response_single(
+                            version,
+                            api.flexible(version),
+                            resps[0]["name"],
+                            pr["index"],
+                            pr["error_code"],
+                            pr["base_offset"],
+                            log_start_offset=pr.get("log_start_offset", -1),
+                        )
+                        if fast is not None:
+                            return fast
+            except (KeyError, IndexError):
+                pass
+        return api.encode_response(msg, version)
 
     def _unsupported_version(self, hdr: RequestHeader) -> bytes:
         """ApiVersions contract: reply v0 + UNSUPPORTED_VERSION so the

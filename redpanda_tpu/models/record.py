@@ -267,7 +267,7 @@ class RecordBatch:
     surfaces as a distant recovery/fetch CRC mismatch. The debug file
     sanitizer (RP_FILE_SANITIZER=1) enforces this at the call site."""
 
-    __slots__ = ("header", "body", "finalized")
+    __slots__ = ("header", "body", "finalized", "_ser", "_ser_key")
 
     def __init__(self, header: RecordBatchHeader, body: bytes):
         self.header = header
@@ -277,6 +277,11 @@ class RecordBatch:
         # checked by log.append so a batch whose body was mutated after
         # build can't persist a stale body crc silently
         self.finalized = False
+        # serialize() memo (leader dispatch serializes the same batch
+        # once per follower); keyed on the header fields the append
+        # path may rewrite, so offset reassignment invalidates it
+        self._ser: bytes | None = None
+        self._ser_key = None
 
     # -- integrity ---------------------------------------------------
     def compute_crc(self) -> int:
@@ -313,8 +318,17 @@ class RecordBatch:
 
     # -- internal (on-disk) serialization ---------------------------
     def serialize(self) -> bytes:
-        self.header.size_bytes = self.size_bytes()
-        return self.header.pack() + self.body
+        h = self.header
+        key = (h.base_offset, h.term, h.header_crc)
+        if self._ser is not None and self._ser_key == key:
+            return self._ser
+        h.size_bytes = self.size_bytes()
+        out = h.pack() + self.body
+        if self.finalized:
+            # finalized batches are immutable by contract (and offset
+            # rewrites bump header_crc, changing the key)
+            self._ser, self._ser_key = out, key
+        return out
 
     @staticmethod
     def deserialize(data: bytes | IOBufParser) -> "RecordBatch":

@@ -38,7 +38,12 @@ class GroupManager:
         from .append_aggregator import AppendAggregator
 
         self.append_aggregator = AppendAggregator(send)
-        self._send = self.append_aggregator.send
+        # RP_NO_APPEND_AGG=1: measurement knob — raw per-call sends
+        self._send = (
+            send
+            if os.environ.get("RP_NO_APPEND_AGG", "0") == "1"
+            else self.append_aggregator.send
+        )
         self._election_timeout = election_timeout_s
         self.kvstore = kvstore or KvStore(os.path.join(data_dir, "kvstore"))
         self._owns_kvstore = kvstore is None

@@ -31,6 +31,7 @@ from typing import Awaitable, Callable, Optional
 
 import numpy as np
 
+from . import shard_state
 from . import types as rt
 from .consensus import Consensus, Role
 from ..models.consensus_state import SELF_SLOT
@@ -50,6 +51,7 @@ class _PeerPlan:
         "rows", "slots", "gids", "gids_arr", "cons", "pos_by_gid",
         "tb_cache", "frame_cache", "reply_cache",
         "same_epoch", "same_counter", "same_ticks", "same_crc",
+        "same_fp",
     )
 
     def __init__(self, pairs: list[tuple[Consensus, int]]):
@@ -81,6 +83,7 @@ class _PeerPlan:
         self.same_counter = 0
         self.same_ticks = 0
         self.same_crc: tuple | None = None
+        self.same_fp: int | None = None  # RP_SAME_DEBUG lane checksum
 
     def prev_terms_cached(self, arrays, prevs: np.ndarray):
         from .shard_state import term_at_batch_cached
@@ -194,6 +197,15 @@ class HeartbeatManager:
                 and arrays.hb_suppress_total == 0
                 and p.same_ticks < self.FORCE_FULL_EVERY
             ):
+                if shard_state.SAME_DEBUG and p.same_fp is not None:
+                    fp = arrays.same_fingerprint()
+                    if fp != p.same_fp:
+                        raise AssertionError(
+                            "SAME-frame mask (leader): raft lanes "
+                            "changed while mut_epoch did not — a "
+                            "write site missed touch() (armed fp "
+                            f"{p.same_fp:#x}, now {fp:#x})"
+                        )
                 same_sent[peer] = rt.encode_same_req(
                     self.node_id,
                     len(p.gids),
@@ -388,6 +400,11 @@ class HeartbeatManager:
                         p.same_crc = (prefix, zlib.crc32(prefix))
                     p.same_epoch = epoch0
                     p.same_ticks = 0
+                    p.same_fp = (
+                        arrays.same_fingerprint()
+                        if shard_state.SAME_DEBUG
+                        else None
+                    )
                 continue
             reply = rt.HeartbeatReply.decode(raw)
             r_groups = np.asarray(reply.groups, np.int64)
@@ -503,7 +520,7 @@ class HeartbeatManager:
             for i in np.flatnonzero(lag):
                 c = p.cons[int(i)]
                 if c.role == Role.LEADER:
-                    c._spawn(c._catch_up(peer))
+                    c.kick_catch_up(peer)
                     n_spawned += 1
         if spans.ENABLED:
             spans.add("hb.scan", time.perf_counter() - t_scan)
@@ -529,4 +546,4 @@ class HeartbeatManager:
                 int(c.arrays.match_index[c.row, slot]),
                 int(reply.last_dirty[i]),
             )
-            c._spawn(c._catch_up(peer))
+            c.kick_catch_up(peer)

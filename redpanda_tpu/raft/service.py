@@ -198,10 +198,13 @@ class RaftService(Service):
                 if ent is None or ent[0] != arrays.mut_epoch or ent[1] != n:
                     import zlib
 
+                    from .shard_state import SAME_DEBUG
+
                     self._same_armed[sender] = (
                         arrays.mut_epoch,
                         n,
                         zlib.crc32(payload[: len(payload) - 8 * n]),
+                        arrays.same_fingerprint() if SAME_DEBUG else None,
                     )
                     self._arm_same_coverage(sender, arrays, c_lr)
                 seq_bytes = np.ascontiguousarray(req.seqs, "<q").tobytes()
@@ -322,6 +325,16 @@ class RaftService(Service):
             or ent[2] != crc
         ):
             return rt.encode_same_reply(rt.SAME_NEED_FULL, counter)
+        from .shard_state import SAME_DEBUG
+
+        if SAME_DEBUG and ent[3] is not None:
+            fp = arrays.same_fingerprint()
+            if fp != ent[3]:
+                raise AssertionError(
+                    "SAME-frame mask: raft lanes changed while "
+                    "mut_epoch did not — a write site missed touch() "
+                    f"(armed fp {ent[3]:#x}, now {fp:#x})"
+                )
         arrays.node_hb[node_id] = asyncio.get_event_loop().time()
         return rt.encode_same_reply(rt.SAME_OK, counter)
 

@@ -14,6 +14,8 @@ Rows are recycled through a free list; freed rows are neutralized
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..models.consensus_state import (
@@ -26,6 +28,11 @@ from . import quorum_scalar as qs
 I64_MIN = np.int64(np.iinfo(np.int64).min)
 I64_MAX = np.int64(np.iinfo(np.int64).max)
 NO_OFFSET = np.int64(-1)
+
+# RP_SAME_DEBUG=1: SAME-frame serves verify a lane fingerprint against
+# the armed snapshot — catches write sites that missed touch() at the
+# first masked serve (tests flip this module attribute directly)
+SAME_DEBUG = os.environ.get("RP_SAME_DEBUG", "0") == "1"
 
 # term-boundary mirror ring per group: the last TB_SLOTS (start_offset,
 # term) pairs of the log, so the heartbeat build can answer
@@ -156,6 +163,35 @@ class ShardGroupArrays:
     def touch(self) -> None:
         """Invalidate armed SAME-frame heartbeat state (see mut_epoch)."""
         self.mut_epoch += 1
+
+    # SAME-frame lanes whose writers MUST call touch(); the debug
+    # fingerprint (RP_SAME_DEBUG=1) checksums exactly these, so a
+    # write site that forgets the bump is caught at the next SAME
+    # serve instead of being masked until the forced-full cadence.
+    SAME_LANES = (
+        "term",
+        "is_leader",
+        "is_follower",
+        "match_index",
+        "flushed_index",
+        "commit_index",
+        "log_start",
+        "snap_index",
+    )
+
+    def same_fingerprint(self) -> int:
+        """CRC over every SAME-relevant lane + the term-boundary epoch.
+        Debug-mode invariant: while mut_epoch is unchanged, this value
+        must not change — a divergence means some write site missed
+        touch() (correctness-by-convention made checkable)."""
+        import zlib
+
+        acc = zlib.crc32(str(self.tb_epoch).encode())
+        for name in self.SAME_LANES:
+            acc = zlib.crc32(
+                np.ascontiguousarray(getattr(self, name)).tobytes(), acc
+            )
+        return acc
 
     # -- row lifecycle ------------------------------------------------
     def alloc_row(self) -> int:

@@ -74,7 +74,8 @@ class Dispatcher:
         if entry is None:
             raise RpcError(Status.METHOD_NOT_FOUND, f"method {method_id}")
         svc, name, fn = entry
-        await honey_badger.maybe_inject(svc, name)
+        if honey_badger.active:  # skip a coroutine per dispatch when idle
+            await honey_badger.maybe_inject(svc, name)
         return await fn(payload)
 
 

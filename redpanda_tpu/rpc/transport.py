@@ -186,7 +186,11 @@ class ReconnectTransport:
     async def call(
         self, method_id: int, payload: bytes, timeout: float | None = None
     ) -> bytes:
-        t = await self._ensure()
+        # connected fast path: skip the async lock + reconnect dance
+        # (one async CM + lock churn per RPC on the hot append path)
+        t = self._transport
+        if t is None or not t.is_connected():
+            t = await self._ensure()
         try:
             return await t.call(method_id, payload, timeout)
         except ConnectionError:

@@ -43,6 +43,12 @@ class HoneyBadger:
     def clear(self) -> None:
         self._probes.clear()
 
+    @property
+    def active(self) -> bool:
+        """Cheap hot-path predicate: any probes armed? (Callers skip
+        the maybe_inject coroutine allocation per dispatch when idle.)"""
+        return bool(self._probes)
+
     async def maybe_inject(self, module: str, point: str) -> None:
         if not self._probes:
             return
