@@ -664,7 +664,58 @@ def build_parser() -> argparse.ArgumentParser:
     dbg.add_argument("-o", "--output", default="debug-bundle.json.gz")
     dbg.set_defaults(fn=cmd_debug)
 
+    # `rpk redpanda tune|check` analog (ref src/go/rpk/pkg/cli/cmd/
+    # redpanda/tune.go + check.go; tuner inventory tuners/)
+    rp = sub.add_parser("redpanda")
+    rp.add_argument("action", choices=["check", "tune"])
+    rp.add_argument(
+        "--apply",
+        action="store_true",
+        help="apply mutations (default: dry-run report of the plan)",
+    )
+    rp.set_defaults(fn=cmd_redpanda)
+
     return ap
+
+
+async def cmd_redpanda(args) -> None:
+    from .tuners import check_all, tune_all
+
+    if args.action == "check":
+        results = check_all()
+        rows = []
+        for r in results:
+            rows.append(
+                {
+                    "tuner": r.tuner,
+                    "ok": r.ok,
+                    "supported": r.supported,
+                    "current": r.current,
+                    "required": r.required,
+                    "severity": r.severity.value,
+                    **({"error": r.error} if r.error else {}),
+                }
+            )
+        _print(rows)
+        if any(
+            not r.ok and r.severity.value == "fatal" and r.supported
+            for r in results
+        ):
+            raise SystemExit(1)
+        return
+    results = tune_all(dry_run=not args.apply)
+    rows = []
+    for r in results:
+        rows.append(
+            {
+                "tuner": r.tuner,
+                "changed": r.changed,
+                "applied": r.applied,
+                "actions": [a.describe() for a in r.actions],
+                **({"error": r.error} if r.error else {}),
+            }
+        )
+    _print(rows)
 
 
 _BUNDLE_ROUTES = [

@@ -458,6 +458,48 @@ class Operator:
     async def start(self) -> None:
         self._task = asyncio.create_task(self._loop())
 
+    async def install_webhooks(
+        self, service: str = "redpanda-operator"
+    ) -> dict:
+        """Bootstrap admission webhooks: issue a self-signed CA +
+        serving cert, store the pair as a Secret, and apply the
+        Mutating/Validating webhook configurations pointing at the
+        operator's service (the cert-manager-less path the reference
+        operator supports). Returns the PEM map for the server."""
+        from .operator_webhook import issue_webhook_certs, webhook_configurations
+
+        pems = issue_webhook_certs(service, self.namespace)
+        api = self.reconciler.api
+        await api.create(
+            "v1",
+            self.namespace,
+            "secrets",
+            {
+                "apiVersion": "v1",
+                "kind": "Secret",
+                "metadata": {
+                    "name": f"{service}-webhook-cert",
+                    "namespace": self.namespace,
+                },
+                "type": "kubernetes.io/tls",
+                "stringData": {
+                    "tls.crt": pems["server_cert"],
+                    "tls.key": pems["server_key"],
+                    "ca.crt": pems["ca_cert"],
+                },
+            },
+        )
+        for cfg in webhook_configurations(
+            service, self.namespace, pems["ca_cert"]
+        ):
+            await api.create(
+                "admissionregistration.k8s.io/v1",
+                self.namespace,
+                cfg["kind"].lower() + "s",
+                cfg,
+            )
+        return pems
+
     async def stop(self) -> None:
         if self._task is not None:
             self._task.cancel()
