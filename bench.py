@@ -222,19 +222,21 @@ async def _live_tick_async(n_groups: int) -> dict:
             await hb.tick()
             full_times.append((time.perf_counter() - t0) * 1e3)
         interval_ms = 50.0
+        full_p99 = float(np.percentile(full_times, 99))
+        # HEADLINE is the FULL-frame p99 — what an actively-churning
+        # cluster pays every tick (VERDICT r4 #2); the quiesced SAME
+        # path's O(1) numbers ride along as steady_*.
         return {
             "metric": f"live_heartbeat_tick_p99_{n_groups}_groups",
-            "value": round(p99, 3),
+            "value": round(full_p99, 3),
             "unit": "ms",
-            "vs_baseline": round(interval_ms / p99, 3),
-            "p50_ms": round(float(np.percentile(times, 50)), 3),
-            "mean_ms": round(float(np.mean(times)), 3),
-            "full_frame_p99_ms": round(
-                float(np.percentile(full_times, 99)), 3
-            ),
+            "vs_baseline": round(interval_ms / full_p99, 3),
             "full_frame_p50_ms": round(
                 float(np.percentile(full_times, 50)), 3
             ),
+            "steady_p99_ms": round(p99, 3),
+            "steady_p50_ms": round(float(np.percentile(times, 50)), 3),
+            "steady_mean_ms": round(float(np.mean(times)), 3),
         }
     finally:
         for gm in gms.values():

@@ -51,12 +51,28 @@ class _PeerPlan:
         "rows", "slots", "gids", "gids_arr", "cons", "pos_by_gid",
         "tb_cache", "frame_cache", "reply_cache",
         "same_epoch", "same_counter", "same_ticks", "same_crc",
-        "same_fp",
+        "same_fp", "row_slice", "slot_u",
     )
 
     def __init__(self, pairs: list[tuple[Consensus, int]]):
         self.rows = np.array([c.row for c, _ in pairs], np.int64)
         self.slots = np.array([s for _, s in pairs], np.int64)
+        # contiguity fast path: rows are allocated sequentially, so in
+        # the common case the plan covers a dense row range with one
+        # uniform slot — every 50k-wide fancy gather/scatter in the
+        # tick then becomes a strided slice op (4-10x cheaper measured;
+        # a 50k fancy gather is 0.2-0.5 ms, the slice copy 0.02 ms)
+        n = len(self.rows)
+        self.row_slice = None
+        if n and int(self.rows[-1]) - int(self.rows[0]) + 1 == n:
+            if n == 1 or bool((np.diff(self.rows) == 1).all()):
+                r0 = int(self.rows[0])
+                self.row_slice = slice(r0, r0 + n)
+        self.slot_u = (
+            int(self.slots[0])
+            if n and bool((self.slots == self.slots[0]).all())
+            else None
+        )
         self.gids = [c.group_id for c, _ in pairs]
         self.gids_arr = np.array(self.gids, np.int64)
         self.cons = [c for c, _ in pairs]
@@ -84,6 +100,22 @@ class _PeerPlan:
         self.same_ticks = 0
         self.same_crc: tuple | None = None
         self.same_fp: int | None = None  # RP_SAME_DEBUG lane checksum
+
+    def col2(self, arr: np.ndarray) -> np.ndarray:
+        """Contiguous SNAPSHOT of arr[rows, slots] (callers compare,
+        encode, or hold it across awaits — explicit .copy(): with the
+        lanes column-major the slice is already contiguous and
+        ascontiguousarray would alias the live lane)."""
+        if self.row_slice is not None and self.slot_u is not None:
+            return arr[self.row_slice, self.slot_u].copy()
+        return arr[self.rows, self.slots]
+
+    def lane1(self, arr: np.ndarray) -> np.ndarray:
+        """arr[rows]: a contiguous VIEW when rows are dense (callers
+        must .copy() before caching), else a fancy-index copy."""
+        if self.row_slice is not None:
+            return arr[self.row_slice]
+        return arr[self.rows]
 
     def prev_terms_cached(self, arrays, prevs: np.ndarray):
         from .shard_state import term_at_batch_cached
@@ -159,7 +191,14 @@ class HeartbeatManager:
                 slot = c._slot_map.get(peer)
                 if slot is not None:
                     per_node.setdefault(peer, []).append((c, slot))
-        return {peer: _PeerPlan(pairs) for peer, pairs in per_node.items()}
+        # sort by row: sequentially created groups then form ONE dense
+        # run, so the plan's gathers take the slice fast path (the
+        # follower's rows follow this gid order too — its allocation
+        # sequence mirrors ours, keeping both sides dense)
+        return {
+            peer: _PeerPlan(sorted(pairs, key=lambda cs: cs[0].row))
+            for peer, pairs in per_node.items()
+        }
 
     # forced full-frame cadence while quiesced: bounds the staleness
     # window of any mutation-epoch bump a writer site might miss
@@ -250,11 +289,16 @@ class HeartbeatManager:
                     p, prevs, seqs, msg, rows, slots, gids, keep_idx, False,
                 )
                 continue
-            arrays.next_seq[p.rows, p.slots] += 1
-            seqs = arrays.next_seq[p.rows, p.slots]
-            prevs = arrays.match_index[p.rows, p.slots]
-            terms = arrays.term[p.rows]
-            commits = arrays.commit_index[p.rows]
+            if p.row_slice is not None and p.slot_u is not None:
+                nsv = arrays.next_seq[p.row_slice, p.slot_u]
+                nsv += 1
+                seqs = np.ascontiguousarray(nsv)
+            else:
+                arrays.next_seq[p.rows, p.slots] += 1
+                seqs = arrays.next_seq[p.rows, p.slots]
+            prevs = p.col2(arrays.match_index)
+            terms = p.lane1(arrays.term)
+            commits = p.lane1(arrays.commit_index)
             fc = p.frame_cache
             if (
                 fc is not None
@@ -289,11 +333,14 @@ class HeartbeatManager:
                     commit_indices=commits,
                     seqs=seqs,
                 ).encode()
-                # prefix ends right after the seq vector's u32 count
+                # prefix ends right after the seq vector's u32 count.
+                # SNAPSHOT the lanes (lane1 returns live views on the
+                # dense-row path — caching a view would track future
+                # mutations and falsify the steady-state compare)
                 p.frame_cache = (
-                    prevs,
-                    terms,
-                    commits,
+                    prevs.copy(),
+                    terms.copy(),
+                    commits.copy(),
                     arrays.tb_epoch,
                     msg[: len(msg) - 8 * len(p.gids)],
                 )
@@ -362,6 +409,7 @@ class HeartbeatManager:
             n = len(gids)
             seq_lo = len(raw) - (4 + n) - 8 * n
             rc = p.reply_cache
+            fast = keep_idx is None and p.row_slice is not None
             if (
                 keep_idx is None
                 and rc is not None
@@ -371,22 +419,38 @@ class HeartbeatManager:
                 and raw[seq_lo + 8 * n :] == rc[1]
                 and not arrays.quorum_dirty.any()
                 and np.array_equal(
-                    arrays.match_index[rows, SELF_SLOT],
-                    arrays._folded_self_m[rows],
+                    np.ascontiguousarray(
+                        arrays.match_index[p.row_slice, SELF_SLOT]
+                    )
+                    if fast
+                    else arrays.match_index[rows, SELF_SLOT],
+                    arrays._folded_self_m[p.row_slice]
+                    if fast
+                    else arrays._folded_self_m[rows],
                 )
                 and np.array_equal(
-                    arrays.flushed_index[rows, SELF_SLOT],
-                    arrays._folded_self_f[rows],
+                    np.ascontiguousarray(
+                        arrays.flushed_index[p.row_slice, SELF_SLOT]
+                    )
+                    if fast
+                    else arrays.flushed_index[rows, SELF_SLOT],
+                    arrays._folded_self_f[p.row_slice]
+                    if fast
+                    else arrays._folded_self_f[rows],
                 )
             ):
                 r_seqs = np.frombuffer(
                     raw[seq_lo : seq_lo + 8 * n], "<q"
                 ).astype(np.int64, copy=False)
-                # (rows, slots) pairs are unique within one plan:
-                # gather+max+scatter beats the unbuffered ufunc.at 2x
-                arrays.last_seq[rows, slots] = np.maximum(
-                    arrays.last_seq[rows, slots], r_seqs
-                )
+                if fast and p.slot_u is not None:
+                    lsv = arrays.last_seq[p.row_slice, p.slot_u]
+                    np.maximum(lsv, r_seqs, out=lsv)
+                else:
+                    # (rows, slots) pairs are unique within one plan:
+                    # gather+max+scatter beats the unbuffered ufunc.at
+                    arrays.last_seq[rows, slots] = np.maximum(
+                        arrays.last_seq[rows, slots], r_seqs
+                    )
                 if spliced and arrays.mut_epoch == epoch0:
                     # spliced frame + byte-identical reply + no local
                     # mutation during the RPC: both sides are armed for
@@ -503,20 +567,38 @@ class HeartbeatManager:
         for peer, p in plan.items():
             if peer in same_sent:
                 continue  # quiesced: nothing moved, nothing to scan
-            lag = (
-                arrays.is_leader[p.rows]
-                & (
-                    (
-                        arrays.match_index[p.rows, p.slots]
-                        < arrays.match_index[p.rows, SELF_SLOT]
-                    )
-                    | (
-                        arrays.flushed_index[p.rows, p.slots]
-                        < arrays.match_index[p.rows, p.slots]
-                    )
+            if p.row_slice is not None and p.slot_u is not None:
+                sl, su = p.row_slice, p.slot_u
+                # contiguous copies first: strided-view compares cost
+                # ~10x a contiguous op at 50k (measured)
+                m_peer = np.ascontiguousarray(arrays.match_index[sl, su])
+                m_self = np.ascontiguousarray(
+                    arrays.match_index[sl, SELF_SLOT]
                 )
-                & (arrays.hb_suppress[p.rows, p.slots] == 0)
-            )
+                f_peer = np.ascontiguousarray(
+                    arrays.flushed_index[sl, su]
+                )
+                sup = np.ascontiguousarray(arrays.hb_suppress[sl, su])
+                lag = (
+                    arrays.is_leader[sl]
+                    & ((m_peer < m_self) | (f_peer < m_peer))
+                    & (sup == 0)
+                )
+            else:
+                lag = (
+                    arrays.is_leader[p.rows]
+                    & (
+                        (
+                            arrays.match_index[p.rows, p.slots]
+                            < arrays.match_index[p.rows, SELF_SLOT]
+                        )
+                        | (
+                            arrays.flushed_index[p.rows, p.slots]
+                            < arrays.match_index[p.rows, p.slots]
+                        )
+                    )
+                    & (arrays.hb_suppress[p.rows, p.slots] == 0)
+                )
             for i in np.flatnonzero(lag):
                 c = p.cons[int(i)]
                 if c.role == Role.LEADER:

@@ -72,7 +72,12 @@ class ShardGroupArrays:
     def __init__(self, capacity: int = 64, replica_slots: int = DEFAULT_REPLICA_SLOTS):
         self.replica_slots = replica_slots
         self._cap = capacity
-        self._free: list[int] = list(range(capacity))
+        # stored descending so pop() hands rows out ASCENDING: plans
+        # built over sequentially created groups then cover dense row
+        # ranges, unlocking the slice fast paths in the heartbeat
+        # tick/service (row_slice) — fancy gathers over 50k rows cost
+        # 4-10x a strided slice
+        self._free: list[int] = list(range(capacity - 1, -1, -1))
         self._alloc_count = 0
         g, r = capacity, replica_slots
         self.term = np.zeros(g, np.int64)
@@ -80,13 +85,19 @@ class ShardGroupArrays:
         self.commit_index = np.full(g, NO_OFFSET, np.int64)
         self.term_start = np.zeros(g, np.int64)
         self.last_visible = np.full(g, NO_OFFSET, np.int64)
-        self.match_index = np.full((g, r), NO_OFFSET, np.int64)
-        self.flushed_index = np.full((g, r), NO_OFFSET, np.int64)
+        # column-major (order='F'): the heartbeat tick reads/writes
+        # whole per-slot COLUMNS (match_index[:, slot]); with C order
+        # each such pass strides 8*r bytes and walks the full 3 MB row
+        # space at 50k groups — F order makes columns contiguous and
+        # the tick's column ops memcpy-fast. Row access (per-group
+        # scalar paths) is unaffected semantically.
+        self.match_index = np.full((g, r), NO_OFFSET, np.int64, order="F")
+        self.flushed_index = np.full((g, r), NO_OFFSET, np.int64, order="F")
         self.is_voter = np.zeros((g, r), bool)
         self.is_voter_old = np.zeros((g, r), bool)
-        self.last_seq = np.zeros((g, r), np.int64)
+        self.last_seq = np.zeros((g, r), np.int64, order="F")
         # host-only: next request seq per (group, peer slot)
-        self.next_seq = np.zeros((g, r), np.int64)
+        self.next_seq = np.zeros((g, r), np.int64, order="F")
         # host-only: term-boundary ring (ascending starts; unused slots
         # hold I64_MAX so they never match a <= comparison)
         self.tb_start = np.full((g, TB_SLOTS), I64_MAX, np.int64)
@@ -158,7 +169,7 @@ class ShardGroupArrays:
         # heartbeat_manager.cc needs_heartbeat). A counter, not a
         # timestamp: suppression lifts the moment the fiber exits, so
         # the tick's recovery-fallback role is preserved exactly.
-        self.hb_suppress = np.zeros((g, r), np.int32)
+        self.hb_suppress = np.zeros((g, r), np.int32, order="F")
 
     def touch(self) -> None:
         """Invalidate armed SAME-frame heartbeat state (see mut_epoch)."""
@@ -272,7 +283,14 @@ class ShardGroupArrays:
         ):
             arr = getattr(self, name)
             shape = (new,) + arr.shape[1:]
-            grown = np.zeros(shape, arr.dtype)
+            order = (
+                "F"
+                if arr.ndim == 2
+                and arr.flags.f_contiguous
+                and not arr.flags.c_contiguous
+                else "C"
+            )
+            grown = np.zeros(shape, arr.dtype, order=order)
             grown[:old] = arr
             if arr.dtype == np.int64 and name in (
                 "commit_index",
@@ -293,7 +311,7 @@ class ShardGroupArrays:
             elif name == "el_timeout":
                 grown[old:] = 3600.0
             setattr(self, name, grown)
-        self._free.extend(range(old, new))
+        self._free.extend(range(new - 1, old - 1, -1))
         self._cap = new
         self.voter_epoch += 1  # cached voter counts have the old shape
 
