@@ -17,7 +17,10 @@ import threading
 import zlib
 from typing import Callable
 
-import zstandard
+try:
+    import zstandard
+except ImportError:  # gated: image may lack the wheel; zstd raises at use
+    zstandard = None
 
 from . import lz4_codec, snappy_codec
 
@@ -51,6 +54,10 @@ _zstd_tls = threading.local()
 
 
 def _zstd_ctx() -> tuple:
+    if zstandard is None:
+        raise RuntimeError(
+            "zstd codec unavailable: the zstandard module is not installed"
+        )
     ctx = getattr(_zstd_tls, "ctx", None)
     if ctx is None:
         ctx = (zstandard.ZstdCompressor(level=3), zstandard.ZstdDecompressor())

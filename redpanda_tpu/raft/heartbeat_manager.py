@@ -628,4 +628,5 @@ class HeartbeatManager:
                 int(c.arrays.match_index[c.row, slot]),
                 int(reply.last_dirty[i]),
             )
+            c.arrays.touch()  # match_index is a SAME lane
             c.kick_catch_up(peer)

@@ -99,5 +99,7 @@ def apply_reply(
     if seq <= replica.last_seq:
         return
     replica.last_seq = seq
-    replica.match_index = max(replica.match_index, last_dirty)
-    replica.flushed_index = max(replica.flushed_index, last_flushed)
+    # ReplicaState is the per-replica scalar reference model, not the
+    # SoA lanes — no mut_epoch to bump here
+    replica.match_index = max(replica.match_index, last_dirty)  # rplint: disable=RPL001
+    replica.flushed_index = max(replica.flushed_index, last_flushed)  # rplint: disable=RPL001

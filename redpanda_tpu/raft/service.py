@@ -104,7 +104,7 @@ class RaftService(Service):
         until its next forced-full frame (up to FORCE_FULL_EVERY ticks,
         longer than the election timeout — a spurious election)."""
         if isinstance(rows, slice):  # dense-path liveness rows
-            rows = np.arange(rows.start, rows.stop, dtype=np.int64)
+            rows = _np.arange(rows.start, rows.stop, dtype=_np.int64)
         prev = self._same_rows.get(sender)
         if prev is not None:
             mine = prev[arrays.same_cover_node[prev] == sender]
@@ -270,13 +270,18 @@ class RaftService(Service):
 
                     from .shard_state import SAME_DEBUG
 
+                    # coverage BEFORE the armed entry: if arming raises
+                    # partway, an armed-but-uncovered entry would serve
+                    # SAME_OK forever while the liveness merge stays
+                    # dead (cover=-1) — and never retry, because the
+                    # entry already matches mut_epoch
+                    self._arm_same_coverage(sender, arrays, c_lr)
                     self._same_armed[sender] = (
                         arrays.mut_epoch,
                         n,
                         zlib.crc32(payload[: len(payload) - 8 * n]),
                         arrays.same_fingerprint() if SAME_DEBUG else None,
                     )
-                    self._arm_same_coverage(sender, arrays, c_lr)
                 # the reply echoes the request's seq vector verbatim —
                 # splice the raw request tail straight in
                 seq_bytes = (

@@ -668,11 +668,14 @@ class ShardGroupArrays:
         new = heartbeat_tick_jit(state, g_rows, g_slots, g_dirty, g_flushed, g_seqs)
         # write back the sweep's outputs (np.array: the views produced
         # from jax buffers are read-only; rows must stay host-writable)
-        self.commit_index[touched] = np.array(new.commit_index)[touched]
-        self.last_visible[touched] = np.array(new.last_visible)[touched]
-        self.match_index = np.array(new.match_index)
-        self.flushed_index = np.array(new.flushed_index)
-        self.last_seq = np.array(new.last_seq)
+        self.commit_index[touched] = np.array(new.commit_index)[touched]  # rplint: disable=RPL002
+        self.last_visible[touched] = np.array(new.last_visible)[touched]  # rplint: disable=RPL002
+        self.match_index = np.array(new.match_index)  # rplint: disable=RPL002
+        self.flushed_index = np.array(new.flushed_index)  # rplint: disable=RPL002
+        self.last_seq = np.array(new.last_seq)  # rplint: disable=RPL002
+        # commit/match/flushed are SAME lanes: invalidate armed frames
+        # (host_tick bumps the epoch for the same reason)
+        self.touch()
         from ..models.consensus_state import SELF_SLOT as _SELF2
 
         self._folded_self_m[touched] = self.match_index[touched, _SELF2]

@@ -16,6 +16,8 @@ import asyncio
 from .server import Dispatcher, Service
 from .types import RpcError, Status
 
+_TIMEOUT_CTX = getattr(asyncio, "timeout", None)  # 3.11+
+
 
 class LoopbackNetwork:
     def __init__(self):
@@ -102,10 +104,13 @@ class LoopbackTransport:
                 # task instead of wrapping the coro in a new Task the
                 # way wait_for does — one Task per RPC was ~5% of the
                 # replicated-bench core
-                async with asyncio.timeout(timeout):
-                    return await coro
+                if _TIMEOUT_CTX is not None:
+                    async with _TIMEOUT_CTX(timeout):
+                        return await coro
+                # 3.10 fallback: a Task per RPC, but functional
+                return await asyncio.wait_for(coro, timeout)
             return await coro
-        except TimeoutError:
+        except (TimeoutError, asyncio.TimeoutError):
             raise RpcError(Status.TIMEOUT, f"method {method_id} timed out")
 
     async def close(self) -> None:

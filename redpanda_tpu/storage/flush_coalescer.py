@@ -106,6 +106,8 @@ class FlushCoalescer:
                         FlushCoalescer._ewma_s += 0.2 * (
                             dt - FlushCoalescer._ewma_s
                         )
+                except asyncio.CancelledError:
+                    raise  # teardown must propagate, not land in futures
                 except BaseException as e:  # executor itself failed
                     by_fd = {fd: (e, 0.0) for fd in order}
                 for fd, fut in batch:

@@ -26,6 +26,43 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import pytest
+
+
+# -- SAME-frame fingerprint verification on by default ------------------
+# RP_SAME_DEBUG=1 adds a CRC over the SAME lanes to every armed frame
+# and every serve, turning a missed touch() into an immediate assertion
+# instead of a silent stale read. The raft suites run with it armed
+# unconditionally — the fuzz suite proved the check cheap enough, and a
+# regression in the mut_epoch contract should fail HERE, not in chaos.
+
+_SAME_DEBUG_MODULES = frozenset(
+    {
+        "test_raft",
+        "test_raft_snapshot",
+        "test_same_epoch_fuzz",
+        "test_replicate_batcher",
+        "test_membership",
+        "test_recovery_throttle",
+    }
+)
+
+
+@pytest.fixture(autouse=True)
+def _same_debug_for_raft_tests(request):
+    module = getattr(request, "module", None)
+    if module is None or module.__name__ not in _SAME_DEBUG_MODULES:
+        yield
+        return
+    from redpanda_tpu.raft import shard_state
+
+    old = shard_state.SAME_DEBUG
+    shard_state.SAME_DEBUG = True
+    try:
+        yield
+    finally:
+        shard_state.SAME_DEBUG = old
+
 
 # -- timing-sensitive retry (1-core full-suite interference) -----------
 # This environment has ONE core; the full suite's load occasionally
@@ -40,6 +77,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "timing: timing-sensitive on the 1-core host; retried once",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow')",
     )
 
 

@@ -359,6 +359,7 @@ async def _self_test_distributed(tmp_path):
         assert st == 200
 
 
+@pytest.mark.timing  # 3-broker netcheck windows slip under full-suite load
 def test_self_test_distributed(tmp_path):
     asyncio.run(_self_test_distributed(tmp_path))
 

@@ -273,6 +273,7 @@ def test_state_machine_applies_committed(tmp_path):
     run(main())
 
 
+@pytest.mark.timing  # fixed isolate/heal sleeps vs election windows
 def test_prevote_isolated_node_does_not_bump_terms(tmp_path):
     """A partitioned node must not advance its term (prevote_stm.cc):
     its prevotes go unanswered, so the real election never starts, and

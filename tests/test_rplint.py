@@ -1,0 +1,464 @@
+"""rplint: the tier-1 gate plus per-rule fixture tests.
+
+The gate test is the point of the tool: the tree must lint clean
+against the committed baseline, so a PR that introduces a SAME-lane
+write without touch(), a host sync in a hot path, an impure jit
+function, or a blocking call in a coroutine fails tier-1 — not a
+2 am debugging session three PRs later.
+
+Each rule also gets a planted-violation fixture pair: the violation is
+reported at the exact file:line, and an otherwise-identical copy with
+a `# rplint: disable=...` suppression is not reported.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools.rplint.engine import (  # noqa: E402
+    Finding,
+    apply_baseline,
+    load_baseline,
+    run_paths,
+    save_baseline,
+)
+
+
+def _lint_source(tmp_path, source, relpath="mod.py", rules=None):
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return run_paths([str(path)], rules=rules)
+
+
+def _only(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# -- the gate ----------------------------------------------------------
+
+
+def test_tree_lints_clean_against_baseline(monkeypatch):
+    """Tier-1 gate: zero non-baselined findings over redpanda_tpu/."""
+    monkeypatch.chdir(REPO_ROOT)
+    findings = apply_baseline(run_paths(["redpanda_tpu"]), load_baseline())
+    assert findings == [], "new rplint findings:\n" + "\n".join(
+        f.render() for f in findings
+    )
+
+
+def test_baseline_has_no_rpl001_entries():
+    """The SAME-lane contract is fully enforced: nothing grandfathered."""
+    baseline = load_baseline()
+    rpl001 = [k for k in baseline if k.endswith("::RPL001")]
+    assert rpl001 == []
+
+
+# -- RPL001: SAME-lane touch contract ----------------------------------
+
+
+RPL001_BAD = """\
+class Arrays:
+    def promote(self, row):
+        self.term[row] = 7
+        self.is_leader[row] = True
+"""
+
+RPL001_GOOD = """\
+class Arrays:
+    def promote(self, row):
+        self.term[row] = 7
+        self.is_leader[row] = True
+        self.touch()
+"""
+
+
+def test_rpl001_reports_missing_touch(tmp_path):
+    findings = _only(
+        _lint_source(tmp_path, RPL001_BAD, "raft/mod.py"), "RPL001"
+    )
+    assert [(f.line, f.qualname) for f in findings] == [
+        (3, "Arrays.promote"),
+        (4, "Arrays.promote"),
+    ]
+    assert "term" in findings[0].message
+
+
+def test_rpl001_touch_in_same_function_satisfies(tmp_path):
+    assert _only(
+        _lint_source(tmp_path, RPL001_GOOD, "raft/mod.py"), "RPL001"
+    ) == []
+
+
+def test_rpl001_suppression(tmp_path):
+    src = RPL001_BAD.replace(
+        "self.term[row] = 7",
+        "self.term[row] = 7  # rplint: disable=RPL001",
+    ).replace(
+        "self.is_leader[row] = True",
+        "self.is_leader[row] = True  # rplint: disable=RPL001",
+    )
+    assert _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL001") == []
+
+
+def test_rpl001_out_of_raft_not_in_scope(tmp_path):
+    assert _only(
+        _lint_source(tmp_path, RPL001_BAD, "storage/mod.py"), "RPL001"
+    ) == []
+
+
+def test_rpl001_init_exempt(tmp_path):
+    src = """\
+    class Arrays:
+        def __init__(self, n):
+            self.term[0] = 0
+    """
+    assert _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL001") == []
+
+
+def test_rpl001_copyto_and_ufunc_at(tmp_path):
+    src = """\
+    class Arrays:
+        def rewind(self, v):
+            np.copyto(self.commit_index, v)
+
+        def scatter(self, idx, v):
+            np.maximum.at(self.match_index, idx, v)
+    """
+    findings = _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL001")
+    assert [(f.line, f.qualname) for f in findings] == [
+        (3, "Arrays.rewind"),
+        (6, "Arrays.scatter"),
+    ]
+
+
+# -- RPL002: host sync in hot paths ------------------------------------
+
+
+RPL002_BAD = """\
+class S:
+    def tick(self):  # rplint: hot
+        x = compute_jit(self.state)
+        return float(x)
+"""
+
+
+def test_rpl002_reports_materialization_in_hot_path(tmp_path):
+    findings = _only(_lint_source(tmp_path, RPL002_BAD), "RPL002")
+    assert [(f.line, f.qualname) for f in findings] == [(4, "S.tick")]
+    assert "float" in findings[0].message
+
+
+def test_rpl002_cold_function_not_flagged(tmp_path):
+    src = RPL002_BAD.replace("  # rplint: hot", "")
+    assert _only(_lint_source(tmp_path, src), "RPL002") == []
+
+
+def test_rpl002_suppression(tmp_path):
+    src = RPL002_BAD.replace(
+        "return float(x)", "return float(x)  # rplint: disable=RPL002"
+    )
+    assert _only(_lint_source(tmp_path, src), "RPL002") == []
+
+
+def test_rpl002_unconditional_syncs(tmp_path):
+    src = """\
+    def tick():  # rplint: hot
+        y.block_until_ready()
+        z = q.item()
+        jax.device_get(y)
+    """
+    findings = _only(_lint_source(tmp_path, src), "RPL002")
+    assert [f.line for f in findings] == [2, 3, 4]
+
+
+def test_rpl002_host_numpy_untainted(tmp_path):
+    src = """\
+    def tick(rows):  # rplint: hot
+        host = np.zeros(8)
+        return float(host[0])
+    """
+    assert _only(_lint_source(tmp_path, src), "RPL002") == []
+
+
+def test_rpl002_manifest_entry_matches(tmp_path):
+    src = """\
+    class S:
+        def tick(self):
+            x = compute_jit(self.a)
+            return int(x)
+    """
+    from tools.rplint.rules import rpl002_host_sync
+
+    rule = rpl002_host_sync.HostSyncInHotPathRule(
+        manifest={"hot_mod.py": {"S.tick"}}
+    )
+    findings = _lint_source(tmp_path, src, "hot_mod.py", rules=[rule])
+    assert [(f.rule, f.line) for f in findings] == [("RPL002", 4)]
+
+
+# -- RPL003: jit purity ------------------------------------------------
+
+
+RPL003_BAD = """\
+import jax
+
+
+@jax.jit
+def kernel(x):
+    print(x)
+    return x + time.time()
+"""
+
+
+def test_rpl003_reports_impurity_under_jit_decorator(tmp_path):
+    findings = _only(_lint_source(tmp_path, RPL003_BAD), "RPL003")
+    assert [(f.line, f.qualname) for f in findings] == [
+        (6, "kernel"),
+        (7, "kernel"),
+    ]
+    assert "print" in findings[0].message
+    assert "time.time" in findings[1].message
+
+
+def test_rpl003_partial_jit_and_wrap_forms(tmp_path):
+    src = """\
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def a(x, n):
+        return random.random()
+
+
+    def b(x):
+        return os.environ["RP_MODE"]
+
+
+    b_jit = jax.jit(b)
+
+
+    def plain(x):
+        print(x)  # not jitted: allowed
+        return x
+    """
+    findings = _only(_lint_source(tmp_path, src), "RPL003")
+    assert [(f.line, f.qualname) for f in findings] == [(3, "a"), (7, "b")]
+
+
+def test_rpl003_jax_debug_print_allowed(tmp_path):
+    src = """\
+    @jax.jit
+    def kernel(x):
+        jax.debug.print("x={}", x)
+        return x
+    """
+    assert _only(_lint_source(tmp_path, src), "RPL003") == []
+
+
+def test_rpl003_suppression(tmp_path):
+    src = RPL003_BAD.replace("print(x)", "print(x)  # rplint: disable=RPL003")
+    findings = _only(_lint_source(tmp_path, src), "RPL003")
+    assert [f.line for f in findings] == [7]
+
+
+# -- RPL004: blocking calls in async -----------------------------------
+
+
+RPL004_BAD = """\
+import time
+
+
+async def drain(self):
+    time.sleep(0.05)
+    await self.flush()
+"""
+
+
+def test_rpl004_reports_blocking_in_async(tmp_path):
+    findings = _only(
+        _lint_source(tmp_path, RPL004_BAD, "rpc/mod.py"), "RPL004"
+    )
+    assert [(f.line, f.qualname) for f in findings] == [(5, "drain")]
+    assert "time.sleep" in findings[0].message
+
+
+def test_rpl004_sync_function_not_flagged(tmp_path):
+    src = RPL004_BAD.replace("async def", "def").replace(
+        "await self.flush()", "pass"
+    )
+    assert _only(_lint_source(tmp_path, src, "rpc/mod.py"), "RPL004") == []
+
+
+def test_rpl004_out_of_scope_dir_not_flagged(tmp_path):
+    assert _only(
+        _lint_source(tmp_path, RPL004_BAD, "tools_local/mod.py"), "RPL004"
+    ) == []
+
+
+def test_rpl004_suppression(tmp_path):
+    src = RPL004_BAD.replace(
+        "time.sleep(0.05)", "time.sleep(0.05)  # rplint: disable=RPL004"
+    )
+    assert _only(_lint_source(tmp_path, src, "rpc/mod.py"), "RPL004") == []
+
+
+def test_rpl004_subprocess_and_open(tmp_path):
+    src = """\
+    async def snap(self):
+        with open("x", "rb") as f:
+            data = f.read()
+        subprocess.run(["sync"])
+        await self.send(data)
+    """
+    findings = _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL004")
+    assert [f.line for f in findings] == [2, 4]
+
+
+# -- RPL005: CancelledError swallow ------------------------------------
+
+
+RPL005_BAD = """\
+async def loop(self):
+    while True:
+        try:
+            await self.step()
+        except:
+            pass
+"""
+
+
+def test_rpl005_reports_bare_except_swallow(tmp_path):
+    findings = _only(_lint_source(tmp_path, RPL005_BAD), "RPL005")
+    assert [(f.line, f.qualname) for f in findings] == [(5, "loop")]
+    assert "CancelledError" in findings[0].message
+
+
+def test_rpl005_reraise_exempt(tmp_path):
+    src = RPL005_BAD.replace("            pass", "            raise")
+    assert _only(_lint_source(tmp_path, src), "RPL005") == []
+
+
+def test_rpl005_earlier_cancelled_clause_exempts(tmp_path):
+    src = """\
+    async def loop(self):
+        try:
+            await self.step()
+        except asyncio.CancelledError:
+            raise
+        except BaseException:
+            log.warning("step failed")
+    """
+    assert _only(_lint_source(tmp_path, src), "RPL005") == []
+
+
+def test_rpl005_exception_pure_swallow_flagged(tmp_path):
+    src = """\
+    async def loop(self):
+        try:
+            await self.step()
+        except Exception:
+            pass
+    """
+    findings = _only(_lint_source(tmp_path, src), "RPL005")
+    assert [f.line for f in findings] == [4]
+
+
+def test_rpl005_exception_with_handling_not_flagged(tmp_path):
+    src = """\
+    async def loop(self):
+        try:
+            await self.step()
+        except Exception as e:
+            log.warning("step failed: %s", e)
+    """
+    assert _only(_lint_source(tmp_path, src), "RPL005") == []
+
+
+def test_rpl005_no_await_in_try_not_flagged(tmp_path):
+    src = """\
+    async def loop(self):
+        try:
+            self.step_sync()
+        except:
+            pass
+        await self.flush()
+    """
+    assert _only(_lint_source(tmp_path, src), "RPL005") == []
+
+
+def test_rpl005_suppression(tmp_path):
+    src = RPL005_BAD.replace(
+        "        except:", "        except:  # rplint: disable=RPL005"
+    )
+    assert _only(_lint_source(tmp_path, src), "RPL005") == []
+
+
+# -- baseline mechanics ------------------------------------------------
+
+
+def test_baseline_roundtrip_and_excess(tmp_path):
+    f1 = Finding("a.py", 10, 0, "RPL005", "m", "f")
+    f2 = Finding("a.py", 20, 0, "RPL005", "m", "f")
+    path = str(tmp_path / "baseline.json")
+    save_baseline([f1], path)
+    baseline = load_baseline(path)
+    assert baseline == {"a.py::f::RPL005": 1}
+    # same count: clean; one more in the same scope: the excess reports
+    assert apply_baseline([f1], baseline) == []
+    assert apply_baseline([f1, f2], baseline) == [f2]
+
+
+# -- CLI exit codes ----------------------------------------------------
+
+
+def _run_cli(args, cwd):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.rplint", *args],
+        cwd=cwd,
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT},
+    )
+
+
+def test_cli_exit_0_on_clean_tree(tmp_path):
+    clean = tmp_path / "pkg" / "ok.py"
+    clean.parent.mkdir()
+    clean.write_text("def f():\n    return 1\n")
+    proc = _run_cli([str(clean)], REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_cli_exit_1_on_findings(tmp_path):
+    bad = tmp_path / "raft" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(textwrap.dedent(RPL001_BAD))
+    proc = _run_cli([str(bad)], REPO_ROOT)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "RPL001" in proc.stdout
+    # file:line:col prefix
+    assert f"{bad}".replace(os.sep, "/") + ":3:" in proc.stdout.replace(
+        os.sep, "/"
+    )
+
+
+def test_cli_exit_2_on_internal_error(tmp_path):
+    proc = _run_cli([str(tmp_path / "does_not_exist_xyz")], REPO_ROOT)
+    assert proc.returncode == 2
+    assert "error" in proc.stderr
+
+
+def test_cli_unknown_rule_is_usage_error():
+    proc = _run_cli(["--rules", "RPL999", "tools/rplint"], REPO_ROOT)
+    assert proc.returncode == 2
+
+
+@pytest.mark.slow
+def test_cli_baseline_gate_full_tree():
+    proc = _run_cli(["--baseline", "redpanda_tpu"], REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

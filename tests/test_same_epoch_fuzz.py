@@ -124,6 +124,10 @@ def test_missed_bump_window_bounded_by_forced_full(tmp_path):
     lane state and re-arms against it."""
 
     async def main():
+        # the conftest autouse fixture arms the debug check for raft
+        # suites; this test's premise is production mode (debug OFF)
+        # during the masking window — the fixture restores afterwards
+        shard_state.SAME_DEBUG = False
         cluster, hb = await _quiesced_cluster(tmp_path, n_groups=1)
         p = next(iter(hb._plan.values()))
         assert p.same_epoch is not None

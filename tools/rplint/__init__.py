@@ -1,0 +1,26 @@
+"""rplint — project-specific AST invariant checker for redpanda_tpu.
+
+Static analysis over the codebase's correctness-by-convention
+contracts, the review-time complement to the RP_SAME_DEBUG runtime
+fingerprint (raft/shard_state.py):
+
+  RPL001  SAME-lane writes must bump mut_epoch via touch()
+  RPL002  host-sync (device materialization) forbidden in hot paths
+  RPL003  jit-compiled functions must be pure
+  RPL004  blocking calls forbidden inside async bodies (rpc/raft/admin)
+  RPL005  broad except in async code must not swallow CancelledError
+
+Stdlib-only (ast + tokenize): importable everywhere the repo is, with
+no jax/numpy import cost — `python -m tools.rplint redpanda_tpu/`.
+
+Suppressions: `# rplint: disable=RPL001` (comma-separated rule list)
+anywhere on the lines spanned by the offending statement.
+
+Baseline: tools/rplint/baseline.json maps `path::qualname::rule` keys
+to counts; `--baseline` subtracts it (the gate ratchets — new findings
+in a baselined scope still fail), `--update-baseline` rewrites it.
+"""
+
+from .engine import Finding, LintError, load_baseline, run_paths  # noqa: F401
+
+__all__ = ["Finding", "LintError", "load_baseline", "run_paths"]

@@ -1,0 +1,91 @@
+"""rplint CLI.
+
+    python -m tools.rplint [--baseline] [--update-baseline] paths...
+
+Exit codes:
+    0  clean (no findings, or all findings baselined with --baseline)
+    1  findings reported
+    2  internal error (unparseable file, bad baseline, bad usage)
+
+With no paths the default scan root is `redpanda_tpu`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .engine import (
+    BASELINE_PATH,
+    LintError,
+    apply_baseline,
+    load_baseline,
+    run_paths,
+    save_baseline,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rplint",
+        description="AST invariant checker for the redpanda_tpu codebase",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["redpanda_tpu"],
+        help="files or directories to scan (default: redpanda_tpu)",
+    )
+    parser.add_argument(
+        "--baseline",
+        action="store_true",
+        help=f"subtract entries recorded in {BASELINE_PATH}",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline file from the current findings and exit 0",
+    )
+    parser.add_argument(
+        "--rules",
+        metavar="RPL001,RPL002",
+        help="comma-separated subset of rule codes to run",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        rules = None
+        if args.rules:
+            from .engine import default_rules
+
+            wanted = {r.strip().upper() for r in args.rules.split(",")}
+            rules = [r for r in default_rules() if r.code in wanted]
+            unknown = wanted - {r.code for r in rules}
+            if unknown:
+                raise LintError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+
+        findings = run_paths(list(args.paths), rules=rules)
+
+        if args.update_baseline:
+            save_baseline(findings)
+            print(
+                f"baseline updated: {len(findings)} finding(s) -> {BASELINE_PATH}"
+            )
+            return 0
+
+        if args.baseline:
+            findings = apply_baseline(findings, load_baseline())
+
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"rplint: {len(findings)} finding(s)", file=sys.stderr)
+            return 1
+        return 0
+    except LintError as e:
+        print(f"rplint: error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

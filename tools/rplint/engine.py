@@ -1,0 +1,253 @@
+"""rplint rule engine: file walking, AST parsing, suppression and
+baseline bookkeeping shared by every rule.
+
+A rule is an object with:
+  code     -- "RPL00x"
+  name     -- short slug for --list-rules
+  check(ctx) -> iterable[Finding]
+
+`ctx` is a ModuleContext: one parsed file plus the helpers rules need
+(qualname-aware function iteration, dotted-name resolution). Rules
+never read the filesystem themselves — the engine owns IO so the whole
+suite stays stdlib-only and trivially testable against tmp fixtures.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+_SUPPRESS_RE = re.compile(r"#\s*rplint:\s*disable=([A-Z0-9,\s]+)")
+
+
+class LintError(Exception):
+    """Internal analyzer failure (exit code 2), as opposed to findings."""
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # posix-style path relative to the scan root
+    line: int  # 1-based line of the offending statement
+    col: int
+    rule: str
+    message: str
+    qualname: str = ""  # enclosing function, "" at module level
+
+    @property
+    def key(self) -> str:
+        """Baseline identity: line numbers drift, scopes rarely do."""
+        return f"{self.path}::{self.qualname or '<module>'}::{self.rule}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclass
+class FunctionScope:
+    qualname: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    is_async: bool
+    parents: tuple = ()  # enclosing FunctionDef/ClassDef nodes, outermost first
+
+
+@dataclass
+class ModuleContext:
+    path: str  # relative posix path
+    abs_path: str
+    tree: ast.Module
+    source: str
+    suppressions: dict[int, set[str]]  # line -> rules disabled there
+    _functions: list[FunctionScope] = field(default_factory=list)
+
+    def functions(self) -> list[FunctionScope]:
+        if not self._functions:
+            self._collect(self.tree, prefix="", parents=())
+        return self._functions
+
+    def _collect(self, node: ast.AST, prefix: str, parents: tuple) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                self._functions.append(
+                    FunctionScope(
+                        qualname=qn,
+                        node=child,
+                        is_async=isinstance(child, ast.AsyncFunctionDef),
+                        parents=parents,
+                    )
+                )
+                self._collect(child, prefix=qn + ".", parents=parents + (child,))
+            elif isinstance(child, ast.ClassDef):
+                self._collect(
+                    child, prefix=f"{prefix}{child.name}.", parents=parents + (child,)
+                )
+            else:
+                self._collect(child, prefix=prefix, parents=parents)
+
+    def suppressed(self, node: ast.AST, rule: str) -> bool:
+        """True if any line spanned by `node` carries a disable comment
+        for `rule` (so the comment can sit on any line of a multi-line
+        statement, including the closing paren)."""
+        start = getattr(node, "lineno", 0)
+        end = getattr(node, "end_lineno", start)
+        for line in range(start, end + 1):
+            if rule in self.suppressions.get(line, ()):
+                return True
+        return False
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression: `np.maximum.at` ->
+    "np.maximum.at", `touch` -> "touch". Unresolvable parts (calls,
+    subscripts) contribute "?" so callers can still suffix-match."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{dotted_name(node.value)}.{node.attr}"
+    if isinstance(node, ast.Call):
+        return f"{dotted_name(node.func)}()"
+    if isinstance(node, ast.Subscript):
+        return f"{dotted_name(node.value)}[]"
+    return "?"
+
+
+def _collect_suppressions(source: str) -> dict[int, set[str]]:
+    out: dict[int, set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(iter(source.splitlines(True)).__next__)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                out.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        pass  # parse errors surface via ast.parse instead
+    return out
+
+
+def parse_module(abs_path: str, rel_path: str) -> ModuleContext:
+    try:
+        with open(abs_path, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=rel_path)
+    except (OSError, SyntaxError, ValueError) as e:
+        raise LintError(f"{rel_path}: cannot parse: {e}") from e
+    return ModuleContext(
+        path=rel_path,
+        abs_path=abs_path,
+        tree=tree,
+        source=source,
+        suppressions=_collect_suppressions(source),
+    )
+
+
+def iter_python_files(paths: list[str]) -> list[tuple[str, str]]:
+    """(abs_path, rel_path) for every .py under `paths`, rel to cwd
+    when possible so finding keys are stable across machines."""
+    out: list[tuple[str, str]] = []
+    cwd = os.getcwd()
+
+    def rel(p: str) -> str:
+        ap = os.path.abspath(p)
+        try:
+            r = os.path.relpath(ap, cwd)
+        except ValueError:  # different drive (windows)
+            return ap.replace(os.sep, "/")
+        return (ap if r.startswith("..") else r).replace(os.sep, "/")
+
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                out.append((os.path.abspath(path), rel(path)))
+            continue
+        if not os.path.isdir(path):
+            raise LintError(f"no such file or directory: {path}")
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d for d in dirs if d not in ("__pycache__", ".git", "build")
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    full = os.path.join(root, name)
+                    out.append((os.path.abspath(full), rel(full)))
+    return out
+
+
+def default_rules() -> list:
+    from .rules import ALL_RULES
+
+    return [cls() for cls in ALL_RULES]
+
+
+def run_paths(
+    paths: list[str], rules: list | None = None
+) -> list[Finding]:
+    """Lint every python file under `paths`; returns raw findings
+    (suppressions applied, baseline NOT applied)."""
+    if rules is None:
+        rules = default_rules()
+    findings: list[Finding] = []
+    for abs_path, rel_path in iter_python_files(paths):
+        ctx = parse_module(abs_path, rel_path)
+        for rule in rules:
+            findings.extend(rule.check(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# -- baseline ----------------------------------------------------------
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str | None = None) -> dict[str, int]:
+    path = path or BASELINE_PATH
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise LintError(f"baseline {path}: {e}") from e
+    entries = data.get("entries", {})
+    if not isinstance(entries, dict):
+        raise LintError(f"baseline {path}: 'entries' must be an object")
+    return {str(k): int(v) for k, v in entries.items()}
+
+
+def save_baseline(findings: list[Finding], path: str | None = None) -> None:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    path = path or BASELINE_PATH
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {"version": 1, "entries": dict(sorted(counts.items()))},
+            f,
+            indent=2,
+        )
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict[str, int]
+) -> list[Finding]:
+    """Subtract baselined counts per key; the excess (new findings in
+    that scope) is reported. Reported findings within a key are the
+    LAST ones by line — newly added code tends to sit below old."""
+    by_key: dict[str, list[Finding]] = {}
+    for f in findings:
+        by_key.setdefault(f.key, []).append(f)
+    out: list[Finding] = []
+    for key, group in by_key.items():
+        allowed = baseline.get(key, 0)
+        if len(group) > allowed:
+            out.extend(group[allowed:])
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out

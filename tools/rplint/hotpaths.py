@@ -1,0 +1,46 @@
+"""Hot-path manifest for RPL002 (host-sync-in-hot-path).
+
+These are the functions on the tick/serve/heartbeat axis — the paths
+whose per-call budget at 50k groups is microseconds, where a single
+device materialization (`.item()`, `block_until_ready`,
+`np.asarray(device_value)`) stalls the event loop on a host<->device
+round-trip and starves every other group's heartbeat.
+
+Keys are path suffixes (posix separators) matched with endswith();
+values are sets of function qualnames within that module. A function
+can also opt itself in from source with a `# rplint: hot` comment on
+its `def` line — fixtures and new subsystems use that form so hotness
+lives next to the code.
+"""
+
+HOT_FUNCTIONS: dict[str, set[str]] = {
+    "redpanda_tpu/raft/shard_state.py": {
+        "ShardGroupArrays.host_tick",
+        "ShardGroupArrays.device_tick",
+        "ShardGroupArrays.term_at_batch",
+        "ShardGroupArrays.scalar_commit_update",
+        "ShardGroupArrays.same_fingerprint",
+        "term_at_batch_cached",
+    },
+    "redpanda_tpu/raft/heartbeat_manager.py": {
+        "HeartbeatManager.tick",
+        "HeartbeatManager._handle_failure",
+        "_PeerPlan.col2",
+        "_PeerPlan.lane1",
+        "_PeerPlan.prev_terms_cached",
+    },
+    "redpanda_tpu/raft/service.py": {
+        "RaftService.heartbeat",
+        "RaftService.heartbeat_same",
+        "RaftService._resolve_batch",
+        "RaftService._prev_terms_cached",
+    },
+    "redpanda_tpu/raft/consensus.py": {
+        "Consensus.handle_heartbeat",
+        "Consensus.process_append_reply",
+        "Consensus.kick_quorum_ackers",
+    },
+    "redpanda_tpu/raft/group_manager.py": {
+        "GroupManager._election_sweeper",
+    },
+}

@@ -1,0 +1,19 @@
+"""rplint rule registry: one module per rule, each grounded in a real
+invariant of this codebase (see each module's docstring for the
+contract and the production incident shape it guards against)."""
+
+from .rpl001_same_touch import SameLaneTouchRule
+from .rpl002_host_sync import HostSyncInHotPathRule
+from .rpl003_jit_purity import JitPurityRule
+from .rpl004_blocking_async import BlockingInAsyncRule
+from .rpl005_cancelled_swallow import CancelledSwallowRule
+
+ALL_RULES = [
+    SameLaneTouchRule,
+    HostSyncInHotPathRule,
+    JitPurityRule,
+    BlockingInAsyncRule,
+    CancelledSwallowRule,
+]
+
+__all__ = ["ALL_RULES"]

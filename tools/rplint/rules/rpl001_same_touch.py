@@ -1,0 +1,193 @@
+"""RPL001 — SAME-lane writes must bump mut_epoch via touch().
+
+The quiesced SAME-frame heartbeat path (raft/shard_state.py) is armed
+against a snapshot of `mut_epoch`; a write to any lane listed in
+`ShardGroupArrays.SAME_LANES` that does not bump the epoch leaves an
+armed leader serving stale O(1) frames for up to FORCE_FULL_EVERY
+ticks — the exact failure the RP_SAME_DEBUG runtime fingerprint
+catches, but only when a test happens to drive that write site. This
+rule closes it at review time: every function in `raft/` that mutates
+a SAME lane must also call touch() (coarse on purpose — mut_epoch is
+a frame-level invalidation, so a single bump anywhere in the same
+synchronous mutation scope is sufficient), or carry an explicit
+`# rplint: disable=RPL001` stating why the write cannot affect an
+armed frame (e.g. row construction before registration).
+
+Detected mutation forms:
+  arrays.term[row] = v            subscript assign
+  arrays.match_index[r, s] += v   augmented assign
+  arrays.commit_index = other     attribute rebind (whole-lane swap)
+  np.copyto(arrays.term, v)       copyto into a lane
+  np.maximum.at(arrays.match_index, idx, v)   ufunc .at scatter
+
+`__init__` methods are exempt: a row/array under construction cannot
+be covered by an armed frame yet.
+
+The lane list is read from shard_state.py's SAME_LANES tuple when the
+file is reachable from the scan root (self-maintaining: adding a lane
+extends the rule), with a pinned fallback for fixture runs.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..engine import Finding, ModuleContext, dotted_name
+
+# fallback if shard_state.py is not under the scan root (fixtures)
+_FALLBACK_LANES = (
+    "term",
+    "is_leader",
+    "is_follower",
+    "match_index",
+    "flushed_index",
+    "commit_index",
+    "log_start",
+    "snap_index",
+)
+
+_MUTATOR_CALLS = ("copyto",)  # np.copyto(lane, ...)
+
+
+def _load_lanes_from_source(path: str) -> tuple[str, ...] | None:
+    """Parse `SAME_LANES = ("a", "b", ...)` out of shard_state.py
+    without importing it (no numpy dependency for the linter)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "SAME_LANES":
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        vals = [
+                            e.value
+                            for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        ]
+                        if vals:
+                            return tuple(vals)
+    return None
+
+
+class SameLaneTouchRule:
+    code = "RPL001"
+    name = "same-lane-touch"
+
+    def __init__(self) -> None:
+        self._lanes: tuple[str, ...] | None = None
+
+    def _lanes_for(self, ctx: ModuleContext) -> tuple[str, ...]:
+        if self._lanes is not None:
+            return self._lanes
+        # look for shard_state.py near the scanned file: the defining
+        # module itself, a sibling, or the canonical repo location
+        cand = [
+            os.path.join(os.path.dirname(ctx.abs_path), "shard_state.py"),
+            os.path.join(os.getcwd(), "redpanda_tpu", "raft", "shard_state.py"),
+        ]
+        for path in cand:
+            lanes = _load_lanes_from_source(path)
+            if lanes:
+                self._lanes = lanes
+                return lanes
+        self._lanes = _FALLBACK_LANES
+        return self._lanes
+
+    def _in_scope(self, ctx: ModuleContext) -> bool:
+        parts = ctx.path.split("/")
+        return "raft" in parts[:-1]
+
+    def check(self, ctx: ModuleContext):
+        if not self._in_scope(ctx):
+            return
+        lanes = self._lanes_for(ctx)
+        for fn in ctx.functions():
+            if fn.node.name == "__init__":
+                continue
+            mutations = self._lane_mutations(fn.node, lanes)
+            if not mutations:
+                continue
+            if self._calls_touch(fn.node):
+                continue
+            for node, lane in mutations:
+                if ctx.suppressed(node, self.code):
+                    continue
+                yield Finding(
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.code,
+                    message=(
+                        f"SAME lane '{lane}' mutated but '{fn.qualname}' "
+                        "never calls touch(): an armed SAME-frame "
+                        "heartbeat would keep serving stale state"
+                    ),
+                    qualname=fn.qualname,
+                )
+
+    # -- helpers ------------------------------------------------------
+
+    def _own_statements(self, func: ast.AST):
+        """Walk the function body, not descending into nested defs
+        (a nested function mutating a lane is its own scope)."""
+        stack = list(getattr(func, "body", []))
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue
+                stack.append(child)
+
+    def _lane_attr(self, node: ast.AST, lanes) -> str | None:
+        """lane name if `node` is (a subscript of) an attribute whose
+        terminal name is a SAME lane, e.g. `self.arrays.term[r]`."""
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Attribute) and node.attr in lanes:
+            return node.attr
+        return None
+
+    def _lane_mutations(self, func: ast.AST, lanes):
+        out = []
+        for node in self._own_statements(func):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    for el in self._flatten_targets(tgt):
+                        lane = self._lane_attr(el, lanes)
+                        if lane:
+                            out.append((node, lane))
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                lane = self._lane_attr(node.target, lanes)
+                if lane:
+                    out.append((node, lane))
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                last = name.rsplit(".", 1)[-1]
+                if (last in _MUTATOR_CALLS or name.endswith(".at")) and node.args:
+                    lane = self._lane_attr(node.args[0], lanes)
+                    if lane:
+                        out.append((node, lane))
+        return out
+
+    def _flatten_targets(self, tgt: ast.AST):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                yield from self._flatten_targets(el)
+        else:
+            yield tgt
+
+    def _calls_touch(self, func: ast.AST) -> bool:
+        for node in self._own_statements(func):
+            if isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                if fname == "touch" or fname.endswith(".touch"):
+                    return True
+        return False

@@ -1,0 +1,134 @@
+"""RPL003 — jit-compiled functions must be pure traces.
+
+`jax.jit` traces the function ONCE per input signature and replays the
+compiled XLA executable afterwards. Anything that happens at trace
+time only — `print`, `time.time()`, `random.random()`, reading
+`os.environ`, mutating a module global — silently bakes the first
+call's value into every subsequent call, which is exactly the class of
+bug that passes a one-shot unit test and corrupts state in a steady
+loop. This rule flags those calls inside any function compiled with
+jit, however the compilation is spelled:
+
+  @jax.jit                                   decorator
+  @functools.partial(jax.jit, static_argnums=(2,))
+  crc_jit = jax.jit(_crc_impl)               module-level wrap
+  return jax.jit(kernel)                     factory return
+
+For the wrap/factory forms the rule resolves the wrapped name to a
+function defined in the same module and checks that function's body.
+
+`jax.debug.print` / `jax.debug.callback` are the sanctioned escape
+hatches and are not flagged. Reads of globals are fine (closures over
+static config are idiomatic); only the `global` statement (a write) is
+flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext, dotted_name
+
+_BANNED_PREFIXES = (
+    "time.",
+    "random.",
+    "np.random.",
+    "numpy.random.",
+    "os.environ.",
+)
+_BANNED_CALLS = ("print", "os.getenv", "input", "open")
+_ALLOWED = ("jax.debug.print", "jax.debug.callback", "jax.debug.breakpoint")
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for `jax.jit`, `jit`, `partial(jax.jit, ...)`,
+    `functools.partial(jax.jit, ...)`."""
+    name = dotted_name(node)
+    if name in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in ("partial", "functools.partial") and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+class JitPurityRule:
+    code = "RPL003"
+    name = "jit-purity"
+
+    def check(self, ctx: ModuleContext):
+        jitted = self._jitted_functions(ctx)
+        for fn in jitted:
+            yield from self._check_body(ctx, fn)
+
+    def _jitted_functions(self, ctx: ModuleContext):
+        by_name: dict[str, object] = {}
+        for fn in ctx.functions():
+            by_name[fn.node.name] = fn
+            by_name[fn.qualname] = fn
+
+        jitted: dict[str, object] = {}  # qualname -> FunctionScope
+
+        def mark(target: ast.AST) -> None:
+            """Resolve a jit(...) argument back to a same-module def."""
+            name = dotted_name(target)
+            fn = by_name.get(name) or by_name.get(name.rsplit(".", 1)[-1])
+            if fn is not None:
+                jitted[fn.qualname] = fn
+
+        for fn in ctx.functions():
+            for dec in getattr(fn.node, "decorator_list", []):
+                if _is_jit_expr(dec):
+                    jitted[fn.qualname] = fn
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and dotted_name(node.func) in (
+                "jax.jit",
+                "jit",
+            ):
+                if node.args:
+                    mark(node.args[0])
+            elif (
+                isinstance(node, ast.Call)
+                and dotted_name(node.func) in ("partial", "functools.partial")
+                and node.args
+                and dotted_name(node.args[0]) in ("jax.jit", "jit")
+                and len(node.args) > 1
+            ):
+                mark(node.args[1])
+        return list(jitted.values())
+
+    def _check_body(self, ctx: ModuleContext, fn):
+        for node in ast.walk(fn.node):
+            finding = None
+            if isinstance(node, ast.Call):
+                finding = self._impure_call(node)
+            elif isinstance(node, ast.Global):
+                finding = "'global' statement (trace-time global mutation)"
+            elif isinstance(node, ast.Subscript):
+                if dotted_name(node.value) == "os.environ":
+                    finding = "os.environ read (baked in at trace time)"
+            if finding is None or ctx.suppressed(node, self.code):
+                continue
+            yield Finding(
+                path=ctx.path,
+                line=node.lineno,
+                col=node.col_offset,
+                rule=self.code,
+                message=(
+                    f"{finding} inside jit-compiled '{fn.qualname}': runs "
+                    "once at trace time, then the first value replays forever"
+                ),
+                qualname=fn.qualname,
+            )
+
+    def _impure_call(self, call: ast.Call) -> str | None:
+        name = dotted_name(call.func)
+        if name in _ALLOWED or name.startswith("jax.debug."):
+            return None
+        if name in _BANNED_CALLS:
+            return f"call to '{name}()'"
+        for prefix in _BANNED_PREFIXES:
+            if name.startswith(prefix):
+                return f"call to '{name}()'"
+        return None

@@ -1,0 +1,88 @@
+"""RPL004 — no synchronous blocking calls inside async def bodies.
+
+The whole node runs on ONE event loop (1 core per VM in the paper's
+deployment): a `time.sleep(0.05)` inside any coroutine freezes every
+raft group's heartbeat on that node for 50 ms — one leader's stall is
+every group's missed deadline. The same goes for synchronous file IO
+(`open`/`.read`/`.write` on a file object) and `subprocess.*` calls.
+
+Scope: async functions in `rpc/`, `raft/` and `admin/` — the serving
+tree. Batch tools and tests can block freely.
+
+Sanctioned patterns, not flagged:
+  await asyncio.sleep(...)           (it's awaited)
+  loop.run_in_executor(None, fn)     (blocking work moved off-loop)
+  await asyncio.to_thread(fn)
+
+Deliberate cold-path IO (snapshot chunk streaming) carries
+`# rplint: disable=RPL004` with a justification comment.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext, dotted_name
+
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep() blocks the event loop",
+    "open": "synchronous open() on the event loop",
+    "subprocess.run": "subprocess.run() blocks the event loop",
+    "subprocess.call": "subprocess.call() blocks the event loop",
+    "subprocess.check_call": "subprocess.check_call() blocks the event loop",
+    "subprocess.check_output": "subprocess.check_output() blocks the event loop",
+    "subprocess.Popen.wait": "Popen.wait() blocks the event loop",
+    "os.system": "os.system() blocks the event loop",
+}
+
+_SCOPE_DIRS = ("rpc", "raft", "admin")
+
+
+class BlockingInAsyncRule:
+    code = "RPL004"
+    name = "blocking-in-async"
+
+    def _in_scope(self, ctx: ModuleContext) -> bool:
+        parts = ctx.path.split("/")[:-1]
+        return any(d in parts for d in _SCOPE_DIRS)
+
+    def check(self, ctx: ModuleContext):
+        if not self._in_scope(ctx):
+            return
+        for fn in ctx.functions():
+            if not fn.is_async:
+                continue
+            for node in self._own_nodes(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._blocking(node)
+                if msg is None or ctx.suppressed(node, self.code):
+                    continue
+                yield Finding(
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.code,
+                    message=f"{msg} in async '{fn.qualname}'",
+                    qualname=fn.qualname,
+                )
+
+    def _own_nodes(self, func: ast.AST):
+        """Body nodes excluding nested function defs — a sync helper
+        defined inside a coroutine runs wherever it's called from."""
+        stack = list(getattr(func, "body", []))
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                stack.append(child)
+
+    def _blocking(self, call: ast.Call) -> str | None:
+        name = dotted_name(call.func)
+        if name in _BLOCKING_CALLS:
+            return _BLOCKING_CALLS[name]
+        if name.startswith("subprocess."):
+            return f"'{name}()' blocks the event loop"
+        return None

@@ -1,0 +1,143 @@
+"""RPL005 — except clauses in coroutines must not eat CancelledError.
+
+Task teardown in asyncio is delivered as `asyncio.CancelledError`
+raised at the `await` point. A handler that catches it and does not
+re-raise turns `task.cancel()` into a no-op: the coroutine keeps
+looping, `stop()` hangs on `await task`, and shutdown deadlocks —
+the classic "drain loop won't die" incident.
+
+On Python >= 3.8 `CancelledError` derives from `BaseException`, so a
+plain `except Exception:` genuinely lets it propagate. What CAN still
+swallow it, and what this rule flags inside any `async def` whose
+`try` body contains an `await`:
+
+  except:                    (bare)          without a bare `raise`
+  except BaseException:                      without re-raising
+
+plus the belt-and-suspenders case people write by muscle memory:
+
+  except Exception: pass     pure swallow with nothing else in the
+                             handler — harmless for cancellation on
+                             3.8+, but it hides real faults in a loop
+                             that is supposed to surface them.
+
+A clause is exempt when:
+  - its body contains a bare `raise` (or `raise e` of the bound name),
+  - an EARLIER clause on the same try already handles
+    `asyncio.CancelledError` (the later clause can never see it),
+  - it carries `# rplint: disable=RPL005`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext, dotted_name
+
+
+def _catches(handler: ast.ExceptHandler, names: tuple[str, ...]) -> bool:
+    t = handler.type
+    types = t.elts if isinstance(t, ast.Tuple) else [t] if t else []
+    for el in types:
+        dn = dotted_name(el)
+        if dn in names or dn.rsplit(".", 1)[-1] in names:
+            return True
+    return False
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            if node.exc is None:
+                return True
+            if (
+                bound
+                and isinstance(node.exc, ast.Name)
+                and node.exc.id == bound
+            ):
+                return True
+    return False
+
+
+def _pure_swallow(handler: ast.ExceptHandler) -> bool:
+    """Body is only pass/continue (optionally after a log-free `...`)."""
+    for stmt in handler.body:
+        if not isinstance(stmt, (ast.Pass, ast.Continue)):
+            return False
+    return True
+
+
+def _has_await(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+                return True
+    return False
+
+
+class CancelledSwallowRule:
+    code = "RPL005"
+    name = "cancelled-error-swallow"
+
+    def check(self, ctx: ModuleContext):
+        for fn in ctx.functions():
+            if not fn.is_async:
+                continue
+            for node in self._own_nodes(fn.node):
+                if not isinstance(node, ast.Try):
+                    continue
+                if not _has_await(node.body):
+                    continue  # nothing in this try can be cancelled
+                yield from self._check_try(ctx, fn, node)
+
+    def _own_nodes(self, func: ast.AST):
+        stack = list(getattr(func, "body", []))
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                stack.append(child)
+
+    def _check_try(self, ctx: ModuleContext, fn, node: ast.Try):
+        cancelled_handled = False
+        for handler in node.handlers:
+            if _catches(handler, ("CancelledError",)):
+                cancelled_handled = True
+                continue
+            msg = None
+            if handler.type is None or _catches(handler, ("BaseException",)):
+                if not cancelled_handled and not _reraises(handler):
+                    what = (
+                        "bare 'except:'"
+                        if handler.type is None
+                        else "'except BaseException:'"
+                    )
+                    msg = (
+                        f"{what} swallows asyncio.CancelledError in "
+                        f"'{fn.qualname}': task.cancel() becomes a no-op"
+                    )
+            elif _catches(handler, ("Exception",)):
+                if (
+                    not cancelled_handled
+                    and _pure_swallow(handler)
+                    and not _reraises(handler)
+                ):
+                    msg = (
+                        "'except Exception: pass' around an await in "
+                        f"'{fn.qualname}' hides faults in a cancellable loop"
+                    )
+            if msg is None:
+                continue
+            if ctx.suppressed(handler, self.code):
+                continue
+            yield Finding(
+                path=ctx.path,
+                line=handler.lineno,
+                col=handler.col_offset,
+                rule=self.code,
+                message=msg,
+                qualname=fn.qualname,
+            )

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Full local verification: static analysis first (fails in seconds on
+# a broken invariant, before 10+ minutes of tests), then the tier-1
+# suite with the same flags the driver uses.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== rplint (baseline gate) =="
+python -m tools.rplint --baseline redpanda_tpu
+
+echo "== tier-1 tests =="
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly "$@"
