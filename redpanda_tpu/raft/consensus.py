@@ -39,6 +39,7 @@ from ..storage import snapshot as snapfmt
 from ..storage.kvstore import KeySpace, KvStore, KvStoreClosed
 from ..storage.log import Log
 from ..utils import serde, spans
+from ..utils.retry_chain import RetryChainAborted, RetryChainNode
 from . import quorum_scalar as qs
 from . import types as rt
 from .configuration import GroupConfiguration
@@ -99,6 +100,17 @@ class Consensus:
         # (raft/recovery.py; ref recovery_throttle.h) — None in unit
         # fixtures that build Consensus directly
         self.recovery_throttle = recovery_throttle
+        # unified retry budget for the remote send loops (catch-up
+        # backoff, snapshot chunks): a child of the node-wide root when
+        # one is wired, so a node-level abort cancels every group's
+        # nested retries; standalone fixtures own a private root
+        parent = getattr(recovery_throttle, "retry_root", None)
+        self._own_retry_root = parent is None
+        self._retry_root = (
+            RetryChainNode(base_backoff_s=0.02, max_backoff_s=0.5)
+            if parent is None
+            else parent.child()
+        )
 
         self.row = arrays.alloc_row()
         self._role = Role.FOLLOWER
@@ -439,6 +451,10 @@ class Consensus:
 
     async def stop(self) -> None:
         self._closed = True
+        if self._own_retry_root:
+            # shared roots belong to the node (GroupManager aborts
+            # them); aborting one here would kill sibling groups' loops
+            self._retry_root.abort()
         await self._batcher.stop()
         for t in self._bg_tasks:
             t.cancel()
@@ -547,6 +563,21 @@ class Consensus:
             return
         try:
             if await self.dispatch_prevote():
+                # Re-check leader liveness before mutating ANY term
+                # state: on a loaded host the sweeper can observe a
+                # stale _last_heartbeat after a loop stall, win the
+                # (stateless) prevote off equally stale observers, and
+                # only HERE — after the prevote gather's awaits drained
+                # the queued heartbeats — is the truth visible. An
+                # election that was a scheduling artifact aborts with
+                # terms untouched.
+                now = asyncio.get_event_loop().time()
+                if (
+                    self._closed
+                    or self.role == Role.LEADER
+                    or now - self._last_heartbeat < self._election_timeout
+                ):
+                    return
                 await self.dispatch_vote()
         except Exception:
             logger.exception("g%d: election round failed", self.group_id)
@@ -1207,6 +1238,7 @@ class Consensus:
 
     async def _catch_up_locked(self, peer: int) -> None:
         rounds = 0
+        chain = self._retry_root.child()
         while (
             not self._closed
             and self.role == Role.LEADER
@@ -1240,10 +1272,19 @@ class Consensus:
             )
             if after <= before:
                 # no forward progress this round (mismatch backoff,
-                # reordered reply, stuck follower): yield — a hot
+                # reordered reply, stuck follower): back off — a hot
                 # retry loop here monopolizes the event loop with
-                # full-size append payloads (recovery_stm backoff)
-                await asyncio.sleep(0.02)
+                # full-size append payloads (recovery_stm backoff).
+                # Jittered exponential via the node's retry tree, so
+                # node stop aborts the sleep instead of waiting it out
+                try:
+                    if not await chain.backoff():
+                        return
+                except RetryChainAborted:
+                    return
+            else:
+                # forward progress: re-arm the backoff from the base
+                chain = self._retry_root.child()
 
     def _follower_needs_data(self, peer: int) -> bool:
         slot = self._slot_map[peer]
@@ -1357,6 +1398,15 @@ class Consensus:
         if rep.term > term:
             self._step_down(int(rep.term))
             return False
+        slot = self._slot_map.get(peer)
+        if slot is None:
+            return False  # peer reconfigured away during the rpc
+        # staleness gate BEFORE folding: a duplicated or reordered old
+        # reply (nemesis duplicate/reorder, or a late packet beaten by
+        # a newer round) must move neither next_index nor the mismatch
+        # backoff — process_append_reply has the same guard internally
+        # for match/flushed, but next_index lives host-side here
+        stale = int(rep.seq) <= int(self.arrays.last_seq[row, slot])
         if rep.status == rt.AppendEntriesReply.SUCCESS:
             self._failed_peers.discard(peer)
             self.process_append_reply(
@@ -1365,8 +1415,13 @@ class Consensus:
                 int(rep.last_flushed_log_index),
                 int(rep.seq),
             )
-            self._next_index[peer] = int(rep.last_dirty_log_index) + 1
+            if not stale:
+                self._next_index[peer] = int(rep.last_dirty_log_index) + 1
             return True
+        if stale:
+            return True  # stale mismatch hint: newer evidence already folded
+        self.arrays.last_seq[row, slot] = int(rep.seq)
+        self.arrays.touch()  # last_seq is a SAME lane
         # log mismatch: back off (consensus.cc follower hints)
         self._next_index[peer] = min(
             max(0, next_idx - 1), int(rep.last_dirty_log_index) + 1
@@ -1474,6 +1529,10 @@ class Consensus:
             "g%d: sending snapshot (%d bytes, upto %d) to follower %d",
             self.group_id, len(data), snap_idx, peer,
         )
+        # bounded retry budget for the whole stream: a dropped chunk
+        # rpc no longer abandons the transfer (the old behavior forced
+        # a full stream restart on the next catch-up kick)
+        chain = self._retry_root.child(deadline_s=30.0)
         while True:
             chunk = data[sent : sent + chunk_size]
             done = sent + len(chunk) >= len(data)
@@ -1491,6 +1550,11 @@ class Consensus:
                 raw = await self._send(peer, rt.INSTALL_SNAPSHOT, req, 10.0)
                 rep = rt.InstallSnapshotReply.decode(raw)
             except Exception:
+                try:
+                    if await chain.backoff():
+                        continue  # re-send the same chunk offset
+                except RetryChainAborted:
+                    pass
                 return False
             if self._closed or self.role != Role.LEADER or self.term != term:
                 return False

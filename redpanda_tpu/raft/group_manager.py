@@ -96,6 +96,10 @@ class GroupManager:
             except asyncio.CancelledError:
                 pass
             self._sweeper_task = None
+        # abort the node-wide retry tree FIRST: every group's catch-up
+        # backoff / snapshot retry wakes immediately instead of the
+        # per-group stop() waiting out jittered sleeps
+        self.recovery_throttle.retry_root.abort()
         await self.heartbeat_manager.stop()
         for c in list(self._groups.values()):
             await c.stop()

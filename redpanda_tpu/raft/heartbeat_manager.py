@@ -624,9 +624,17 @@ class HeartbeatManager:
             slot = c._slot_map.get(peer)
             if slot is None:
                 return
+            seq = int(reply.seqs[i])
+            if seq <= int(c.arrays.last_seq[c.row, slot]):
+                # stale echo (duplicated or reordered reply): a newer
+                # reply already folded for this peer — rewinding match
+                # off old evidence would re-trigger catch-up forever
+                # under nemesis duplicate/reorder schedules
+                return
+            c.arrays.last_seq[c.row, slot] = seq
             c.arrays.match_index[c.row, slot] = min(
                 int(c.arrays.match_index[c.row, slot]),
                 int(reply.last_dirty[i]),
             )
-            c.arrays.touch()  # match_index is a SAME lane
+            c.arrays.touch()  # match_index + last_seq are SAME lanes
             c.kick_catch_up(peer)

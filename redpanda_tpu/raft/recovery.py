@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 
+from ..utils.retry_chain import RetryChainNode
 from ..utils.token_bucket import TokenBucket
 
 
@@ -37,6 +38,13 @@ class RecoveryThrottle:
         self._bucket = TokenBucket(rate_bytes_s, rate_bytes_s, 0.0)
         self._sem = asyncio.Semaphore(concurrency)
         self.throttled_s = 0.0  # cumulative wait (probe/metrics)
+        # node-wide retry/abort root (retry_chain_node.h): every
+        # group's send-loop backoff (catch-up rounds, snapshot chunks)
+        # hangs off this tree, so GroupManager.stop() cancels all
+        # nested retries in one abort instead of waiting out sleeps
+        self.retry_root = RetryChainNode(
+            base_backoff_s=0.02, max_backoff_s=0.5
+        )
 
     def set_rate(self, rate_bytes_s: float) -> None:
         """Live binding target (cluster config raft_learner_recovery_rate)."""

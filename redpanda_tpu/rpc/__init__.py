@@ -10,7 +10,7 @@ connection cache.
 from .types import FrameHeader, RpcError, Status
 from .transport import Transport, TcpTransport, ReconnectTransport
 from .server import RpcServer, Service, method
-from .loopback import LoopbackNetwork, LoopbackTransport
+from .loopback import LoopbackNetwork, LoopbackTransport, NemesisSchedule, NetRule
 from .connection_cache import ConnectionCache
 
 __all__ = [
@@ -25,5 +25,7 @@ __all__ = [
     "method",
     "LoopbackNetwork",
     "LoopbackTransport",
+    "NemesisSchedule",
+    "NetRule",
     "ConnectionCache",
 ]

@@ -39,6 +39,7 @@ from ..models.record import (
     parse_record_descriptors,
 )
 from ..utils.iobuf import IOBufParser
+from . import dirsync
 
 _COMPRESSION_MASK = 0x07
 
@@ -212,6 +213,7 @@ def compact_segment(seg, key_map: dict[bytes, int], participates) -> tuple[int, 
         return 0, 0
     seg._release_handles()  # old inode is about to be replaced
     os.replace(tmp, path)
+    dirsync.fsync_dir(seg._dir)  # rename durable only after dir sync
     if os.path.exists(seg._index_path):
         os.remove(seg._index_path)
     # reopen through recovery: rebuilds the sparse index + offsets from
@@ -245,6 +247,7 @@ def merge_adjacent(log, max_bytes: int) -> int:
         a._release_handles()
         b._release_handles()
         os.replace(tmp, a._path)
+        dirsync.fsync_dir(a._dir)
         for p in (b._path, a._index_path, b._index_path):
             if os.path.exists(p):
                 os.remove(p)

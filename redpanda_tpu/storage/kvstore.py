@@ -23,6 +23,7 @@ import threading
 from typing import Iterator
 
 from ..utils.crc import crc32c
+from . import dirsync
 from . import snapshot as snap
 
 
@@ -68,6 +69,9 @@ class KvStore:
         self._lock = threading.RLock()
         self._recover()
         self._wal = open(self._wal_path, "ab")
+        # first open creates the WAL: its dir entry must be durable
+        # before any acked write lands in it
+        dirsync.fsync_dir(self._dir)
 
     # -- paths -------------------------------------------------------
     @property

@@ -13,7 +13,7 @@ from __future__ import annotations
 import os
 
 from ..models.record import RecordBatch
-from . import file_sanitizer
+from . import dirsync, file_sanitizer
 from .batch_cache import BatchCache, BatchCacheIndex
 from .segment import Segment
 
@@ -551,6 +551,9 @@ class Log:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._start_path)
+        # the rename only durably points the NAME at the new inode
+        # once the directory itself is synced
+        dirsync.fsync_dir(self._dir)
 
     def install_snapshot_reset(self, next_offset: int, term: int) -> None:
         """Drop the ENTIRE log and restart it empty at next_offset —

@@ -24,7 +24,7 @@ import struct
 
 from ..models.record import HEADER_SIZE, RecordBatch, RecordBatchHeader
 from ..utils.crc import crc32c
-from . import file_sanitizer, iofaults
+from . import dirsync, file_sanitizer, iofaults
 
 INDEX_INTERVAL_BYTES = 32 * 1024
 
@@ -115,8 +115,11 @@ class Segment:
         else:
             # the file's existence is what marks this segment (and its
             # base offset) on reopen scans — create it eagerly even
-            # though the append handle itself is lazy
+            # though the append handle itself is lazy, and make the
+            # dir entry durable: an fsynced segment whose NAME never
+            # reached the platter vanishes whole on power loss
             open(self._path, "ab").close()
+            dirsync.fsync_dir(directory)
 
     # -- fd budget ----------------------------------------------------
     def _wfile(self):

@@ -292,6 +292,7 @@ async def run_chaos(
     faults=("partition", "crash", "transfer"),
     tiered: bool = False,
     admin_ops: bool = False,
+    nemesis=None,
 ) -> dict:
     """`tiered=True` runs the same fault schedule against a
     remote.write topic with aggressive segment roll + retention, with
@@ -299,7 +300,14 @@ async def run_chaos(
     the validator's fetch-from-0 then crosses the remote/local seam,
     so I1..I3 hold the whole tiered read path to the acked ground
     truth, and the replicated archival boundary is checked for
-    cluster-wide agreement afterwards."""
+    cluster-wide agreement afterwards.
+
+    `nemesis` (an rpc.loopback.NemesisSchedule) arms probabilistic
+    link faults — drop/dup/reorder/jitter/corrupt — for the whole
+    fault window; it is cleared (like a heal) before the settle +
+    validate phase, and its firing counts ride back in the stats. To
+    replay a run byte-identically, rebuild the same schedule with the
+    same seed (see README "Fault injection")."""
     rng = random.Random(seed)
     store = None
     if tiered:
@@ -308,6 +316,8 @@ async def run_chaos(
         store = MemoryObjectStore()
     cluster = ChaosCluster(tmp_path, n=3, object_store=store)
     await cluster.start()
+    if nemesis is not None:
+        cluster.net.install_nemesis(nemesis)
     housekeeper: asyncio.Task | None = None
     try:
         boot = KafkaClient(cluster.addresses())
@@ -390,6 +400,8 @@ async def run_chaos(
             else:
                 cluster.heal_network()
         cluster.heal_network()
+        if nemesis is not None:
+            cluster.net.clear_nemesis()  # the nemesis heals too
         await asyncio.sleep(1.0)
         producer.stop()
         fuzz_stop[0] = True
@@ -404,6 +416,9 @@ async def run_chaos(
         await asyncio.sleep(0.5)
         stats = await validate(cluster, "chaos", partitions, producer)
         stats["events"] = events
+        if nemesis is not None:
+            stats["nemesis"] = dict(nemesis.injected)
+            stats["nemesis_trace_len"] = len(nemesis.trace)
         if fuzz_task is not None:
             stats["admin_ops"] = admin_counts
         if tiered:

@@ -306,32 +306,61 @@ async def _self_test_distributed(tmp_path):
     """Cluster-wide start/status/stop (self_test_frontend/backend over
     internal RPC): any node coordinates, every node runs, reports
     aggregate, double-start conflicts, stop cancels."""
+    import threading
+
     async with cluster(tmp_path, n=3) as brokers:
         addr = brokers[0].admin.address
-        st, body = await http(
-            addr, "POST", "/v1/debug/self_test/start",
-            {"disk_mb": 2, "net_mb": 1},
-        )
-        assert st == 200, body
-        test_id = body["test_id"]
-        assert all(n["ok"] for n in body["nodes"].values()), body
+        # Gate every node's disk check behind one Event: the first run
+        # is then GUARANTEED still in flight when the double-start
+        # arrives, with no wall-clock assumption about how fast a small
+        # write+fsync completes under full-suite load. The check runs
+        # in an executor thread, so the blocking wait is safe.
+        gate = threading.Event()
+        originals = [
+            (b.self_test_backend, b.self_test_backend._diskcheck)
+            for b in brokers
+        ]
 
-        # a second start while the first still runs must report
-        # per-node conflicts (the 2MB disk check cannot finish between
-        # the two back-to-back requests)
-        st, body2 = await http(
-            addr, "POST", "/v1/debug/self_test/start", {"disk_mb": 2}
-        )
-        conflicts = [n for n in body2["nodes"].values() if not n["ok"]]
-        assert conflicts, body2
-        assert all("already running" in n["error"] for n in conflicts)
+        def gated(orig):
+            def check(size_mb):
+                gate.wait(timeout=30.0)
+                return orig(size_mb)
 
-        for _ in range(200):
+            return check
+
+        for backend, orig in originals:
+            backend._diskcheck = gated(orig)
+        try:
+            st, body = await http(
+                addr, "POST", "/v1/debug/self_test/start",
+                {"disk_mb": 2, "net_mb": 1},
+            )
+            assert st == 200, body
+            test_id = body["test_id"]
+            assert all(n["ok"] for n in body["nodes"].values()), body
+
+            # a second start while the first still runs must report
+            # per-node conflicts on every node (all are gated)
+            st, body2 = await http(
+                addr, "POST", "/v1/debug/self_test/start", {"disk_mb": 2}
+            )
+            conflicts = [n for n in body2["nodes"].values() if not n["ok"]]
+            assert len(conflicts) == 3, body2
+            assert all("already running" in n["error"] for n in conflicts)
+        finally:
+            gate.set()
+            for backend, orig in originals:
+                backend._diskcheck = orig
+
+        deadline = asyncio.get_event_loop().time() + 30.0
+        status = []
+        while asyncio.get_event_loop().time() < deadline:
             st, status = await http(addr, "GET", "/v1/debug/self_test/status")
             assert st == 200
-            if all(n["status"] == "idle" for n in status):
+            if status and all(n["status"] == "idle" for n in status):
                 break
             await asyncio.sleep(0.05)
+        assert status and all(n["status"] == "idle" for n in status), status
         assert {n["node_id"] for n in status} == {0, 1, 2}
         # whichever test ran LAST on each node, its report is complete
         for n in status:
@@ -359,7 +388,6 @@ async def _self_test_distributed(tmp_path):
         assert st == 200
 
 
-@pytest.mark.timing  # 3-broker netcheck windows slip under full-suite load
 def test_self_test_distributed(tmp_path):
     asyncio.run(_self_test_distributed(tmp_path))
 

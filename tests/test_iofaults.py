@@ -63,6 +63,53 @@ def test_rules_fire_and_power_cut_truncates(tmp_path):
     assert sched.injected.get("lie_fsync", 0) == 1
 
 
+def test_dir_entry_durability_unit(tmp_path):
+    """Directory-entry simulation: a file created during the fault
+    window vanishes on power cut unless an HONEST dir fsync captured
+    its name — even when the file's own bytes were fsynced."""
+    from redpanda_tpu.storage import dirsync
+
+    pre = str(tmp_path / "pre.bin")
+    with open(pre, "wb") as f:
+        f.write(b"old")
+    iofaults.install(FaultSchedule(rules=[], seed=7), watch_dir=str(tmp_path))
+
+    def make(path):
+        with open(path, "wb") as f:
+            f.write(b"A" * 64)
+            f.flush()
+            os.fsync(f.fileno())  # bytes synced; entry still volatile
+
+    entry_synced = str(tmp_path / "entry_synced.bin")
+    entry_lost = str(tmp_path / "entry_lost.bin")
+    make(entry_synced)
+    dirsync.fsync_dir(str(tmp_path))  # captures entry_synced (+ pre)
+    make(entry_lost)  # created AFTER the dir sync: entry volatile
+    lost = iofaults.simulate_power_cut(str(tmp_path))
+    assert os.path.exists(pre), "baseline file predates the window"
+    assert os.path.exists(entry_synced), "dir-fsynced entry must survive"
+    assert not os.path.exists(entry_lost), "unsynced entry must vanish"
+    assert (entry_lost, 64, -1) in lost
+
+
+def test_dir_entry_rename_tracks_synced_size(tmp_path):
+    """tmp-write + fsync + os.replace: the synced-size record follows
+    the rename, and the renamed entry is durable once the dir is."""
+    from redpanda_tpu.storage import dirsync
+
+    iofaults.install(FaultSchedule(rules=[], seed=8), watch_dir=str(tmp_path))
+    tmp = str(tmp_path / "state.tmp")
+    final = str(tmp_path / "state")
+    with open(tmp, "wb") as f:
+        f.write(b"S" * 32)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+    dirsync.fsync_dir(str(tmp_path))
+    iofaults.simulate_power_cut(str(tmp_path))
+    assert os.path.getsize(final) == 32  # not truncated to 0
+
+
 def test_write_error_and_delay_rules(tmp_path):
     sched = FaultSchedule(
         rules=[
@@ -194,6 +241,79 @@ def test_lying_fsync_detected_after_power_cut(tmp_path):
     assert missing, (
         "lying fsync + power cut lost nothing — the probe cannot see "
         "the bug class it exists for"
+    )
+
+
+def test_power_cut_dir_entry_durability(tmp_path):
+    """Power cut WITH directory-entry simulation armed: acked data
+    must still survive — the storage layer's parent-dir fsyncs
+    (segments at create, kvstore WAL at open, start-offset and
+    snapshot renames) are what keep every acked file's NAME on the
+    platter, not just its bytes."""
+
+    async def main():
+        iofaults.install(
+            FaultSchedule(rules=[], seed=9), watch_dir=str(tmp_path)
+        )
+        cluster = ChaosCluster(tmp_path, 3)
+        await cluster.start()
+        acked = await _produce_some(cluster, "dirdur", 2, 40)
+        assert len(acked) == 40
+        await cluster.stop()
+        lost = iofaults.simulate_power_cut(str(tmp_path))
+        vanished = [p for p, _o, n in lost if n == -1]
+        for nid in range(3):
+            await cluster.restart(nid)
+        data = await _read_back(cluster, "dirdur", 2)
+        for pid, off, seq in acked:
+            entry = data[pid].get(off)
+            assert entry is not None, (
+                f"p{pid}@{off} (seq {seq}) lost after dir-entry power cut; "
+                f"vanished files: {[os.path.basename(p) for p in vanished][:10]}"
+            )
+            assert entry == (b"seq-%d" % seq, b"payload-%d" % seq)
+        await cluster.stop()
+
+    run(main())
+
+
+def test_lying_dirsync_detected_after_power_cut(tmp_path):
+    """Seeded-bug validation for the dir-entry gap: with every
+    DIRECTORY fsync lying, created files' names never reach the
+    platter, the power cut unlinks them, and read-back MUST observe
+    acked-data loss (proves the probe sees this bug class)."""
+
+    async def main():
+        iofaults.install(
+            FaultSchedule(
+                rules=[Rule(path_glob="*", op="dirsync", action="lie_fsync")],
+                seed=10,
+            ),
+            watch_dir=str(tmp_path),
+        )
+        cluster = ChaosCluster(tmp_path, 3)
+        await cluster.start()
+        acked = await _produce_some(cluster, "dirlie", 2, 30)
+        await cluster.stop()
+        lost = iofaults.simulate_power_cut(str(tmp_path))
+        assert any(n == -1 for _p, _o, n in lost), (
+            "lying dirsync left every entry durable — simulation inert"
+        )
+        for nid in range(3):
+            await cluster.restart(nid)
+        data = await _read_back(cluster, "dirlie", 2, timeout_s=10.0)
+        missing = [
+            (pid, off, seq)
+            for pid, off, seq in acked
+            if data[pid].get(off) != (b"seq-%d" % seq, b"payload-%d" % seq)
+        ]
+        await cluster.stop()
+        return missing
+
+    missing = run(main())
+    assert missing, (
+        "lying dirsync + power cut lost nothing — the probe cannot see "
+        "the dir-entry bug class it exists for"
     )
 
 

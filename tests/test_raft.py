@@ -273,11 +273,16 @@ def test_state_machine_applies_committed(tmp_path):
     run(main())
 
 
-@pytest.mark.timing  # fixed isolate/heal sleeps vs election windows
 def test_prevote_isolated_node_does_not_bump_terms(tmp_path):
     """A partitioned node must not advance its term (prevote_stm.cc):
     its prevotes go unanswered, so the real election never starts, and
-    on heal it rejoins without forcing the leader to step down."""
+    on heal it rejoins without forcing the leader to step down.
+
+    (Previously retry-marked: a loop stall could queue heartbeats
+    across the prevote gather, so a node whose prevote round succeeded
+    off stale silence went on to bump terms cluster-wide. try_election
+    now re-checks leader liveness between the prevote and vote phases,
+    so the race is fixed rather than retried away.)"""
 
     async def main():
         cluster = RaftCluster(tmp_path, n_nodes=3)

@@ -397,6 +397,80 @@ def test_rpl005_suppression(tmp_path):
     assert _only(_lint_source(tmp_path, src), "RPL005") == []
 
 
+# -- RPL006: network awaits need a budget ------------------------------
+
+
+RPL006_BAD = """\
+async def push(self):
+    await self.transport.send(self.peer, 7, b"x")
+"""
+
+
+def test_rpl006_reports_unbudgeted_send(tmp_path):
+    findings = _only(
+        _lint_source(tmp_path, RPL006_BAD, "rpc/mod.py"), "RPL006"
+    )
+    assert [(f.line, f.qualname) for f in findings] == [(2, "push")]
+    assert "RetryChainNode" in findings[0].message
+
+
+def test_rpl006_timeout_kwarg_or_positional_slot_bounds(tmp_path):
+    src = """\
+    async def push(self):
+        await self.transport.send(self.peer, 7, b"x", timeout=5.0)
+        await self._send(self.peer, 7, b"x", 5.0)
+        await self.t.call(7, b"x", self._rpc_timeout)
+    """
+    assert _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL006") == []
+
+
+def test_rpl006_async_with_timeout_guard_exempts(tmp_path):
+    src = """\
+    async def push(self):
+        async with asyncio.timeout(2.0):
+            await self.net.deliver(1, 2, 7, b"x")
+    """
+    assert _only(_lint_source(tmp_path, src, "rpc/mod.py"), "RPL006") == []
+
+
+def test_rpl006_stored_coroutine_await_flagged(tmp_path):
+    src = """\
+    async def push(self):
+        coro = self.net.deliver(1, 2, 7, b"x")
+        return await coro
+    """
+    findings = _only(_lint_source(tmp_path, src, "rpc/mod.py"), "RPL006")
+    assert [f.line for f in findings] == [3]
+
+
+def test_rpl006_retry_chain_budget_exempts(tmp_path):
+    src = """\
+    async def push(self):
+        chain = self._retry_root.child(deadline_s=30.0)
+        while True:
+            await self.transport.send(self.peer, 7, b"x")
+            if not await chain.backoff():
+                return
+    """
+    assert _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL006") == []
+
+
+def test_rpl006_out_of_scope_dir_not_flagged(tmp_path):
+    assert (
+        _only(_lint_source(tmp_path, RPL006_BAD, "storage/mod.py"), "RPL006")
+        == []
+    )
+
+
+def test_rpl006_suppression(tmp_path):
+    src = RPL006_BAD.replace(
+        'await self.transport.send(self.peer, 7, b"x")',
+        'await self.transport.send(self.peer, 7, b"x")'
+        "  # rplint: disable=RPL006",
+    )
+    assert _only(_lint_source(tmp_path, src, "rpc/mod.py"), "RPL006") == []
+
+
 # -- baseline mechanics ------------------------------------------------
 
 

@@ -7,6 +7,7 @@ from .rpl002_host_sync import HostSyncInHotPathRule
 from .rpl003_jit_purity import JitPurityRule
 from .rpl004_blocking_async import BlockingInAsyncRule
 from .rpl005_cancelled_swallow import CancelledSwallowRule
+from .rpl006_net_await_budget import NetAwaitBudgetRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -14,6 +15,7 @@ ALL_RULES = [
     JitPurityRule,
     BlockingInAsyncRule,
     CancelledSwallowRule,
+    NetAwaitBudgetRule,
 ]
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,168 @@
+"""RPL006 — awaited network sends must carry an explicit budget.
+
+Under NemesisNet schedules (rpc/loopback.py) a link can silently drop,
+hold, or slow every message: an `await` on a send/deliver path with no
+timeout and no retry-chain budget turns one lost packet into a fiber
+wedged forever — the exact shape of the recovery stalls the chaos
+suite hunts. Every awaited network call must be bounded by one of:
+
+  * a `timeout` argument (keyword, or the transport convention's
+    positional slot: 4th for `send`/`_send`, 3rd for `call`);
+  * an enclosing `async with asyncio.timeout(...)` /
+    `asyncio.wait_for(...)` wrapper;
+  * a function-scope RetryChainNode budget (`utils/retry_chain.py`) —
+    the loop's `backoff()` carries the deadline, so the individual
+    sends inside it may rely on it.
+
+Scope: async functions in `rpc/`, `raft/` and `admin/` — the serving
+tree. Flagged calls: `.send(...)`, `._send(...)`, `.deliver(...)`,
+`.call(...)`, plus `await x` where `x` was assigned from one of those
+in the same function (the stored-coroutine idiom).
+
+Deliberate unbounded awaits (e.g. a transport's timeout=None pass-
+through, where the CALLER owns the budget) carry
+`# rplint: disable=RPL006` or live in the ratchet baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext, dotted_name
+
+_SEND_ATTRS = {"send", "_send", "deliver"}
+_CALL_ATTRS = {"call"}
+_SCOPE_DIRS = ("rpc", "raft", "admin")
+
+
+class NetAwaitBudgetRule:
+    code = "RPL006"
+    name = "net-await-budget"
+
+    def _in_scope(self, ctx: ModuleContext) -> bool:
+        parts = ctx.path.split("/")[:-1]
+        return any(d in parts for d in _SCOPE_DIRS)
+
+    def check(self, ctx: ModuleContext):
+        if not self._in_scope(ctx):
+            return
+        for fn in ctx.functions():
+            if not fn.is_async:
+                continue
+            body = list(self._own_nodes(fn.node))
+            if self._has_chain_budget(body):
+                continue
+            guarded = self._guarded_awaits(fn.node)
+            send_vars = self._send_assignments(body)
+            for node in body:
+                if not isinstance(node, ast.Await):
+                    continue
+                target = self._net_target(node.value, send_vars)
+                if target is None:
+                    continue
+                call, attr = target
+                if call is not None and self._bounded(call, attr):
+                    continue
+                if id(node) in guarded or ctx.suppressed(node, self.code):
+                    continue
+                yield Finding(
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.code,
+                    message=(
+                        f"awaited network '{attr}' without timeout or "
+                        f"RetryChainNode budget in async '{fn.qualname}'"
+                    ),
+                    qualname=fn.qualname,
+                )
+
+    # -- helpers ------------------------------------------------------
+    def _own_nodes(self, func: ast.AST):
+        """Body nodes excluding nested function defs (same scoping rule
+        as RPL004: a nested helper runs wherever it's called from)."""
+        stack = list(getattr(func, "body", []))
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                stack.append(child)
+
+    @staticmethod
+    def _attr_of(call: ast.Call) -> str:
+        return dotted_name(call.func).rsplit(".", 1)[-1]
+
+    def _net_target(self, expr: ast.AST, send_vars: dict[str, str]):
+        """(call_node | None, attr) when `expr` is a network send —
+        directly, or a name holding a stored send coroutine."""
+        if isinstance(expr, ast.Call):
+            attr = self._attr_of(expr)
+            if attr in _SEND_ATTRS or attr in _CALL_ATTRS:
+                return expr, attr
+            return None
+        if isinstance(expr, ast.Name) and expr.id in send_vars:
+            return None, send_vars[expr.id]
+        return None
+
+    def _send_assignments(self, body) -> dict[str, str]:
+        """name -> send attr, for `coro = x.deliver(...)`-style stores."""
+        out: dict[str, str] = {}
+        for node in body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            attr = self._attr_of(node.value)
+            if attr not in _SEND_ATTRS:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = attr
+        return out
+
+    def _bounded(self, call: ast.Call, attr: str) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                return True
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, (ast.Name, ast.Attribute)):
+                    if "timeout" in dotted_name(sub).lower():
+                        return True
+        # transport arity conventions: send(dst, method, payload,
+        # timeout) / call(method, payload, timeout); `deliver` has no
+        # timeout parameter at all, so arity never bounds it
+        if attr in _SEND_ATTRS and attr != "deliver" and len(call.args) >= 4:
+            return True
+        if attr in _CALL_ATTRS and len(call.args) >= 3:
+            return True
+        return False
+
+    def _has_chain_budget(self, body) -> bool:
+        for node in body:
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func).lower()
+                if name.endswith(".backoff") or "retry" in name:
+                    return True
+        return False
+
+    def _guarded_awaits(self, func: ast.AST) -> set[int]:
+        """ids of Await nodes lexically inside an async-with timeout
+        context (asyncio.timeout / wait_for-style wrappers)."""
+        out: set[int] = set()
+        for node in self._own_nodes(func):
+            if not isinstance(node, ast.AsyncWith):
+                continue
+            if not any(
+                isinstance(item.context_expr, ast.Call)
+                and "timeout" in dotted_name(item.context_expr.func).lower()
+                for item in node.items
+            ):
+                continue
+            for sub in node.body:
+                for inner in ast.walk(sub):
+                    if isinstance(inner, ast.Await):
+                        out.add(id(inner))
+        return out
