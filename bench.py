@@ -835,11 +835,28 @@ async def _replicated_async() -> dict:
                 await c.close()
 
         await asyncio.gather(*(warmup(i) for i in range(n_producers)))
+        # --attrib / RP_BENCH_ATTRIB=1: per-coroutine event-loop time
+        # attribution over the measured window only (warmup excluded)
+        attr = None
+        if os.environ.get("RP_BENCH_ATTRIB") == "1":
+            from bench_profiles.loop_attrib import LoopAttributor
+
+            attr = LoopAttributor()
+            attr.start()
         t0 = time.perf_counter()
         await asyncio.gather(
             *(producer(i, t0 + duration_s) for i in range(n_producers))
         )
         mbps = sent / (time.perf_counter() - t0) / 1e6
+        if attr is not None:
+            attr.stop()
+            print(
+                "\n-- replicated loop attribution "
+                f"({len(lat_ms)} rounds) --\n"
+                + attr.table(rounds=len(lat_ms))
+                + "\n",
+                file=sys.stderr,
+            )
         return {
             "metric": "replicated_produce_mbps_3brokers_1k_partitions",
             "value": round(mbps, 1),
@@ -1060,7 +1077,15 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", choices=sorted(BENCHES))
     ap.add_argument("--skip-extras", action="store_true")
+    ap.add_argument(
+        "--attrib",
+        action="store_true",
+        help="emit a per-coroutine event-loop us/round attribution "
+        "table for the replicated bench (bench_profiles/loop_attrib)",
+    )
     args = ap.parse_args()
+    if args.attrib:
+        os.environ["RP_BENCH_ATTRIB"] = "1"
 
     if args.only:
         print(json.dumps(BENCHES[args.only]()))

@@ -1,0 +1,306 @@
+// One-call follower AppendEntries fast path.
+//
+// Replaces the per-dispatch Python work of the steady-state follower
+// append (serde decode, RecordBatchHeader.unpack per batch, per-field
+// guard chain, reply encode) with a single C call over the contiguous
+// request frame — the thin-C++-per-message shape of the reference's
+// append_entries_buffer/consensus::do_append_entries path
+// (src/v/raft/consensus.cc:1734).
+//
+// Scope is deliberately the HAPPY PATH ONLY: the caller supplies a
+// snapshot of the group's scalar raft state, and ANY condition the
+// steady state does not exhibit — term conflict, gap, duplicate
+// delivery, truncation-on-conflict, config/control batches, segment
+// roll, forward-compat envelopes — returns a positive "punt" code and
+// the caller falls back to the existing Python handler, which remains
+// the single source of truth for raft DECISIONS. This mirrors the
+// paper's split: port the mechanical framing, never the consensus
+// logic.
+//
+// Wire layout parsed here (utils/serde.py Envelope + raft/types.py
+// AppendEntriesRequest):
+//
+//   [version u8][compat u8][payload_size u32 LE]
+//   group i64 | node_id i32 | target_node_id i32 | term i64 |
+//   prev_log_index i64 | prev_log_term i64 | commit_index i64 |
+//   seq i64 | flush u8 | batches: count u32, each (len u32, bytes)
+//
+// Each batch is RecordBatch.serialize(): the 69-byte internal header
+// (models/record.py _HDR, little-endian) followed by the body. Both
+// CRCs are verified per batch — header_crc over header[4:69], body crc
+// over the big-endian crc_prefix (attrs..record_count) then the body —
+// so only leader-authenticated bytes are ever handed to writev.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" uint32_t rp_crc32c(uint32_t crc, const uint8_t* buf, size_t len);
+
+namespace {
+
+// state[] slots supplied by the caller (see utils/native.py
+// append_frame(); keep in sync with AF_STATE_N there)
+enum {
+    ST_GROUP = 0,
+    ST_TERM = 1,
+    ST_DIRTY = 2,      // log dirty offset (tail)
+    ST_LAST_TERM = 3,  // term_at(dirty): snapshot-boundary aware
+    ST_COMMIT = 4,
+    ST_IS_FOLLOWER = 5,
+    ST_NODE_ID = 6,    // self (responder) id
+    ST_SEG_TERM = 7,   // active segment term
+    ST_SEG_ROOM = 8,   // segment_max_bytes - active segment size
+    ST_RESERVED = 9,
+    AF_STATE_N = 10,
+};
+
+// desc[] header slots (per-batch rows of AF_DESC_W follow)
+enum {
+    D_NBATCHES = 0,
+    D_TOTAL_BYTES = 1,
+    D_NEW_DIRTY = 2,
+    D_LAST_NEW_ENTRY = 3,
+    D_SEQ = 4,
+    D_LEADER_ID = 5,
+    D_REQ_COMMIT = 6,
+    D_FLUSH = 7,
+    AF_DESC_HDR = 8,
+};
+
+// per-batch row: span offset/len into the payload + the header fields
+// the Python side needs for bookkeeping without re-unpacking
+enum {
+    B_OFF = 0,
+    B_LEN = 1,
+    B_BASE = 2,
+    B_LAST = 3,
+    B_TERM = 4,
+    B_FIRST_TS = 5,
+    B_MAX_TS = 6,
+    B_RESERVED = 7,
+    AF_DESC_W = 8,
+};
+
+// punt codes (> 0). Informational only — every one means "fall back
+// to the Python handler"; tests assert specific codes so guard
+// regressions are visible.
+enum {
+    P_TRUNCATED = 1,       // frame shorter than its declared layout
+    P_ENVELOPE = 2,        // version/compat/size not the v1 shape
+    P_GROUP = 3,           // group mismatch vs caller state
+    P_TERM = 4,            // stale or newer term (step-down path)
+    P_NOT_FOLLOWER = 5,
+    P_PREV_MISMATCH = 6,   // gap / dup / truncate-on-conflict territory
+    P_PREV_TERM = 7,
+    P_NO_BATCHES = 8,      // heartbeat-shaped or empty append
+    P_BATCH_TYPE = 9,      // config/control batch: python handles hooks
+    P_BATCH_SIZE = 10,     // size_bytes disagrees with the span
+    P_HEADER_CRC = 11,
+    P_BODY_CRC = 12,
+    P_NOT_CONTIGUOUS = 13, // base != expected next offset
+    P_SEG_TERM = 14,       // batch term would roll the segment
+    P_SEG_FULL = 15,       // append would roll the segment
+    P_CAPACITY = 16,       // more batches than the descriptor holds
+};
+
+constexpr size_t ENV_HDR = 6;       // version, compat, payload_size
+constexpr size_t FIXED_FIELDS = 57; // group..flush
+constexpr size_t BATCH_HDR = 69;    // models/record.py HEADER_SIZE
+constexpr int8_t RAFT_DATA = 1;     // RecordBatchType.raft_data
+
+inline uint32_t rd_u32le(const uint8_t* p) {
+    uint32_t v;
+    memcpy(&v, p, 4);
+    return v;  // x86/arm64 little-endian hosts
+}
+
+inline int32_t rd_i32le(const uint8_t* p) {
+    int32_t v;
+    memcpy(&v, p, 4);
+    return v;
+}
+
+inline int64_t rd_i64le(const uint8_t* p) {
+    int64_t v;
+    memcpy(&v, p, 8);
+    return v;
+}
+
+inline int16_t rd_i16le(const uint8_t* p) {
+    int16_t v;
+    memcpy(&v, p, 2);
+    return v;
+}
+
+inline void wr_u32le(uint8_t* p, uint32_t v) { memcpy(p, &v, 4); }
+inline void wr_i64le(uint8_t* p, int64_t v) { memcpy(p, &v, 8); }
+
+inline void be16(uint8_t* p, uint16_t v) {
+    p[0] = (uint8_t)(v >> 8);
+    p[1] = (uint8_t)v;
+}
+
+inline void be32(uint8_t* p, uint32_t v) {
+    p[0] = (uint8_t)(v >> 24);
+    p[1] = (uint8_t)(v >> 16);
+    p[2] = (uint8_t)(v >> 8);
+    p[3] = (uint8_t)v;
+}
+
+inline void be64(uint8_t* p, uint64_t v) {
+    be32(p, (uint32_t)(v >> 32));
+    be32(p + 4, (uint32_t)v);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Reply size: 6-byte envelope head + 45-byte AppendEntriesReply body.
+enum { RP_AF_REPLY_SIZE = 51 };
+
+// Parse + guard + build reply for one AppendEntries request frame.
+// Returns 0 on the happy path (desc and reply filled; the caller then
+// writev()s the batch spans and mirrors the bookkeeping), a positive
+// punt code otherwise (desc/reply contents undefined), or a negative
+// value on caller-contract violations (undersized buffers).
+int64_t rp_append_frame(const uint8_t* payload, uint64_t len,
+                        const int64_t* state, int64_t* desc,
+                        uint64_t desc_rows, uint8_t* reply,
+                        uint64_t reply_cap) {
+    if (reply_cap < RP_AF_REPLY_SIZE) return -1;
+    if (len < ENV_HDR + FIXED_FIELDS + 4) return P_TRUNCATED;
+    if (payload[0] != 1 || payload[1] != 1) return P_ENVELOPE;
+    uint64_t psize = rd_u32le(payload + 2);
+    // exact-frame contract: a newer peer appending fields (or trailing
+    // garbage) is the serde evolution path — python handles it
+    if (ENV_HDR + psize != len) return P_ENVELOPE;
+
+    const uint8_t* f = payload + ENV_HDR;
+    int64_t group = rd_i64le(f + 0);
+    int32_t leader_id = rd_i32le(f + 8);
+    int64_t term = rd_i64le(f + 16);
+    int64_t prev_idx = rd_i64le(f + 24);
+    int64_t prev_term = rd_i64le(f + 32);
+    int64_t commit_index = rd_i64le(f + 40);
+    int64_t seq = rd_i64le(f + 48);
+    uint8_t flush = f[56];
+
+    if (group != state[ST_GROUP]) return P_GROUP;
+    if (term != state[ST_TERM]) return P_TERM;
+    if (!state[ST_IS_FOLLOWER]) return P_NOT_FOLLOWER;
+    // steady state: the leader appends exactly at our tail. Anything
+    // else (gap, dup redelivery, divergence) is conflict-resolution
+    // territory and punts.
+    if (prev_idx != state[ST_DIRTY]) return P_PREV_MISMATCH;
+    if (prev_idx >= 0 && prev_term != state[ST_LAST_TERM]) return P_PREV_TERM;
+
+    uint32_t nbatches = rd_u32le(f + FIXED_FIELDS);
+    if (nbatches == 0) return P_NO_BATCHES;
+    if (nbatches > desc_rows) return P_CAPACITY;
+
+    uint64_t pos = ENV_HDR + FIXED_FIELDS + 4;
+    int64_t expect_base = prev_idx + 1;
+    int64_t total = 0;
+    int64_t seg_room = state[ST_SEG_ROOM];
+    int64_t last_new = prev_idx;
+    int64_t* row = desc + AF_DESC_HDR;
+    uint8_t crc_prefix[40];
+
+    for (uint32_t i = 0; i < nbatches; i++) {
+        if (pos + 4 > len) return P_TRUNCATED;
+        uint64_t blen = rd_u32le(payload + pos);
+        pos += 4;
+        if (blen < BATCH_HDR || pos + blen > len) return P_TRUNCATED;
+        const uint8_t* b = payload + pos;
+
+        // internal header (models/record.py _HDR "<IiqbIhiqqqhiiq")
+        uint32_t header_crc = rd_u32le(b + 0);
+        int32_t size_bytes = rd_i32le(b + 4);
+        int64_t base = rd_i64le(b + 8);
+        int8_t type = (int8_t)b[16];
+        uint32_t crc = rd_u32le(b + 17);
+        int16_t attrs = rd_i16le(b + 21);
+        int32_t lod = rd_i32le(b + 23);
+        int64_t first_ts = rd_i64le(b + 27);
+        int64_t max_ts = rd_i64le(b + 35);
+        int64_t producer_id = rd_i64le(b + 43);
+        int16_t producer_epoch = rd_i16le(b + 51);
+        int32_t base_seq = rd_i32le(b + 53);
+        int32_t rcount = rd_i32le(b + 57);
+        int64_t bterm = rd_i64le(b + 61);
+
+        if (size_bytes < 0 || (uint64_t)size_bytes != blen) return P_BATCH_SIZE;
+        // only plain data batches: config/control batches drive python
+        // side effects (configuration_manager, producer/tx state)
+        if (type != RAFT_DATA) return P_BATCH_TYPE;
+        if (base != expect_base) return P_NOT_CONTIGUOUS;
+        if (lod < 0) return P_NOT_CONTIGUOUS;
+        if (bterm != state[ST_SEG_TERM]) return P_SEG_TERM;
+        // _active_segment admits a batch only while size < max; the
+        // caller passes room = max - size, so each batch needs >= 1
+        // byte of room BEFORE it lands (the batch itself may overflow)
+        if (seg_room < 1) return P_SEG_FULL;
+        seg_room -= (int64_t)blen;
+
+        if (rp_crc32c(0, b + 4, BATCH_HDR - 4) != header_crc)
+            return P_HEADER_CRC;
+        // body crc covers the big-endian kafka crc_prefix
+        // (models/record.py _CRC_PREFIX ">hiqqqhii") then the body
+        be16(crc_prefix + 0, (uint16_t)attrs);
+        be32(crc_prefix + 2, (uint32_t)lod);
+        be64(crc_prefix + 6, (uint64_t)first_ts);
+        be64(crc_prefix + 14, (uint64_t)max_ts);
+        be64(crc_prefix + 22, (uint64_t)producer_id);
+        be16(crc_prefix + 30, (uint16_t)producer_epoch);
+        be32(crc_prefix + 32, (uint32_t)base_seq);
+        be32(crc_prefix + 36, (uint32_t)rcount);
+        uint32_t body_crc = rp_crc32c(0, crc_prefix, sizeof(crc_prefix));
+        body_crc = rp_crc32c(body_crc, b + BATCH_HDR, blen - BATCH_HDR);
+        if (body_crc != crc) return P_BODY_CRC;
+
+        row[B_OFF] = (int64_t)pos;
+        row[B_LEN] = (int64_t)blen;
+        row[B_BASE] = base;
+        row[B_LAST] = base + lod;
+        row[B_TERM] = bterm;
+        row[B_FIRST_TS] = first_ts;
+        row[B_MAX_TS] = max_ts;
+        row[B_RESERVED] = 0;
+        row += AF_DESC_W;
+
+        last_new = base + lod;
+        expect_base = last_new + 1;
+        total += (int64_t)blen;
+        pos += blen;
+    }
+    if (pos != len) return P_ENVELOPE;  // trailing bytes
+
+    desc[D_NBATCHES] = (int64_t)nbatches;
+    desc[D_TOTAL_BYTES] = total;
+    desc[D_NEW_DIRTY] = last_new;
+    desc[D_LAST_NEW_ENTRY] = last_new;
+    desc[D_SEQ] = seq;
+    desc[D_LEADER_ID] = (int64_t)leader_id;
+    desc[D_REQ_COMMIT] = commit_index;
+    desc[D_FLUSH] = (int64_t)flush;
+
+    // AppendEntriesReply SUCCESS, flushed == dirty (the python caller
+    // fsyncs before sending; it patches the flushed field in the
+    // impossible case the flush lands short)
+    reply[0] = 1;
+    reply[1] = 1;
+    wr_u32le(reply + 2, 45);
+    wr_i64le(reply + 6, group);
+    int32_t self_id = (int32_t)state[ST_NODE_ID];
+    memcpy(reply + 14, &self_id, 4);
+    wr_i64le(reply + 18, state[ST_TERM]);
+    wr_i64le(reply + 26, last_new);  // last_dirty_log_index
+    wr_i64le(reply + 34, last_new);  // last_flushed_log_index
+    wr_i64le(reply + 42, seq);
+    reply[50] = 0;  // SUCCESS
+    return 0;
+}
+
+}  // extern "C"

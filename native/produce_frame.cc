@@ -1,0 +1,252 @@
+// Kafka produce frontend fast path: header decode + single-topic/
+// single-partition body decode + per-batch wire CRC verification in
+// one C call over the request frame.
+//
+// The hot produce shape (kafka/protocol/produce_fast.py) is one topic,
+// one partition, non-transactional, v3-v9. This module parses exactly
+// that shape and verifies every record batch's Kafka wire CRC
+// (kafka_batch_adapter.cc:99 analog) so the Python handler can skip
+// its per-batch verify pass. ANY deviation — other api keys, unusual
+// versions, multi-topic/partition fan-out, transactional ids, tagged
+// fields, null/truncated/corrupt record sets — returns a punt code and
+// the caller falls back to the generic Python decoder, which keeps the
+// exact error semantics (a corrupt batch must fail in dispatch order,
+// not up front).
+//
+// Wire layout (Kafka request header v1/v2 + Produce body):
+//   api_key i16 | api_version i16 | correlation_id i32 |
+//   client_id nullable-string (i16 len) | [v9: tagged fields]
+//   body: transactional_id | acks i16 | timeout i32 | topics[1] |
+//   name | partitions[1] | index i32 | records
+// Record batches: base_offset i64 | batch_length i32 | leader_epoch
+// i32 | magic u8(=2) | crc u32 | ... ; crc covers bytes [21, 12 +
+// batch_length) of the batch (attributes onward).
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" uint32_t rp_crc32c(uint32_t crc, const uint8_t* buf, size_t len);
+
+namespace {
+
+// out[] slots (keep in sync with utils/native.py produce_frame())
+enum {
+    O_API_VERSION = 0,
+    O_CORRELATION_ID = 1,
+    O_FLEXIBLE = 2,
+    O_CLIENT_ID_OFF = 3,  // -1 when null
+    O_CLIENT_ID_LEN = 4,
+    O_ACKS = 5,
+    O_TIMEOUT_MS = 6,
+    O_TOPIC_OFF = 7,
+    O_TOPIC_LEN = 8,
+    O_INDEX = 9,
+    O_RECORDS_OFF = 10,
+    O_RECORDS_LEN = 11,
+    O_NBATCHES = 12,
+    PF_OUT_N = 13,
+};
+
+// punt codes (> 0): fall back to the generic Python decode path
+enum {
+    P_TRUNCATED = 1,
+    P_NOT_PRODUCE = 2,   // api_key != 0
+    P_VERSION = 3,       // outside the v3-v9 fast range
+    P_TAGGED = 4,        // non-empty tagged-field sections
+    P_TXID = 5,          // transactional produce: cold path
+    P_SHAPE = 6,         // not single-topic/single-partition
+    P_RECORDS = 7,       // null/odd records section
+    P_BATCH = 8,         // malformed batch framing (magic, bounds)
+    P_CRC = 9,           // wire crc mismatch: python reproduces the
+                         // in-order corrupt_message error semantics
+    P_TRAILING = 10,     // bytes after the parsed body
+};
+
+constexpr size_t KAFKA_BATCH_OVERHEAD = 61;  // base_offset..record_count
+constexpr size_t KAFKA_AFTER_LEN = 49;       // overhead minus offset+length
+constexpr size_t CRC_START = 21;             // attributes field offset
+
+inline int16_t rd_i16be(const uint8_t* p) {
+    return (int16_t)(((uint16_t)p[0] << 8) | p[1]);
+}
+
+inline int32_t rd_i32be(const uint8_t* p) {
+    return (int32_t)(((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+                     ((uint32_t)p[2] << 8) | p[3]);
+}
+
+inline uint32_t rd_u32be(const uint8_t* p) {
+    return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | p[3];
+}
+
+// Kafka unsigned varint; returns false on truncation/overflow.
+bool rd_uvarint(const uint8_t* buf, uint64_t len, uint64_t* pos,
+                uint64_t* out) {
+    uint64_t v = 0;
+    int shift = 0;
+    while (*pos < len) {
+        uint8_t b = buf[(*pos)++];
+        v |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) {
+            *out = v;
+            return true;
+        }
+        shift += 7;
+        if (shift > 63) return false;
+    }
+    return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode + verify one produce request frame. Returns 0 with out[]
+// filled on the fast shape, a positive punt code otherwise, or -1 on
+// caller-contract violations (undersized out).
+int64_t rp_produce_frame(const uint8_t* frame, uint64_t len, int64_t* out,
+                         uint64_t out_n) {
+    if (out_n < PF_OUT_N) return -1;
+    if (len < 14) return P_TRUNCATED;
+    if (rd_i16be(frame) != 0) return P_NOT_PRODUCE;
+    int16_t version = rd_i16be(frame + 2);
+    if (version < 3 || version > 9) return P_VERSION;
+    int32_t correlation_id = rd_i32be(frame + 4);
+    bool flexible = version >= 9;  // PRODUCE flex_since=9
+
+    uint64_t pos = 8;
+    // client_id: classic nullable string even in header v2 (wire quirk)
+    int16_t cid_len = rd_i16be(frame + pos);
+    pos += 2;
+    int64_t cid_off = -1;
+    if (cid_len >= 0) {
+        if (pos + (uint64_t)cid_len > len) return P_TRUNCATED;
+        cid_off = (int64_t)pos;
+        pos += (uint64_t)cid_len;
+    }
+    if (flexible) {  // header v2 tagged fields: require none
+        if (pos >= len) return P_TRUNCATED;
+        if (frame[pos++] != 0) return P_TAGGED;
+    }
+
+    // -- body --
+    if (flexible) {
+        uint64_t n;
+        if (!rd_uvarint(frame, len, &pos, &n)) return P_TRUNCATED;
+        if (n != 0) return P_TXID;  // compact nullable: 0 == null
+    } else {
+        if (pos + 2 > len) return P_TRUNCATED;
+        int16_t n = rd_i16be(frame + pos);
+        pos += 2;
+        if (n >= 0) return P_TXID;
+    }
+    if (pos + 6 > len) return P_TRUNCATED;
+    int16_t acks = rd_i16be(frame + pos);
+    int32_t timeout_ms = rd_i32be(frame + pos + 2);
+    pos += 6;
+
+    // topics: exactly one
+    if (flexible) {
+        uint64_t n;
+        if (!rd_uvarint(frame, len, &pos, &n)) return P_TRUNCATED;
+        if (n != 2) return P_SHAPE;  // compact count = 1 + 1
+    } else {
+        if (pos + 4 > len) return P_TRUNCATED;
+        if (rd_i32be(frame + pos) != 1) return P_SHAPE;
+        pos += 4;
+    }
+    uint64_t topic_len;
+    if (flexible) {
+        uint64_t n;
+        if (!rd_uvarint(frame, len, &pos, &n)) return P_TRUNCATED;
+        if (n == 0) return P_SHAPE;  // null for non-nullable
+        topic_len = n - 1;
+    } else {
+        if (pos + 2 > len) return P_TRUNCATED;
+        int16_t n = rd_i16be(frame + pos);
+        pos += 2;
+        if (n < 0) return P_SHAPE;
+        topic_len = (uint64_t)n;
+    }
+    if (pos + topic_len > len) return P_TRUNCATED;
+    uint64_t topic_off = pos;
+    pos += topic_len;
+
+    // partitions: exactly one
+    if (flexible) {
+        uint64_t n;
+        if (!rd_uvarint(frame, len, &pos, &n)) return P_TRUNCATED;
+        if (n != 2) return P_SHAPE;
+    } else {
+        if (pos + 4 > len) return P_TRUNCATED;
+        if (rd_i32be(frame + pos) != 1) return P_SHAPE;
+        pos += 4;
+    }
+    if (pos + 4 > len) return P_TRUNCATED;
+    int32_t index = rd_i32be(frame + pos);
+    pos += 4;
+
+    uint64_t rec_len;
+    if (flexible) {
+        uint64_t n;
+        if (!rd_uvarint(frame, len, &pos, &n)) return P_TRUNCATED;
+        if (n == 0) return P_RECORDS;  // null records
+        rec_len = n - 1;
+    } else {
+        if (pos + 4 > len) return P_TRUNCATED;
+        int32_t n = rd_i32be(frame + pos);
+        pos += 4;
+        if (n < 0) return P_RECORDS;
+        rec_len = (uint64_t)n;
+    }
+    if (pos + rec_len > len) return P_TRUNCATED;
+    uint64_t rec_off = pos;
+    pos += rec_len;
+
+    if (flexible) {  // partition, topic, top-level tag sections
+        for (int i = 0; i < 3; i++) {
+            if (pos >= len) return P_TRUNCATED;
+            if (frame[pos++] != 0) return P_TAGGED;
+        }
+    }
+    if (pos != len) return P_TRAILING;
+
+    // -- walk + CRC-verify the record batches --
+    uint64_t bpos = rec_off;
+    uint64_t rend = rec_off + rec_len;
+    int64_t nbatches = 0;
+    while (bpos < rend) {
+        if (rend - bpos < KAFKA_BATCH_OVERHEAD) return P_BATCH;
+        const uint8_t* b = frame + bpos;
+        int32_t batch_length = rd_i32be(b + 8);
+        if (batch_length < (int32_t)KAFKA_AFTER_LEN) return P_BATCH;
+        uint64_t total = 12 + (uint64_t)batch_length;
+        if (bpos + total > rend) return P_BATCH;
+        if (b[16] != 2) return P_BATCH;  // magic v2 only
+        uint32_t wire_crc = rd_u32be(b + 17);
+        if (rp_crc32c(0, b + CRC_START, total - CRC_START) != wire_crc)
+            return P_CRC;
+        nbatches++;
+        bpos += total;
+    }
+    if (nbatches == 0) return P_RECORDS;
+
+    out[O_API_VERSION] = version;
+    out[O_CORRELATION_ID] = correlation_id;
+    out[O_FLEXIBLE] = flexible ? 1 : 0;
+    out[O_CLIENT_ID_OFF] = cid_off;
+    out[O_CLIENT_ID_LEN] = cid_len;
+    out[O_ACKS] = acks;
+    out[O_TIMEOUT_MS] = timeout_ms;
+    out[O_TOPIC_OFF] = (int64_t)topic_off;
+    out[O_TOPIC_LEN] = (int64_t)topic_len;
+    out[O_INDEX] = index;
+    out[O_RECORDS_OFF] = (int64_t)rec_off;
+    out[O_RECORDS_LEN] = (int64_t)rec_len;
+    out[O_NBATCHES] = nbatches;
+    return 0;
+}
+
+}  // extern "C"

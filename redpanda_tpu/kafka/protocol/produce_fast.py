@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import struct
 
+from ...utils import native as native_mod
+from .headers import RequestHeader
 from .schema import Msg
 from .wire import Reader, encode_uvarint
 
@@ -119,6 +121,57 @@ def decode_request(data, version: int, flexible: bool) -> Msg | None:
             )
         ],
     )
+
+
+def native_ready() -> bool:
+    """Probe for the C produce frontend (RP_NATIVE / RP_NATIVE_PRODUCE
+    escape hatches honored per call by utils/native.py)."""
+    return native_mod.produce_frame_ready()
+
+
+def decode_request_native(frame) -> tuple[RequestHeader, Msg] | None:
+    """One C call over the whole request frame (header + body +
+    per-batch wire CRC verification, native/produce_frame.cc). Returns
+    (RequestHeader, Msg) for the hot single-topic/single-partition
+    non-transactional shape with every batch CRC already verified
+    (the partition Msg carries `_crc_ok=True` so the dispatch loop
+    skips its per-batch verify pass), or None → the caller runs the
+    header decode + generic/fast Python decoders, which reproduce the
+    exact error semantics for every punt (corrupt batches must fail in
+    dispatch order, unusual shapes take the schema walker, etc.)."""
+    if not native_mod.produce_frame_ready():
+        return None
+    if not isinstance(frame, bytes):
+        frame = bytes(frame)
+    desc = native_mod.produce_frame(frame)
+    if desc is None:
+        return None
+    (
+        version, correlation_id, _flexible, cid_off, cid_len,
+        acks, timeout_ms, topic_off, topic_len, index,
+        rec_off, rec_len, _nbatches,
+    ) = desc
+    try:
+        client_id = (
+            None if cid_off < 0
+            else frame[cid_off : cid_off + cid_len].decode("utf-8")
+        )
+        name = frame[topic_off : topic_off + topic_len].decode("utf-8")
+    except UnicodeDecodeError:
+        return None  # generic path reproduces the decode error
+    hdr = RequestHeader(0, version, correlation_id, client_id)
+    partition = Msg(
+        index=index,
+        records=memoryview(frame)[rec_off : rec_off + rec_len],
+    )
+    partition._crc_ok = True
+    req = Msg(
+        transactional_id=None,
+        acks=acks,
+        timeout_ms=timeout_ms,
+        topics=[Msg(name=name, partitions=[partition])],
+    )
+    return hdr, req
 
 
 # -- response ---------------------------------------------------------

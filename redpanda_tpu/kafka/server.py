@@ -386,8 +386,20 @@ class KafkaServer:
     async def _process(self, frame: bytes, ctx: ConnectionContext) -> bytes | None:
         from .protocol.admin_apis import SASL_AUTHENTICATE, SASL_HANDSHAKE
 
-        r = Reader(frame)
-        hdr = decode_request_header(r)
+        # Native produce frontend: header decode + body decode +
+        # per-batch wire CRC verification in one C call over the frame
+        # (native/produce_frame.cc). Punts (None) on anything but the
+        # hot single-topic/single-partition shape; all the gates below
+        # still run on the returned header, so SASL/session/version
+        # semantics are unchanged.
+        req = None
+        if produce_fast.native_ready():
+            nat = produce_fast.decode_request_native(frame)
+            if nat is not None:
+                hdr, req = nat
+        if req is None:
+            r = Reader(frame)
+            hdr = decode_request_header(r)
         api = API_BY_KEY.get(hdr.api_key)
         if api is None:
             logger.warning("unknown api key %d", hdr.api_key)
@@ -433,15 +445,16 @@ class KafkaServer:
                 api.name, hdr.api_version, api.min_version, api.max_version,
             )
             raise _CloseConnection(b"")
-        body_mv = frame[len(frame) - r.remaining :]
-        if hdr.api_key == 0:  # PRODUCE: hand-rolled single-shape codec
-            req = produce_fast.decode_request(
-                body_mv, hdr.api_version, api.flexible(hdr.api_version)
-            )
-            if req is None:
+        if req is None:
+            body_mv = frame[len(frame) - r.remaining :]
+            if hdr.api_key == 0:  # PRODUCE: hand-rolled single-shape codec
+                req = produce_fast.decode_request(
+                    body_mv, hdr.api_version, api.flexible(hdr.api_version)
+                )
+                if req is None:
+                    req = api.decode_request(body_mv, hdr.api_version)
+            else:
                 req = api.decode_request(body_mv, hdr.api_version)
-        else:
-            req = api.decode_request(body_mv, hdr.api_version)
         if hdr.api_key == SASL_HANDSHAKE.key:
             resp = self.handle_sasl_handshake(ctx, hdr, req)
         elif hdr.api_key == SASL_AUTHENTICATE.key:
@@ -883,8 +896,11 @@ class KafkaServer:
                         ctype_cfg is not None
                         and parser.bytes_left() > 57  # header floor
                     )
+                    # _crc_ok: the native frontend already verified
+                    # every batch's wire crc in its one-pass decode
                     batch = RecordBatch.from_kafka_wire(
-                        parser, verify=not recompress
+                        parser,
+                        verify=not recompress and not p.get("_crc_ok"),
                     )
                     if recompress:
                         # recompressed() verifies the wire crc in the

@@ -562,8 +562,7 @@ def parse_record_descriptors(data: bytes, count: int) -> list[int] | None:
     unavailable. Raises ValueError on malformed input. Lets scan-heavy
     callers (compaction's key map, verbatim record slicing) avoid
     materializing Record objects entirely."""
-    lib = native_mod.load()
-    if lib is None:
+    if native_mod.load() is None:
         return None
     if count <= 0:
         # match the pure-Python decoder: range(count) is empty
@@ -577,7 +576,9 @@ def parse_record_descriptors(data: bytes, count: int) -> list[int] | None:
     import ctypes
 
     desc = (ctypes.c_int64 * (count * _DESC_W))()
-    rc = lib.rp_parse_records(data, len(data), count, desc)
+    rc = native_mod.parse_records(data, len(data), count, desc)
+    if rc is None:
+        return None
     if rc != 0:
         raise ValueError(f"malformed record body (native walker code {rc})")
     return list(desc)
@@ -634,8 +635,9 @@ class RecordBatchBuilder:
         return not self._records
 
     def _encode_raw(self) -> bytes:
-        lib = native_mod.load()
-        if lib is not None and not any(h for _, _, _, h in self._records):
+        if native_mod.load() is not None and not any(
+            h for _, _, _, h in self._records
+        ):
             import ctypes
 
             n = len(self._records)
@@ -650,10 +652,10 @@ class RecordBatchBuilder:
             vals = b"".join(r[2] for r in self._records if r[2] is not None)
             cap = 64 * n + len(keys) + len(vals)
             out = ctypes.create_string_buffer(cap)
-            written = lib.rp_encode_records(
+            written = native_mod.encode_records(
                 n, ts, keys, key_lens, vals, val_lens, out, cap
             )
-            if written > 0:
+            if written is not None and written > 0:
                 return out.raw[:written]
             # fall through to Python on the (impossible) bound miss
         return b"".join(

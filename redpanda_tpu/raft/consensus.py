@@ -29,15 +29,23 @@ import itertools
 import logging
 import os
 import random
+import struct
 from enum import Enum
 from typing import Awaitable, Callable, Optional
 
-from ..models.record import RecordBatch, RecordBatchBuilder, RecordBatchType
+from ..models.record import (
+    HEADER_SIZE,
+    RecordBatch,
+    RecordBatchBuilder,
+    RecordBatchHeader,
+    RecordBatchType,
+)
 from ..models.consensus_state import SELF_SLOT
 from ..models.fundamental import NO_OFFSET
 from ..storage import snapshot as snapfmt
 from ..storage.kvstore import KeySpace, KvStore, KvStoreClosed
 from ..storage.log import Log
+from ..utils import native as native_mod
 from ..utils import serde, spans
 from ..utils.retry_chain import RetryChainAborted, RetryChainNode
 from . import quorum_scalar as qs
@@ -142,6 +150,10 @@ class Consensus:
         self._lazy_last_kick: dict[int, float] = {}
         self._bg_tasks: set[asyncio.Task] = set()
         self._append_lock = asyncio.Lock()  # append_entries_buffer analog
+        # scratch (state, desc, reply) for the native append fast path,
+        # allocated on first use; reuse is safe because calls are
+        # serialized under _append_lock on one event loop
+        self._af_bufs = None
         self._vote_lock = asyncio.Lock()
         # fired on role/config/slot changes so the heartbeat manager
         # can invalidate its cached per-peer build plan
@@ -812,6 +824,15 @@ class Consensus:
             async with self._append_lock:
                 return await self._do_append_entries(req)
 
+    async def try_native_append(self, payload: bytes) -> bytes | None:
+        """RPC-layer zero-decode fast path: run the native follower
+        framing under the same per-group lock the Python handler uses.
+        `payload` is the serialized AppendEntriesRequest envelope;
+        returns encoded reply bytes, or None ⇒ caller decodes and
+        dispatches through handle_append_entries as usual."""
+        async with self._append_lock:
+            return self.native_append_frame(payload)
+
     def _reply(self, status: int, seq: int) -> rt.AppendEntriesReply:
         return rt.AppendEntriesReply(
             group=self.group_id,
@@ -913,6 +934,101 @@ class Consensus:
             self.arrays.touch()
             self._notify_commit()
         return self._reply(rt.AppendEntriesReply.SUCCESS, int(req.seq))
+
+    def native_append_frame(self, payload: bytes) -> bytes | None:
+        """Steady-state follower append in one native call
+        (native/append_frame.cc): parse + guards + per-batch CRC +
+        reply build happen in C; this method only assembles the scalar
+        state snapshot, writev()s the verified spans into the active
+        segment, and mirrors the bookkeeping _do_append_entries would
+        have done (index, cache, on_append hooks, arrays, commit).
+
+        Returns the encoded AppendEntriesReply bytes, or None to PUNT —
+        any non-happy-path condition falls back byte-for-byte to the
+        Python handler. Caller must hold _append_lock."""
+        log = self.log
+        segs = log._segments
+        if not segs:
+            return None
+        seg = segs[-1]
+        dirty = seg.dirty_offset
+        if dirty >= seg.base_offset:
+            last_term = seg.term
+        elif dirty == self._snap_index:
+            last_term = self._snap_term
+        else:
+            return None  # empty tail not at the snapshot boundary
+        if dirty < self._snap_index:
+            return None  # below-snapshot batches need the dedup loop
+        row = self.row
+        arrays = self.arrays
+        if int(arrays.match_index[row, SELF_SLOT]) != dirty:
+            return None  # arrays mirror out of step with storage
+        bufs = self._af_bufs
+        if bufs is None:
+            bufs = self._af_bufs = native_mod.append_frame_buffers()
+        state, desc, reply = bufs
+        state[0] = self.group_id
+        state[1] = int(arrays.term[row])
+        state[2] = dirty
+        state[3] = last_term
+        state[4] = int(arrays.commit_index[row])
+        state[5] = 1 if self._role is Role.FOLLOWER else 0
+        state[6] = self.node_id
+        state[7] = seg.term
+        state[8] = log.config.segment_max_bytes - seg._size
+        rc = native_mod.append_frame(payload, state, desc, reply)
+        if rc != 0:
+            return None
+        # happy path: everything below mirrors _do_append_entries with
+        # the request already validated — no punt past this point
+        self._last_heartbeat = asyncio.get_event_loop().time()
+        self.leader_id = int(desc[5])
+        n = int(desc[0])
+        new_dirty = int(desc[2])
+        pv = memoryview(payload)
+        d = native_mod.AF_DESC_HDR
+        w = native_mod.AF_DESC_W
+        span_list = []
+        batches = []
+        for i in range(n):
+            off = desc[d + i * w]
+            ln = desc[d + i * w + 1]
+            span_list.append(pv[off : off + ln])
+            hdr = RecordBatchHeader.unpack(payload[off : off + HEADER_SIZE])
+            batch = RecordBatch(hdr, payload[off + HEADER_SIZE : off + ln])
+            batch.finalized = True  # both CRCs verified in C
+            batches.append(batch)
+        seg.append_verified_spans(span_list, batches)
+        cache = log._cache_index
+        hooks = log.on_append
+        for batch in batches:
+            if cache is not None:
+                cache.put(batch)
+            for fn in hooks:
+                fn(batch)
+        flushed = log.flush()
+        arrays.match_index[row, SELF_SLOT] = new_dirty
+        arrays.flushed_index[row, SELF_SLOT] = flushed
+        new_commit = qs.follower_commit_index(
+            int(arrays.commit_index[row]),
+            flushed,
+            min(int(desc[6]), int(desc[3])),
+        )
+        if new_commit != int(arrays.commit_index[row]):
+            arrays.commit_index[row] = new_commit
+            if new_commit > int(arrays.last_visible[row]):
+                arrays.last_visible[row] = new_commit
+            arrays.touch()
+            self._notify_commit()
+        else:
+            arrays.touch()
+        out = reply.raw
+        if flushed != new_dirty:  # defensive: reply carries the truth
+            out = bytearray(out)
+            struct.pack_into("<q", out, 34, flushed)
+            out = bytes(out)
+        return out
 
     def handle_heartbeat(
         self,

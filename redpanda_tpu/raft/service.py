@@ -10,8 +10,12 @@ per-group RPC overhead — the follower side of the batched sweep.
 from __future__ import annotations
 
 import logging
+import struct
 
 from ..rpc import Service, method
+from ..storage import file_sanitizer, iofaults
+from ..utils import native as native_mod
+from ..utils import spans
 from . import types as rt
 
 logger = logging.getLogger("raft.service")
@@ -132,6 +136,25 @@ class RaftService(Service):
 
     @method(rt.APPEND_ENTRIES)
     async def append_entries(self, payload: bytes) -> bytes:
+        # Native follower fast path: parse + guards + per-batch CRC +
+        # reply framing in one C call over the raw frame
+        # (native/append_frame.cc via Consensus.try_native_append).
+        # Debug instrumentation that must observe the Python write path
+        # (spans, file sanitizer, iofault injection) disables it, and
+        # any in-frame anomaly punts to the decode route below.
+        if (
+            not spans.ENABLED
+            and not file_sanitizer.enabled()
+            and not iofaults.active()
+            and native_mod.append_frame_ready()
+            and len(payload) >= 14
+        ):
+            gid = struct.unpack_from("<q", payload, 6)[0]
+            c = self._consensus(int(gid))
+            if c is not None:
+                out = await c.try_native_append(payload)
+                if out is not None:
+                    return out
         req = rt.AppendEntriesRequest.decode(payload)
         c = self._consensus(int(req.group))
         if c is None:

@@ -209,6 +209,30 @@ class Segment:
         self.dirty_offset = batch.header.last_offset
         self.max_timestamp = max(self.max_timestamp, batch.header.max_timestamp)
 
+    def append_verified_spans(self, span_list, batches) -> None:
+        """Native fast-path handoff (utils/native.py append_frame):
+        `span_list` holds wire-format [header|body] memoryviews whose
+        CRCs, sizes, and contiguity were already verified in C, and
+        `batches` the matching decoded RecordBatch objects for index
+        bookkeeping. One writev lands them all; mirrors append()'s
+        per-batch accounting without re-packing any header."""
+        f = self._wfile()
+        fd = f.fileno()
+        total = sum(len(s) for s in span_list)
+        n = os.writev(fd, span_list)
+        if n != total:  # short write (signal/ENOSPC)
+            data = b"".join(bytes(s) for s in span_list)[n:]
+            while data:
+                data = data[os.write(fd, data) :]
+        pos = self._size
+        for batch in batches:
+            self._maybe_index(batch, pos)
+            pos += batch.header.size_bytes
+            if batch.header.max_timestamp > self.max_timestamp:
+                self.max_timestamp = batch.header.max_timestamp
+        self._size = pos
+        self.dirty_offset = batches[-1].header.last_offset
+
     def _maybe_index(self, batch: RecordBatch, pos: int) -> None:
         if self._bytes_since_index >= INDEX_INTERVAL_BYTES:
             self._idx_offsets.append(batch.header.base_offset)

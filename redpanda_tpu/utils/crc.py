@@ -45,17 +45,17 @@ def _crc32c_py(crc: int, data: bytes) -> int:
 
 def crc32c(data: bytes, crc: int = 0) -> int:
     """Extend CRC-32C `crc` over `data` (init 0 == fresh checksum)."""
-    lib = native.load()
-    if lib is not None:
-        return lib.rp_crc32c(crc, data, len(data))
+    v = native.crc32c(data, crc)
+    if v is not None:
+        return v
     return _crc32c_py(crc, data)
 
 
 def crc32c_combine(crc1: int, crc2: int, len2: int) -> int:
     """CRC of concat(A, B) given crc(A), crc(B) and len(B)."""
-    lib = native.load()
-    if lib is not None:
-        return lib.rp_crc32c_combine(crc1, crc2, len2)
+    v = native.crc32c_combine(crc1, crc2, len2)
+    if v is not None:
+        return v
     # GF(2) matrix method (zlib crc32_combine scheme).
     if len2 == 0:
         return crc1
@@ -107,16 +107,14 @@ def crc32c_batch(bufs: np.ndarray, lens: np.ndarray) -> np.ndarray:
     n, stride = bufs.shape
     if n and int(lens.max()) > stride:
         raise ValueError(f"lens.max()={int(lens.max())} exceeds stride={stride}")
-    lib = native.load()
-    if lib is not None:
-        out = np.zeros(n, dtype=np.uint32)
-        lib.rp_crc32c_batch(
-            bufs.ctypes.data_as(ctypes.c_char_p),
-            stride,
-            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-            n,
-        )
+    out = np.zeros(n, dtype=np.uint32)
+    if native.crc32c_batch(
+        bufs.ctypes.data_as(ctypes.c_char_p),
+        stride,
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        n,
+    ):
         return out
     return np.array(
         [crc32c(bufs[i, : int(lens[i])].tobytes()) for i in range(n)],

@@ -135,9 +135,15 @@ class IOBufParser:
 
     Walks fragments in place with a cursor — no up-front linearization;
     a read only copies when it straddles a fragment boundary.
+
+    Contiguous inputs (raw bytes / a single-fragment IOBuf — the RPC
+    and produce paths hand whole frames in) take a dedicated fast
+    path: one memoryview + one integer cursor, so read() is a slice
+    and a cursor add, and skip() advances without copying. Fragmented
+    inputs keep the full (_frag_idx, _frag_off) bookkeeping.
     """
 
-    __slots__ = ("_frags", "_frag_idx", "_frag_off", "_pos", "_size")
+    __slots__ = ("_frags", "_frag_idx", "_frag_off", "_pos", "_size", "_mv")
 
     def __init__(self, buf: "IOBuf | bytes | bytearray | memoryview"):
         if isinstance(buf, IOBuf):
@@ -150,11 +156,23 @@ class IOBufParser:
         self._frag_idx = 0
         self._frag_off = 0
         self._pos = 0
+        # _frag_idx/_frag_off stay untouched (and unread) on this path
+        self._mv = self._frags[0] if len(self._frags) == 1 else None
 
     def bytes_left(self) -> int:
         return self._size - self._pos
 
     def read(self, n: int) -> bytes:
+        mv = self._mv
+        if mv is not None:
+            pos = self._pos
+            if 0 <= n <= self._size - pos:
+                out = bytes(mv[pos : pos + n])
+                self._pos = pos + n
+                return out
+            if n < 0:
+                raise ValueError(f"negative read length {n}")
+            raise EOFError(f"need {n} bytes, have {self._size - pos}")
         if n < 0:
             raise ValueError(f"negative read length {n}")
         if self.bytes_left() < n:
@@ -184,6 +202,10 @@ class IOBufParser:
         return b"".join(parts)
 
     def peek(self, n: int) -> bytes:
+        mv = self._mv
+        if mv is not None:
+            pos = self._pos
+            return bytes(mv[pos : pos + min(n, self._size - pos)])
         saved = (self._frag_idx, self._frag_off, self._pos)
         try:
             return self.read(min(n, self.bytes_left()))
@@ -193,6 +215,11 @@ class IOBufParser:
     def _read_byte(self) -> int:
         if self._pos >= self._size:
             raise EOFError("vint past end of buffer")
+        mv = self._mv
+        if mv is not None:
+            b = mv[self._pos]
+            self._pos += 1
+            return b
         frag = self._frags[self._frag_idx]
         b = frag[self._frag_off]
         self._frag_off += 1
@@ -222,6 +249,15 @@ class IOBufParser:
         return (u >> 1) ^ -(u & 1)  # zigzag, inlined: hot per-record path
 
     def skip(self, n: int) -> None:
+        mv = self._mv
+        if mv is not None:  # advance the cursor, no copy
+            if n < 0:
+                raise ValueError(f"negative read length {n}")
+            left = self._size - self._pos
+            if left < n:
+                raise EOFError(f"need {n} bytes, have {left}")
+            self._pos += n
+            return
         self.read(n)
 
     def pos(self) -> int:

@@ -1,10 +1,22 @@
-"""Loader for the host-native C++ hot-path library.
+"""Loader + typed wrappers for the host-native C++ hot-path library.
 
 The reference keeps its data-plane primitives (CRC32c, compression,
-segment appender) in C++ (src/v/hashing/, src/v/compression/); we do the
-same: `native/` holds a small C++ library built with the system
-toolchain, loaded here via ctypes. Pure-Python fallbacks keep the
-framework importable if the toolchain is unavailable.
+segment appender, append_entries framing) in C++ (src/v/hashing/,
+src/v/compression/, src/v/raft/); we do the same: `native/` holds a
+small C++ library built with the system toolchain, loaded here via
+ctypes. Pure-Python fallbacks keep the framework importable if the
+toolchain is unavailable.
+
+This module is the ONLY place raw `rp_*` symbols may be touched
+(enforced by rplint RPL007): every native entry point is exposed as a
+typed wrapper below whose callers must tolerate a `None`/"unavailable"
+result, so each one keeps a Python fallback twin and `RP_NATIVE=0`
+degrades the whole library transparently.
+
+Escape hatches (checked per call, so tests can flip them at runtime):
+  RP_NATIVE=0          disable the native library entirely
+  RP_NATIVE_APPEND=0   disable only the AppendEntries follower fast path
+  RP_NATIVE_PRODUCE=0  disable only the Kafka produce frontend fast path
 """
 
 from __future__ import annotations
@@ -20,6 +32,16 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libredpanda_native.so")
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _build_failed = False
+
+# -- append_frame layout (keep in sync with native/append_frame.cc) --
+AF_STATE_N = 10
+AF_DESC_HDR = 8
+AF_DESC_W = 8
+AF_MAX_BATCHES = 64
+AF_REPLY_SIZE = 51
+
+# -- produce_frame layout (keep in sync with native/produce_frame.cc) --
+PF_OUT_N = 13
 
 
 def _sources_newer_than_lib() -> bool:
@@ -47,8 +69,12 @@ def _build() -> bool:
 
 
 def load() -> ctypes.CDLL | None:
-    """Load (building if needed) the native library; None on failure."""
+    """Load (building if needed) the native library; None on failure
+    or when RP_NATIVE=0 (the env var is consulted on every call, so a
+    test flipping it mid-run takes effect immediately)."""
     global _lib, _build_failed
+    if os.environ.get("RP_NATIVE") == "0":
+        return None
     if _lib is not None or _build_failed:
         return _lib
     with _lock:
@@ -102,5 +128,139 @@ def load() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_char),   # out (writable)
             ctypes.c_uint64,
         ]
+        lib.rp_append_frame.restype = ctypes.c_int64
+        lib.rp_append_frame.argtypes = [
+            ctypes.c_char_p,                 # payload
+            ctypes.c_uint64,                 # len
+            ctypes.POINTER(ctypes.c_int64),  # state
+            ctypes.POINTER(ctypes.c_int64),  # desc
+            ctypes.c_uint64,                 # desc rows
+            ctypes.POINTER(ctypes.c_char),   # reply (writable)
+            ctypes.c_uint64,                 # reply cap
+        ]
+        lib.rp_produce_frame.restype = ctypes.c_int64
+        lib.rp_produce_frame.argtypes = [
+            ctypes.c_char_p,                 # frame
+            ctypes.c_uint64,                 # len
+            ctypes.POINTER(ctypes.c_int64),  # out
+            ctypes.c_uint64,                 # out slots
+        ]
         _lib = lib
         return _lib
+
+
+# ------------------------------------------------------ crc wrappers
+def crc32c(data, crc: int = 0) -> int | None:
+    """Native CRC-32C extend, or None when the library is unavailable
+    (caller falls back to its pure-Python twin)."""
+    lib = load()
+    if lib is None:
+        return None
+    return lib.rp_crc32c(crc, data, len(data))
+
+
+def crc32c_sw(data, crc: int = 0) -> int | None:
+    """Software (slice-by-8) engine — the HW path's cross-check twin."""
+    lib = load()
+    if lib is None:
+        return None
+    return lib.rp_crc32c_sw(crc, data, len(data))
+
+
+def crc32c_combine(crc1: int, crc2: int, len2: int) -> int | None:
+    lib = load()
+    if lib is None:
+        return None
+    return lib.rp_crc32c_combine(crc1, crc2, len2)
+
+
+def crc32c_batch(bufs_ptr, stride: int, lens_ptr, out_ptr, n: int) -> bool:
+    """Batched CRC over `n` strided rows; the caller supplies ctypes
+    pointers (numpy .ctypes views). False when unavailable."""
+    lib = load()
+    if lib is None:
+        return False
+    lib.rp_crc32c_batch(bufs_ptr, stride, lens_ptr, out_ptr, n)
+    return True
+
+
+# --------------------------------------------------- record wrappers
+def parse_records(data, length: int, count: int, desc) -> int | None:
+    """Record-walker descriptor scan; returns the native rc (0 ok,
+    nonzero malformed) or None when the library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    return lib.rp_parse_records(data, length, count, desc)
+
+
+def encode_records(
+    n: int, ts_deltas, keys, key_lens, vals, val_lens, out, cap: int
+) -> int | None:
+    """Record-batch body encoder; returns bytes written (<=0 on bound
+    miss) or None when the library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    return lib.rp_encode_records(
+        n, ts_deltas, keys, key_lens, vals, val_lens, out, cap
+    )
+
+
+# --------------------------------------- append_frame (raft follower)
+def append_frame_ready() -> bool:
+    """Feature probe for the follower AppendEntries fast path."""
+    if os.environ.get("RP_NATIVE_APPEND") == "0":
+        return False
+    return load() is not None
+
+
+def append_frame_buffers():
+    """(state, desc, reply) scratch buffers for append_frame(); the
+    caller owns them (one set per consensus group, reused per call)."""
+    return (
+        (ctypes.c_int64 * AF_STATE_N)(),
+        (ctypes.c_int64 * (AF_DESC_HDR + AF_DESC_W * AF_MAX_BATCHES))(),
+        ctypes.create_string_buffer(AF_REPLY_SIZE),
+    )
+
+
+def append_frame(payload: bytes, state, desc, reply) -> int:
+    """One-call follower append framing (native/append_frame.cc).
+    Returns 0 on the happy path (desc/reply filled), a positive punt
+    code, or -1 when the library is unavailable."""
+    lib = load()
+    if lib is None:
+        return -1
+    return lib.rp_append_frame(
+        payload, len(payload), state, desc, AF_MAX_BATCHES, reply,
+        AF_REPLY_SIZE,
+    )
+
+
+# ------------------------------------- produce_frame (kafka frontend)
+def produce_frame_ready() -> bool:
+    """Feature probe for the Kafka produce frontend fast path."""
+    if os.environ.get("RP_NATIVE_PRODUCE") == "0":
+        return False
+    return load() is not None
+
+
+_pf_out = (ctypes.c_int64 * PF_OUT_N)()  # event-loop-thread scratch
+
+
+def produce_frame(frame: bytes) -> tuple | None:
+    """Decode + CRC-verify one produce request frame
+    (native/produce_frame.cc). Returns the 13-slot descriptor tuple
+    (api_version, correlation_id, flexible, client_id_off,
+    client_id_len, acks, timeout_ms, topic_off, topic_len, index,
+    records_off, records_len, n_batches) on the fast shape, None on
+    punt or when the library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    out = _pf_out
+    rc = lib.rp_produce_frame(frame, len(frame), out, PF_OUT_N)
+    if rc != 0:
+        return None
+    return tuple(out)

@@ -204,9 +204,6 @@ class SerdeError(ValueError):
     pass
 
 
-_UNSET = object()  # _fast_prefix cache sentinel (None is a valid value)
-
-
 class Envelope:
     """Base for versioned wire types. Subclasses set SERDE_FIELDS (and
     optionally SERDE_VERSION / SERDE_COMPAT_VERSION) and get __init__,
@@ -218,22 +215,36 @@ class Envelope:
     # defaults for trailing fields absent in envelopes written by older
     # versions (property of appended-field evolution)
     SERDE_DEFAULTS: dict = {}
+    # compiled encode/decode plan — see _compile_plan()
+    _SERDE_PLAN = None
+
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        # registration-time plan compile: every subclass pays the
+        # struct.Struct construction once at class-creation, never on
+        # the hot encode/decode path. Classes that assemble
+        # SERDE_FIELDS after the class body recompile transparently on
+        # first use via the identity check in _plan().
+        super().__init_subclass__(**kwargs)
+        cls._compile_plan()
 
     @classmethod
-    def _fast_prefix(cls):
-        """One compiled struct for the leading run of fixed-width/bool
-        fields — collapses N per-field decode lambdas into a single
-        unpack (and likewise for encode). Wire bytes are identical to
-        the per-field path (same fixed LE encodings; bool is one byte,
-        normalized to 0/1 on encode, `!= 0` on decode). Computed
-        lazily per class so dynamically-built SERDE_FIELDS still work."""
-        fast = cls.__dict__.get("_FAST_PREFIX_CACHE", _UNSET)
-        if fast is not _UNSET:
-            return fast
+    def _compile_plan(cls):
+        """(fields, prefix_struct|None, names, bools, full_struct|None):
+        `prefix_struct` collapses the leading run of fixed-width/bool
+        fields into one pack/unpack; when that run covers EVERY field
+        the envelope is fully fixed-width and `full_struct` spans
+        header+payload ("<BBI"+fmt) for a single-call wire round trip
+        (AppendEntriesReply et al on the replication hot loop). Wire
+        bytes are identical to the per-field path (same fixed LE
+        encodings; bool is one byte, normalized to 0/1 on encode,
+        `!= 0` on decode). The plan is keyed to the SERDE_FIELDS list
+        object itself so a class mutating its field table gets a fresh
+        compile."""
+        fields = cls.SERDE_FIELDS
         fmt = "<"
         names: list[str] = []
         bools: list[int] = []
-        for i, (name, t) in enumerate(cls.SERDE_FIELDS):
+        for i, (name, t) in enumerate(fields):
             spec = t.spec
             if spec is not None and spec[0] == "fixed":
                 fmt += spec[1][1:]  # strip the leading "<"
@@ -243,13 +254,22 @@ class Envelope:
             else:
                 break
             names.append(name)
-        fast = (
-            (struct.Struct(fmt), tuple(names), tuple(bools))
-            if len(names) >= 2
+        prefix = struct.Struct(fmt) if len(names) >= 2 else None
+        full = (
+            struct.Struct("<BBI" + fmt[1:])
+            if prefix is not None and len(names) == len(fields)
             else None
         )
-        cls._FAST_PREFIX_CACHE = fast
-        return fast
+        plan = (fields, prefix, tuple(names), tuple(bools), full)
+        cls._SERDE_PLAN = plan
+        return plan
+
+    @classmethod
+    def _plan(cls):
+        plan = cls._SERDE_PLAN
+        if plan is None or plan[0] is not cls.SERDE_FIELDS:
+            plan = cls._compile_plan()
+        return plan
 
     def __init__(self, **kwargs: Any):
         names = [n for n, _ in self.SERDE_FIELDS]
@@ -266,26 +286,59 @@ class Envelope:
             raise TypeError(f"unknown fields: {sorted(kwargs)}")
 
     def encode(self) -> bytes:
-        fast = self._fast_prefix()
-        if fast is not None:
-            s, names, bools = fast
-            vals = [getattr(self, n) for n in names]
+        cls = type(self)
+        fields, prefix, names, bools, full = cls._plan()
+        getter = self.__getattribute__  # localize: one dict probe/field
+        if full is not None:
+            # fully fixed-width envelope: header + payload in ONE pack
+            vals = [getter(n) for n in names]
             for i in bools:
                 vals[i] = 1 if vals[i] else 0
-            body = bytearray(s.pack(*vals))
-            rest = self.SERDE_FIELDS[len(names):]
+            return full.pack(
+                cls.SERDE_VERSION,
+                cls.SERDE_COMPAT_VERSION,
+                full.size - 6,
+                *vals,
+            )
+        if prefix is not None:
+            vals = [getter(n) for n in names]
+            for i in bools:
+                vals[i] = 1 if vals[i] else 0
+            body = bytearray(prefix.pack(*vals))
+            rest = fields[len(names):]
         else:
             body = bytearray()
-            rest = self.SERDE_FIELDS
+            rest = fields
         for name, t in rest:
-            t.encode(body, getattr(self, name))
+            t.encode(body, getter(name))
         head = struct.pack(
-            "<BBI", self.SERDE_VERSION, self.SERDE_COMPAT_VERSION, len(body)
+            "<BBI", cls.SERDE_VERSION, cls.SERDE_COMPAT_VERSION, len(body)
         )
         return head + bytes(body)
 
     @classmethod
     def decode(cls, data: "bytes | IOBufParser") -> "Envelope":
+        fields, prefix, names, bools, full = cls._plan()
+        if (
+            full is not None
+            and type(data) is bytes
+            and len(data) == full.size
+        ):
+            # fully fixed-width envelope arriving as an exact-size
+            # buffer: ONE unpack covers header + every field. Size or
+            # version skew (evolved peers) falls through to the
+            # general path below — wire semantics unchanged.
+            vals = full.unpack(data)
+            if vals[1] <= cls.SERDE_VERSION and vals[2] == full.size - 6:
+                obj = cls.__new__(cls)
+                setter = obj.__setattr__
+                i = 3
+                for n in names:
+                    setter(n, vals[i])
+                    i += 1
+                for i in bools:
+                    setter(names[i], vals[3 + i] != 0)
+                return obj
         p = data if isinstance(data, IOBufParser) else IOBufParser(data)
         version, compat, size = struct.unpack("<BBI", p.read(6))
         if compat > cls.SERDE_VERSION:
@@ -295,19 +348,16 @@ class Envelope:
             )
         end = p.pos() + size
         obj = cls.__new__(cls)
-        fast = cls._fast_prefix()
-        fields = cls.SERDE_FIELDS
-        if fast is not None:
-            s, names, bools = fast
-            if end - p.pos() >= s.size:
-                vals = s.unpack(p.read(s.size))
-                i = 0
-                for n in names:
-                    setattr(obj, n, vals[i])
-                    i += 1
-                for i in bools:
-                    setattr(obj, names[i], vals[i] != 0)
-                fields = fields[len(names):]
+        if prefix is not None and end - p.pos() >= prefix.size:
+            vals = prefix.unpack(p.read(prefix.size))
+            setter = obj.__setattr__
+            i = 0
+            for n in names:
+                setter(n, vals[i])
+                i += 1
+            for i in bools:
+                setter(names[i], vals[i] != 0)
+            fields = fields[len(names):]
         for name, t in fields:
             if p.pos() >= end:
                 # older peer/log entry: fields added after its version

@@ -53,7 +53,8 @@ class TestCrc32c:
         rng = np.random.default_rng(0)
         for n in [0, 1, 7, 8, 9, 63, 64, 1024, 4097]:
             data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
-            assert lib.rp_crc32c(0, data, n) == lib.rp_crc32c_sw(0, data, n)
+            # deliberate raw-symbol ABI cross-check of the two engines
+            assert lib.rp_crc32c(0, data, n) == lib.rp_crc32c_sw(0, data, n)  # rplint: disable=RPL007
 
     def test_combine(self):
         a, b = b"hello, ", b"redpanda on tpu"

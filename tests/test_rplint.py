@@ -471,6 +471,59 @@ def test_rpl006_suppression(tmp_path):
     assert _only(_lint_source(tmp_path, src, "rpc/mod.py"), "RPL006") == []
 
 
+# -- RPL007: raw native symbols outside utils/native.py ---------------
+
+RPL007_BAD = """
+    from redpanda_tpu.utils import native
+
+    def checksum(data):
+        lib = native.load()
+        if lib is not None:
+            return lib.rp_crc32c(0, data, len(data))
+        return None
+"""
+
+
+def test_rpl007_reports_raw_symbol(tmp_path):
+    (f,) = _only(_lint_source(tmp_path, RPL007_BAD, "utils/crc.py"), "RPL007")
+    assert "rp_crc32c" in f.message
+    assert f.line == 7
+
+
+def test_rpl007_getattr_string_form(tmp_path):
+    src = """
+        def probe(lib):
+            return getattr(lib, "rp_append_frame", None)
+    """
+    (f,) = _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL007")
+    assert "rp_append_frame" in f.message
+
+
+def test_rpl007_native_module_exempt(tmp_path):
+    assert (
+        _only(_lint_source(tmp_path, RPL007_BAD, "utils/native.py"), "RPL007")
+        == []
+    )
+
+
+def test_rpl007_suppression(tmp_path):
+    src = RPL007_BAD.replace(
+        "return lib.rp_crc32c(0, data, len(data))",
+        "return lib.rp_crc32c(0, data, len(data))  # rplint: disable=RPL007",
+    )
+    assert _only(_lint_source(tmp_path, src, "utils/crc.py"), "RPL007") == []
+
+
+def test_rpl007_wrapper_calls_not_flagged(tmp_path):
+    src = """
+        from redpanda_tpu.utils import native
+
+        def checksum(data):
+            return native.crc32c(data)
+    """
+    assert _only(_lint_source(tmp_path, src, "utils/crc.py"), "RPL007") == []
+
+
 # -- baseline mechanics ------------------------------------------------
 
 

@@ -8,6 +8,7 @@ from .rpl003_jit_purity import JitPurityRule
 from .rpl004_blocking_async import BlockingInAsyncRule
 from .rpl005_cancelled_swallow import CancelledSwallowRule
 from .rpl006_net_await_budget import NetAwaitBudgetRule
+from .rpl007_native_symbols import NativeSymbolRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -16,6 +17,7 @@ ALL_RULES = [
     BlockingInAsyncRule,
     CancelledSwallowRule,
     NetAwaitBudgetRule,
+    NativeSymbolRule,
 ]
 
 __all__ = ["ALL_RULES"]

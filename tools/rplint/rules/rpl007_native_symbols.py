@@ -1,0 +1,73 @@
+"""RPL007 — raw native `rp_*` symbols only inside utils/native.py.
+
+The host-native library (native/*.cc, loaded via ctypes) is wrapped
+by `redpanda_tpu/utils/native.py`: every entry point gets a typed
+wrapper that (a) carries the ctypes argtypes/restype contract in ONE
+place, (b) honors the RP_NATIVE / RP_NATIVE_APPEND / RP_NATIVE_PRODUCE
+escape hatches on every call, and (c) returns a None/"unavailable"
+sentinel so callers keep their pure-Python fallback twin.
+
+A call site that grabs the CDLL handle and touches `lib.rp_foo`
+directly skips all three: a signature drift in native/ becomes a
+silent ABI mismatch (ctypes happily truncates ints without declared
+argtypes), and RP_NATIVE=0 no longer degrades that path — the exact
+failure shape the differential-fuzz suite exists to prevent.
+
+Flagged anywhere under the scan root except utils/native.py:
+
+  lib.rp_crc32c(...)              attribute access on any object
+  getattr(lib, "rp_append_frame") string-form access
+
+Suppress a deliberate exception (e.g. an ABI cross-check test) with
+`# rplint: disable=RPL007`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext
+
+_EXEMPT_SUFFIX = "utils/native.py"
+
+
+def _is_rp_symbol(name: str) -> bool:
+    return name.startswith("rp_")
+
+
+class NativeSymbolRule:
+    code = "RPL007"
+    name = "raw-native-symbol"
+
+    def check(self, ctx: ModuleContext):
+        if ctx.path.replace("\\", "/").endswith(_EXEMPT_SUFFIX):
+            return
+        for node in ast.walk(ctx.tree):
+            sym = None
+            if isinstance(node, ast.Attribute) and _is_rp_symbol(node.attr):
+                sym = node.attr
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "getattr"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+                and _is_rp_symbol(node.args[1].value)
+            ):
+                sym = node.args[1].value
+            if sym is None:
+                continue
+            if ctx.suppressed(node, self.code):
+                continue
+            yield Finding(
+                path=ctx.path,
+                line=node.lineno,
+                col=node.col_offset,
+                rule=self.code,
+                message=(
+                    f"raw native symbol '{sym}' used outside "
+                    "utils/native.py — go through its typed wrapper "
+                    "(escape hatches and ctypes signatures live there)"
+                ),
+            )

@@ -1,14 +1,32 @@
 #!/usr/bin/env bash
 # Full local verification: static analysis first (fails in seconds on
-# a broken invariant, before 10+ minutes of tests), then the tier-1
-# suite with the same flags the driver uses.
+# a broken invariant, before 10+ minutes of tests), then the native
+# library build, then the tier-1 suite with the same flags the driver
+# uses — twice-lite: the full suite with the native hot paths live,
+# plus a pure-Python smoke pass (RP_NATIVE=0) over the suites that
+# gate the native/fallback seam, so a fallback regression can't hide
+# behind a working .so.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== rplint (baseline gate) =="
 python -m tools.rplint --baseline redpanda_tpu
 
-echo "== tier-1 tests =="
-exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+echo "== native build =="
+if make -s -C native; then
+    echo "built native/build/libredpanda_native.so"
+else
+    echo "WARN: native build failed; suite runs on pure-Python fallbacks"
+fi
+
+echo "== tier-1 tests (native) =="
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly "$@"
+
+echo "== fallback smoke (RP_NATIVE=0) =="
+exec env JAX_PLATFORMS=cpu RP_NATIVE=0 python -m pytest \
+    tests/test_native_append.py tests/test_native_records.py \
+    tests/test_produce_fast.py tests/test_foundation.py \
+    -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
