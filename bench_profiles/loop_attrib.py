@@ -35,6 +35,7 @@ class LoopAttributor:
     def __init__(self) -> None:
         self.ns: dict[str, int] = defaultdict(int)
         self.calls: dict[str, int] = defaultdict(int)
+        self.max_ns: dict[str, int] = defaultdict(int)
         self._orig = None
 
     def start(self) -> None:
@@ -43,6 +44,7 @@ class LoopAttributor:
         self._orig = orig = asyncio.events.Handle._run
         ns = self.ns
         calls = self.calls
+        max_ns = self.max_ns
         perf = time.perf_counter_ns
         Task = asyncio.Task
 
@@ -60,8 +62,11 @@ class LoopAttributor:
             try:
                 return orig(handle)
             finally:
-                ns[label] += perf() - t0
+                dt = perf() - t0
+                ns[label] += dt
                 calls[label] += 1
+                if dt > max_ns[label]:
+                    max_ns[label] = dt
 
         asyncio.events.Handle._run = _run
 
@@ -73,6 +78,7 @@ class LoopAttributor:
     def reset(self) -> None:
         self.ns.clear()
         self.calls.clear()
+        self.max_ns.clear()
 
     def table(self, rounds: int | None = None, top: int = 24) -> str:
         """Formatted per-coroutine attribution, sorted by total time.
@@ -80,7 +86,10 @@ class LoopAttributor:
         µs/round column normalizes across window lengths."""
         rows = sorted(self.ns.items(), key=lambda kv: -kv[1])[:top]
         total_ns = sum(self.ns.values())
-        head = f"{'coroutine':<52} {'calls':>9} {'total_ms':>9} {'us/call':>8}"
+        head = (
+            f"{'coroutine':<52} {'calls':>9} {'total_ms':>9} "
+            f"{'us/call':>8} {'max_ms':>7}"
+        )
         if rounds:
             head += f" {'us/round':>9}"
         lines = [head, "-" * len(head)]
@@ -88,7 +97,7 @@ class LoopAttributor:
             c = self.calls[label]
             line = (
                 f"{label[:52]:<52} {c:>9} {t / 1e6:>9.1f} "
-                f"{t / c / 1e3:>8.1f}"
+                f"{t / c / 1e3:>8.1f} {self.max_ns[label] / 1e6:>7.2f}"
             )
             if rounds:
                 line += f" {t / rounds / 1e3:>9.1f}"

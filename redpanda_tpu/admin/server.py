@@ -105,6 +105,8 @@ class AdminServer(HttpServer):
           self._balancer_cancel)
         r("GET", r"/v1/raft/recovery/status", self._recovery_status)
         r("GET", r"/v1/debug/blocked_reactor", self._blocked_reactor)
+        r("GET", r"/v1/debug/traces", self._debug_traces)
+        r("GET", r"/v1/debug/probes", self._debug_probes)
         r("POST", r"/v1/debug/cpu_profiler", self._cpu_profile)
         r("GET", r"/v1/shadow_indexing/manifest/([^/]+)/(\d+)",
           self._si_manifest)
@@ -1299,6 +1301,53 @@ class AdminServer(HttpServer):
         """Per-group shares/queue/consumption of the background
         weighted-fair scheduler (resource_mgmt)."""
         return self.broker.scheduler.stats()
+
+    async def _debug_traces(self, _m, q, _b):
+        """Flight-recorder dump: frozen slow-request span trees, the
+        ring tail of recent trees, and the fault-event log
+        (observability/trace.py). `?tail=N` bounds the ring slice."""
+        try:
+            tail = int(q.get("tail", 50) or 50)
+        except ValueError:
+            raise HttpError(400, f"bad tail {q.get('tail')!r}") from None
+        dump = self.broker.recorder.dump(tail=tail)
+        # nemesis events recorded through the module default recorder
+        # (rpc/loopback fires them without broker context) surface in
+        # the same dump so a fault and the spans it hit read together
+        from ..observability.trace import default_recorder
+
+        shared = default_recorder()
+        if shared is not self.broker.recorder and shared.events():
+            dump["events"] = dump["events"] + shared.events()
+        return dump
+
+    async def _debug_probes(self, _m, _q, _b):
+        """Per-partition raft state + live histogram snapshots (the
+        probe families as quantiles rather than Prometheus buckets)."""
+        groups = []
+        for c in self.broker.group_manager.groups():
+            offs = c.log.offsets()
+            groups.append(
+                {
+                    "group": c.group_id,
+                    "role": c.role.name,
+                    "term": c.term,
+                    "leader_id": c.leader_id,
+                    "commit_index": c.commit_index,
+                    "dirty_offset": offs.dirty_offset,
+                    "flushed_offset": offs.committed_offset,
+                }
+            )
+        return {
+            "node_id": self.broker.node_id,
+            "groups": groups,
+            "histograms": {
+                name: h.snapshot()
+                for name, h in sorted(
+                    self.broker.metrics.histograms().items()
+                )
+            },
+        }
 
     async def _metrics(self, _m, _q, _b):
         return self.broker.metrics.render()

@@ -171,8 +171,13 @@ class Broker:
         self.node_id = config.node_id
         self._loopback = loopback
 
-        self.storage = StorageApi(config.data_dir)
         self.metrics = MetricsRegistry()
+        self.storage = StorageApi(config.data_dir, metrics=self.metrics)
+        # flight recorder (observability/trace.py): per-broker ring of
+        # span trees + slow-request freezer, dumped at /v1/debug/traces
+        from .observability import FlightRecorder
+
+        self.recorder = FlightRecorder(node_id=config.node_id)
         if object_store is None and config.cloud_storage_endpoint is not None:
             from .cloud.s3_client import S3ObjectStore, StaticCredentialsProvider
 
@@ -215,6 +220,7 @@ class Broker:
             election_timeout_s=config.election_timeout_s,
             heartbeat_interval_s=config.heartbeat_interval_s,
             kvstore=self.storage.kvs,
+            metrics=self.metrics,
         )
         self.shard_table = ShardTable()
         self.partition_manager = PartitionManager(
@@ -512,6 +518,21 @@ class Broker:
         )
         from .resource_mgmt import MemoryGovernor
 
+        m.gauge(
+            "raft_recovery_throttled_seconds_total",
+            lambda: self.group_manager.recovery_throttle.throttled_s,
+            "Cumulative recovery-throttle wait (recovery_throttle.h)",
+        )
+        m.gauge(
+            "trace_trees_total",
+            lambda: self.recorder.trees_total,
+            "Flight-recorder span trees completed",
+        )
+        m.gauge(
+            "trace_slow_frozen_total",
+            lambda: self.recorder.frozen_total,
+            "Flight-recorder slow-request trees frozen",
+        )
         m.gauge(
             "gc_pause_max_ms",
             lambda: MemoryGovernor.instance().pause_max_ms,

@@ -33,6 +33,7 @@ from ..cluster.producer_state import (
 from ..models.fundamental import NTP, DEFAULT_NS, TopicNamespace, kafka_ntp
 from ..compression import CompressionType
 from ..models.record import CrcMismatch, RecordBatch
+from ..observability import trace
 from ..raft.consensus import NotLeaderError, ReplicateTimeout
 from ..security.acl import AclOperation, AclResourceType
 from ..utils.iobuf import IOBufParser
@@ -78,6 +79,41 @@ def _default_rf(n_brokers: int) -> int:
 class _CloseConnection(Exception):
     """Raised by the request pipeline to drop the connection — the
     reference closes on unparseable/unanswerable requests."""
+
+
+class _RxStampProtocol(asyncio.StreamReaderProtocol):
+    """StreamReaderProtocol that stamps when a request's first bytes
+    reach the broker. data_received runs in the same loop iteration
+    the selector reports the socket readable — BEFORE the connection
+    task's readexactly wakes — so the stamp includes the reader-task
+    wakeup delay on a backlogged loop: request queueing the client's
+    clock counts but a _process-entry stamp misses."""
+
+    def __init__(self, stream_reader, client_connected_cb, loop):
+        super().__init__(stream_reader, client_connected_cb, loop=loop)
+        self.rx_t0 = -1.0  # re-armed by the reader after each frame
+
+    def data_received(self, data: bytes) -> None:
+        if self.rx_t0 < 0.0:
+            self.rx_t0 = time.monotonic()
+        super().data_received(data)
+
+
+class _TrackedResponse:
+    """Response plus a callback fired once the frame is on the wire.
+
+    The produce/fetch `done` stage closes at write time, not at
+    handler-return time: on a saturated loop the hop through the
+    pending queue, the write task's wakeup, and head-of-line blocking
+    behind earlier responses on the shared connection are all real
+    milliseconds the client's clock sees — without this the probe's
+    p99 under-reports the e2e p99 by ~2x the scheduling latency."""
+
+    __slots__ = ("resp", "on_written")
+
+    def __init__(self, resp, on_written):
+        self.resp = resp  # bytes | None | coroutine
+        self.on_written = on_written
 
 
 def _consume_exc(fut: "asyncio.Future") -> None:
@@ -161,6 +197,11 @@ class KafkaServer:
         self._latency_hist = broker.metrics.histogram(
             "kafka_handler_seconds", "Kafka handler latency"
         )
+        # per-stage produce/fetch probe (latency_probe.h analog): all
+        # label children resolved here, hot path pays bound observes
+        from .probe import KafkaProbe
+
+        self.probe = KafkaProbe(broker.metrics)
         # hdr_hist quantiles (latency_probe.h): bounded-relative-error
         # percentiles the log2 Prometheus buckets cannot resolve
         from ..utils.hdr_hist import HdrHist
@@ -235,14 +276,18 @@ class KafkaServer:
                 with open(cfg.kafka_tls_cert, "rb") as f:
                     own = x509.load_pem_x509_certificate(f.read())
                 self._own_cert_der = own.public_bytes(Encoding.DER)
-        self._server = await asyncio.start_server(
-            self._on_conn,
-            cfg.kafka_host,
-            cfg.kafka_port,
-            ssl=ssl_ctx,
+        loop = asyncio.get_event_loop()
+
+        def _proto_factory() -> _RxStampProtocol:
             # default 64 KiB stream high-water drowns MB-sized produce
             # frames in pause/resume churn (~15% of a produce round)
-            limit=1 << 21,
+            reader = asyncio.StreamReader(limit=1 << 21, loop=loop)
+            return _RxStampProtocol(reader, self._on_conn, loop)
+
+        # create_server instead of start_server: the protocol factory
+        # is how the rx stamp gets under the stream reader
+        self._server = await loop.create_server(
+            _proto_factory, cfg.kafka_host, cfg.kafka_port, ssl=ssl_ctx
         )
         self.port = self._server.sockets[0].getsockname()[1]
 
@@ -308,12 +353,15 @@ class KafkaServer:
                 ctx.authenticated = True
         pending: asyncio.Queue = asyncio.Queue()
         conn_failed = asyncio.Event()
+        proto = writer.transport.get_protocol()
+        rx = proto if isinstance(proto, _RxStampProtocol) else None
 
         async def write_loop() -> None:
             while True:
-                fut = await pending.get()
-                if fut is None:
+                item = await pending.get()
+                if item is None:
                     return
+                fut, on_written = item
                 try:
                     resp = await fut
                 except _CloseConnection as e:
@@ -333,6 +381,8 @@ class KafkaServer:
                 if resp is not None:
                     writer.write(_SIZE.pack(len(resp)) + resp)
                     await writer.drain()
+                if on_written is not None:
+                    on_written()
 
         write_task = asyncio.ensure_future(write_loop())
         try:
@@ -348,19 +398,33 @@ class KafkaServer:
                 if size <= 0 or size > max_frame:
                     return
                 frame = await reader.readexactly(size)
+                # request clock starts at wire arrival when the stamp
+                # is armed; fallback (frame already buffered when the
+                # previous one was consumed) is "now" — conservative
+                if rx is not None and rx.rx_t0 >= 0.0:
+                    t_req = rx.rx_t0
+                    rx.rx_t0 = -1.0
+                else:
+                    t_req = time.monotonic()
                 try:
-                    resp = await self._process(frame, ctx)
+                    resp = await self._process(frame, ctx, t_req)
                 except _CloseConnection as e:
                     fut = asyncio.get_event_loop().create_future()
                     fut.set_exception(e)
-                    await pending.put(fut)
+                    await pending.put((fut, None))
                     break
+                on_written = None
+                if type(resp) is _TrackedResponse:
+                    on_written = resp.on_written
+                    resp = resp.resp
                 if asyncio.iscoroutine(resp):
-                    await pending.put(asyncio.ensure_future(resp))
+                    await pending.put(
+                        (asyncio.ensure_future(resp), on_written)
+                    )
                 else:
                     fut = asyncio.get_event_loop().create_future()
                     fut.set_result(resp)
-                    await pending.put(fut)
+                    await pending.put((fut, on_written))
             await pending.put(None)  # writer drains then exits
             await write_task
         except (asyncio.CancelledError, ConnectionError):
@@ -375,15 +439,17 @@ class KafkaServer:
                 pass
             # settle any still-pending response futures
             while not pending.empty():
-                fut = pending.get_nowait()
-                if fut is not None:
-                    fut.cancel()
+                item = pending.get_nowait()
+                if item is not None:
+                    item[0].cancel()
             try:
                 writer.close()
             except Exception:
                 pass
 
-    async def _process(self, frame: bytes, ctx: ConnectionContext) -> bytes | None:
+    async def _process(
+        self, frame: bytes, ctx: ConnectionContext, t_req: float | None = None
+    ) -> bytes | None:
         from .protocol.admin_apis import SASL_AUTHENTICATE, SASL_HANDSHAKE
 
         # Native produce frontend: header decode + body decode +
@@ -392,11 +458,16 @@ class KafkaServer:
         # hot single-topic/single-partition shape; all the gates below
         # still run on the returned header, so SASL/session/version
         # semantics are unchanged.
+        if t_req is None:  # callers without an rx stamp
+            t_req = time.monotonic()
         req = None
+        native_path = False
         if produce_fast.native_ready():
             nat = produce_fast.decode_request_native(frame)
             if nat is not None:
                 hdr, req = nat
+                native_path = True
+                self.probe.decode[(0, True)](time.monotonic() - t_req)
         if req is None:
             r = Reader(frame)
             hdr = decode_request_header(r)
@@ -453,8 +524,15 @@ class KafkaServer:
                 )
                 if req is None:
                     req = api.decode_request(body_mv, hdr.api_version)
+                self.probe.decode[(0, False)](time.monotonic() - t_req)
             else:
                 req = api.decode_request(body_mv, hdr.api_version)
+                if hdr.api_key == 1:
+                    self.probe.decode[(1, False)](time.monotonic() - t_req)
+        probe_key = (
+            (hdr.api_key, native_path) if hdr.api_key in (0, 1) else None
+        )
+        root = None
         if hdr.api_key == SASL_HANDSHAKE.key:
             resp = self.handle_sasl_handshake(ctx, hdr, req)
         elif hdr.api_key == SASL_AUTHENTICATE.key:
@@ -469,10 +547,23 @@ class KafkaServer:
             if has_identity:
                 token = CURRENT_PRINCIPAL.set(ctx.principal)
                 itoken = CURRENT_INTERNAL.set(ctx.internal)
+            if probe_key is not None and trace.ENABLED:
+                # flight-recorder root; its lifetime crosses into the
+                # write loop (on_written), so the contextvar scope
+                # (detach) and the end stamp (finish) split
+                root = self.broker.recorder.span(
+                    "kafka.produce" if hdr.api_key == 0 else "kafka.fetch",
+                    path="native" if native_path else "python",
+                )
+                root.__enter__()
             t0 = asyncio.get_event_loop().time()
             try:
                 resp = await handler(hdr, req)
             except Exception:
+                if root is not None:
+                    # error path never reaches the write loop, so the
+                    # span can't close at write time — stamp it here
+                    root.finish()
                 logger.exception(
                     "%s v%d handler failed", api.name, hdr.api_version
                 )
@@ -485,25 +576,52 @@ class KafkaServer:
                 elapsed = asyncio.get_event_loop().time() - t0
                 self._latency_hist.observe(elapsed)
                 self._latency_hdr.record(int(elapsed * 1e6))
+                if probe_key is not None:
+                    self.probe.dispatch[probe_key](elapsed)
+                if root is not None:
+                    root.detach()
+        on_written = None
+        if probe_key is not None:
+            # fires in write_loop after writer.drain(): the done window
+            # matches what the client's own clock measures (see
+            # _TrackedResponse)
+            def on_written(
+                done_obs=self.probe.done[probe_key], t_req=t_req, root=root
+            ):
+                done_obs(time.monotonic() - t_req)
+                if root is not None:
+                    root.finish()
+
         if asyncio.iscoroutine(resp):
             # staged handler (produce): dispatch done, response later —
             # encode when it settles, off the reader path
-            async def finish(inner=resp, hdr=hdr, api=api):
-                body = await inner
+            async def finish(inner=resp, hdr=hdr, api=api, root=root):
+                if root is not None:
+                    with trace.span("produce.ack_wait", parent=root):
+                        body = await inner
+                else:
+                    body = await inner
                 if body is None:
                     return None
                 head = encode_response_header(
                     hdr.api_key, hdr.api_version, hdr.correlation_id
                 )
-                return head + self._encode_response(api, body, hdr.api_version)
+                return head + self._encode_response(
+                    api, body, hdr.api_version
+                )
 
+            if on_written is not None:
+                return _TrackedResponse(finish(), on_written)
             return finish()
         if resp is None:  # acks=0 produce: no response on the wire
             return None
         head = encode_response_header(
             hdr.api_key, hdr.api_version, hdr.correlation_id
         )
-        return head + self._encode_response(api, resp, hdr.api_version)
+        out = head + self._encode_response(api, resp, hdr.api_version)
+        if on_written is not None:
+            return _TrackedResponse(out, on_written)
+        return out
 
     @staticmethod
     def _encode_response(api, msg, version: int) -> bytes:
@@ -962,13 +1080,14 @@ class KafkaServer:
         # order is fixed by enqueue order
         work = []
         produced_bytes = 0
-        for t in req.topics:
-            for p in t.partitions:
-                produced_bytes += len(p.records or b"")
-            partition_work = [
-                await dispatch_partition(t.name, p) for p in t.partitions
-            ]
-            work.append((t.name, partition_work))
+        with trace.span("produce.dispatch"):
+            for t in req.topics:
+                for p in t.partitions:
+                    produced_bytes += len(p.records or b"")
+                partition_work = [
+                    await dispatch_partition(t.name, p) for p in t.partitions
+                ]
+                work.append((t.name, partition_work))
         throttle = self.quotas.record_and_throttle(
             "produce", hdr.client_id, produced_bytes
         )

@@ -1,0 +1,16 @@
+"""Observability layer: flight-recorder tracing + latency probes.
+
+The reference ships per-subsystem seastar probes and HdrHistograms but
+no request tracer (SURVEY §5.1); this package adds both halves for the
+port — `trace` (ring-buffered span trees with a slow-request freezer)
+feeding the admin `/v1/debug/traces` surface, with the histogram side
+living in `redpanda_tpu.metrics` + per-subsystem `*/probe.py` objects.
+"""
+
+from .trace import (  # noqa: F401
+    FlightRecorder,
+    Span,
+    current_span,
+    span,
+    tag_current,
+)

@@ -30,6 +30,7 @@ import logging
 import os
 import random
 import struct
+import time
 from enum import Enum
 from typing import Awaitable, Callable, Optional
 
@@ -95,6 +96,7 @@ class Consensus:
         send: SendFn,
         election_timeout_s: float = 0.3,
         recovery_throttle=None,
+        probe=None,
     ):
         self.group_id = group_id
         self.node_id = node_id
@@ -108,6 +110,16 @@ class Consensus:
         # (raft/recovery.py; ref recovery_throttle.h) — None in unit
         # fixtures that build Consensus directly
         self.recovery_throttle = recovery_throttle
+        # latency/event probe (raft/probe.cc analog): GroupManager
+        # shares its node-level probe; direct fixtures get a private
+        # unscraped one so the hot path never branches on None
+        if probe is None:
+            from .probe import fixture_probe
+
+            probe = fixture_probe()
+        self.probe = probe
+        self._observe_commit = probe.observe_commit
+        self._election_t0: Optional[float] = None
         # unified retry budget for the remote send loops (catch-up
         # backoff, snapshot chunks): a child of the node-wide root when
         # one is wired, so a node-level abort cancels every group's
@@ -590,6 +602,8 @@ class Consensus:
                     or now - self._last_heartbeat < self._election_timeout
                 ):
                     return
+                self.probe.elections_started.inc()
+                self._election_t0 = now
                 await self.dispatch_vote()
         except Exception:
             logger.exception("g%d: election round failed", self.group_id)
@@ -701,6 +715,12 @@ class Consensus:
         return ok
 
     def _become_leader(self) -> None:
+        self.probe.leadership_changes.inc()
+        if self._election_t0 is not None:
+            self.probe.election_hist.observe(
+                asyncio.get_event_loop().time() - self._election_t0
+            )
+            self._election_t0 = None
         row = self.row
         self.role = Role.LEADER
         self.leader_id = self.node_id
@@ -999,7 +1019,9 @@ class Consensus:
             batch = RecordBatch(hdr, payload[off + HEADER_SIZE : off + ln])
             batch.finalized = True  # both CRCs verified in C
             batches.append(batch)
+        t_seg = time.monotonic()
         seg.append_verified_spans(span_list, batches)
+        log._observe_append(time.monotonic() - t_seg)
         cache = log._cache_index
         hooks = log.on_append
         for batch in batches:
@@ -1160,6 +1182,8 @@ class Consensus:
             self._qw_timer = loop.call_later(1.0, self._sweep_quorum_timeouts)
 
     def _resolve_quorum_items(self, term: int, items: list) -> None:
+        now = time.monotonic()
+        observe = self._observe_commit
         for it in items:
             fut = it.stages.done
             if fut.done():
@@ -1169,6 +1193,8 @@ class Consensus:
                 fut.set_exception(NotLeaderError(self.leader_id))
             else:
                 fut.set_result((it.base, it.last))
+                # enqueue -> quorum ack (raft/probe.cc replicate done)
+                observe(now - it.t0)
 
     def _fail_quorum_waiters(self, make_exc) -> None:
         waiters, self._quorum_waiters = self._quorum_waiters, []
@@ -1379,6 +1405,7 @@ class Consensus:
             rounds += 1
             if rounds > 1:
                 spans.add("catchup.extra_round", 1.0)
+                self.probe.recovery_rounds.inc()
             slot = self._slot_map.get(peer)
             if slot is None:
                 return

@@ -29,6 +29,7 @@ class GroupManager:
         election_timeout_s: float = 0.3,
         heartbeat_interval_s: float = 0.05,
         kvstore: Optional[KvStore] = None,
+        metrics=None,
     ):
         self.node_id = node_id
         self.data_dir = data_dir
@@ -53,9 +54,15 @@ class GroupManager:
         from .recovery import RecoveryThrottle
 
         self.recovery_throttle = RecoveryThrottle()
+        # node-level probe shared by every group (raft/probe.cc wires
+        # one per partition; the families aggregate the same way)
+        from .probe import RaftProbe
+
+        self.probe = RaftProbe(metrics)
         self.heartbeat_manager = HeartbeatManager(
             node_id, send, interval_s=heartbeat_interval_s
         )
+        self.heartbeat_manager.probe = self.probe
         self.service = RaftService(self)
         self._groups: dict[int, Consensus] = {}
         self._by_row: dict[int, Consensus] = {}
@@ -227,6 +234,7 @@ class GroupManager:
             send=self._send,
             election_timeout_s=election_timeout_s or self._election_timeout,
             recovery_throttle=self.recovery_throttle,
+            probe=self.probe,
         )
         self._groups[group_id] = c
         self._by_row[c.row] = c

@@ -143,6 +143,8 @@ class HeartbeatManager:
         self._plan: Optional[dict[int, _PeerPlan]] = None
         self._task: Optional[asyncio.Task] = None
         self._closed = False
+        # RaftProbe set by GroupManager; None for direct fixtures
+        self.probe = None
 
     def register(self, c: Consensus) -> None:
         self._groups[c.group_id] = c
@@ -176,8 +178,13 @@ class HeartbeatManager:
     async def _loop(self) -> None:
         while not self._closed:
             try:
+                t0 = time.perf_counter()
                 with spans.span("hb.tick"):
                     await self.tick()
+                if self.probe is not None:
+                    self.probe.heartbeat_tick_hist.observe(
+                        time.perf_counter() - t0
+                    )
             except Exception:
                 logger.exception("heartbeat tick failed")
             await asyncio.sleep(self.interval)

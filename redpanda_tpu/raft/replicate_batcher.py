@@ -27,10 +27,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import TYPE_CHECKING, Optional
 
 from ..models.record import RecordBatch, RecordBatchBuilder
 from ..models.consensus_state import SELF_SLOT
+from ..observability import trace
 from ..utils import spans
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -61,7 +63,9 @@ class ReplicateStages:
 
 
 class _Item:
-    __slots__ = ("batch", "acks", "stages", "size", "base", "last")
+    __slots__ = (
+        "batch", "acks", "stages", "size", "base", "last", "t0", "span",
+    )
 
     def __init__(self, batch: RecordBatch, acks: int, size: int):
         self.batch = batch
@@ -70,6 +74,13 @@ class _Item:
         self.size = size
         self.base = -1
         self.last = -1
+        # enqueue stamp for the commit-latency probe
+        # (consensus._resolve_quorum_items observes now - t0)
+        self.t0 = time.monotonic()
+        # requester's open trace span (the produce dispatch), captured
+        # here because the flush round runs in a different task — it
+        # parents the round's raft.append/raft.flush spans
+        self.span = trace.current_span()
 
 
 class ReplicateBatcher:
@@ -173,17 +184,21 @@ class ReplicateBatcher:
         row = c.row
         round_last = -1
         appended: list[_Item] = []
-        with spans.span("batcher.append"):
-            for it in items:
-                it.base, it.last = c.log.append(it.batch, term=term)
-                round_last = it.last
-                if it.acks == 0 and not it.stages.done.done():
-                    it.stages.done.set_result((it.base, it.last))
-                appended.append(it)
+        t_append = time.monotonic()
+        with trace.span("raft.append", parent=items[0].span, items=len(items)):
+            with spans.span("batcher.append"):
+                for it in items:
+                    it.base, it.last = c.log.append(it.batch, term=term)
+                    round_last = it.last
+                    if it.acks == 0 and not it.stages.done.done():
+                        it.stages.done.set_result((it.base, it.last))
+                    appended.append(it)
+        c.probe.observe_append(time.monotonic() - t_append)
         spans.add("batcher.round_items", float(len(items)))
         self.flush_rounds += 1
-        with spans.span("batcher.fsync"):
-            flushed = await c.log.flush_async()
+        with trace.span("raft.flush", parent=items[0].span):
+            with spans.span("batcher.fsync"):
+                flushed = await c.log.flush_async()
         # leadership may have moved while the fsync ran
         if c._closed or c.role != Role.LEADER or c.term != term:
             exc = NotLeaderError(c.leader_id)

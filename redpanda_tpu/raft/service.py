@@ -9,6 +9,7 @@ per-group RPC overhead — the follower side of the batched sweep.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import struct
 
@@ -463,9 +464,17 @@ class RaftService(Service):
         """Many groups' appends in one frame (append_aggregator): one
         sequential pass — with coalesced/inline fsync each per-group
         handler rarely suspends, so no per-group task spawn is needed —
-        and one multiplexed reply."""
+        and one multiplexed reply. The pass yields every 8 groups:
+        at 1k partitions a full frame is a multi-ms inline chunk on
+        the shared loop, and unsplit it sits in front of every other
+        connection's epoll readiness — the dominant p99 tail driver
+        on the replicated bench (groups in one frame are independent,
+        so the yield is safe; the multiplexed reply waits for all of
+        them either way)."""
         replies: list[bytes] = []
-        for item in rt.decode_multi(payload):
+        for n, item in enumerate(rt.decode_multi(payload)):
+            if n and (n & 7) == 0:
+                await asyncio.sleep(0)
             replies.append(await self.append_entries(item))
         return rt.encode_multi(replies)
 

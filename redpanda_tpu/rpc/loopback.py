@@ -45,6 +45,7 @@ import random
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..observability import trace
 from ..utils.crc import crc32c
 from .server import Dispatcher, Service
 from .types import RpcError, Status
@@ -234,6 +235,13 @@ class LoopbackNetwork:
             rule = sched.act(src, dst, method_id)
             if rule is not None:
                 act = rule.action
+                # flight recorder: the fault marks the span it fired
+                # under (a produce's raft.append, a heartbeat tick) and
+                # lands in the event log for /v1/debug/traces
+                trace.default_recorder().record_event(
+                    "nemesis", action=act, src=src, dst=dst,
+                    method=method_id,
+                )
                 if act in ("drop", "one_way"):
                     raise ConnectionError(
                         f"nemesis: {act} {src}->{dst} m{method_id}"

@@ -11,6 +11,7 @@ batch-cache-served reads with CRC-verified disk fallback
 from __future__ import annotations
 
 import os
+import time
 
 from ..models.record import RecordBatch
 from . import dirsync, file_sanitizer
@@ -142,7 +143,18 @@ class Log:
         directory: str,
         config: LogConfig | None = None,
         cache: BatchCache | None = None,
+        probe=None,
     ):
+        # StorageProbe shared across the shard's logs; standalone Logs
+        # (unit fixtures, raft group logs built directly) share a
+        # private unscraped one so hot paths never branch on None
+        if probe is None:
+            from .probe import fixture_probe
+
+            probe = fixture_probe()
+        self.probe = probe
+        self._observe_append = probe.observe_append
+        self._observe_flush_wait = probe.observe_flush_wait
         self._dir = directory
         os.makedirs(directory, exist_ok=True)
         self.config = config or LogConfig()
@@ -291,7 +303,9 @@ class Log:
         batch.header.header_crc = batch.header.compute_header_crc()
 
         seg = self._active_segment(term)
+        t0 = time.monotonic()
         seg.append(batch)
+        self._observe_append(time.monotonic() - t0)
         if self._cache_index is not None:
             self._cache_index.put(batch)
         for fn in self.on_append:
@@ -358,7 +372,10 @@ class Log:
         if not self._segments:
             return -1
         seg = self._segments[-1]
+        t0 = time.monotonic()
         await seg.flush_async()
+        # includes the flush-coalescer queueing delay (storage probe)
+        self._observe_flush_wait(time.monotonic() - t0)
         return self._segments[-1].stable_offset
 
     # -- read --------------------------------------------------------
@@ -621,7 +638,10 @@ class Log:
         tx data) from participating."""
         from .compaction import compact_log
 
-        return compact_log(self, max_offset, visible)
+        t0 = time.monotonic()
+        out = compact_log(self, max_offset, visible)
+        self.probe.compaction_hist.observe(time.monotonic() - t0)
+        return out
 
     def size_bytes(self) -> int:
         """On-disk bytes across all segments (disk_log_impl size probe;

@@ -29,17 +29,26 @@ def _ntp_dir(base: str, ntp: NTP) -> str:
 
 
 class LogManager:
-    def __init__(self, data_dir: str, cache: BatchCache | None = None):
+    def __init__(
+        self,
+        data_dir: str,
+        cache: BatchCache | None = None,
+        probe=None,
+    ):
         self._data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         self._cache = cache if cache is not None else BatchCache()
+        self._probe = probe  # StorageProbe shared by every managed log
         self._logs: dict[NTP, Log] = {}
 
     def manage(self, ntp: NTP, config: LogConfig | None = None) -> Log:
         """Create-or-open the log for ntp (log_manager.h:159)."""
         if ntp in self._logs:
             return self._logs[ntp]
-        log = Log(_ntp_dir(self._data_dir, ntp), config, self._cache)
+        log = Log(
+            _ntp_dir(self._data_dir, ntp), config, self._cache,
+            probe=self._probe,
+        )
         self._logs[ntp] = log
         return log
 
@@ -87,11 +96,21 @@ class LogManager:
 class StorageApi:
     """Per-shard storage facade (storage/api.h:102)."""
 
-    def __init__(self, data_dir: str, cache_max_bytes: int = 128 * 1024 * 1024):
+    def __init__(
+        self,
+        data_dir: str,
+        cache_max_bytes: int = 128 * 1024 * 1024,
+        metrics=None,
+    ):
+        from .probe import StorageProbe
+
         self.data_dir = data_dir
         self.cache = BatchCache(cache_max_bytes)
         self.kvs = KvStore(os.path.join(data_dir, "kvstore"))
-        self.log_mgr = LogManager(os.path.join(data_dir, "data"), self.cache)
+        self.probe = StorageProbe(metrics)
+        self.log_mgr = LogManager(
+            os.path.join(data_dir, "data"), self.cache, probe=self.probe
+        )
 
     def close(self) -> None:
         self.log_mgr.close()

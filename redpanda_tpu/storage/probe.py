@@ -1,0 +1,51 @@
+"""Storage probe (reference: src/v/storage probes feeding
+disk_log_impl / segment appender metrics).
+
+One probe per broker (StorageApi), threaded LogManager -> Log so every
+log on the shard shares the same histogram families.
+
+Wired sites:
+  segment append   Log.append — the active segment write (header fix
+                   + disk write), per batch
+  flush wait       Log.flush_async — executor fsync including the
+                   flush-coalescer queueing delay it rides on
+  compaction       Log.compact — one key-based compaction pass
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..metrics import MetricsRegistry
+
+
+class StorageProbe:
+    def __init__(self, metrics: Optional[MetricsRegistry] = None):
+        m = metrics if metrics is not None else MetricsRegistry()
+        self.registry = m
+        self.segment_append_hist = m.histogram(
+            "storage_segment_append_seconds",
+            "Active-segment batch append (disk write path)",
+        )
+        self.flush_wait_hist = m.histogram(
+            "storage_flush_wait_seconds",
+            "fsync wait including flush-coalescer queueing",
+        )
+        self.compaction_hist = m.histogram(
+            "storage_compaction_seconds",
+            "One key-based log compaction pass",
+        )
+        # hot-path pre-resolved observers
+        self.observe_append = self.segment_append_hist.observe
+        self.observe_flush_wait = self.flush_wait_hist.observe
+
+
+_fixture_probe: Optional[StorageProbe] = None
+
+
+def fixture_probe() -> StorageProbe:
+    """Shared standalone probe for Logs built without a Broker."""
+    global _fixture_probe
+    if _fixture_probe is None:
+        _fixture_probe = StorageProbe()
+    return _fixture_probe

@@ -524,6 +524,95 @@ def test_rpl007_wrapper_calls_not_flagged(tmp_path):
     assert _only(_lint_source(tmp_path, src, "utils/crc.py"), "RPL007") == []
 
 
+# -- RPL008: flight-recorder discipline --------------------------------
+
+RPL008_BARE_SPAN = """
+    from redpanda_tpu.observability.trace import Span
+
+    def handle(recorder):
+        s = Span("kafka.produce", recorder=recorder)
+        s.finish()
+"""
+
+
+def test_rpl008_reports_bare_span_construction(tmp_path):
+    (f,) = _only(
+        _lint_source(tmp_path, RPL008_BARE_SPAN, "kafka/mod.py"), "RPL008"
+    )
+    assert "bare Span()" in f.message
+    assert f.line == 5
+
+
+def test_rpl008_span_ctor_allowed_inside_observability(tmp_path):
+    assert (
+        _only(
+            _lint_source(
+                tmp_path, RPL008_BARE_SPAN, "observability/trace.py"
+            ),
+            "RPL008",
+        )
+        == []
+    )
+
+
+def test_rpl008_fstring_tag_on_hot_path(tmp_path):
+    src = """
+        from redpanda_tpu.observability.trace import span
+
+        async def produce(topic, pid):
+            with span("kafka.produce", ntp=f"{topic}/{pid}"):
+                pass
+    """
+    (f,) = _only(_lint_source(tmp_path, src, "kafka/server.py"), "RPL008")
+    assert "f-string" in f.message
+
+
+def test_rpl008_percent_and_format_tags(tmp_path):
+    src = """
+        async def flush(rec, group):
+            with rec.span("raft.flush", g="g%d" % group):
+                pass
+
+        async def elect(rec, group):
+            with rec.span("raft.election", g="{}".format(group)):
+                pass
+    """
+    found = _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL008")
+    assert {"%-format" in f.message for f in found} == {True, False}
+    assert len(found) == 2
+
+
+def test_rpl008_raw_tag_values_clean(tmp_path):
+    src = """
+        from redpanda_tpu.observability.trace import span
+
+        async def produce(topic, pid):
+            with span("kafka.produce", topic=topic, partition=pid):
+                pass
+    """
+    assert _only(_lint_source(tmp_path, src, "kafka/server.py"), "RPL008") == []
+
+
+def test_rpl008_formatting_ok_off_hot_path(tmp_path):
+    # cold paths (admin handlers, tools) may format tags freely
+    src = """
+        from redpanda_tpu.observability.trace import span
+
+        async def snapshot(name):
+            with span("admin.snapshot", label=f"snap-{name}"):
+                pass
+    """
+    assert _only(_lint_source(tmp_path, src, "admin/server.py"), "RPL008") == []
+
+
+def test_rpl008_suppression(tmp_path):
+    src = RPL008_BARE_SPAN.replace(
+        's = Span("kafka.produce", recorder=recorder)',
+        's = Span("kafka.produce", recorder=recorder)  # rplint: disable=RPL008',
+    )
+    assert _only(_lint_source(tmp_path, src, "kafka/mod.py"), "RPL008") == []
+
+
 # -- baseline mechanics ------------------------------------------------
 
 

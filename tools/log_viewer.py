@@ -11,6 +11,12 @@ Usage:
     python tools/log_viewer.py DATA_DIR --ntp kafka/t/0    # one log
     python tools/log_viewer.py DATA_DIR --controller       # raft0 cmds
     python tools/log_viewer.py DATA_DIR -v                 # + records
+    python tools/log_viewer.py --traces traces.json        # waterfalls
+
+The --traces mode renders a flight-recorder dump (the JSON from
+`GET /v1/debug/traces`, or a file of one tree per line) as aligned
+per-request waterfalls: one row per span, indented by tree depth,
+with a bar showing where the span sits inside its root's lifetime.
 """
 
 from __future__ import annotations
@@ -120,15 +126,122 @@ def find_ntp_dirs(data_dir: str) -> dict[str, str]:
     return out
 
 
+# -- flight-recorder waterfalls (observability/trace.py dumps) ---------
+
+_BAR_WIDTH = 40
+
+
+def _fmt_tags(tags: dict | None) -> str:
+    if not tags:
+        return ""
+    return " " + " ".join(f"{k}={v}" for k, v in sorted(tags.items()))
+
+
+def render_tree(tree: dict, out=None, slow: bool = False) -> None:
+    """One aligned waterfall per span tree. Rows are sorted by start
+    time; the bar column maps [root start, root end] onto a fixed
+    width so sibling gaps (queue waits, flush coalescing) read as
+    horizontal whitespace."""
+    out = out if out is not None else sys.stdout
+    spans = tree.get("spans", [])
+    if not spans:
+        return
+    by_id = {s["id"]: s for s in spans}
+    t0 = min(s["start_ns"] for s in spans)
+    root_dur = max(tree.get("dur_ns", 0), 1)
+
+    def depth(s: dict) -> int:
+        d = 0
+        while s.get("parent") and s["parent"] in by_id and d < 32:
+            s = by_id[s["parent"]]
+            d += 1
+        return d
+
+    flag = "  [SLOW]" if slow else ""
+    print(
+        f"trace {tree.get('trace_id')} root={tree.get('root')} "
+        f"dur={tree.get('dur_ns', 0) / 1e6:.2f}ms{flag}",
+        file=out,
+    )
+    name_w = max(len("  " * depth(s) + s["name"]) for s in spans)
+    for s in sorted(spans, key=lambda s: (s["start_ns"], s["id"])):
+        off_ns = s["start_ns"] - t0
+        dur_ns = max(s.get("dur_ns", 0), 0)
+        lo = min(int(off_ns * _BAR_WIDTH / root_dur), _BAR_WIDTH - 1)
+        hi = min(
+            max(int((off_ns + dur_ns) * _BAR_WIDTH / root_dur), lo + 1),
+            _BAR_WIDTH,
+        )
+        bar = " " * lo + "█" * (hi - lo) + " " * (_BAR_WIDTH - hi)
+        label = "  " * depth(s) + s["name"]
+        print(
+            f"  {off_ns / 1e6:9.3f}ms |{bar}| "
+            f"{dur_ns / 1e6:9.3f}ms  {label:<{name_w}}"
+            f"{_fmt_tags(s.get('tags'))}",
+            file=out,
+        )
+
+
+def dump_traces(path: str, out=None) -> None:
+    """Render a /v1/debug/traces JSON dump (or one tree per line)."""
+    import json
+
+    out = out if out is not None else sys.stdout
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read().strip()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = {"ring": [json.loads(ln) for ln in text.splitlines() if ln.strip()]}
+    if isinstance(doc, list):
+        doc = {"ring": doc}
+    frozen = doc.get("frozen", [])
+    ring = doc.get("ring", [])
+    frozen_ids = {t.get("trace_id") for t in frozen}
+    print(
+        f"flight recorder node={doc.get('node_id', '?')} "
+        f"trees_total={doc.get('trees_total', len(ring))} "
+        f"frozen={len(frozen)} "
+        f"slow_threshold={doc.get('slow_threshold_ms', '?')}ms",
+        file=out,
+    )
+    for tree in frozen:
+        render_tree(tree, out=out, slow=True)
+    for tree in ring:
+        if tree.get("trace_id") in frozen_ids:
+            continue  # already rendered above, flagged slow
+        render_tree(tree, out=out)
+    events = doc.get("events", [])
+    if events:
+        print(f"events ({len(events)}):", file=out)
+        for e in events:
+            print(
+                f"  {e.get('at_ns', 0) / 1e6:.3f}ms {e.get('name')}"
+                f"{_fmt_tags(e.get('tags'))}",
+                file=out,
+            )
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("data_dir")
+    ap.add_argument("data_dir", nargs="?")
     ap.add_argument("--ntp", help="ns/topic/partition to dump")
     ap.add_argument(
         "--controller", action="store_true", help="decode the raft0 log"
     )
+    ap.add_argument(
+        "--traces",
+        metavar="FILE",
+        help="render a /v1/debug/traces JSON dump as span waterfalls",
+    )
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.traces:
+        dump_traces(args.traces)
+        return
+    if not args.data_dir:
+        ap.error("data_dir is required unless --traces is given")
 
     if args.controller:
         cdir = os.path.join(args.data_dir, "group_0")

@@ -9,6 +9,7 @@ from .rpl004_blocking_async import BlockingInAsyncRule
 from .rpl005_cancelled_swallow import CancelledSwallowRule
 from .rpl006_net_await_budget import NetAwaitBudgetRule
 from .rpl007_native_symbols import NativeSymbolRule
+from .rpl008_trace_discipline import TraceDisciplineRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -18,6 +19,7 @@ ALL_RULES = [
     CancelledSwallowRule,
     NetAwaitBudgetRule,
     NativeSymbolRule,
+    TraceDisciplineRule,
 ]
 
 __all__ = ["ALL_RULES"]

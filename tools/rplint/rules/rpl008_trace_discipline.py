@@ -1,0 +1,107 @@
+"""RPL008 — flight-recorder discipline: no bare Span(), no formatting
+in span() tag arguments on hot paths.
+
+Two contracts from observability/trace.py:
+
+  1. `Span(...)` may only be constructed inside `observability/` —
+     everywhere else goes through the `span()` / `recorder.span()`
+     context-manager helpers. A bare Span that never closes keeps its
+     whole tree out of the flight-recorder ring AND (worse) leaves
+     `_current` pointing at a dead node, silently mis-parenting every
+     span the task opens afterwards. The helpers also own the
+     RP_TRACE=0 no-op path: a direct construction allocates even with
+     tracing killed.
+
+  2. On hot paths (files under raft/, kafka/, storage/, rpc/), tag
+     values passed to `span(...)` / `.span(...)` must be pre-formatted
+     plain objects — no f-strings (JoinedStr), no `"%s" % x`, no
+     `"{}".format(x)`. Python evaluates the argument list BEFORE
+     span() gets to check ENABLED, so a formatted tag string is
+     per-request allocation + formatting that survives RP_TRACE=0 —
+     exactly the off-path cost the ≤2% bench A/B budget exists to cap.
+     Pass the raw value (`span("produce", topic=topic)`) and let the
+     dump serializer do the formatting once, at read time.
+
+Suppress a deliberate exception with `# rplint: disable=RPL008`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext, dotted_name
+
+_EXEMPT_DIR = "observability"
+_HOT_DIRS = ("raft", "kafka", "storage", "rpc")
+
+
+def _is_format_expr(node: ast.AST) -> str | None:
+    """Slug for a formatting expression, or None."""
+    if isinstance(node, ast.JoinedStr):
+        return "f-string"
+    if (
+        isinstance(node, ast.BinOp)
+        and isinstance(node.op, ast.Mod)
+        and isinstance(node.left, ast.Constant)
+        and isinstance(node.left.value, str)
+    ):
+        return "%-format"
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "format"
+        and isinstance(node.func.value, ast.Constant)
+        and isinstance(node.func.value.value, str)
+    ):
+        return "str.format"
+    return None
+
+
+class TraceDisciplineRule:
+    code = "RPL008"
+    name = "trace-discipline"
+
+    @staticmethod
+    def _dir_parts(ctx: ModuleContext) -> list[str]:
+        return ctx.path.replace("\\", "/").split("/")[:-1]
+
+    def check(self, ctx: ModuleContext):
+        parts = self._dir_parts(ctx)
+        exempt_span_ctor = _EXEMPT_DIR in parts
+        hot = any(d in parts for d in _HOT_DIRS)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func).rsplit(".", 1)[-1]
+            if callee == "Span" and not exempt_span_ctor:
+                if ctx.suppressed(node, self.code):
+                    continue
+                yield Finding(
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.code,
+                    message=(
+                        "bare Span() construction outside observability/ "
+                        "— use span()/recorder.span(): they own the "
+                        "RP_TRACE no-op path and guarantee the exit stamp"
+                    ),
+                )
+            elif callee == "span" and hot:
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    slug = _is_format_expr(arg)
+                    if slug is None:
+                        continue
+                    if ctx.suppressed(node, self.code):
+                        continue
+                    yield Finding(
+                        path=ctx.path,
+                        line=arg.lineno,
+                        col=arg.col_offset,
+                        rule=self.code,
+                        message=(
+                            f"{slug} in span() tag argument on a hot "
+                            "path — the formatting runs even with "
+                            "RP_TRACE=0; pass the raw value instead"
+                        ),
+                    )

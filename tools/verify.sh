@@ -19,14 +19,24 @@ else
     echo "WARN: native build failed; suite runs on pure-Python fallbacks"
 fi
 
+echo "== observability scrape smoke =="
+env JAX_PLATFORMS=cpu python tools/scrape_smoke.py
+
 echo "== tier-1 tests (native) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly "$@"
 
 echo "== fallback smoke (RP_NATIVE=0) =="
-exec env JAX_PLATFORMS=cpu RP_NATIVE=0 python -m pytest \
+env JAX_PLATFORMS=cpu RP_NATIVE=0 python -m pytest \
     tests/test_native_append.py tests/test_native_records.py \
     tests/test_produce_fast.py tests/test_foundation.py \
+    -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== tracing-off smoke (RP_TRACE=0) =="
+exec env JAX_PLATFORMS=cpu RP_TRACE=0 python -m pytest \
+    tests/test_observability.py tests/test_kafka_e2e.py \
+    tests/test_admin_server.py \
     -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
