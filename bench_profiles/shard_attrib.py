@@ -1,0 +1,99 @@
+"""Per-shard attribution for the ssx shard runtime: start a 2-shard
+ShardedBroker, produce/fetch across a partition spread, and print
+where the work landed (ShardStats counters + shard-table counts).
+
+Run from the repo root:  python bench_profiles/shard_attrib.py
+Feeds the attribution table in bench_profiles/SHARDS_AB.md.
+"""
+
+import asyncio
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_PARTITIONS = int(os.environ.get("ATTRIB_PARTITIONS", "16"))
+N_ROUNDS = int(os.environ.get("ATTRIB_ROUNDS", "50"))
+VALUE = b"x" * 512
+
+
+async def main():
+    from redpanda_tpu.app import BrokerConfig
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.ssx.sharded_broker import ShardedBroker
+
+    tmp = tempfile.mkdtemp(dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+    cfg = BrokerConfig(
+        node_id=0,
+        data_dir=tmp,
+        members=[0],
+        election_timeout_s=0.3,
+        heartbeat_interval_s=0.05,
+        enable_admin=False,
+    )
+    sb = ShardedBroker(cfg, n_shards=2)
+    await sb.start()
+    assert sb.active, sb.standdown
+    c = KafkaClient([("127.0.0.1", sb.kafka_port)])
+    try:
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                await c.create_topic(
+                    "attrib", partitions=N_PARTITIONS, replication_factor=1
+                )
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.2)
+        # warm every partition (leadership settles), then measure
+        for p in range(N_PARTITIONS):
+            while True:
+                try:
+                    await c.produce("attrib", p, [(b"k", VALUE)])
+                    break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
+        t0 = time.monotonic()
+        for r in range(N_ROUNDS):
+            await asyncio.gather(
+                *(
+                    c.produce("attrib", p, [(b"k", VALUE)])
+                    for p in range(N_PARTITIONS)
+                )
+            )
+        dt = time.monotonic() - t0
+        for p in range(N_PARTITIONS):
+            await c.fetch("attrib", p, 0)
+        n_msgs = N_ROUNDS * N_PARTITIONS
+        counts = sb.broker.shard_table.counts()
+        stats = await sb.shard_stats()
+        print(f"partitions={N_PARTITIONS} rounds={N_ROUNDS} "
+              f"msgs={n_msgs} value={len(VALUE)}B wall={dt:.2f}s "
+              f"rate={n_msgs / dt:.0f} msg/s")
+        print(f"shard_table counts (shard -> partitions): "
+              f"{dict(sorted(counts.items()))}")
+        print("| shard | partitions | leaders | produce_reqs | "
+              "produce_bytes | fetch_reqs | frontend_conns | frontend_frames |")
+        print("|---|---|---|---|---|---|---|---|")
+        for s in stats:
+            print(
+                f"| {s.shard} | {s.partitions} | {s.leaders} "
+                f"| {s.produce_reqs} | {s.produce_bytes} "
+                f"| {s.fetch_reqs} | {s.frontend_conns} "
+                f"| {s.frontend_frames} |"
+            )
+    finally:
+        await c.close()
+        await sb.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

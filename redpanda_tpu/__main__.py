@@ -80,6 +80,20 @@ def parse_args(argv=None) -> argparse.Namespace:
         help="advertise an older feature level (mixed-version testing)",
     )
     ap.add_argument("--log-level", default="INFO")
+    ap.add_argument(
+        "--shards",
+        type=int,
+        default=int(os.environ.get("RP_SHARDS", "1") or "1"),
+        help="worker shards (processes) for the data plane; 1 = "
+        "single-process broker (ssx shard-per-core runtime)",
+    )
+    ap.add_argument(
+        "--pin-core",
+        type=int,
+        default=None,
+        help="pin this broker process to one CPU core "
+        "(sched_setaffinity; mp bench uses it for honest core counts)",
+    )
     return ap.parse_args(argv)
 
 
@@ -210,7 +224,7 @@ def build_config(args) -> BrokerConfig:
     )
 
 
-async def run(config: BrokerConfig) -> None:
+async def run(config: BrokerConfig, shards: int = 1) -> None:
     import os
 
     from . import syschecks
@@ -218,8 +232,16 @@ async def run(config: BrokerConfig) -> None:
     os.makedirs(config.data_dir, exist_ok=True)
     # exclusive dir ownership BEFORE touching any on-disk state
     pidlock = syschecks.acquire_pidlock(config.data_dir)
-    broker = Broker(config)
-    await broker.start()
+    if shards > 1:
+        from .ssx.sharded_broker import ShardedBroker
+
+        owner = ShardedBroker(config, n_shards=shards)
+        await owner.start()
+        broker = owner.broker
+    else:
+        owner = None
+        broker = Broker(config)
+        await broker.start()
     logging.getLogger("main").info(
         "node %d serving: kafka :%d rpc :%d admin :%d",
         config.node_id,
@@ -231,9 +253,18 @@ async def run(config: BrokerConfig) -> None:
     loop = asyncio.get_event_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
+    if owner is not None and owner.active:
+        # a dead shard means silently lost partitions: stop the whole
+        # broker rather than limp (seastar: an engine abort takes the
+        # process down)
+        fail_task = asyncio.ensure_future(owner.failed.wait())
+        fail_task.add_done_callback(lambda _t: stop.set())
     await stop.wait()
     logging.getLogger("main").info("shutting down")
-    await broker.stop()
+    if owner is not None:
+        await owner.stop()
+    else:
+        await broker.stop()
     pidlock.release()
 
 
@@ -244,7 +275,14 @@ def main(argv=None) -> None:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
         stream=sys.stderr,
     )
-    asyncio.run(run(build_config(args)))
+    if args.pin_core is not None:
+        try:
+            os.sched_setaffinity(0, {args.pin_core})
+        except OSError:
+            logging.getLogger("main").warning(
+                "could not pin to core %d", args.pin_core
+            )
+    asyncio.run(run(build_config(args), shards=args.shards))
 
 
 if __name__ == "__main__":

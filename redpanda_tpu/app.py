@@ -52,6 +52,10 @@ class BrokerConfig:
     peer_addresses: Optional[dict[int, tuple[str, int]]] = None
     kafka_host: str = "127.0.0.1"
     kafka_port: int = 0  # 0 = ephemeral
+    # SO_REUSEPORT kafka listener: set by ssx.ShardedBroker so every
+    # shard's frontend binds the same pre-reserved port (requires a
+    # concrete kafka_port, not 0)
+    kafka_reuse_port: bool = False
     rpc_host: str = "127.0.0.1"
     rpc_port: int = 0
     advertised_host: Optional[str] = None
@@ -223,6 +227,9 @@ class Broker:
             metrics=self.metrics,
         )
         self.shard_table = ShardTable()
+        # set by ssx.ShardedBroker when worker shards are active; None
+        # keeps every kafka/controller shard seam on the local path
+        self.shard_router = None
         self.partition_manager = PartitionManager(
             self.storage.log_mgr, self.group_manager
         )

@@ -421,6 +421,9 @@ class Controller:
         # leadership view for the balancer (Broker assigns its
         # dissemination-fed PartitionLeadersTable after construction)
         self.leaders_table = None
+        # ssx.ShardRouter when worker shards are active: the backend
+        # routes data-partition create/remove to the owning shard
+        self.shard_router = None
         self._balance_ticks = 0
         self._barrier_defer_until = 0.0
         # cluster genesis state (bootstrap_backend): "" until the first
@@ -1131,6 +1134,22 @@ class Controller:
         except Exception:
             logger.exception("node %d: controller snapshot failed", self.node_id)
 
+    def _shard_for_new(self, d) -> int:
+        """Worker shard that should own a new partition, or 0 (local).
+
+        v1 sharding policy (ssx/sharded_broker.py): only sole-replica
+        data partitions in the default namespace spread across shards —
+        internal/coordinator topics (tx, groups) and replicated raft
+        groups keep the shard-0 path, where the full rpc/dissemination
+        machinery lives."""
+        if self.shard_router is None:
+            return 0
+        if list(d.replicas) != [self.node_id]:
+            return 0
+        if d.ntp.ns != DEFAULT_NS or d.ntp.topic.startswith("__"):
+            return 0
+        return self.shard_router.shard_of(d.group)
+
     async def _backend_loop(self) -> None:
         """Turn topic_table deltas into local partition create/remove
         (reference: cluster/controller_backend.{h,cc}); periodically
@@ -1159,6 +1178,23 @@ class Controller:
             for d in deltas:
                 try:
                     if d.kind == "add" and self.node_id in d.replicas:
+                        shard = self._shard_for_new(d)
+                        if shard:
+                            # shard-owned: create on the worker shard,
+                            # record ownership, and advertise ourselves
+                            # as leader (the shard's single-voter group
+                            # elects itself; metadata must not wait)
+                            await self.shard_router.create_partition(
+                                shard,
+                                d.ntp,
+                                d.group,
+                                d.replicas,
+                                self._log_config_for(d.ntp),
+                            )
+                            self._shards.insert(d.ntp, d.group, shard)
+                            if self.leaders_table is not None:
+                                self.leaders_table.update(d.ntp, self.node_id)
+                            continue
                         p = await self._pm.manage(
                             d.ntp,
                             d.group,
@@ -1169,8 +1205,14 @@ class Controller:
                         if self.on_partition_added is not None:
                             await self.on_partition_added(d.ntp, p)
                     elif d.kind == "del" and self.node_id in d.replicas:
+                        shard = self._shards.shard_for(d.ntp)
                         self._shards.erase(d.ntp, d.group)
-                        await self._pm.remove(d.ntp)
+                        if shard and self.shard_router is not None:
+                            await self.shard_router.remove_partition(
+                                shard, d.ntp
+                            )
+                        else:
+                            await self._pm.remove(d.ntp)
                     elif d.kind == "cfg":
                         p = self._pm.get(d.ntp)
                         if p is not None:

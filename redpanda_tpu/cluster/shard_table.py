@@ -1,10 +1,11 @@
 """ntp/group → shard lookup (reference: src/v/cluster/shard_table.h:26-46).
 
-The host runtime currently runs one asyncio shard per node (SURVEY
-§2.11 P1 maps seastar's shard-per-core onto per-host shards feeding
-batched device kernels); the table preserves the placement seam so the
-kafka layer always resolves a shard before touching a partition, as
-produce.cc:249 does.
+With the ssx shard runtime active (ssx/sharded_broker.py) this table
+is load-bearing: the controller backend records which worker shard owns
+each data partition, and the kafka layer resolves a shard before
+touching a partition — exactly as produce.cc:249 does — forwarding
+non-local ones through `invoke_on`. Single-process brokers keep every
+entry at shard 0 and the table stays a pass-through seam.
 """
 
 from __future__ import annotations
@@ -14,6 +15,8 @@ from ..models.fundamental import NTP
 
 class ShardTable:
     def __init__(self, shard_count: int = 1):
+        # ssx.ShardedBroker overwrites this with the live shard count;
+        # everything else treats it as read-only topology metadata
         self.shard_count = shard_count
         self._ntp: dict[NTP, int] = {}
         self._group: dict[int, int] = {}
@@ -31,3 +34,10 @@ class ShardTable:
 
     def shard_for_group(self, group_id: int) -> int | None:
         return self._group.get(group_id)
+
+    def counts(self) -> dict[int, int]:
+        """partitions per shard (admin/bench attribution)."""
+        out: dict[int, int] = {}
+        for shard in self._ntp.values():
+            out[shard] = out.get(shard, 0) + 1
+        return out

@@ -36,6 +36,7 @@ from ..models.record import CrcMismatch, RecordBatch
 from ..observability import trace
 from ..raft.consensus import NotLeaderError, ReplicateTimeout
 from ..security.acl import AclOperation, AclResourceType
+from ..ssx import InvokeError
 from ..utils.iobuf import IOBufParser
 from .protocol import (
     ALL_APIS,
@@ -286,9 +287,19 @@ class KafkaServer:
 
         # create_server instead of start_server: the protocol factory
         # is how the rx stamp gets under the stream reader
-        self._server = await loop.create_server(
-            _proto_factory, cfg.kafka_host, cfg.kafka_port, ssl=ssl_ctx
-        )
+        if getattr(cfg, "kafka_reuse_port", False):
+            # shard-per-core mode: every shard's frontend binds the same
+            # pre-reserved port and the kernel spreads accepted conns
+            from ..ssx import bind_reuse_port
+
+            sock = bind_reuse_port(cfg.kafka_host, cfg.kafka_port)
+            self._server = await loop.create_server(
+                _proto_factory, sock=sock, ssl=ssl_ctx
+            )
+        else:
+            self._server = await loop.create_server(
+                _proto_factory, cfg.kafka_host, cfg.kafka_port, ssl=ssl_ctx
+            )
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
@@ -949,6 +960,10 @@ class KafkaServer:
                 return int(ErrorCode.invalid_producer_epoch)
             if isinstance(exc, ValueError):
                 return int(ErrorCode.corrupt_message)
+            if isinstance(exc, InvokeError):
+                # cross-shard hop failed (timeout / shard down):
+                # retriable from the client's perspective
+                return int(ErrorCode.request_timed_out)
             return int(ErrorCode.unknown_server_error)
 
         async def dispatch_partition(topic: str, p: Msg):
@@ -963,6 +978,25 @@ class KafkaServer:
                 )
             ntp = kafka_ntp(topic, p.index)
             partition = self.broker.partition_manager.get(ntp)
+            if partition is None and self.broker.shard_router is not None:
+                # shard-owned partition: this broker is the leader but
+                # the raft group lives on another core — forward the
+                # raw record set through invoke_on and let stage 2
+                # await the shard's ack (ssx shard seam)
+                shard = self.broker.shard_table.shard_for(ntp)
+                if shard:
+                    if p.records is None:
+                        return Msg(
+                            index=p.index,
+                            error_code=int(ErrorCode.invalid_request),
+                            base_offset=-1,
+                        )
+                    fut = asyncio.ensure_future(
+                        self.broker.shard_router.produce(
+                            shard, ntp, bytes(p.records), acks
+                        )
+                    )
+                    return (p.index, [("shard", fut)])
             if partition is None:
                 known = self.broker.controller.topic_table.group_of(ntp)
                 err = int(
@@ -1063,6 +1097,23 @@ class KafkaServer:
                 if kind == "dup":
                     if base < 0:
                         base = v
+                    continue
+                if kind == "shard":
+                    # cross-shard produce: one future covering the whole
+                    # record set, resolved to (error_code, base_offset)
+                    try:
+                        serr, kbase = await asyncio.wait_for(
+                            asyncio.shield(v), 15.0
+                        )
+                    except Exception as e:
+                        err = produce_error(e)
+                        _consume_exc(v)
+                        break
+                    if serr:
+                        err = serr
+                        break
+                    if base < 0:
+                        base = kbase
                     continue
                 try:
                     kbase = await asyncio.wait_for(asyncio.shield(v.done), 10.0)
@@ -1348,6 +1399,47 @@ class KafkaServer:
                         records=wire if wire else None,
                     )
 
+        # shard-owned partitions: reads happen on the owning shard, so
+        # they run as an async pre-pass per poll iteration (read_all
+        # itself must stay synchronous) and read_all serves the rows
+        shard_rows: dict[tuple[str, int], Msg] = {}
+        shard_router = self.broker.shard_router
+
+        async def shard_prepass() -> None:
+            shard_rows.clear()
+            budget = req.max_bytes if req.max_bytes > 0 else 1 << 30
+            for t in plan_topics:
+                if not authorized.get(t.topic):
+                    continue
+                for p in t.partitions:
+                    ntp = kafka_ntp(t.topic, p.partition)
+                    if self.broker.partition_manager.get(ntp) is not None:
+                        continue
+                    shard = self.broker.shard_table.shard_for(ntp)
+                    if not shard or budget <= 0:
+                        continue
+                    try:
+                        rep = await shard_router.fetch(
+                            shard,
+                            ntp,
+                            p.fetch_offset,
+                            min(p.partition_max_bytes, budget),
+                            read_committed,
+                        )
+                    except InvokeError:
+                        continue  # read_all answers not_leader (retriable)
+                    wire = bytes(rep.records)
+                    budget -= len(wire)
+                    shard_rows[(t.topic, p.partition)] = Msg(
+                        partition_index=p.partition,
+                        error_code=rep.error,
+                        high_watermark=rep.high_watermark,
+                        last_stable_offset=rep.last_stable_offset,
+                        log_start_offset=rep.log_start,
+                        aborted_transactions=None,
+                        records=wire if wire else None,
+                    )
+
         def read_all() -> tuple[list[Msg], int, bool]:
             total = 0
             has_error = False
@@ -1376,6 +1468,13 @@ class KafkaServer:
                     ntp = kafka_ntp(t.topic, p.partition)
                     partition = self.broker.partition_manager.get(ntp)
                     if partition is None:
+                        row = shard_rows.get((t.topic, p.partition))
+                        if row is not None:
+                            if row.error_code:
+                                has_error = True
+                            total += len(row.records or b"")
+                            parts.append(row)
+                            continue
                         known = self.broker.controller.topic_table.group_of(ntp)
                         has_error = True
                         parts.append(
@@ -1530,6 +1629,8 @@ class KafkaServer:
         # long-poll: debounced re-read until min_bytes or max_wait
         # (fetch.cc:432 over_min_bytes, :546 debounce)
         while True:
+            if shard_router is not None:
+                await shard_prepass()
             responses, total, has_error = read_all()
             # error partitions complete the fetch immediately — holding
             # the long-poll would stall the client's metadata refresh
@@ -1622,6 +1723,31 @@ class KafkaServer:
                     continue
                 ntp = kafka_ntp(t.name, p.partition_index)
                 partition = self.broker.partition_manager.get(ntp)
+                if partition is None and self.broker.shard_router is not None:
+                    shard = self.broker.shard_table.shard_for(ntp)
+                    if shard:
+                        try:
+                            err, off, ts = (
+                                await self.broker.shard_router.list_offsets(
+                                    shard, ntp, p.timestamp
+                                )
+                            )
+                        except InvokeError:
+                            err, off, ts = (
+                                int(ErrorCode.not_leader_for_partition),
+                                -1,
+                                -1,
+                            )
+                        parts.append(
+                            Msg(
+                                partition_index=p.partition_index,
+                                error_code=err,
+                                old_style_offsets=[off] if off >= 0 else [],
+                                timestamp=ts,
+                                offset=off,
+                            )
+                        )
+                        continue
                 if partition is None:
                     parts.append(
                         Msg(

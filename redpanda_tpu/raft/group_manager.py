@@ -30,9 +30,15 @@ class GroupManager:
         heartbeat_interval_s: float = 0.05,
         kvstore: Optional[KvStore] = None,
         metrics=None,
+        shard_id: int = 0,
+        shard_count: int = 1,
     ):
         self.node_id = node_id
         self.data_dir = data_dir
+        # shard-per-core (ssx): which slice of the group-id space this
+        # manager may own. The default (0 of 1) owns everything.
+        self.shard_id = shard_id
+        self.shard_count = shard_count
         os.makedirs(data_dir, exist_ok=True)
         # append RPCs to a peer multiplex into one frame per dispatch
         # window (append_aggregator); all other methods pass through
@@ -221,6 +227,18 @@ class GroupManager:
     ) -> Consensus:
         if group_id in self._groups:
             raise ValueError(f"group {group_id} exists")
+        if self.shard_id > 0:
+            # worker shards own exactly their deterministic slice of
+            # the group-id space; shard 0 may host anything (internal
+            # topics, replicated groups — see Controller._shard_for_new)
+            from ..ssx import shard_of
+
+            owner = shard_of(group_id, self.shard_count)
+            if owner != self.shard_id:
+                raise ValueError(
+                    f"group {group_id} belongs to shard {owner}, "
+                    f"not shard {self.shard_id}"
+                )
         if log is None:
             log_dir = os.path.join(self.data_dir, f"group_{group_id}")
             log = Log(log_dir, config=log_config)

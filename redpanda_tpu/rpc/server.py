@@ -125,6 +125,16 @@ class RpcServer:
         task = asyncio.current_task()
         if task is not None:
             self._conn_tasks.add(task)
+        # mirror the client side: response frames are small and must
+        # not sit behind Nagle when the peer is a real process
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            import socket as _socket
+
+            try:
+                sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
         write_lock = asyncio.Lock()
         pending: set[asyncio.Task] = set()
         try:

@@ -56,6 +56,20 @@ class TcpTransport:
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port, limit=1 << 21
         )
+        # request/response RPC on a warm connection: Nagle + delayed
+        # ACK turns every small raft frame into a ~40 ms stall once
+        # brokers are real processes (mp bench); the reference sets
+        # nodelay on all rpc sockets (net/server.cc)
+        sock = self._writer.get_extra_info("socket")
+        if sock is not None:
+            import socket as _socket
+
+            try:
+                sock.setsockopt(
+                    _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
+                )
+            except OSError:
+                pass
         self._reader_task = asyncio.ensure_future(self._read_loop())
 
     async def _read_loop(self) -> None:

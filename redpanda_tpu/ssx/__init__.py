@@ -1,0 +1,34 @@
+"""ssx: shard-per-core runtime (seastar `ss::sharded<T>` / smp analog).
+
+The only package allowed to fork worker processes (rplint RPL009);
+everything above it talks to shards through `invoke_on` with serde
+envelope payloads.
+"""
+
+from .shards import (
+    InvokeError,
+    InvokeReply,
+    InvokeRequest,
+    ShardChannel,
+    ShardContext,
+    ShardRuntime,
+    bind_reuse_port,
+    pin_to_core,
+    reserve_reuse_port,
+    shard_of,
+    standdown_reason,
+)
+
+__all__ = [
+    "InvokeError",
+    "InvokeReply",
+    "InvokeRequest",
+    "ShardChannel",
+    "ShardContext",
+    "ShardRuntime",
+    "bind_reuse_port",
+    "pin_to_core",
+    "reserve_reuse_port",
+    "shard_of",
+    "standdown_reason",
+]

@@ -1,0 +1,818 @@
+"""Sharded broker composition: one full broker on shard 0 plus
+partition engines on shards 1..N-1 (reference: redpanda/application.cc
+runs every subsystem as a `ss::sharded<T>` across all cores; here the
+controller/coordinators stay on shard 0 and only the partition data
+plane — storage, raft groups, produce/fetch — spreads).
+
+Division of labor:
+- shard 0 (the parent process): the unmodified `app.Broker` — raft0
+  controller, group/tx coordinators, admin, and the Kafka listener
+  (bound with SO_REUSEPORT). Partition deltas whose raft group maps to
+  another shard are routed there through `invoke_on` instead of the
+  local partition_manager (cluster/controller.py backend seam), and
+  produce/fetch/list_offsets for those partitions forward the same way
+  (kafka/server.py seam).
+- shards k>0: a `PartitionShard` — its own StorageApi (data_dir/
+  shard_k), GroupManager and PartitionManager, serving the `partition`
+  invoke service; outbound raft RPC relays through shard 0's
+  connection cache (`rpc.out`). Each shard also binds a thin Kafka
+  frontend on the SHARED SO_REUSEPORT port: the kernel spreads
+  accepted client connections across shards, and frames a shard cannot
+  serve locally forward to shard 0's full protocol engine as raw
+  envelopes (`kafka.raw`) — `smp_service_group` style cross-core
+  request passing.
+
+v1 scope (documented, asserted): single-node sharded brokers — shard
+placement is local, so replicas for shard-owned groups are `[node_id]`
+and cross-broker replication of those groups stays on shard 0.
+Transactions and consumer groups live on shard 0 (their coordinator
+partitions map there by `shard_of`'s group-0 pinning plus the internal
+topic's low group ids only when they land on shard 0; sharded data
+partitions serve plain produce/fetch).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Optional
+
+from ..utils.serde import (
+    Envelope,
+    boolean,
+    bytes_t,
+    f64,
+    i8,
+    i16,
+    i32,
+    i64,
+    optional,
+    string,
+    u16,
+    u32,
+    u64,
+    vector,
+)
+from .shards import (
+    InvokeError,
+    ShardContext,
+    ShardRuntime,
+    bind_reuse_port,
+    reserve_reuse_port,
+    shard_of,
+    standdown_reason,
+)
+
+logger = logging.getLogger("ssx.broker")
+
+
+# ------------------------------------------------------- wire envelopes
+class PartitionCreate(Envelope):
+    SERDE_FIELDS = [
+        ("ns", string),
+        ("topic", string),
+        ("partition", i32),
+        ("group", i64),
+        ("replicas", vector(i32)),
+        ("segment_max_bytes", i64),
+        ("retention_bytes", optional(i64)),
+        ("retention_ms", optional(i64)),
+        ("cleanup_policy", string),
+        ("local_retention_bytes", optional(i64)),
+        ("local_retention_ms", optional(i64)),
+    ]
+
+
+class PartitionRef(Envelope):
+    SERDE_FIELDS = [("ns", string), ("topic", string), ("partition", i32)]
+
+
+class ShardProduceRequest(Envelope):
+    SERDE_FIELDS = [
+        ("ns", string),
+        ("topic", string),
+        ("partition", i32),
+        ("acks", i8),
+        ("records", bytes_t),
+    ]
+
+
+class ShardProduceReply(Envelope):
+    SERDE_FIELDS = [("error", i16), ("base_offset", i64)]
+
+
+class ShardFetchRequest(Envelope):
+    SERDE_FIELDS = [
+        ("ns", string),
+        ("topic", string),
+        ("partition", i32),
+        ("offset", i64),
+        ("max_bytes", i64),
+        ("read_committed", boolean),
+    ]
+
+
+class ShardFetchReply(Envelope):
+    SERDE_FIELDS = [
+        ("error", i16),
+        ("high_watermark", i64),
+        ("last_stable_offset", i64),
+        ("log_start", i64),
+        ("records", bytes_t),
+    ]
+
+
+class ShardListOffsetsRequest(Envelope):
+    SERDE_FIELDS = [
+        ("ns", string),
+        ("topic", string),
+        ("partition", i32),
+        ("timestamp", i64),
+    ]
+
+
+class ShardListOffsetsReply(Envelope):
+    SERDE_FIELDS = [("error", i16), ("offset", i64), ("timestamp", i64)]
+
+
+class RpcOut(Envelope):
+    """Outbound internal RPC relayed through shard 0's connection
+    cache (children own no peer transports)."""
+
+    SERDE_FIELDS = [
+        ("node", i32),
+        ("method", u32),
+        ("payload", bytes_t),
+        ("timeout", f64),
+    ]
+
+
+class KafkaFrame(Envelope):
+    """One raw Kafka request frame forwarded from a shard's thin
+    frontend to shard 0's protocol engine."""
+
+    SERDE_FIELDS = [("conn", u64), ("frame", bytes_t)]
+
+
+class KafkaFrameReply(Envelope):
+    SERDE_FIELDS = [
+        ("has_resp", boolean),
+        ("resp", bytes_t),
+        ("close", boolean),
+    ]
+
+
+class ShardStats(Envelope):
+    """Per-shard attribution counters (bench_profiles tables)."""
+
+    SERDE_FIELDS = [
+        ("shard", u16),
+        ("partitions", u32),
+        ("leaders", u32),
+        ("produce_reqs", u64),
+        ("produce_bytes", u64),
+        ("fetch_reqs", u64),
+        ("fetch_bytes", u64),
+        ("frontend_conns", u64),
+        ("frontend_frames", u64),
+    ]
+
+
+def _ntp_of(ns: str, topic: str, partition: int):
+    from ..models.fundamental import NTP
+
+    return NTP(ns, topic, partition)
+
+
+# ------------------------------------------------------------- children
+class ShardKafkaFrontend:
+    """Thin per-shard Kafka listener on the shared SO_REUSEPORT port.
+    Frames are forwarded whole to shard 0 (`kafka.raw`) and responses
+    relayed back in order — per-connection serialization, which is the
+    Kafka protocol's own ordering contract anyway."""
+
+    def __init__(self, ctx: ShardContext, host: str, port: int):
+        self._ctx = ctx
+        self.host = host
+        self.port = port
+        self._server = None
+        self._conn_seq = 0
+        self.conns_total = 0
+        self.frames_total = 0
+
+    async def start(self) -> None:
+        sock = bind_reuse_port(self.host, self.port)
+        self._server = await asyncio.start_server(
+            self._on_conn, sock=sock, limit=1 << 21
+        )
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _on_conn(self, reader, writer) -> None:
+        import struct
+
+        size_s = struct.Struct(">i")
+        self._conn_seq += 1
+        self.conns_total += 1
+        # globally unique across shards: shard id in the high bits
+        conn_id = (self._ctx.shard_id << 48) | self._conn_seq
+        try:
+            while True:
+                try:
+                    raw = await reader.readexactly(4)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                (size,) = size_s.unpack(raw)
+                if size <= 0 or size > (1 << 26):
+                    return
+                frame = await reader.readexactly(size)
+                self.frames_total += 1
+                rep_raw = await self._ctx.invoke_on(
+                    0,
+                    "kafka",
+                    "raw",
+                    KafkaFrame(conn=conn_id, frame=frame).encode(),
+                    timeout=60.0,
+                )
+                rep = KafkaFrameReply.decode(rep_raw)
+                if rep.has_resp:
+                    body = bytes(rep.resp)
+                    writer.write(size_s.pack(len(body)) + body)
+                    await writer.drain()
+                if rep.close:
+                    return
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            asyncio.CancelledError,
+            InvokeError,
+        ):
+            pass
+        finally:
+            try:
+                await self._ctx.invoke_on(
+                    0,
+                    "kafka",
+                    "close",
+                    KafkaFrame(conn=conn_id, frame=b"").encode(),
+                    timeout=5.0,
+                )
+            except (InvokeError, ConnectionError, OSError, RuntimeError):
+                pass  # shard 0 already tearing down; ctx state is gone
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+class PartitionShard:
+    """The data-plane engine a worker shard runs: local storage + raft
+    + partitions, exposed to siblings via the `partition` service."""
+
+    def __init__(self, config, ctx: ShardContext):
+        self._config = config
+        self.ctx = ctx
+        base = os.path.join(config.data_dir, f"shard_{ctx.shard_id}")
+        os.makedirs(base, exist_ok=True)
+        from ..cluster.partition_manager import PartitionManager
+        from ..raft.group_manager import GroupManager
+        from ..storage.log_manager import StorageApi
+
+        self.storage = StorageApi(base)
+
+        async def send(node, method_id, payload, timeout):
+            env = RpcOut(
+                node=node, method=method_id, payload=payload, timeout=timeout
+            ).encode()
+            return await ctx.invoke_on(
+                0, "rpc.out", "call", env, timeout=timeout + 5.0
+            )
+
+        self.group_manager = GroupManager(
+            config.node_id,
+            base,
+            send,
+            election_timeout_s=config.election_timeout_s,
+            heartbeat_interval_s=config.heartbeat_interval_s,
+            kvstore=self.storage.kvs,
+            shard_id=ctx.shard_id,
+            shard_count=ctx.n_shards,
+        )
+        self.partition_manager = PartitionManager(
+            self.storage.log_mgr, self.group_manager
+        )
+        self.frontend: Optional[ShardKafkaFrontend] = None
+        self.produce_reqs = 0
+        self.produce_bytes = 0
+        self.fetch_reqs = 0
+        self.fetch_bytes = 0
+
+    async def start(self) -> None:
+        await self.group_manager.start()
+        self.ctx.register("partition", self.partition_service)
+        self.frontend = ShardKafkaFrontend(
+            self.ctx, self._config.kafka_host, self._config.kafka_port
+        )
+        await self.frontend.start()
+
+    async def stop(self) -> None:
+        if self.frontend is not None:
+            await self.frontend.stop()
+        await self.group_manager.stop()
+        self.storage.close()
+
+    # -- invoke service ----------------------------------------------
+    async def partition_service(self, method: str, payload: bytes) -> bytes:
+        if method == "create":
+            return await self._create(PartitionCreate.decode(payload))
+        if method == "remove":
+            return await self._remove(PartitionRef.decode(payload))
+        if method == "produce":
+            return await self._produce(ShardProduceRequest.decode(payload))
+        if method == "fetch":
+            return self._fetch(ShardFetchRequest.decode(payload))
+        if method == "list_offsets":
+            return self._list_offsets(
+                ShardListOffsetsRequest.decode(payload)
+            )
+        if method == "stats":
+            return self._stats()
+        raise LookupError(f"partition: no such method {method!r}")
+
+    async def _create(self, req: PartitionCreate) -> bytes:
+        from ..storage.log import LogConfig
+
+        ntp = _ntp_of(req.ns, req.topic, req.partition)
+        cfg = LogConfig(
+            segment_max_bytes=req.segment_max_bytes,
+            retention_bytes=req.retention_bytes,
+            retention_ms=req.retention_ms,
+            cleanup_policy=req.cleanup_policy,
+            local_retention_bytes=req.local_retention_bytes,
+            local_retention_ms=req.local_retention_ms,
+        )
+        await self.partition_manager.manage(
+            ntp, req.group, list(req.replicas), log_config=cfg
+        )
+        return b""
+
+    async def _remove(self, req: PartitionRef) -> bytes:
+        await self.partition_manager.remove(
+            _ntp_of(req.ns, req.topic, req.partition)
+        )
+        return b""
+
+    async def _produce(self, req: ShardProduceRequest) -> bytes:
+        from ..cluster.producer_state import (
+            DuplicateSequence,
+            OutOfOrderSequence,
+            ProducerFenced,
+        )
+        from ..kafka.protocol.headers import ErrorCode
+        from ..models.record import CrcMismatch, RecordBatch
+        from ..raft.consensus import NotLeaderError, ReplicateTimeout
+        from ..utils.iobuf import IOBufParser
+
+        def perr(exc: BaseException) -> int:
+            if isinstance(exc, CrcMismatch):
+                return int(ErrorCode.corrupt_message)
+            if isinstance(exc, NotLeaderError):
+                return int(ErrorCode.not_leader_for_partition)
+            if isinstance(exc, (ReplicateTimeout, asyncio.TimeoutError)):
+                return int(ErrorCode.request_timed_out)
+            if isinstance(exc, OutOfOrderSequence):
+                return int(ErrorCode.out_of_order_sequence_number)
+            if isinstance(exc, ProducerFenced):
+                return int(ErrorCode.invalid_producer_epoch)
+            if isinstance(exc, ValueError):
+                return int(ErrorCode.corrupt_message)
+            return int(ErrorCode.unknown_server_error)
+
+        self.produce_reqs += 1
+        self.produce_bytes += len(req.records)
+        partition = self.partition_manager.get(
+            _ntp_of(req.ns, req.topic, req.partition)
+        )
+        if partition is None:
+            # routed here by the shard table: creation not reconciled
+            # yet — retriable, exactly like a moving leader
+            return ShardProduceReply(
+                error=int(ErrorCode.not_leader_for_partition), base_offset=-1
+            ).encode()
+        entries: list[tuple] = []
+        try:
+            parser = IOBufParser(req.records)
+            prev_enqueued = None
+            while parser.bytes_left() > 0:
+                batch = RecordBatch.from_kafka_wire(parser, verify=True)
+                if prev_enqueued is not None:
+                    await asyncio.shield(prev_enqueued)
+                try:
+                    ps = await partition.replicate_in_stages(
+                        batch, acks=req.acks
+                    )
+                except DuplicateSequence as dup:
+                    entries.append(("dup", dup.base_offset))
+                    continue
+                entries.append(("ps", ps))
+                prev_enqueued = ps.enqueued
+        except Exception as e:
+            for kind, v in entries:
+                if kind == "ps":
+                    _consume_exc(v.enqueued)
+                    _consume_exc(v.done)
+            return ShardProduceReply(error=perr(e), base_offset=-1).encode()
+        base = -1
+        err = 0
+        for i, (kind, v) in enumerate(entries):
+            if kind == "dup":
+                if base < 0:
+                    base = v
+                continue
+            try:
+                kbase = await asyncio.wait_for(asyncio.shield(v.done), 10.0)
+                if base < 0:
+                    base = kbase
+            except Exception as e:
+                err = perr(e)
+                for kind2, v2 in entries[i:]:
+                    if kind2 == "ps":
+                        _consume_exc(v2.done)
+                break
+        return ShardProduceReply(
+            error=err, base_offset=base if not err else -1
+        ).encode()
+
+    def _fetch(self, req: ShardFetchRequest) -> bytes:
+        from ..kafka.protocol.headers import ErrorCode
+        from ..kafka.server import _frame_kafka
+
+        self.fetch_reqs += 1
+        partition = self.partition_manager.get(
+            _ntp_of(req.ns, req.topic, req.partition)
+        )
+        if partition is None or not partition.is_leader:
+            return ShardFetchReply(
+                error=int(ErrorCode.not_leader_for_partition),
+                high_watermark=-1,
+                last_stable_offset=-1,
+                log_start=-1,
+                records=b"",
+            ).encode()
+        hw = partition.high_watermark()
+        lso = partition.last_stable_offset()
+        start = partition.start_offset()
+        if req.offset < start or req.offset > hw:
+            return ShardFetchReply(
+                error=int(ErrorCode.offset_out_of_range),
+                high_watermark=hw,
+                last_stable_offset=lso,
+                log_start=start,
+                records=b"",
+            ).encode()
+        pairs = partition.read_kafka(
+            req.offset,
+            max_bytes=req.max_bytes,
+            upto_kafka=lso if req.read_committed else None,
+        )
+        wire = b"".join(_frame_kafka(b, kb) for kb, b in pairs)
+        self.fetch_bytes += len(wire)
+        return ShardFetchReply(
+            error=0,
+            high_watermark=hw,
+            last_stable_offset=lso,
+            log_start=start,
+            records=wire,
+        ).encode()
+
+    def _list_offsets(self, req: ShardListOffsetsRequest) -> bytes:
+        from ..kafka.protocol.headers import ErrorCode
+
+        partition = self.partition_manager.get(
+            _ntp_of(req.ns, req.topic, req.partition)
+        )
+        if partition is None or not partition.is_leader:
+            return ShardListOffsetsReply(
+                error=int(ErrorCode.not_leader_for_partition),
+                offset=-1,
+                timestamp=-1,
+            ).encode()
+        if req.timestamp == -2:  # earliest
+            off, ts = partition.start_offset(), -1
+        elif req.timestamp == -1:  # latest
+            off, ts = partition.high_watermark(), -1
+        else:
+            q = partition.timequery(req.timestamp)
+            off, ts = (q, req.timestamp) if q is not None else (-1, -1)
+        return ShardListOffsetsReply(
+            error=0, offset=off, timestamp=ts
+        ).encode()
+
+    def _stats(self) -> bytes:
+        parts = self.partition_manager.partitions()
+        return ShardStats(
+            shard=self.ctx.shard_id,
+            partitions=len(parts),
+            leaders=sum(1 for p in parts.values() if p.is_leader),
+            produce_reqs=self.produce_reqs,
+            produce_bytes=self.produce_bytes,
+            fetch_reqs=self.fetch_reqs,
+            fetch_bytes=self.fetch_bytes,
+            frontend_conns=(
+                self.frontend.conns_total if self.frontend else 0
+            ),
+            frontend_frames=(
+                self.frontend.frames_total if self.frontend else 0
+            ),
+        ).encode()
+
+
+def _consume_exc(fut) -> None:
+    """Mark a future's exception retrieved (mirrors kafka/server.py)."""
+
+    def _done(f):
+        if not f.cancelled():
+            f.exception()
+
+    fut.add_done_callback(_done)
+
+
+# --------------------------------------------------------------- router
+class ShardRouter:
+    """Shard-0 facade the kafka layer and controller backend use to
+    reach partition engines on other shards. Thin typed wrappers over
+    `invoke_on` with serde envelopes (RPL009)."""
+
+    def __init__(self, runtime: ShardRuntime, n_shards: int):
+        self._rt = runtime
+        self.n_shards = n_shards
+
+    def shard_of(self, group_id: int) -> int:
+        return shard_of(group_id, self.n_shards)
+
+    async def create_partition(
+        self, shard: int, ntp, group: int, replicas, log_cfg
+    ) -> None:
+        await self._rt.invoke_on(
+            shard,
+            "partition",
+            "create",
+            PartitionCreate(
+                ns=ntp.ns,
+                topic=ntp.topic,
+                partition=ntp.partition,
+                group=group,
+                replicas=list(replicas),
+                segment_max_bytes=log_cfg.segment_max_bytes,
+                retention_bytes=log_cfg.retention_bytes,
+                retention_ms=log_cfg.retention_ms,
+                cleanup_policy=log_cfg.cleanup_policy,
+                local_retention_bytes=log_cfg.local_retention_bytes,
+                local_retention_ms=log_cfg.local_retention_ms,
+            ).encode(),
+        )
+
+    async def remove_partition(self, shard: int, ntp) -> None:
+        await self._rt.invoke_on(
+            shard,
+            "partition",
+            "remove",
+            PartitionRef(
+                ns=ntp.ns, topic=ntp.topic, partition=ntp.partition
+            ).encode(),
+        )
+
+    async def produce(
+        self, shard: int, ntp, records: bytes, acks: int
+    ) -> tuple[int, int]:
+        raw = await self._rt.invoke_on(
+            shard,
+            "partition",
+            "produce",
+            ShardProduceRequest(
+                ns=ntp.ns,
+                topic=ntp.topic,
+                partition=ntp.partition,
+                acks=acks,
+                records=records,
+            ).encode(),
+            timeout=15.0,
+        )
+        rep = ShardProduceReply.decode(raw)
+        return rep.error, rep.base_offset
+
+    async def fetch(
+        self,
+        shard: int,
+        ntp,
+        offset: int,
+        max_bytes: int,
+        read_committed: bool,
+    ) -> ShardFetchReply:
+        raw = await self._rt.invoke_on(
+            shard,
+            "partition",
+            "fetch",
+            ShardFetchRequest(
+                ns=ntp.ns,
+                topic=ntp.topic,
+                partition=ntp.partition,
+                offset=offset,
+                max_bytes=max_bytes,
+                read_committed=read_committed,
+            ).encode(),
+            timeout=15.0,
+        )
+        return ShardFetchReply.decode(raw)
+
+    async def list_offsets(
+        self, shard: int, ntp, timestamp: int
+    ) -> tuple[int, int, int]:
+        raw = await self._rt.invoke_on(
+            shard,
+            "partition",
+            "list_offsets",
+            ShardListOffsetsRequest(
+                ns=ntp.ns,
+                topic=ntp.topic,
+                partition=ntp.partition,
+                timestamp=timestamp,
+            ).encode(),
+            timeout=10.0,
+        )
+        rep = ShardListOffsetsReply.decode(raw)
+        return rep.error, rep.offset, rep.timestamp
+
+    async def stats(self, shard: int) -> ShardStats:
+        raw = await self._rt.invoke_on(
+            shard, "partition", "stats", b"", timeout=10.0
+        )
+        return ShardStats.decode(raw)
+
+
+# ------------------------------------------------------- sharded broker
+class ShardedBroker:
+    """Owner of one broker's shard group. With `n_shards <= 1`, a
+    stand-down condition (RP_SHARDS=0, fault injection armed), or any
+    activation failure it degrades to the plain single-process Broker —
+    the default loopback/NemesisNet test path is untouched."""
+
+    def __init__(self, config, n_shards: int = 2):
+        self.config = config
+        self.n_shards = max(1, int(n_shards))
+        self.broker = None
+        self.runtime: Optional[ShardRuntime] = None
+        self.router: Optional[ShardRouter] = None
+        self.active = False
+        self.standdown: Optional[str] = None
+        self.failed = asyncio.Event()
+        self._reserve_sock = None
+        self._fwd_ctx: dict[int, object] = {}
+
+    async def start(self) -> None:
+        from ..app import Broker
+
+        reason = (
+            "n_shards <= 1" if self.n_shards <= 1 else standdown_reason()
+        )
+        if reason is not None:
+            self.standdown = reason
+            if self.n_shards > 1:
+                logger.warning(
+                    "shard runtime standing down (%s): single-process broker",
+                    reason,
+                )
+            self.broker = Broker(self.config)
+            await self.broker.start()
+            return
+        # reserve the shared kafka port BEFORE forking so every shard
+        # (parent included) binds the same number with SO_REUSEPORT
+        self._reserve_sock, port = reserve_reuse_port(
+            self.config.kafka_host, self.config.kafka_port
+        )
+        self.config.kafka_port = port
+        self.config.kafka_reuse_port = True
+        self.runtime = ShardRuntime(self.n_shards, self._shard_child_main)
+        self.runtime.register("rpc.out", self._rpc_out_service)
+        self.runtime.register("kafka", self._kafka_service)
+        self.runtime.on_crash = self._on_shard_crash
+        await self.runtime.start()
+        # the Broker is constructed AFTER the fork: children must not
+        # inherit open storage fds or the admin/kafka listeners
+        self.broker = Broker(self.config)
+        self.router = ShardRouter(self.runtime, self.n_shards)
+        self.broker.shard_router = self.router
+        self.broker.shard_table.shard_count = self.n_shards
+        self.broker.controller.shard_router = self.router
+        await self.broker.start()
+        self._reserve_sock.close()
+        self._reserve_sock = None
+        self.active = True
+        logger.info(
+            "sharded broker up: node %d, %d shards on kafka port %d",
+            self.config.node_id,
+            self.n_shards,
+            self.broker.kafka_server.port,
+        )
+
+    async def stop(self) -> None:
+        if self.broker is not None:
+            await self.broker.stop()
+            self.broker = None
+        if self.runtime is not None:
+            await self.runtime.stop()
+            self.runtime = None
+        if self._reserve_sock is not None:
+            self._reserve_sock.close()
+            self._reserve_sock = None
+        self.active = False
+
+    # -- child side ----------------------------------------------------
+    async def _shard_child_main(self, ctx: ShardContext):
+        # `self` here is the fork-time copy: config only, no Broker
+        shard = PartitionShard(self.config, ctx)
+        await shard.start()
+        return shard.stop
+
+    # -- parent services ----------------------------------------------
+    def _on_shard_crash(self, shard_id: int, status: int) -> None:
+        logger.error(
+            "node %d: shard %d died (status %d) — broker must stop",
+            self.config.node_id,
+            shard_id,
+            status,
+        )
+        self.failed.set()
+
+    async def _rpc_out_service(self, method: str, payload: bytes) -> bytes:
+        if method != "call":
+            raise LookupError(f"rpc.out: no such method {method!r}")
+        if self.broker is None:
+            raise RuntimeError("broker not started")
+        req = RpcOut.decode(payload)
+        return await self.broker.send_rpc(
+            req.node, req.method, bytes(req.payload), req.timeout
+        )
+
+    async def _kafka_service(self, method: str, payload: bytes) -> bytes:
+        from ..kafka.server import (
+            ConnectionContext,
+            _CloseConnection,
+            _TrackedResponse,
+        )
+
+        req = KafkaFrame.decode(payload)
+        if method == "close":
+            self._fwd_ctx.pop(req.conn, None)
+            return b""
+        if method != "raw":
+            raise LookupError(f"kafka: no such method {method!r}")
+        if self.broker is None:
+            raise RuntimeError("broker not started")
+        ctx = self._fwd_ctx.get(req.conn)
+        if ctx is None:
+            ctx = self._fwd_ctx[req.conn] = ConnectionContext()
+        ks = self.broker.kafka_server
+        try:
+            resp = await ks._process(bytes(req.frame), ctx)
+        except _CloseConnection as e:
+            data = e.args[0] if e.args else b""
+            self._fwd_ctx.pop(req.conn, None)
+            return KafkaFrameReply(
+                has_resp=bool(data), resp=data or b"", close=True
+            ).encode()
+        on_written = None
+        if type(resp) is _TrackedResponse:
+            on_written = resp.on_written
+            resp = resp.resp
+        if asyncio.iscoroutine(resp):
+            resp = await resp
+        out = KafkaFrameReply(
+            has_resp=resp is not None, resp=resp or b"", close=False
+        ).encode()
+        if on_written is not None:
+            on_written()
+        return out
+
+    # -- conveniences --------------------------------------------------
+    @property
+    def kafka_port(self) -> int:
+        return self.broker.kafka_server.port
+
+    async def shard_stats(self) -> list[ShardStats]:
+        if not self.active or self.router is None:
+            return []
+        out = []
+        for sid in range(1, self.n_shards):
+            try:
+                out.append(await self.router.stats(sid))
+            except InvokeError:
+                pass
+        return out

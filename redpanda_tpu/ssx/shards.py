@@ -1,0 +1,658 @@
+"""Shard-per-core runtime (reference: seastar ss::sharded<T> / smp).
+
+The reference runs one reactor per core and moves work between them
+with `sharded<T>::invoke_on(shard, fn)` (seastar/include/seastar/core/
+sharded.hh). CPython cannot do that inside one process — the GIL makes
+N asyncio loops in one interpreter time-share a single core — so the
+shard here is a forked *process*: same memory image at fork time, own
+interpreter and event loop afterwards, pinned to a core with
+`os.sched_setaffinity`.
+
+Topology: the parent IS shard 0 (seastar's main thread), shards
+1..N-1 are forked children. Every pair of shards shares a pre-fork
+AF_UNIX socketpair, so `invoke_on` between any two shards is one hop —
+no broker process in the middle. Each message is a serde envelope
+(`InvokeRequest`/`InvokeReply`) behind a 4-byte length + 1-byte kind
+frame, the same framing discipline as rpc/transport.py; payloads are
+themselves serde envelopes (rplint RPL009 — no pickled object graphs
+crossing the shard boundary).
+
+Supervision (shard 0 only): a reaper task polls `waitpid(WNOHANG)`;
+an unexpected child exit either escalates (`failed` is set, `on_crash`
+fires — the broker embedding decides to shut down) or, with
+`restart_limit > 0`, tears down and re-forks the whole shard group
+(state is rebuilt by `child_main`, exactly like a process manager
+restart — per-shard in-place restart would need SCM_RIGHTS fd
+re-plumbing into live siblings and is deliberately out of scope).
+
+Stand-down discipline mirrors the native gates (raft/service.py):
+fault-injection layers (file_sanitizer, iofaults) instrument
+*in-process* state that a forked shard cannot see, so the runtime
+refuses to activate while they are armed, and `RP_SHARDS=0` is the
+operator escape hatch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import socket
+import struct
+import traceback
+from typing import Awaitable, Callable, Optional
+
+from ..utils.serde import Envelope, bytes_t, string, u8, u16, u64
+
+logger = logging.getLogger("ssx")
+
+# frame: [u32 size][u8 kind][envelope bytes]; size counts kind + envelope
+_HDR = struct.Struct("<IB")
+_KIND_REQUEST = 0
+_KIND_REPLY = 1
+
+# InvokeReply.status
+_ST_OK = 0
+_ST_APP_ERROR = 1
+_ST_NO_SERVICE = 2
+
+
+class InvokeError(Exception):
+    """An invoke_on failed on the remote shard (or the channel died)."""
+
+
+class InvokeRequest(Envelope):
+    SERDE_FIELDS = [
+        ("corr", u64),
+        ("service", string),
+        ("method", string),
+        ("payload", bytes_t),
+    ]
+
+
+class InvokeReply(Envelope):
+    SERDE_FIELDS = [
+        ("corr", u64),
+        ("status", u8),
+        ("payload", bytes_t),
+    ]
+
+
+class ShardReady(Envelope):
+    SERDE_FIELDS = [("shard", u16), ("pid", u64), ("core", u64)]
+
+
+# ------------------------------------------------------------------ util
+def shard_of(group_id: int, n_shards: int) -> int:
+    """Deterministic raft-group → shard assignment. Group 0 (the
+    controller) and the internal coordinator groups (negative ids in
+    some fixtures) are pinned to shard 0, which runs the full broker;
+    data groups spread round-robin so each shard owns a stable slice
+    (shard_placement_table analog, without rebalancing)."""
+    if n_shards <= 1 or group_id <= 0:
+        return 0
+    return group_id % n_shards
+
+
+def pin_to_core(shard_id: int) -> Optional[int]:
+    """Best-effort affinity pin: shard i takes the i-th available core
+    (mod the cpuset — honest on 1-core boxes: every shard shares it)."""
+    try:
+        avail = sorted(os.sched_getaffinity(0))
+        core = avail[shard_id % len(avail)]
+        os.sched_setaffinity(0, {core})
+        return core
+    except (AttributeError, OSError):
+        return None
+
+
+def standdown_reason() -> Optional[str]:
+    """Why the shard runtime must NOT activate right now, or None.
+    Same discipline as the native-gate stand-down in raft/service.py:
+    fault-injection layers hold in-process state a forked shard cannot
+    observe, so sharding silently changes their semantics."""
+    if os.environ.get("RP_SHARDS", "") == "0":
+        return "RP_SHARDS=0"
+    from ..storage import file_sanitizer, iofaults
+
+    if file_sanitizer.enabled():
+        return "file_sanitizer active"
+    if iofaults.active():
+        return "iofaults active"
+    return None
+
+
+def reserve_reuse_port(
+    host: str = "127.0.0.1", port: int = 0
+) -> tuple[socket.socket, int]:
+    """Reserve the port that N listeners will share: bind a
+    SO_REUSEPORT socket on `port` (0 = ephemeral) and keep it open
+    until every shard has bound its own (the kernel refuses cross-uid
+    squatting, and the held socket keeps an ephemeral port out of the
+    pool meanwhile)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    s.bind((host, port))
+    return s, s.getsockname()[1]
+
+
+def bind_reuse_port(host: str, port: int) -> socket.socket:
+    """A bound (not yet listening) SO_REUSEPORT socket for one shard's
+    listener; pass to loop.create_server(sock=...). The kernel hashes
+    the 4-tuple across all sockets bound to (host, port), spreading
+    accepted connections over the shards."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    s.bind((host, port))
+    return s
+
+
+# ------------------------------------------------------------- channel
+class ShardChannel:
+    """Full-duplex correlation-multiplexed stream over one socketpair
+    end — both sides initiate requests and serve the peer's (the
+    symmetric sibling of rpc/transport.py's client-only TcpTransport).
+    Replies may arrive out of request order; the correlation id pairs
+    them back up."""
+
+    def __init__(self, sock: socket.socket, dispatch, label: str = ""):
+        self._sock = sock
+        self._dispatch = dispatch  # async (service, method, payload) -> bytes
+        self.label = label
+        self._corr = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._task: Optional[asyncio.Future] = None
+        self._closed = False
+
+    async def open(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            sock=self._sock, limit=1 << 21
+        )
+        self._task = asyncio.ensure_future(self._read_loop())
+
+    async def call(
+        self, service: str, method: str, payload: bytes, timeout: float = 30.0
+    ) -> bytes:
+        if self._closed:
+            raise InvokeError(f"channel {self.label} closed")
+        self._corr += 1
+        corr = self._corr
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[corr] = fut
+        env = InvokeRequest(
+            corr=corr, service=service, method=method, payload=payload
+        ).encode()
+        try:
+            self._send(_KIND_REQUEST, env)
+            await self._writer.drain()
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            raise InvokeError(
+                f"invoke_on timeout ({self.label} {service}.{method})"
+            ) from None
+        except (ConnectionError, OSError, RuntimeError) as e:
+            raise InvokeError(
+                f"invoke_on failed ({self.label} {service}.{method}): {e}"
+            ) from None
+        finally:
+            self._pending.pop(corr, None)
+
+    def _send(self, kind: int, env: bytes) -> None:
+        # one write() per frame keeps concurrent senders interleave-free
+        self._writer.write(_HDR.pack(len(env) + 1, kind) + env)
+
+    async def _serve(self, req: InvokeRequest) -> None:
+        try:
+            result = await self._dispatch(
+                req.service, req.method, bytes(req.payload)
+            )
+            status, payload = _ST_OK, (result if result is not None else b"")
+        except LookupError as e:
+            status, payload = _ST_NO_SERVICE, str(e).encode()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            status = _ST_APP_ERROR
+            payload = f"{type(e).__name__}: {e}".encode()
+        if self._closed:
+            return
+        try:
+            self._send(
+                _KIND_REPLY,
+                InvokeReply(
+                    corr=req.corr, status=status, payload=payload
+                ).encode(),
+            )
+            await self._writer.drain()
+        except (ConnectionError, RuntimeError):
+            pass  # peer went away; its caller sees the channel failure
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                hdr = await self._reader.readexactly(_HDR.size)
+                size, kind = _HDR.unpack(hdr)
+                body = await self._reader.readexactly(size - 1)
+                if kind == _KIND_REQUEST:
+                    req = InvokeRequest.decode(body)
+                    asyncio.ensure_future(self._serve(req))
+                else:
+                    rep = InvokeReply.decode(body)
+                    fut = self._pending.pop(rep.corr, None)
+                    if fut is None or fut.done():
+                        continue
+                    if rep.status == _ST_OK:
+                        fut.set_result(bytes(rep.payload))
+                    else:
+                        fut.set_exception(
+                            InvokeError(rep.payload.decode(errors="replace"))
+                        )
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            asyncio.CancelledError,
+            OSError,
+        ):
+            pass
+        finally:
+            self._fail_pending("peer channel closed")
+
+    def _fail_pending(self, why: str) -> None:
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(InvokeError(f"{self.label}: {why}"))
+        self._pending.clear()
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._fail_pending("channel closed")
+
+
+# ------------------------------------------------------------- context
+class ShardContext:
+    """What a shard sees: its id, channels to every sibling, and the
+    service registry this shard exposes to invoke_on (the local half
+    of `ss::sharded<T>`)."""
+
+    def __init__(self, shard_id: int, n_shards: int):
+        self.shard_id = shard_id
+        self.n_shards = n_shards
+        self.core: Optional[int] = None
+        self._services: dict[str, Callable[[str, bytes], Awaitable[bytes]]] = {}
+        self._channels: dict[int, ShardChannel] = {}
+        self.shutdown = asyncio.Event()
+
+    def register(
+        self, service: str, handler: Callable[[str, bytes], Awaitable[bytes]]
+    ) -> None:
+        self._services[service] = handler
+
+    async def dispatch(self, service: str, method: str, payload: bytes) -> bytes:
+        h = self._services.get(service)
+        if h is None:
+            raise LookupError(
+                f"shard {self.shard_id}: no such service {service!r}"
+            )
+        return await h(method, payload)
+
+    async def invoke_on(
+        self,
+        shard: int,
+        service: str,
+        method: str,
+        payload: bytes = b"",
+        timeout: float = 30.0,
+    ) -> bytes:
+        """The `ss::sharded<T>::invoke_on` analog. Local shard runs the
+        handler inline (no serialization round-trip, matching seastar's
+        same-shard fast path); remote goes over the socketpair."""
+        if shard == self.shard_id:
+            return await self.dispatch(service, method, payload)
+        ch = self._channels.get(shard)
+        if ch is None:
+            raise InvokeError(
+                f"shard {self.shard_id}: no channel to shard {shard}"
+            )
+        return await ch.call(service, method, payload, timeout)
+
+    async def _close_channels(self) -> None:
+        for ch in self._channels.values():
+            await ch.close()
+        self._channels.clear()
+
+
+# ------------------------------------------------------------- runtime
+class ShardRuntime:
+    """Fork-and-supervise shard group; the constructing process is
+    shard 0. `child_main(ctx)` runs once in every child after the fork
+    (fresh event loop, core pinned, channels open): it registers the
+    shard's services and may return an async cleanup callable invoked
+    at shutdown. The child signals readiness only after child_main
+    returns, so `start()` completing means every shard is serving."""
+
+    PARENT_SHARD = 0
+
+    def __init__(
+        self,
+        n_shards: int,
+        child_main: Callable[[ShardContext], Awaitable],
+        *,
+        restart_limit: int = 0,
+        ready_timeout: float = 30.0,
+        shutdown_timeout: float = 8.0,
+    ):
+        if n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        self.n_shards = n_shards
+        self._child_main = child_main
+        self._restart_limit = restart_limit
+        self._ready_timeout = ready_timeout
+        self._shutdown_timeout = shutdown_timeout
+
+        self.ctx: Optional[ShardContext] = None
+        self.failed = asyncio.Event()
+        self.crashed: dict[int, int] = {}  # shard -> wait status
+        self.restarts = 0
+        self.shard_pids: dict[int, int] = {}
+        self.shard_cores: dict[int, Optional[int]] = {}
+        # on_crash(shard_id, status): escalation hook (sync or async)
+        self.on_crash = None
+        # on_restart(runtime): fired after a successful restart-all
+        self.on_restart = None
+
+        self._pairs: dict[tuple[int, int], tuple[socket.socket, socket.socket]] = {}
+        self._ready_futs: dict[int, asyncio.Future] = {}
+        self._reaper: Optional[asyncio.Future] = None
+        self._stopping = False
+        self._started = False
+        # services registered before start() land on the parent ctx
+        self._pre_services: dict[str, Callable] = {}
+
+    # -- parent-side service registry (usable before start) ----------
+    def register(self, service: str, handler) -> None:
+        if self.ctx is not None:
+            self.ctx.register(service, handler)
+        else:
+            self._pre_services[service] = handler
+
+    async def invoke_on(
+        self,
+        shard: int,
+        service: str,
+        method: str,
+        payload: bytes = b"",
+        timeout: float = 30.0,
+    ) -> bytes:
+        assert self.ctx is not None, "runtime not started"
+        return await self.ctx.invoke_on(shard, service, method, payload, timeout)
+
+    # -- lifecycle ----------------------------------------------------
+    async def start(self) -> None:
+        if self._started:
+            raise RuntimeError("ShardRuntime already started")
+        reason = standdown_reason()
+        if reason is not None:
+            raise RuntimeError(f"shard runtime stand-down: {reason}")
+        self._started = True
+        await self._launch()
+        self._reaper = asyncio.ensure_future(self._reap_loop())
+
+    async def _launch(self) -> None:
+        n = self.n_shards
+        self.ctx = ShardContext(self.PARENT_SHARD, n)
+        for name, h in self._pre_services.items():
+            self.ctx.register(name, h)
+        self.ctx.register("ssx", self._parent_ssx)
+        loop = asyncio.get_event_loop()
+        self._ready_futs = {
+            sid: loop.create_future() for sid in range(1, n)
+        }
+        # full mesh, created BEFORE any fork so every child inherits
+        # the ends it needs and closes the rest
+        self._pairs = {
+            (i, j): socket.socketpair()
+            for i in range(n)
+            for j in range(i + 1, n)
+        }
+        for sid in range(1, n):
+            self.shard_pids[sid] = self._fork_child(sid)
+        # parent keeps its own ends, closes everything else
+        for (i, j), (a, b) in self._pairs.items():
+            if i == self.PARENT_SHARD:
+                b.close()
+            else:
+                a.close()
+                b.close()
+        for (i, j), (a, b) in list(self._pairs.items()):
+            if i != self.PARENT_SHARD:
+                continue
+            ch = ShardChannel(a, self.ctx.dispatch, label=f"0<->{j}")
+            await ch.open()
+            self.ctx._channels[j] = ch
+        if self._ready_futs:
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*self._ready_futs.values()),
+                    self._ready_timeout,
+                )
+            except asyncio.TimeoutError:
+                missing = [
+                    sid for sid, f in self._ready_futs.items() if not f.done()
+                ]
+                await self._kill_all()
+                raise RuntimeError(
+                    f"shards {missing} not ready within "
+                    f"{self._ready_timeout}s"
+                ) from None
+        logger.info(
+            "shard runtime up: %d shards, pids=%s cores=%s",
+            n,
+            self.shard_pids,
+            self.shard_cores,
+        )
+
+    async def _parent_ssx(self, method: str, payload: bytes) -> bytes:
+        if method == "ready":
+            r = ShardReady.decode(payload)
+            self.shard_cores[r.shard] = r.core if r.core != (1 << 63) else None
+            fut = self._ready_futs.get(r.shard)
+            if fut is not None and not fut.done():
+                fut.set_result(None)
+            return b""
+        if method == "ping":
+            return payload
+        raise LookupError(f"ssx: no such method {method!r}")
+
+    def _fork_child(self, sid: int) -> int:
+        pid = os.fork()
+        if pid:
+            return pid
+        # ---- child: never returns ----
+        status = 1
+        try:
+            for (i, j), (a, b) in self._pairs.items():
+                keep = a if i == sid else (b if j == sid else None)
+                for s in (a, b):
+                    if s is not keep:
+                        s.close()
+            core = pin_to_core(sid)
+            # the forked thread-state still marks the parent's loop as
+            # running; clear it so a fresh loop can run here
+            asyncio.events._set_running_loop(None)
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(self._child_body(sid, core))
+            status = 0
+        except BaseException:
+            traceback.print_exc()
+        finally:
+            # NEVER unwind into the parent's stack/atexit machinery
+            os._exit(status)
+
+    async def _child_body(self, sid: int, core: Optional[int]) -> None:
+        ctx = ShardContext(sid, self.n_shards)
+        ctx.core = core
+
+        async def _ssx(method: str, payload: bytes) -> bytes:
+            if method == "ping":
+                return payload
+            if method == "shutdown":
+                ctx.shutdown.set()
+                return b""
+            raise LookupError(f"ssx: no such method {method!r}")
+
+        ctx.register("ssx", _ssx)
+        for (i, j), (a, b) in self._pairs.items():
+            if i == sid:
+                peer, sock = j, a
+            elif j == sid:
+                peer, sock = i, b
+            else:
+                continue
+            ch = ShardChannel(sock, ctx.dispatch, label=f"{sid}<->{peer}")
+            await ch.open()
+            ctx._channels[peer] = ch
+        cleanup = await self._child_main(ctx)
+        await ctx.invoke_on(
+            0,
+            "ssx",
+            "ready",
+            ShardReady(
+                shard=sid,
+                pid=os.getpid(),
+                core=core if core is not None else (1 << 63),
+            ).encode(),
+        )
+        await ctx.shutdown.wait()
+        if cleanup is not None:
+            try:
+                await cleanup()
+            except Exception:
+                traceback.print_exc()
+        await ctx._close_channels()
+
+    # -- supervision --------------------------------------------------
+    async def _reap_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.1)
+            dead: list[tuple[int, int]] = []
+            for sid, pid in list(self.shard_pids.items()):
+                try:
+                    wpid, st = os.waitpid(pid, os.WNOHANG)
+                except ChildProcessError:
+                    wpid, st = pid, -1
+                if wpid == 0:
+                    continue
+                del self.shard_pids[sid]
+                dead.append((sid, st))
+            if not dead or self._stopping:
+                continue
+            for sid, st in dead:
+                self.crashed[sid] = st
+                logger.error(
+                    "shard %d crashed (wait status %d)", sid, st
+                )
+            if self._restart_limit > self.restarts:
+                self.restarts += 1
+                try:
+                    await self._restart_all()
+                    if self.on_restart is not None:
+                        res = self.on_restart(self)
+                        if asyncio.iscoroutine(res):
+                            await res
+                    continue
+                except Exception:
+                    logger.exception("shard group restart failed")
+            self.failed.set()
+            if self.on_crash is not None:
+                for sid, st in dead:
+                    res = self.on_crash(sid, st)
+                    if asyncio.iscoroutine(res):
+                        await res
+            return
+
+    async def _restart_all(self) -> None:
+        """Restart policy: tear down the whole shard group and re-fork
+        it (crash-only restart — every shard rebuilds via child_main)."""
+        logger.warning(
+            "restarting shard group (%d/%d)", self.restarts, self._restart_limit
+        )
+        await self._kill_all()
+        if self.ctx is not None:
+            await self.ctx._close_channels()
+        await self._launch()
+
+    async def _kill_all(self) -> None:
+        for sid, pid in list(self.shard_pids.items()):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        await self._wait_children(2.0)
+        self.shard_pids.clear()
+
+    async def _wait_children(self, timeout: float) -> bool:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while self.shard_pids:
+            for sid, pid in list(self.shard_pids.items()):
+                try:
+                    wpid, _ = os.waitpid(pid, os.WNOHANG)
+                except ChildProcessError:
+                    wpid = pid
+                if wpid:
+                    del self.shard_pids[sid]
+            if not self.shard_pids:
+                return True
+            if asyncio.get_event_loop().time() >= deadline:
+                return False
+            await asyncio.sleep(0.05)
+        return True
+
+    async def stop(self) -> None:
+        """Clean shutdown: polite invoke, then SIGTERM, then SIGKILL."""
+        if not self._started:
+            return
+        self._stopping = True
+        if self._reaper is not None:
+            self._reaper.cancel()
+            try:
+                await self._reaper
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self.ctx is not None:
+            for sid in list(self.ctx._channels):
+                try:
+                    await self.ctx.invoke_on(
+                        sid, "ssx", "shutdown", b"", timeout=2.0
+                    )
+                except InvokeError:
+                    pass
+        if not await self._wait_children(self._shutdown_timeout):
+            for pid in self.shard_pids.values():
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+            if not await self._wait_children(2.0):
+                await self._kill_all()
+        if self.ctx is not None:
+            await self.ctx._close_channels()
+        self._started = False

@@ -678,3 +678,93 @@ def test_cli_unknown_rule_is_usage_error():
 def test_cli_baseline_gate_full_tree():
     proc = _run_cli(["--baseline", "redpanda_tpu"], REPO_ROOT)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- RPL009: shard discipline (fork in ssx/ only, serde payloads) ------
+
+RPL009_FORK = """
+    import os
+
+    def split():
+        pid = os.fork()
+        return pid
+"""
+
+
+def test_rpl009_reports_os_fork_outside_ssx(tmp_path):
+    (f,) = _only(
+        _lint_source(tmp_path, RPL009_FORK, "raft/mod.py"), "RPL009"
+    )
+    assert "os.fork()" in f.message and "ssx" in f.message
+
+
+def test_rpl009_reports_multiprocessing_import(tmp_path):
+    src = """
+        import multiprocessing
+
+        def start():
+            return multiprocessing.Process(target=print)
+    """
+    (f,) = _only(_lint_source(tmp_path, src, "cluster/mod.py"), "RPL009")
+    assert "multiprocessing" in f.message
+    src_from = """
+        from multiprocessing import Pool
+    """
+    (f,) = _only(
+        _lint_source(tmp_path, src_from, "kafka/mod.py"), "RPL009"
+    )
+    assert "multiprocessing" in f.message
+
+
+def test_rpl009_ssx_is_exempt_from_fork_check(tmp_path):
+    assert (
+        _only(
+            _lint_source(
+                tmp_path, RPL009_FORK, "redpanda_tpu/ssx/shards.py"
+            ),
+            "RPL009",
+        )
+        == []
+    )
+
+
+def test_rpl009_reports_pickled_invoke_payload(tmp_path):
+    src = """
+        import pickle
+
+        async def call(ctx, obj):
+            return await ctx.invoke_on(1, "svc", "m", pickle.dumps(obj))
+    """
+    # flagged EVEN inside ssx/: the serde contract has no exemption
+    (f,) = _only(
+        _lint_source(tmp_path, src, "redpanda_tpu/ssx/mod.py"), "RPL009"
+    )
+    assert "pickle.dumps" in f.message and "serde" in f.message
+
+
+def test_rpl009_reports_json_payload_kwarg_form(tmp_path):
+    src = """
+        import json
+
+        async def call(ctx, obj):
+            return await ctx.invoke_on(
+                1, "svc", "m", payload=json.dumps(obj).encode()
+            )
+    """
+    (f,) = _only(_lint_source(tmp_path, src, "app/mod.py"), "RPL009")
+    assert "json.dumps" in f.message
+
+
+def test_rpl009_serde_envelope_payload_clean(tmp_path):
+    src = """
+        async def call(ctx, req):
+            return await ctx.invoke_on(1, "svc", "m", req.encode())
+    """
+    assert _only(_lint_source(tmp_path, src, "kafka/mod.py"), "RPL009") == []
+
+
+def test_rpl009_suppression(tmp_path):
+    src = RPL009_FORK.replace(
+        "pid = os.fork()", "pid = os.fork()  # rplint: disable=RPL009"
+    )
+    assert _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL009") == []

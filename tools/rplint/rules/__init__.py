@@ -10,6 +10,7 @@ from .rpl005_cancelled_swallow import CancelledSwallowRule
 from .rpl006_net_await_budget import NetAwaitBudgetRule
 from .rpl007_native_symbols import NativeSymbolRule
 from .rpl008_trace_discipline import TraceDisciplineRule
+from .rpl009_shard_discipline import ShardDisciplineRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -20,6 +21,7 @@ ALL_RULES = [
     NetAwaitBudgetRule,
     NativeSymbolRule,
     TraceDisciplineRule,
+    ShardDisciplineRule,
 ]
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,135 @@
+"""RPL009 — shard discipline: forking stays in ssx/, invoke_on
+payloads stay serde.
+
+The shard runtime (redpanda_tpu/ssx/shards.py) is the ONE place
+allowed to create worker processes: it owns the fork hygiene that
+makes multi-process safe in this codebase — closing non-owned
+socketpair fds, resetting the inherited asyncio loop state
+(`events._set_running_loop(None)` — the forked thread-state still
+claims the parent's loop is running), pinning, and exiting via
+`os._exit` so a child never unwinds the parent's atexit/finalizer
+stack. A stray `multiprocessing` import or `os.fork()` elsewhere gets
+none of that, and (worse) forks AFTER jax initialization from an
+arbitrary program point — the classic deadlocked-child shape.
+
+Second contract: `invoke_on(shard, service, method, payload)` is a
+cross-process hop, so the payload must be a serde envelope
+(`X(...).encode()` wire bytes) — the same versioned, compat-checked
+framing every other wire surface here uses. Pickled/marshalled/JSON
+blobs on that seam would create a second, unversioned RPC format whose
+compat story is "both ends import the same commit", and pickle across
+a privilege boundary is an RCE primitive besides.
+
+Flagged anywhere under the scan root except redpanda_tpu/ssx/:
+
+  import multiprocessing / from multiprocessing import ...
+  os.fork() / os.forkpty()
+
+Flagged everywhere (ssx/ included):
+
+  ctx.invoke_on(s, "svc", "m", pickle.dumps(x))   (also marshal/json)
+
+Suppress a deliberate exception with `# rplint: disable=RPL009`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext
+
+_EXEMPT_PREFIX = "redpanda_tpu/ssx/"
+_FORK_FUNCS = {"fork", "forkpty"}
+_SERIALIZER_MODULES = {"pickle", "marshal", "json", "cPickle"}
+
+
+def _payload_arg(call: ast.Call):
+    """The payload expression of an invoke_on call, if present."""
+    for kw in call.keywords:
+        if kw.arg == "payload":
+            return kw.value
+    # invoke_on(shard, service, method, payload, ...)
+    if len(call.args) >= 4:
+        return call.args[3]
+    return None
+
+
+class ShardDisciplineRule:
+    code = "RPL009"
+    name = "shard-discipline"
+
+    def check(self, ctx: ModuleContext):
+        path = ctx.path.replace("\\", "/")
+        in_ssx = _EXEMPT_PREFIX in path or path.startswith("ssx/")
+        for node in ast.walk(ctx.tree):
+            # (a) process creation outside ssx/
+            if not in_ssx:
+                bad = None
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        root = alias.name.split(".")[0]
+                        if root == "multiprocessing":
+                            bad = f"import {alias.name}"
+                elif isinstance(node, ast.ImportFrom):
+                    if (node.module or "").split(".")[0] == "multiprocessing":
+                        bad = f"from {node.module} import ..."
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _FORK_FUNCS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "os"
+                ):
+                    bad = f"os.{node.func.attr}()"
+                if bad is not None:
+                    if ctx.suppressed(node, self.code):
+                        continue
+                    yield Finding(
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.code,
+                        message=(
+                            f"{bad} outside redpanda_tpu/ssx/ — worker "
+                            "processes go through ssx.ShardRuntime (fork "
+                            "hygiene: fd closing, loop reset, pinning, "
+                            "os._exit)"
+                        ),
+                    )
+                    continue
+            # (b) non-serde invoke_on payloads (everywhere)
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "invoke_on"
+            ):
+                continue
+            payload = _payload_arg(node)
+            if payload is None:
+                continue
+            for sub in ast.walk(payload):
+                if not (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in ("dumps", "dump")
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id in _SERIALIZER_MODULES
+                ):
+                    continue
+                if ctx.suppressed(node, self.code):
+                    break
+                yield Finding(
+                    path=ctx.path,
+                    line=sub.lineno,
+                    col=sub.col_offset,
+                    rule=self.code,
+                    message=(
+                        f"invoke_on payload built with "
+                        f"{sub.func.value.id}.{sub.func.attr} — the "
+                        "cross-shard seam carries serde envelopes only "
+                        "(Envelope(...).encode()); ad-hoc serializers "
+                        "fork the wire format and pickle is an RCE "
+                        "primitive across the process boundary"
+                    ),
+                )
+                break

@@ -1,0 +1,83 @@
+"""verify.sh mp smoke: boot a 2-shard ShardedBroker (real forked
+worker, SO_REUSEPORT listener), run one produce/fetch round across a
+partition spread that crosses the invoke_on seam, check the work
+actually landed on the worker shard, and shut down cleanly.
+
+Exit 0 = the shard runtime forks, serves, and stands down on this
+machine. Kept deliberately small (~seconds) — the full matrix lives in
+tests/test_shards.py; this is the "does the fork path work at all in
+this environment" gate.
+"""
+
+import asyncio
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_PARTITIONS = 4
+
+
+async def main() -> None:
+    from redpanda_tpu.app import BrokerConfig
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.ssx.sharded_broker import ShardedBroker
+
+    tmp = tempfile.mkdtemp(prefix="shard_smoke_")
+    cfg = BrokerConfig(
+        node_id=0,
+        data_dir=tmp,
+        members=[0],
+        election_timeout_s=0.3,
+        heartbeat_interval_s=0.05,
+        enable_admin=False,
+    )
+    sb = ShardedBroker(cfg, n_shards=2)
+    await sb.start()
+    try:
+        assert sb.active, f"unexpected stand-down: {sb.standdown}"
+        c = KafkaClient([("127.0.0.1", sb.kafka_port)])
+        try:
+            deadline = time.monotonic() + 30
+            while True:
+                try:
+                    await c.create_topic(
+                        "smoke", partitions=N_PARTITIONS, replication_factor=1
+                    )
+                    break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
+            for p in range(N_PARTITIONS):
+                while True:
+                    try:
+                        await c.produce("smoke", p, [(b"k", b"v%d" % p)])
+                        break
+                    except Exception:
+                        if time.monotonic() > deadline:
+                            raise
+                        await asyncio.sleep(0.2)
+            for p in range(N_PARTITIONS):
+                rows = await c.fetch("smoke", p, 0)
+                assert len(rows) == 1, (p, rows)
+            stats = await sb.shard_stats()
+            assert stats and stats[0].partitions > 0, (
+                f"no partitions on the worker shard: {stats}"
+            )
+            assert stats[0].produce_reqs > 0, (
+                "no produce crossed the invoke_on seam"
+            )
+        finally:
+            await c.close()
+    finally:
+        await sb.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("SHARD-SMOKE-OK")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -34,6 +34,15 @@ env JAX_PLATFORMS=cpu RP_NATIVE=0 python -m pytest \
     -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== shard mp smoke (2-shard broker, fork + invoke_on seam) =="
+env JAX_PLATFORMS=cpu python tools/shard_smoke.py
+
+echo "== sharding-off smoke (RP_SHARDS=0) =="
+env JAX_PLATFORMS=cpu RP_SHARDS=0 python -m pytest \
+    tests/test_kafka_e2e.py \
+    -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== tracing-off smoke (RP_TRACE=0) =="
 exec env JAX_PLATFORMS=cpu RP_TRACE=0 python -m pytest \
     tests/test_observability.py tests/test_kafka_e2e.py \
