@@ -24,7 +24,7 @@ benchmarks so BENCH_r*.json tracks them round over round:
                host liblz4 (north-star #1 codec axis; ops/lz4.py).
 
 Usage: python bench.py [--only quorum|live_tick|crc|device_lz4|codec|broker]
-       [--skip-extras]
+       [--skip-extras] [--probes] [--slo PROFILE]
 """
 
 from __future__ import annotations
@@ -951,6 +951,90 @@ def bench_replicated() -> dict:
     return asyncio.run(_replicated_async())
 
 
+# ----------------------------------------- probe scrape helpers (mp / --slo)
+def _scrape_probe_hist(port: int, api: str = "produce", stage: str = "done"):
+    """One admin `/metrics` scrape -> ABSOLUTE merged HistogramChild of
+    the kafka stage histogram filtered to (api, stage), aggregated over
+    every other label (path, and the shard/node labels the fleet scrape
+    adds under --shards N). The `le` strings round-trip exactly because
+    both sides format _BOUNDS with %g; cumulative bucket counts become
+    per-bucket counts by differencing adjacent boundaries."""
+    import re
+    import urllib.request
+
+    from redpanda_tpu.metrics import _BOUNDS, HistogramChild
+
+    name = "redpanda_tpu_kafka_request_stage_seconds"
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ) as r:
+        text = r.read().decode()
+    bound_idx = {f"{b:g}": i for i, b in enumerate(_BOUNDS)}
+    lab_re = re.compile(r'(\w+)="([^"]*)"')
+    buckets_by_series: dict[tuple, dict[str, float]] = {}
+    sums: dict[tuple, float] = {}
+    counts: dict[tuple, int] = {}
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        for kind in ("_bucket", "_sum", "_count"):
+            if rest.startswith(kind):
+                rest = rest[len(kind):]
+                break
+        else:
+            continue
+        try:
+            labels_part, value = rest.rsplit(" ", 1)
+        except ValueError:
+            continue
+        labels = dict(lab_re.findall(labels_part))
+        if labels.get("api") != api or labels.get("stage") != stage:
+            continue
+        le = labels.pop("le", None)
+        key = tuple(sorted(labels.items()))
+        if kind == "_bucket":
+            buckets_by_series.setdefault(key, {})[le] = float(value)
+        elif kind == "_sum":
+            sums[key] = float(value)
+        else:
+            counts[key] = int(float(value))
+    merged = HistogramChild()
+    for key, cum_buckets in buckets_by_series.items():
+        prev = 0.0
+        for le, cum in sorted(
+            cum_buckets.items(),
+            key=lambda kv: (
+                float("inf") if kv[0] == "+Inf" else float(kv[0])
+            ),
+        ):
+            n = int(round(cum - prev))
+            prev = cum
+            if n <= 0:
+                continue
+            if le == "+Inf" or le not in bound_idx:
+                merged._overflow += n
+            else:
+                merged._buckets[bound_idx[le]] += n
+        merged._sum += sums.get(key, 0.0)
+        merged._count += counts.get(key, 0)
+    return merged
+
+
+def _hist_window(after, before):
+    """after - before elementwise: the measured-window-only child
+    (both args are absolute cumulative scrapes of the same series)."""
+    from redpanda_tpu.metrics import HistogramChild
+
+    w = HistogramChild()
+    for i in range(len(w._buckets)):
+        w._buckets[i] = after._buckets[i] - before._buckets[i]
+    w._overflow = after._overflow - before._overflow
+    w._sum = after._sum - before._sum
+    w._count = after._count - before._count
+    return w
+
+
 # ------------------------------------- replicated, multi-process (config #3mp)
 async def _replicated_mp_async(n_cores: int) -> dict:
     """The same 3-broker acks=all replicated produce, but with the
@@ -1080,12 +1164,20 @@ async def _replicated_mp_async(n_cores: int) -> dict:
         await asyncio.gather(*(warmup(i) for i in range(n_producers)))
         gc.collect()
         gc.freeze()
+        # --probes in mp mode: the brokers are separate processes, so
+        # the stage histograms come over the admin /metrics scrape
+        # (fleet-merged under --shards) instead of direct object refs
+        probe_before = None
+        if os.environ.get("RP_BENCH_PROBES") == "1":
+            probe_before = [
+                await asyncio.to_thread(_scrape_probe_hist, p) for p in admin
+            ]
         t0 = time.perf_counter()
         await asyncio.gather(
             *(producer(i, t0 + duration_s) for i in range(n_producers))
         )
         mbps = sent / (time.perf_counter() - t0) / 1e6
-        return {
+        out = {
             "metric": "replicated_produce_mbps_3brokers_1k_partitions_mp",
             "value": round(mbps, 1),
             "unit": "MB/s",
@@ -1106,6 +1198,18 @@ async def _replicated_mp_async(n_cores: int) -> dict:
             "broker_cores": broker_cores,
             "transport": "tcp",
         }
+        if probe_before is not None:
+            from redpanda_tpu.metrics import HistogramChild
+
+            merged = HistogramChild()
+            for port, before in zip(admin, probe_before):
+                after = await asyncio.to_thread(_scrape_probe_hist, port)
+                merged.merge_from(_hist_window(after, before))
+            out["probe_rounds"] = merged._count
+            out["probe_p50_ms"] = round(merged.quantile(0.50) * 1e3, 2)
+            out["probe_p99_ms"] = round(merged.quantile(0.99) * 1e3, 2)
+            out["probe_transport"] = "admin_scrape"
+        return out
     finally:
         for c in clients:
             try:
@@ -1131,6 +1235,210 @@ def bench_replicated_mp() -> dict:
     return asyncio.run(
         _replicated_mp_async(int(os.environ.get("BENCH_MP_CORES", "3")))
     )
+
+
+# -------------------------------------------- SLO-graded sweep (bench --slo)
+def _load_slo_profile(name: str) -> dict:
+    """Resolve --slo PROFILE: a literal path, or a short name looked
+    up as bench_profiles/slo_<name>.json."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tried = []
+    for cand in (
+        name,
+        os.path.join(repo, "bench_profiles", f"slo_{name}.json"),
+        os.path.join(repo, "bench_profiles", name),
+    ):
+        tried.append(cand)
+        if os.path.isfile(cand):
+            with open(cand) as f:
+                prof = json.load(f)
+            base = os.path.splitext(os.path.basename(cand))[0]
+            prof.setdefault("profile", base.removeprefix("slo_"))
+            return prof
+    raise SystemExit(f"--slo: profile {name!r} not found (tried: {tried})")
+
+
+async def _slo_async(prof: dict) -> dict:
+    """SLO-graded latency-vs-throughput sweep (the Pulsar/OMB paper
+    methodology): drive the cluster at FIXED paced rates instead of one
+    saturating closed loop, and grade the measured p99/p99.9 at each
+    rate against the profile's declared SLO. Rate segments are
+    INTERLEAVED round-robin across rounds so slow drift (thermal,
+    co-tenants, accumulating gc debt) spreads over every rate instead
+    of biasing whichever one runs last."""
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.metrics import HistogramChild
+    from redpanda_tpu.models.record import RecordBatchBuilder
+
+    n_brokers = int(prof.get("brokers", 3))
+    n_partitions = int(prof.get("partitions", 64))
+    acks = int(prof.get("acks", -1))
+    batch_records = int(prof.get("batch_records", 64))
+    record_bytes = int(prof.get("record_bytes", 1024))
+    rates = [float(r) for r in prof.get("rates_per_s") or []]
+    if not rates:
+        raise SystemExit("--slo: profile declares no rates_per_s")
+    rounds = int(prof.get("rounds", 3))
+    round_s = float(prof.get("round_s", 2.0))
+    slo = prof.get("slo", {})
+    slo_p99 = float(slo.get("p99_ms", 50.0))
+    slo_p999 = float(slo.get("p999_ms", 4 * slo_p99))
+    # a rate segment that can't sustain >=90% of its target rate fails
+    # the grade even with good quantiles: latency measured while the
+    # pacer falls behind describes a lighter workload than declared
+    min_ratio = float(prof.get("min_rate_ratio", 0.9))
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = tempfile.mkdtemp(prefix="rp_bench_slo_", dir=shm)
+    brokers = []
+    clients: list = []
+    try:
+        brokers = await _cluster(tmp, n_brokers)
+        boot = KafkaClient([b.kafka_advertised for b in brokers])
+        clients.append(boot)
+        await boot.create_topic(
+            "slo", partitions=n_partitions, replication_factor=n_brokers
+        )
+        payload = os.urandom(record_bytes - 16)
+        builder = RecordBatchBuilder()
+        for i in range(batch_records):
+            builder.add(payload, key=b"k%012d" % i)
+        wire = builder.build().to_kafka_wire()
+        deadline = time.monotonic() + 120.0
+        pid_probe = 0
+        while pid_probe < n_partitions:
+            try:
+                await boot.produce_wire("slo", pid_probe, wire, acks=acks)
+                pid_probe += max(1, n_partitions // 16)
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                await asyncio.sleep(0.25)
+        prod = KafkaClient(
+            [b.kafka_advertised for b in brokers], serial_reads=True
+        )
+        clients.append(prod)
+        for pid in range(n_partitions):  # steady state before grading
+            await prod.produce_wire("slo", pid, wire, acks=acks)
+        gc.collect()
+        gc.freeze()
+
+        # merged fleet probe quantiles over the graded window only:
+        # snapshot the produce-done children now, diff at the end
+        probe_children = [
+            b.kafka_server.probe.stage_hist.labels(
+                api="produce", stage="done", path=path
+            )
+            for b in brokers
+            for path in ("native", "python")
+        ]
+        probe_before = [child.counts() for child in probe_children]
+
+        lat_by_rate: dict[float, list[float]] = {r: [] for r in rates}
+        reqs_by_rate: dict[float, int] = {r: 0 for r in rates}
+        overruns_by_rate: dict[float, int] = {r: 0 for r in rates}
+
+        async def segment(rate: float) -> None:
+            pid = 0
+            interval = 1.0 / rate
+            seg_t0 = time.perf_counter()
+            k = 0
+            while True:
+                target = seg_t0 + k * interval
+                if target - seg_t0 >= round_s:
+                    break
+                now = time.perf_counter()
+                if target > now:
+                    await asyncio.sleep(target - now)
+                else:
+                    overruns_by_rate[rate] += 1  # pacer behind schedule
+                t0 = time.monotonic()
+                await prod.produce_wire("slo", pid, wire, acks=acks)
+                t_rx = prod.last_rx_monotonic()
+                lat_by_rate[rate].append(
+                    ((t_rx if t_rx > t0 else time.monotonic()) - t0) * 1e3
+                )
+                reqs_by_rate[rate] += 1
+                pid = (pid + 1) % n_partitions
+                k += 1
+
+        for _round in range(rounds):
+            for rate in rates:
+                await segment(rate)
+
+        merged = HistogramChild()
+        for child, (bb, ov, s, n) in zip(probe_children, probe_before):
+            for i in range(len(bb)):
+                merged._buckets[i] += child._buckets[i] - bb[i]
+            merged._overflow += child._overflow - ov
+            merged._sum += child._sum - s
+            merged._count += child._count - n
+
+        verdicts = []
+        worst_p99 = 0.0
+        for rate in rates:
+            lat = lat_by_rate[rate]
+            achieved = reqs_by_rate[rate] / (rounds * round_s)
+            p50 = float(np.percentile(lat, 50)) if lat else -1.0
+            p99 = float(np.percentile(lat, 99)) if lat else -1.0
+            p999 = float(np.percentile(lat, 99.9)) if lat else -1.0
+            checks = {
+                "p99_ms": bool(lat) and p99 <= slo_p99,
+                "p999_ms": bool(lat) and p999 <= slo_p999,
+                "rate": achieved >= min_ratio * rate,
+            }
+            ok = all(checks.values())
+            worst_p99 = max(worst_p99, p99)
+            verdicts.append(
+                {
+                    "rate_per_s": rate,
+                    "achieved_per_s": round(achieved, 1),
+                    "requests": reqs_by_rate[rate],
+                    "pacer_overruns": overruns_by_rate[rate],
+                    "p50_ms": round(p50, 2),
+                    "p99_ms": round(p99, 2),
+                    "p999_ms": round(p999, 2),
+                    "checks": checks,
+                    "pass": ok,
+                }
+            )
+        return {
+            "metric": f"slo_{prof['profile']}_worst_p99_ms",
+            "value": round(worst_p99, 2),
+            "unit": "ms",
+            # >1 means the worst graded rate still clears the SLO
+            "vs_baseline": (
+                round(slo_p99 / worst_p99, 3) if worst_p99 > 0 else -1
+            ),
+            "slo_profile": prof["profile"],
+            "slo": {"p99_ms": slo_p99, "p999_ms": slo_p999},
+            "slo_pass": all(v["pass"] for v in verdicts),
+            "interleaved_rounds": rounds,
+            "round_s": round_s,
+            "brokers": n_brokers,
+            "partitions": n_partitions,
+            "acks": acks,
+            "verdicts": verdicts,
+            "probe_rounds": merged._count,
+            "probe_p50_ms": round(merged.quantile(0.50) * 1e3, 2),
+            "probe_p99_ms": round(merged.quantile(0.99) * 1e3, 2),
+        }
+    finally:
+        for cl in clients:
+            try:
+                await cl.close()
+            except Exception:
+                pass
+        for b in brokers:
+            try:
+                await b.stop()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_slo(profile: str = "default") -> dict:
+    return asyncio.run(_slo_async(_load_slo_profile(profile)))
 
 
 # ------------------------------------------------- OMB-shaped mix (config #5)
@@ -1310,6 +1618,7 @@ BENCHES = {
     "replicated": bench_replicated,
     "replicated_mp": bench_replicated_mp,
     "omb": bench_omb,
+    "slo": bench_slo,
 }
 
 
@@ -1348,7 +1657,16 @@ def main() -> None:
         "--probes",
         action="store_true",
         help="report p50/p99 from the brokers' live kafka stage "
-        "histograms next to the bench's own timers (replicated bench)",
+        "histograms next to the bench's own timers (replicated bench; "
+        "in mp mode via the admin /metrics fleet scrape)",
+    )
+    ap.add_argument(
+        "--slo",
+        metavar="PROFILE",
+        help="SLO-graded interleaved latency-vs-throughput sweep: load "
+        "bench_profiles/slo_<PROFILE>.json (or a path), pace producers "
+        "at its declared rates, grade p99/p99.9 per rate against its "
+        "SLO and emit pass/fail verdicts in the summary line",
     )
     args = ap.parse_args()
     if args.attrib:
@@ -1358,6 +1676,10 @@ def main() -> None:
 
     if args.cores is not None:
         os.environ["BENCH_MP_CORES"] = str(args.cores)
+
+    if args.slo:
+        _emit_summary(bench_slo(args.slo))
+        return
 
     if args.only:
         result = BENCHES[args.only]()

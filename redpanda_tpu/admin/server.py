@@ -113,6 +113,7 @@ class AdminServer(HttpServer):
         r("GET", r"/v1/cloud_storage/status/([^/]+)/(\d+)",
           self._cloud_status)
         r("GET", r"/metrics", self._metrics)
+        r("GET", r"/v1/shards/(\d+)/metrics", self._shard_metrics)
         # -- r4 additions toward admin_server.cc route parity ----------
         r(
             "POST",
@@ -1319,6 +1320,24 @@ class AdminServer(HttpServer):
         shared = default_recorder()
         if shared is not self.broker.recorder and shared.events():
             dump["events"] = dump["events"] + shared.events()
+        router = getattr(self.broker, "shard_router", None)
+        if router is not None:
+            # fleet collection: worker rings over invoke_on, then trees
+            # sharing a propagated trace_id merge into stitched trees
+            from ..observability import fleet
+            from ..ssx.shards import InvokeError
+
+            worker_dumps = {}
+            for sid in router.worker_shards():
+                try:
+                    worker_dumps[str(sid)] = await router.obs_traces(sid)
+                except InvokeError:
+                    pass
+            dump["shards"] = worker_dumps
+            all_trees = list(dump["frozen"]) + list(dump["ring"])
+            for wd in worker_dumps.values():
+                all_trees.extend(wd["ring"])
+            dump["stitched"] = fleet.stitch_trees(all_trees)
         return dump
 
     async def _debug_probes(self, _m, _q, _b):
@@ -1338,9 +1357,23 @@ class AdminServer(HttpServer):
                     "flushed_offset": offs.committed_offset,
                 }
             )
+        router = getattr(self.broker, "shard_router", None)
+        shards = (
+            router.liveness()
+            if router is not None
+            else {
+                "n_shards": 1,
+                "alive": {},
+                "cores": {},
+                "crashed": {},
+                "restarts": 0,
+                "failed": False,
+            }
+        )
         return {
             "node_id": self.broker.node_id,
             "groups": groups,
+            "shards": shards,
             "histograms": {
                 name: h.snapshot()
                 for name, h in sorted(
@@ -1350,4 +1383,46 @@ class AdminServer(HttpServer):
         }
 
     async def _metrics(self, _m, _q, _b):
-        return self.broker.metrics.render()
+        """Prometheus scrape. Single-process: the local registry.
+        Sharded: the merged fleet view — every worker's registry is
+        snapshotted over invoke_on and every sample (this shard's
+        included) carries a `shard` label."""
+        router = getattr(self.broker, "shard_router", None)
+        if router is None:
+            return self.broker.metrics.render()
+        from ..observability import fleet
+        from ..ssx.shards import InvokeError
+
+        snaps = [
+            fleet.snapshot_registry(
+                self.broker.metrics, 0, self.broker.node_id
+            )
+        ]
+        for sid in router.worker_shards():
+            try:
+                snaps.append(await router.obs_metrics(sid))
+            except InvokeError:
+                self.broker.metrics.counter(
+                    "fleet_scrape_errors_total",
+                    "worker shard snapshots that failed during a fleet scrape",
+                ).inc(shard=str(sid))
+        return fleet.render_fleet(snaps)
+
+    async def _shard_metrics(self, m, _q, _b):
+        """Raw per-shard registry view (no fleet merge, no shard label):
+        shard 0 is the local registry, workers answer over invoke_on."""
+        sid = int(m.group(1))
+        router = getattr(self.broker, "shard_router", None)
+        n_shards = router.n_shards if router is not None else 1
+        if sid >= n_shards:
+            raise HttpError(404, f"no shard {sid} (n_shards={n_shards})")
+        if sid == 0:
+            return self.broker.metrics.render()
+        from ..observability import fleet
+        from ..ssx.shards import InvokeError
+
+        try:
+            snap = await router.obs_metrics(sid)
+        except InvokeError as e:
+            raise HttpError(503, f"shard {sid} unreachable: {e}") from None
+        return fleet.render_snapshot(snap)

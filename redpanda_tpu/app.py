@@ -215,6 +215,12 @@ class Broker:
             )
             self._rpc_server = RpcServer(config.rpc_host, config.rpc_port)
             self._dispatcher = None
+            # traced-call continuations (TRACED_CALL wrapper) land in
+            # this broker's recorder, stamped with its identity
+            self._rpc_server.dispatcher.recorder = self.recorder
+            from .rpc import tracectx
+
+            tracectx.set_local_origin(f"node{config.node_id}")
 
         send = self._conn_cache.call
         self.group_manager = GroupManager(

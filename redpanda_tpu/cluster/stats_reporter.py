@@ -45,6 +45,20 @@ class StatsReporter:
             }
         except Exception:
             pass
+        # shard-per-core liveness: until PR 6 this report silently
+        # described only the parent process even under --shards N
+        router = getattr(self.broker, "shard_router", None)
+        if router is not None:
+            shards = router.liveness()
+        else:
+            shards = {
+                "n_shards": 1,
+                "alive": {},
+                "cores": {},
+                "crashed": {},
+                "restarts": 0,
+                "failed": False,
+            }
         return {
             "node_id": self.broker.node_id,
             "is_controller_leader": c.is_leader,
@@ -57,6 +71,7 @@ class StatsReporter:
             "local_leaders": local_leaders,
             "local_log_bytes": local_bytes,
             "migrations_done": sorted(c.migrations_done),
+            "shards": shards,
             "health": health,
         }
 

@@ -35,6 +35,10 @@ class Counter:
     def inc(self, value: float = 1.0, **labels: str) -> None:
         self._values[tuple(sorted(labels.items()))] += value
 
+    def samples(self) -> list[tuple[dict[str, str], float]]:
+        """(labels, value) pairs for the fleet snapshot protocol."""
+        return [(dict(key), v) for key, v in sorted(self._values.items())]
+
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         if not self._values:
@@ -56,20 +60,23 @@ class Gauge:
         self.fn = fn
         self._errs = errs
 
-    def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+    def samples(self) -> list[tuple[dict[str, str], float]]:
+        """Sample `fn` now; a raising gauge yields no samples (and
+        counts in scrape_errors), same contract as render()."""
         try:
             v = self.fn()
         except Exception:
-            # a broken gauge must be visible in the scrape, not vanish
             if self._errs is not None:
                 self._errs.inc(gauge=self.name)
-            return out
+            return []
         if isinstance(v, (int, float)):
-            out.append(f"{self.name} {v:g}")
-        else:
-            for labels, value in v:
-                out.append(f"{self.name}{_fmt_labels(labels)} {value:g}")
+            return [({}, float(v))]
+        return [(dict(labels), float(value)) for labels, value in v]
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for labels, value in self.samples():
+            out.append(f"{self.name}{_fmt_labels(labels)} {value:g}")
         return out
 
 
@@ -147,6 +154,32 @@ class HistogramChild:
             "p999": self.quantile(0.999),
         }
 
+    def counts(self) -> tuple[list[int], int, float, int]:
+        """(buckets, overflow, sum, count) — the raw state the fleet
+        snapshot envelopes carry across the shard boundary."""
+        return list(self._buckets), self._overflow, self._sum, self._count
+
+    @classmethod
+    def from_counts(
+        cls, buckets: list[int], overflow: int, sum_: float, count: int
+    ) -> "HistogramChild":
+        c = cls()
+        n = min(len(buckets), _NBUCKETS)
+        c._buckets[:n] = [int(b) for b in buckets[:n]]
+        c._overflow = int(overflow) + sum(int(b) for b in buckets[n:])
+        c._sum = sum_
+        c._count = count
+        return c
+
+    def merge_from(self, other: "HistogramChild") -> None:
+        ob = other._buckets
+        b = self._buckets
+        for i in range(_NBUCKETS):
+            b[i] += ob[i]
+        self._overflow += other._overflow
+        self._sum += other._sum
+        self._count += other._count
+
     def render_into(self, out: list[str], name: str, labels: dict[str, str]) -> None:
         # sparse exposition: only boundaries where the cumulative count
         # advances (plus +Inf) — Prometheus semantics only require the
@@ -203,6 +236,14 @@ class Histogram:
         merged = self._merged()
         return merged.quantile(q)
 
+    def series(self) -> list[tuple[dict[str, str], HistogramChild]]:
+        """(labels, child) pairs in render order (default series first)."""
+        out: list[tuple[dict[str, str], HistogramChild]] = []
+        if self._default is not None:
+            out.append(({}, self._default))
+        out.extend((dict(key), c) for key, c in sorted(self._children.items()))
+        return out
+
     def _merged(self) -> HistogramChild:
         series = list(self._children.values())
         if self._default is not None:
@@ -211,11 +252,7 @@ class Histogram:
             return series[0]
         m = HistogramChild()
         for s in series:
-            for i, n in enumerate(s._buckets):
-                m._buckets[i] += n
-            m._overflow += s._overflow
-            m._sum += s._sum
-            m._count += s._count
+            m.merge_from(s)
         return m
 
     def snapshot(self) -> dict:
@@ -272,6 +309,10 @@ class MetricsRegistry:
             m = Histogram(full, help_)
             self._metrics[full] = m
         return m
+
+    def families(self) -> dict[str, object]:
+        """name -> Counter | Gauge | Histogram, for the fleet snapshot."""
+        return dict(self._metrics)
 
     def histograms(self) -> dict[str, Histogram]:
         return {

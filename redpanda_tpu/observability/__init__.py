@@ -11,6 +11,9 @@ from .trace import (  # noqa: F401
     FlightRecorder,
     Span,
     current_span,
+    propagation_ctx,
+    reset_remote_parent,
+    set_remote_parent,
     span,
     tag_current,
 )

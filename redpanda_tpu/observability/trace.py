@@ -42,6 +42,30 @@ EVENTS_CAP = 256
 
 _ids = itertools.count(1)
 _current: ContextVar[Optional["Span"]] = ContextVar("rp_trace_span", default=None)
+# cross-process parent adopted by the next ROOT span opened in this
+# task: (trace_id, parent_span_id, origin) shipped inside the invoke_on
+# envelope / TRACED_CALL rpc wrapper by the sending side
+_remote: ContextVar[Optional[tuple]] = ContextVar("rp_trace_remote", default=None)
+
+
+def _after_fork_child() -> None:
+    """Fork hygiene: the id counter and the module-default recorder are
+    copied by fork — reseed ids into a pid-disjoint range (stitched
+    cross-process trees must never collide on span ids) and drop the
+    parent's trees/events from the child's recorder."""
+    global _ids
+    _ids = itertools.count(((os.getpid() & 0x3FFFFF) << 40) | 1)
+    r = _default_recorder
+    r._ring = [None] * len(r._ring)
+    r._ring_idx = 0
+    r._frozen.clear()
+    r._events.clear()
+    r.trees_total = 0
+    r.frozen_total = 0
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_after_fork_child)
 
 
 class Span:
@@ -57,6 +81,8 @@ class Span:
         "start_ns",
         "dur_ns",
         "tags",
+        "trace_id",
+        "origin",
         "_root",
         "_recorder",
         "_spans",
@@ -80,11 +106,19 @@ class Span:
             self._root = parent._root
             self._recorder = parent._recorder
         else:
-            self.parent_id = 0
             self._root = self
             # collector for every span in this tree, filled on exits
             self._spans: list[dict] = []
             self._recorder = recorder if recorder is not None else _default_recorder
+            r = _remote.get()
+            if r is not None:
+                # root of a remote continuation: join the propagated
+                # trace under the sender's span
+                self.trace_id, self.parent_id, self.origin = r
+            else:
+                self.parent_id = 0
+                self.trace_id = self.span_id
+                self.origin = None
         self._token = None
 
     def tag(self, **tags) -> None:
@@ -197,6 +231,34 @@ def current_span() -> Optional[Span]:
     return _current.get()
 
 
+def propagation_ctx() -> Optional[tuple[int, int]]:
+    """(trace_id, span_id) of the innermost open span, for shipping
+    across a process/rpc boundary (invoke_on envelope, TRACED_CALL
+    wrapper). None when tracing is off or no span is open — callers
+    skip the wrap entirely."""
+    if not ENABLED:
+        return None
+    s = _current.get()
+    if s is None:
+        return None
+    return s._root.trace_id, s.span_id
+
+
+def set_remote_parent(trace_id: int, span_id: int, origin: str):
+    """Adopt an incoming cross-process trace context: the next root
+    span opened under this token joins `trace_id` as a child of the
+    sender's `span_id`. Returns a reset token (None when tracing is off
+    or the context is empty — pass it straight to reset_remote_parent)."""
+    if not ENABLED or not trace_id:
+        return None
+    return _remote.set((trace_id, span_id, origin))
+
+
+def reset_remote_parent(token) -> None:
+    if token is not None:
+        _remote.reset(token)
+
+
 def tag_current(**tags) -> None:
     """Attach tags to the innermost open span, if any."""
     if not ENABLED:
@@ -214,8 +276,10 @@ class FlightRecorder:
         ring_capacity: int = RING_CAP,
         slow_ms: float = SLOW_MS,
         node_id: int = -1,
+        shard: int = 0,
     ):
         self.node_id = node_id
+        self.shard = shard
         self.slow_ns = int(slow_ms * 1e6)
         self._ring: list[Optional[dict]] = [None] * max(1, ring_capacity)
         self._ring_idx = 0
@@ -232,11 +296,18 @@ class FlightRecorder:
 
     def _finish_tree(self, root: Span) -> None:
         tree = {
-            "trace_id": root.span_id,
+            "trace_id": root.trace_id,
             "root": root.name,
             "dur_ns": root.dur_ns,
             "spans": root._spans,
+            "node": self.node_id,
+            "shard": self.shard,
         }
+        if root.origin is not None:
+            # continuation of a remote trace: the root's parent span
+            # lives in another process's dump (stitch by trace_id)
+            tree["remote_parent"] = root.parent_id
+            tree["origin"] = root.origin
         self.trees_total += 1
         self._ring[self._ring_idx] = tree
         self._ring_idx = (self._ring_idx + 1) % len(self._ring)
@@ -276,6 +347,7 @@ class FlightRecorder:
         """JSON-ready dump for /v1/debug/traces and tools/log_viewer."""
         return {
             "node_id": self.node_id,
+            "shard": self.shard,
             "enabled": ENABLED,
             "slow_threshold_ms": self.slow_ns / 1e6,
             "trees_total": self.trees_total,

@@ -14,7 +14,9 @@ import asyncio
 import logging
 from typing import Awaitable, Callable, Optional
 
+from ..observability import trace
 from ..utils.hbadger import honey_badger
+from . import tracectx
 from .types import (
     HEADER_SIZE,
     FrameHeader,
@@ -62,6 +64,9 @@ class Dispatcher:
 
     def __init__(self):
         self._methods: dict[int, tuple[str, str, Handler]] = {}
+        # flight recorder for traced-call continuation spans (the
+        # broker embedding assigns its own; None = module default)
+        self.recorder = None
 
     def register(self, service: Service) -> None:
         for mid, (name, fn) in service.rpc_methods().items():
@@ -70,6 +75,24 @@ class Dispatcher:
             self._methods[mid] = (service.service_name, name, fn)
 
     async def dispatch(self, method_id: int, payload: bytes) -> bytes:
+        if method_id == tracectx.TRACED_CALL:
+            # unwrap BEFORE the handler: byte-splice consumers (raft
+            # prefix caches, native gates) must see the exact payload
+            # bytes an untraced peer would have sent
+            ctx, payload = tracectx.unwrap(payload)
+            token = trace.set_remote_parent(
+                ctx.trace_id, ctx.span_id, ctx.origin
+            )
+            try:
+                with trace.span(
+                    "rpc.dispatch", recorder=self.recorder, method=ctx.method
+                ):
+                    return await self._dispatch_inner(ctx.method, payload)
+            finally:
+                trace.reset_remote_parent(token)
+        return await self._dispatch_inner(method_id, payload)
+
+    async def _dispatch_inner(self, method_id: int, payload: bytes) -> bytes:
         entry = self._methods.get(method_id)
         if entry is None:
             raise RpcError(Status.METHOD_NOT_FOUND, f"method {method_id}")

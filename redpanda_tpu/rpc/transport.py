@@ -15,6 +15,7 @@ import itertools
 import logging
 from typing import Optional, Protocol
 
+from . import tracectx
 from .types import (
     HEADER_SIZE,
     FrameHeader,
@@ -117,6 +118,10 @@ class TcpTransport:
         corr = next(self._correlation)
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[corr] = fut
+        # cross-process trace propagation: identity unless a span is
+        # open (the loopback transport never wraps — contextvars cover
+        # in-process delivery and NemesisNet keys on real method ids)
+        method_id, payload = tracectx.wrap(method_id, payload)
         frame = make_frame(method_id, corr, payload)
         async with self._write_lock:
             assert self._writer is not None

@@ -38,6 +38,7 @@ import logging
 import os
 from typing import Optional
 
+from ..observability import fleet, trace
 from ..utils.serde import (
     Envelope,
     boolean,
@@ -234,13 +235,19 @@ class ShardKafkaFrontend:
                     return
                 frame = await reader.readexactly(size)
                 self.frames_total += 1
-                rep_raw = await self._ctx.invoke_on(
-                    0,
-                    "kafka",
-                    "raw",
-                    KafkaFrame(conn=conn_id, frame=frame).encode(),
-                    timeout=60.0,
-                )
+                # root span on the forwarding shard: the invoke_on hop
+                # carries its (trace_id, span_id) so shard 0's handler
+                # tree stitches under it at dump time
+                with trace.span(
+                    "kafka.forward", recorder=self._ctx.recorder
+                ):
+                    rep_raw = await self._ctx.invoke_on(
+                        0,
+                        "kafka",
+                        "raw",
+                        KafkaFrame(conn=conn_id, frame=frame).encode(),
+                        timeout=60.0,
+                    )
                 rep = KafkaFrameReply.decode(rep_raw)
                 if rep.has_resp:
                     body = bytes(rep.resp)
@@ -282,10 +289,18 @@ class PartitionShard:
         base = os.path.join(config.data_dir, f"shard_{ctx.shard_id}")
         os.makedirs(base, exist_ok=True)
         from ..cluster.partition_manager import PartitionManager
+        from ..metrics import MetricsRegistry
         from ..raft.group_manager import GroupManager
         from ..storage.log_manager import StorageApi
 
-        self.storage = StorageApi(base)
+        # each worker shard owns a full registry + flight recorder; the
+        # fleet plane ships both to shard 0 over the "obs" service
+        self.metrics = MetricsRegistry()
+        self.recorder = trace.FlightRecorder(
+            node_id=config.node_id, shard=ctx.shard_id
+        )
+        ctx.recorder = self.recorder
+        self.storage = StorageApi(base, metrics=self.metrics)
 
         async def send(node, method_id, payload, timeout):
             env = RpcOut(
@@ -302,6 +317,7 @@ class PartitionShard:
             election_timeout_s=config.election_timeout_s,
             heartbeat_interval_s=config.heartbeat_interval_s,
             kvstore=self.storage.kvs,
+            metrics=self.metrics,
             shard_id=ctx.shard_id,
             shard_count=ctx.n_shards,
         )
@@ -313,10 +329,50 @@ class PartitionShard:
         self.produce_bytes = 0
         self.fetch_reqs = 0
         self.fetch_bytes = 0
+        self._register_shard_probes()
+
+    def _register_shard_probes(self) -> None:
+        pm = self.partition_manager
+        self.metrics.gauge(
+            "shard_partitions",
+            lambda: len(pm.partitions()),
+            "partitions owned by this worker shard",
+        )
+        self.metrics.gauge(
+            "shard_leaders",
+            lambda: sum(1 for p in pm.partitions().values() if p.is_leader),
+            "leader partitions on this worker shard",
+        )
+        self.metrics.gauge(
+            "shard_produce_reqs_total",
+            lambda: self.produce_reqs,
+            "produce requests served by this worker shard",
+        )
+        self.metrics.gauge(
+            "shard_fetch_reqs_total",
+            lambda: self.fetch_reqs,
+            "fetch requests served by this worker shard",
+        )
+        self.metrics.gauge(
+            "shard_frontend_conns_total",
+            lambda: self.frontend.conns_total if self.frontend else 0,
+            "kafka connections accepted by this shard's frontend",
+        )
+        self.metrics.gauge(
+            "shard_frontend_frames_total",
+            lambda: self.frontend.frames_total if self.frontend else 0,
+            "kafka frames forwarded by this shard's frontend",
+        )
+        self.metrics.gauge(
+            "trace_trees_total",
+            lambda: self.recorder.trees_total,
+            "span trees completed on this shard",
+        )
 
     async def start(self) -> None:
         await self.group_manager.start()
         self.ctx.register("partition", self.partition_service)
+        self.ctx.register("obs", self.obs_service)
         self.frontend = ShardKafkaFrontend(
             self.ctx, self._config.kafka_host, self._config.kafka_port
         )
@@ -345,6 +401,17 @@ class PartitionShard:
         if method == "stats":
             return self._stats()
         raise LookupError(f"partition: no such method {method!r}")
+
+    async def obs_service(self, method: str, payload: bytes) -> bytes:
+        """Fleet observability plane: this shard's registry snapshot and
+        flight-recorder dump as serde envelopes (RPL009)."""
+        if method == "metrics":
+            return fleet.snapshot_registry(
+                self.metrics, self.ctx.shard_id, self._config.node_id
+            ).encode()
+        if method == "traces":
+            return fleet.dump_to_envelope(self.recorder.dump()).encode()
+        raise LookupError(f"obs: no such method {method!r}")
 
     async def _create(self, req: PartitionCreate) -> bytes:
         from ..storage.log import LogConfig
@@ -656,6 +723,42 @@ class ShardRouter:
         )
         return ShardStats.decode(raw)
 
+    # -- fleet observability ------------------------------------------
+    async def obs_metrics(self, shard: int) -> fleet.RegistrySnapshot:
+        raw = await self._rt.invoke_on(
+            shard, "obs", "metrics", b"", timeout=10.0
+        )
+        return fleet.RegistrySnapshot.decode(raw)
+
+    async def obs_traces(self, shard: int) -> dict:
+        raw = await self._rt.invoke_on(
+            shard, "obs", "traces", b"", timeout=10.0
+        )
+        return fleet.envelope_to_dump(fleet.TraceDump.decode(raw))
+
+    def worker_shards(self) -> range:
+        return range(1, self.n_shards)
+
+    def liveness(self) -> dict:
+        """Supervisor view for /v1/debug/probes and the aggregated
+        stats endpoint: per-shard pid/core plus crash/restart counters."""
+        rt = self._rt
+        return {
+            "n_shards": self.n_shards,
+            "alive": {
+                str(sid): pid for sid, pid in sorted(rt.shard_pids.items())
+            },
+            "cores": {
+                str(sid): core
+                for sid, core in sorted(rt.shard_cores.items())
+            },
+            "crashed": {
+                str(sid): st for sid, st in sorted(rt.crashed.items())
+            },
+            "restarts": rt.restarts,
+            "failed": rt.failed.is_set(),
+        }
+
 
 # ------------------------------------------------------- sharded broker
 class ShardedBroker:
@@ -711,6 +814,9 @@ class ShardedBroker:
         self.broker.shard_router = self.router
         self.broker.shard_table.shard_count = self.n_shards
         self.broker.controller.shard_router = self.router
+        # invoke_on continuations served on shard 0 record into the
+        # broker's flight recorder, same ring the admin surface reads
+        self.runtime.ctx.recorder = self.broker.recorder
         await self.broker.start()
         self._reserve_sock.close()
         self._reserve_sock = None

@@ -43,6 +43,7 @@ import struct
 import traceback
 from typing import Awaitable, Callable, Optional
 
+from ..observability import trace
 from ..utils.serde import Envelope, bytes_t, string, u8, u16, u64
 
 logger = logging.getLogger("ssx")
@@ -63,12 +64,18 @@ class InvokeError(Exception):
 
 
 class InvokeRequest(Envelope):
+    # trace_id/span_id/origin: cross-shard trace propagation (PR 6) —
+    # trailing fields with defaults so pre-upgrade peers interoperate
     SERDE_FIELDS = [
         ("corr", u64),
         ("service", string),
         ("method", string),
         ("payload", bytes_t),
+        ("trace_id", u64),
+        ("span_id", u64),
+        ("origin", string),
     ]
+    SERDE_DEFAULTS = {"trace_id": 0, "span_id": 0, "origin": ""}
 
 
 class InvokeReply(Envelope):
@@ -158,10 +165,15 @@ class ShardChannel:
     Replies may arrive out of request order; the correlation id pairs
     them back up."""
 
-    def __init__(self, sock: socket.socket, dispatch, label: str = ""):
+    def __init__(
+        self, sock: socket.socket, dispatch, label: str = "", origin: str = ""
+    ):
         self._sock = sock
-        self._dispatch = dispatch  # async (service, method, payload) -> bytes
+        self._dispatch = dispatch  # async (InvokeRequest) -> bytes
         self.label = label
+        # precomputed sender identity stamped into propagated trace
+        # contexts (never built per request)
+        self.origin = origin
         self._corr = 0
         self._pending: dict[int, asyncio.Future] = {}
         self._reader: Optional[asyncio.StreamReader] = None
@@ -184,8 +196,16 @@ class ShardChannel:
         corr = self._corr
         fut = asyncio.get_event_loop().create_future()
         self._pending[corr] = fut
+        tctx = trace.propagation_ctx()
+        trace_id, span_id = tctx if tctx is not None else (0, 0)
         env = InvokeRequest(
-            corr=corr, service=service, method=method, payload=payload
+            corr=corr,
+            service=service,
+            method=method,
+            payload=payload,
+            trace_id=trace_id,
+            span_id=span_id,
+            origin=self.origin if trace_id else "",
         ).encode()
         try:
             self._send(_KIND_REQUEST, env)
@@ -208,9 +228,7 @@ class ShardChannel:
 
     async def _serve(self, req: InvokeRequest) -> None:
         try:
-            result = await self._dispatch(
-                req.service, req.method, bytes(req.payload)
-            )
+            result = await self._dispatch(req)
             status, payload = _ST_OK, (result if result is not None else b"")
         except LookupError as e:
             status, payload = _ST_NO_SERVICE, str(e).encode()
@@ -299,6 +317,9 @@ class ShardContext:
         self._services: dict[str, Callable[[str, bytes], Awaitable[bytes]]] = {}
         self._channels: dict[int, ShardChannel] = {}
         self.shutdown = asyncio.Event()
+        # flight recorder for spans opened on the invoke_on serving path
+        # (the broker embedding assigns its own; None = module default)
+        self.recorder = None
 
     def register(
         self, service: str, handler: Callable[[str, bytes], Awaitable[bytes]]
@@ -312,6 +333,28 @@ class ShardContext:
                 f"shard {self.shard_id}: no such service {service!r}"
             )
         return await h(method, payload)
+
+    async def dispatch_request(self, req: InvokeRequest) -> bytes:
+        """Serve one remote invoke. When the sender propagated a trace
+        context, the handler runs under an `ssx.dispatch` root span that
+        joins the sender's trace (stitched by trace_id at dump time)."""
+        if req.trace_id and trace.ENABLED:
+            token = trace.set_remote_parent(
+                req.trace_id, req.span_id, req.origin
+            )
+            try:
+                with trace.span(
+                    "ssx.dispatch",
+                    recorder=self.recorder,
+                    service=req.service,
+                    method=req.method,
+                ):
+                    return await self.dispatch(
+                        req.service, req.method, bytes(req.payload)
+                    )
+            finally:
+                trace.reset_remote_parent(token)
+        return await self.dispatch(req.service, req.method, bytes(req.payload))
 
     async def invoke_on(
         self,
@@ -444,7 +487,9 @@ class ShardRuntime:
         for (i, j), (a, b) in list(self._pairs.items()):
             if i != self.PARENT_SHARD:
                 continue
-            ch = ShardChannel(a, self.ctx.dispatch, label=f"0<->{j}")
+            ch = ShardChannel(
+                a, self.ctx.dispatch_request, label=f"0<->{j}", origin="shard0"
+            )
             await ch.open()
             self.ctx._channels[j] = ch
         if self._ready_futs:
@@ -527,7 +572,12 @@ class ShardRuntime:
                 peer, sock = i, b
             else:
                 continue
-            ch = ShardChannel(sock, ctx.dispatch, label=f"{sid}<->{peer}")
+            ch = ShardChannel(
+                sock,
+                ctx.dispatch_request,
+                label=f"{sid}<->{peer}",
+                origin=f"shard{sid}",
+            )
             await ch.open()
             ctx._channels[peer] = ch
         cleanup = await self._child_main(ctx)

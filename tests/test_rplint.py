@@ -768,3 +768,95 @@ def test_rpl009_suppression(tmp_path):
         "pid = os.fork()", "pid = os.fork()  # rplint: disable=RPL009"
     )
     assert _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL009") == []
+
+
+# -- RPL010: metrics discipline ----------------------------------------
+
+RPL010_BARE_COUNTER = """
+    from redpanda_tpu.metrics import Counter
+
+    def probe_init():
+        return Counter("my_requests_total", "requests")
+"""
+
+
+def test_rpl010_reports_bare_family_construction(tmp_path):
+    (f,) = _only(
+        _lint_source(tmp_path, RPL010_BARE_COUNTER, "kafka/mod.py"), "RPL010"
+    )
+    assert "bare Counter()" in f.message and "MetricsRegistry" in f.message
+
+
+def test_rpl010_module_alias_construction(tmp_path):
+    src = """
+        from redpanda_tpu import metrics
+
+        def probe_init():
+            return metrics.Histogram("lat_seconds", "latency")
+    """
+    (f,) = _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL010")
+    assert "bare Histogram()" in f.message
+
+
+def test_rpl010_collections_counter_is_clean(tmp_path):
+    src = """
+        from collections import Counter
+
+        def top_mask(masks):
+            return Counter(masks.values()).most_common(1)[0]
+    """
+    assert _only(_lint_source(tmp_path, src, "tuners/mod.py"), "RPL010") == []
+
+
+def test_rpl010_registry_construction_allowed_in_metrics_py(tmp_path):
+    src = """
+        from redpanda_tpu.metrics import Counter
+
+        def counter(name):
+            return Counter(name, "")
+    """
+    assert (
+        _only(_lint_source(tmp_path, src, "sub/metrics.py"), "RPL010") == []
+    )
+
+
+def test_rpl010_fstring_label_on_hot_path(tmp_path):
+    src = """
+        def record(hist, topic, pid):
+            hist.labels(ntp=f"{topic}/{pid}").observe(0.1)
+    """
+    (f,) = _only(_lint_source(tmp_path, src, "kafka/mod.py"), "RPL010")
+    assert "f-string" in f.message and "cardinality" in f.message
+
+
+def test_rpl010_format_label_in_inc_on_hot_path(tmp_path):
+    src = """
+        def bump(counter, sid):
+            counter.inc(shard="{}".format(sid))
+    """
+    (f,) = _only(_lint_source(tmp_path, src, "rpc/mod.py"), "RPL010")
+    assert "str.format" in f.message
+
+
+def test_rpl010_plain_labels_hot_path_clean(tmp_path):
+    src = """
+        def probe_init(hist, path):
+            return hist.labels(api="produce", stage="done", path=path)
+    """
+    assert _only(_lint_source(tmp_path, src, "kafka/mod.py"), "RPL010") == []
+
+
+def test_rpl010_fstring_label_cold_path_clean(tmp_path):
+    src = """
+        def scrape_error(counter, sid):
+            counter.inc(shard=f"{sid}")
+    """
+    assert _only(_lint_source(tmp_path, src, "admin/mod.py"), "RPL010") == []
+
+
+def test_rpl010_suppression(tmp_path):
+    src = RPL010_BARE_COUNTER.replace(
+        'Counter("my_requests_total", "requests")',
+        'Counter("my_requests_total", "requests")  # rplint: disable=RPL010',
+    )
+    assert _only(_lint_source(tmp_path, src, "kafka/mod.py"), "RPL010") == []

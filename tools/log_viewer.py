@@ -137,11 +137,27 @@ def _fmt_tags(tags: dict | None) -> str:
     return " " + " ".join(f"{k}={v}" for k, v in sorted(tags.items()))
 
 
+def _span_loc(s: dict) -> str:
+    """shard/node provenance column for stitched fleet spans ("n0/s2");
+    plain single-process dumps carry neither key and get no column."""
+    if "shard" not in s and "node" not in s:
+        return ""
+    node = s.get("node", -1)
+    shard = s.get("shard", "?")
+    if isinstance(node, int) and node >= 0:
+        return f"n{node}/s{shard}"
+    return f"s{shard}"
+
+
 def render_tree(tree: dict, out=None, slow: bool = False) -> None:
     """One aligned waterfall per span tree. Rows are sorted by start
     time; the bar column maps [root start, root end] onto a fixed
     width so sibling gaps (queue waits, flush coalescing) read as
-    horizontal whitespace."""
+    horizontal whitespace. Stitched fleet trees additionally get a
+    shard/node provenance column, a `⇐origin` badge on each process
+    hop's continuation root, and a header counting parts/shards.
+    Parents living in a part that never reached the dump (orphans)
+    simply render at depth 0 — missing links are expected, not fatal."""
     out = out if out is not None else sys.stdout
     spans = tree.get("spans", [])
     if not spans:
@@ -158,12 +174,21 @@ def render_tree(tree: dict, out=None, slow: bool = False) -> None:
         return d
 
     flag = "  [SLOW]" if slow else ""
+    extra = ""
+    if tree.get("stitched"):
+        extra = (
+            f" stitched parts={tree.get('parts')}"
+            f" shards={tree.get('shards')}"
+        )
+        if tree.get("orphaned"):
+            extra += " [ORPHANED: root part missing]"
     print(
         f"trace {tree.get('trace_id')} root={tree.get('root')} "
-        f"dur={tree.get('dur_ns', 0) / 1e6:.2f}ms{flag}",
+        f"dur={tree.get('dur_ns', 0) / 1e6:.2f}ms{extra}{flag}",
         file=out,
     )
     name_w = max(len("  " * depth(s) + s["name"]) for s in spans)
+    loc_w = max((len(_span_loc(s)) for s in spans), default=0)
     for s in sorted(spans, key=lambda s: (s["start_ns"], s["id"])):
         off_ns = s["start_ns"] - t0
         dur_ns = max(s.get("dur_ns", 0), 0)
@@ -174,10 +199,12 @@ def render_tree(tree: dict, out=None, slow: bool = False) -> None:
         )
         bar = " " * lo + "█" * (hi - lo) + " " * (_BAR_WIDTH - hi)
         label = "  " * depth(s) + s["name"]
+        loc = f" {_span_loc(s):<{loc_w}}" if loc_w else ""
+        badge = f"  ⇐{s['origin']}" if s.get("origin") else ""
         print(
-            f"  {off_ns / 1e6:9.3f}ms |{bar}| "
+            f"  {off_ns / 1e6:9.3f}ms |{bar}|{loc} "
             f"{dur_ns / 1e6:9.3f}ms  {label:<{name_w}}"
-            f"{_fmt_tags(s.get('tags'))}",
+            f"{_fmt_tags(s.get('tags'))}{badge}",
             file=out,
         )
 
@@ -211,6 +238,23 @@ def dump_traces(path: str, out=None) -> None:
         if tree.get("trace_id") in frozen_ids:
             continue  # already rendered above, flagged slow
         render_tree(tree, out=out)
+    # fleet dump (--shards N): per-worker recorder summaries, then the
+    # cross-process stitched trees (each span carries shard/node)
+    shard_dumps = doc.get("shards") or {}
+    for sid in sorted(shard_dumps, key=str):
+        sd = shard_dumps[sid]
+        print(
+            f"shard {sid} (node={sd.get('node_id', '?')}): "
+            f"trees_total={sd.get('trees_total', 0)} "
+            f"frozen={len(sd.get('frozen', []))} "
+            f"ring={len(sd.get('ring', []))}",
+            file=out,
+        )
+    stitched = doc.get("stitched") or []
+    if stitched:
+        print(f"stitched cross-process traces ({len(stitched)}):", file=out)
+        for tree in stitched:
+            render_tree(tree, out=out)
     events = doc.get("events", [])
     if events:
         print(f"events ({len(events)}):", file=out)

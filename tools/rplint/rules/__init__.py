@@ -11,6 +11,7 @@ from .rpl006_net_await_budget import NetAwaitBudgetRule
 from .rpl007_native_symbols import NativeSymbolRule
 from .rpl008_trace_discipline import TraceDisciplineRule
 from .rpl009_shard_discipline import ShardDisciplineRule
+from .rpl010_metrics_discipline import MetricsDisciplineRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -22,6 +23,7 @@ ALL_RULES = [
     NativeSymbolRule,
     TraceDisciplineRule,
     ShardDisciplineRule,
+    MetricsDisciplineRule,
 ]
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,126 @@
+"""RPL010 — metrics discipline: no rogue metric families, no
+per-request label formatting on hot paths.
+
+Two contracts from metrics.py + observability/fleet.py:
+
+  1. `Counter(...)` / `Gauge(...)` / `Histogram(...)` may only be
+     constructed inside metrics.py — everywhere else goes through a
+     `MetricsRegistry` (`.counter()` / `.gauge()` / `.histogram()`).
+     A directly-constructed family has no `redpanda_tpu_` prefix, is
+     invisible to `registry.render()`, and — since PR 6 — never rides
+     the fleet `RegistrySnapshot`, so a `/metrics` scrape at shard 0
+     silently drops it for every worker shard. The bug shape is a
+     metric that "works" in a unit test (the test holds the object)
+     and reports nothing in production.
+
+  2. On hot paths (files under raft/, kafka/, storage/, rpc/), label
+     values passed to `.labels(...)` / `.inc(...)` must be
+     pre-formatted plain values — no f-strings (JoinedStr), no
+     `"%s" % x`, no `"{}".format(x)`. Formatting per event is
+     allocation the probe pattern exists to avoid (children are
+     resolved once at init; see kafka/probe.py), and a formatted
+     label derived from request data is unbounded cardinality: every
+     distinct value mints a new child that lives forever in the
+     registry AND in every fleet snapshot shipped over invoke_on.
+
+Suppress a deliberate exception with `# rplint: disable=RPL010`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext, dotted_name
+from .rpl008_trace_discipline import _is_format_expr
+
+_EXEMPT_FILE = "metrics.py"
+_HOT_DIRS = ("raft", "kafka", "storage", "rpc")
+_FAMILY_CTORS = ("Counter", "Gauge", "Histogram")
+_LABELED_CALLS = ("labels", "inc")
+
+
+def _metric_bindings(tree: ast.Module) -> tuple[dict[str, str], set[str]]:
+    """(alias -> ctor name) for names imported from a metrics module,
+    plus the set of local aliases naming the metrics module itself.
+    Import-aware so `collections.Counter` never trips the rule."""
+    ctors: dict[str, str] = {}
+    mod_aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            from_metrics = mod == "metrics" or mod.endswith(".metrics")
+            for a in node.names:
+                if from_metrics and a.name in _FAMILY_CTORS:
+                    ctors[a.asname or a.name] = a.name
+                if a.name == "metrics":
+                    mod_aliases.add(a.asname or "metrics")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname and (
+                    a.name == "metrics" or a.name.endswith(".metrics")
+                ):
+                    mod_aliases.add(a.asname)
+    return ctors, mod_aliases
+
+
+class MetricsDisciplineRule:
+    code = "RPL010"
+    name = "metrics-discipline"
+
+    @staticmethod
+    def _dir_parts(ctx: ModuleContext) -> list[str]:
+        return ctx.path.replace("\\", "/").split("/")[:-1]
+
+    def check(self, ctx: ModuleContext):
+        posix = ctx.path.replace("\\", "/")
+        exempt_ctor = posix.rsplit("/", 1)[-1] == _EXEMPT_FILE
+        parts = self._dir_parts(ctx)
+        hot = any(d in parts for d in _HOT_DIRS)
+        ctors, mod_aliases = _metric_bindings(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            callee = d.rsplit(".", 1)[-1]
+            ctor = ctors.get(d)
+            if ctor is None and callee in _FAMILY_CTORS and "." in d:
+                base = d.rsplit(".", 1)[0]
+                if (
+                    base in mod_aliases
+                    or base == "metrics"
+                    or base.endswith(".metrics")
+                ):
+                    ctor = callee
+            if ctor is not None and not exempt_ctor:
+                if ctx.suppressed(node, self.code):
+                    continue
+                yield Finding(
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.code,
+                    message=(
+                        f"bare {ctor}() construction outside metrics.py "
+                        "— go through MetricsRegistry so the family gets "
+                        "the prefix, renders, and rides the fleet snapshot"
+                    ),
+                )
+            elif callee in _LABELED_CALLS and hot:
+                for kw in node.keywords:
+                    slug = _is_format_expr(kw.value)
+                    if slug is None:
+                        continue
+                    if ctx.suppressed(node, self.code):
+                        continue
+                    yield Finding(
+                        path=ctx.path,
+                        line=kw.value.lineno,
+                        col=kw.value.col_offset,
+                        rule=self.code,
+                        message=(
+                            f"{slug} label value in .{callee}() on a hot "
+                            "path — per-event formatting plus unbounded "
+                            "label cardinality; resolve the child once at "
+                            "probe init with plain values"
+                        ),
+                    )

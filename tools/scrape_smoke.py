@@ -4,6 +4,12 @@ the admin scrape surface is live — /metrics carries the probe
 histogram families and /v1/debug/traces returns at least one span
 tree. Run by tools/verify.sh before the tier-1 suite; exits nonzero
 with a one-line reason on any miss.
+
+With --fleet the smoke boots a 2-shard ShardedBroker instead and
+asserts the PR-6 fleet plane: the merged /metrics scrape at shard 0
+carries `shard="1"` samples, the per-shard raw view serves at
+/v1/shards/1/metrics, probes report worker liveness, and (tracing on)
+a forwarded produce surfaces as one stitched cross-process span tree.
 """
 
 from __future__ import annotations
@@ -110,5 +116,142 @@ async def main() -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+async def main_fleet() -> int:
+    from redpanda_tpu.ssx.sharded_broker import ShardedBroker
+
+    tmp = tempfile.mkdtemp(prefix="rp-fleet-smoke-")
+    sb = ShardedBroker(
+        BrokerConfig(
+            node_id=0,
+            data_dir=tmp,
+            members=[0],
+            election_timeout_s=0.3,
+            heartbeat_interval_s=0.05,
+            enable_admin=True,
+        ),
+        n_shards=2,
+    )
+    try:
+        await sb.start()
+        if not sb.active:
+            print(
+                f"fleet smoke: shard runtime stood down: {sb.standdown}",
+                file=sys.stderr,
+            )
+            return 1
+        from redpanda_tpu.kafka.client import KafkaClient
+
+        client = KafkaClient([("127.0.0.1", sb.kafka_port)])
+        try:
+            deadline = asyncio.get_event_loop().time() + 30.0
+
+            async def retry(fn):
+                while True:
+                    try:
+                        return await fn()
+                    except Exception:
+                        if asyncio.get_event_loop().time() > deadline:
+                            raise
+                        await asyncio.sleep(0.2)
+
+            await retry(lambda: client.create_topic("smoke", partitions=4))
+            while not sb.broker.shard_table.counts().get(1, 0):
+                if asyncio.get_event_loop().time() > deadline:
+                    print(
+                        "fleet smoke: no partitions routed to shard 1",
+                        file=sys.stderr,
+                    )
+                    return 1
+                await asyncio.sleep(0.1)
+            for p in range(4):
+                await retry(
+                    lambda p=p: client.produce("smoke", p, [(None, b"ping")])
+                )
+        finally:
+            await client.close()
+
+        addr = sb.broker.admin.address
+        st, body = await _http(addr, "/metrics")
+        if st != 200:
+            print(f"fleet smoke: /metrics returned {st}", file=sys.stderr)
+            return 1
+        text = body.decode()
+        for sid in ("0", "1"):
+            if f'shard="{sid}"' not in text:
+                print(
+                    f'fleet smoke: merged /metrics has no shard="{sid}" '
+                    "samples",
+                    file=sys.stderr,
+                )
+                return 1
+        st, body = await _http(addr, "/v1/shards/1/metrics")
+        if st != 200 or b"redpanda_tpu_" not in body:
+            print(
+                f"fleet smoke: /v1/shards/1/metrics returned {st}",
+                file=sys.stderr,
+            )
+            return 1
+        if b'shard="' in body:
+            print(
+                "fleet smoke: per-shard raw view must not carry the "
+                "shard label",
+                file=sys.stderr,
+            )
+            return 1
+
+        st, body = await _http(addr, "/v1/debug/probes")
+        shards = json.loads(body).get("shards", {}) if st == 200 else {}
+        if shards.get("n_shards") != 2 or "1" not in shards.get("alive", {}):
+            print(
+                f"fleet smoke: probes liveness wrong: {shards!r}",
+                file=sys.stderr,
+            )
+            return 1
+
+        st, body = await _http(addr, "/v1/debug/traces")
+        if st != 200:
+            print(
+                f"fleet smoke: /v1/debug/traces returned {st}",
+                file=sys.stderr,
+            )
+            return 1
+        dump = json.loads(body)
+        stitched_n = 0
+        if dump.get("enabled"):
+            if "1" not in dump.get("shards", {}):
+                print(
+                    "fleet smoke: no shard-1 recorder dump in the fleet "
+                    "trace collection",
+                    file=sys.stderr,
+                )
+                return 1
+            multi = [
+                t
+                for t in dump.get("stitched", [])
+                if len(t.get("shards", [])) >= 2
+            ]
+            if not multi:
+                print(
+                    "fleet smoke: no stitched cross-process span tree "
+                    "for the forwarded produce",
+                    file=sys.stderr,
+                )
+                return 1
+            stitched_n = len(multi)
+        print(
+            "fleet smoke OK: merged scrape carries shard=0/1, per-shard "
+            f"view live, {stitched_n} stitched cross-process traces "
+            f"(tracing {'on' if dump.get('enabled') else 'off'})"
+        )
+        return 0
+    finally:
+        try:
+            await sb.stop()
+        except Exception:
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
-    raise SystemExit(asyncio.run(main()))
+    entry = main_fleet if "--fleet" in sys.argv[1:] else main
+    raise SystemExit(asyncio.run(entry()))

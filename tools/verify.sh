@@ -37,6 +37,9 @@ env JAX_PLATFORMS=cpu RP_NATIVE=0 python -m pytest \
 echo "== shard mp smoke (2-shard broker, fork + invoke_on seam) =="
 env JAX_PLATFORMS=cpu python tools/shard_smoke.py
 
+echo "== fleet scrape smoke (merged /metrics + stitched traces) =="
+env JAX_PLATFORMS=cpu python tools/scrape_smoke.py --fleet
+
 echo "== sharding-off smoke (RP_SHARDS=0) =="
 env JAX_PLATFORMS=cpu RP_SHARDS=0 python -m pytest \
     tests/test_kafka_e2e.py \
@@ -44,6 +47,7 @@ env JAX_PLATFORMS=cpu RP_SHARDS=0 python -m pytest \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
 echo "== tracing-off smoke (RP_TRACE=0) =="
+env JAX_PLATFORMS=cpu RP_TRACE=0 python tools/scrape_smoke.py --fleet
 exec env JAX_PLATFORMS=cpu RP_TRACE=0 python -m pytest \
     tests/test_observability.py tests/test_kafka_e2e.py \
     tests/test_admin_server.py \
