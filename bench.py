@@ -174,12 +174,52 @@ async def _live_tick_async(n_groups: int) -> dict:
             leaders.append(c)
         hb = gms[0].heartbeat_manager
         # drive ticks until every follower caught up (config batch
-        # replicated + committed everywhere)
-        deadline = time.monotonic() + 60.0
-        while any(c.commit_index < c.term_start for c in leaders):
+        # replicated + committed everywhere); setup budget scales with
+        # group count — 100k groups legitimately need a few minutes of
+        # initial config replication before the measured steady state
+        deadline = time.monotonic() + max(60.0, n_groups / 250.0)
+        t_trace = time.monotonic()
+        # convergence check must stay amortized O(1) PER TICK, not
+        # O(n_groups): the follower services the catch-up herd's
+        # batched append frames with a yield between sub-append
+        # chunks, and every yield interleaves one iteration of this
+        # loop — per-tick O(n) here stretches frame service past the
+        # RPC timeout at high group counts, failing the whole herd's
+        # waiters at once (congestive-collapse livelock). Popping the
+        # converged tail examines each leader a bounded number of
+        # times across the whole catch-up.
+        pending = list(leaders)
+        while True:
+            while pending and (
+                pending[-1].commit_index >= pending[-1].term_start
+            ):
+                pending.pop()
+            if not pending:
+                break
+            t_tick = time.monotonic()
             await hb.tick()
-            if time.monotonic() > deadline:
-                raise TimeoutError("followers never caught up")
+            now = time.monotonic()
+            if os.environ.get("BENCH_TICK_TRACE") and now - t_trace > 10.0:
+                t_trace = now
+                arrays0 = gms[0].arrays
+                c0 = pending[-1]
+                print(
+                    f"# catch-up: <={len(pending)} behind, tick "
+                    f"{(now - t_tick) * 1e3:.0f} ms, frame flushes "
+                    f"{gms[0].tick_frame.flushes}; sample row {c0.row}: "
+                    f"commit={arrays0.commit_index[c0.row]} "
+                    f"term_start={arrays0.term_start[c0.row]} "
+                    f"match={arrays0.match_index[c0.row, :3]} "
+                    f"flushed={arrays0.flushed_index[c0.row, :3]}",
+                    file=sys.stderr,
+                )
+            if now > deadline:
+                behind = sum(
+                    1 for c in leaders if c.commit_index < c.term_start
+                )
+                raise TimeoutError(
+                    f"followers never caught up ({behind} groups behind)"
+                )
             await asyncio.sleep(0)
 
         # long-lived heap tuning: 100k Consensus objects make gen2 GC
@@ -227,7 +267,8 @@ async def _live_tick_async(n_groups: int) -> dict:
         # HEADLINE is the FULL-frame p99 — what an actively-churning
         # cluster pays every tick (VERDICT r4 #2); the quiesced SAME
         # path's O(1) numbers ride along as steady_*.
-        return {
+        tf = gms[0].tick_frame
+        out = {
             "metric": f"live_heartbeat_tick_p99_{n_groups}_groups",
             "value": round(full_p99, 3),
             "unit": "ms",
@@ -238,7 +279,15 @@ async def _live_tick_async(n_groups: int) -> dict:
             "steady_p99_ms": round(p99, 3),
             "steady_p50_ms": round(float(np.percentile(times, 50)), 3),
             "steady_mean_ms": round(float(np.mean(times)), 3),
+            # batched replication plane: every reply's quorum math went
+            # through the tick frame, not per-group Python
+            "tick_frame_flushes": tf.flushes,
+            "tick_frame_replies": tf.replies_folded,
+            "tick_frame_max_batch": tf.max_batch,
         }
+        if os.environ.get("RP_BENCH_PROBES") == "1":
+            out["stages"] = _stage_quantiles(gms[0].probe)
+        return out
     finally:
         for gm in gms.values():
             try:
@@ -251,6 +300,64 @@ async def _live_tick_async(n_groups: int) -> dict:
 def bench_live_tick() -> dict:
     n = int(os.environ.get("BENCH_LIVE_GROUPS", "5000"))
     return asyncio.run(_live_tick_async(n))
+
+
+_REPL_STAGES = ("coalesce", "frame", "wire", "quorum")
+
+
+def _stage_quantiles(probe) -> dict:
+    """Per-stage p50/p99 (ms) from the raft replicate-stage histogram
+    (coalesce -> device frame -> wire -> quorum), the same series the
+    admin /metrics renders as raft_replicate_stage_seconds."""
+    out = {}
+    for stage in _REPL_STAGES:
+        c = probe.replicate_stage_hist.labels(stage=stage)
+        out[stage] = {
+            "count": c._count,
+            "p50_ms": round(c.quantile(0.50) * 1e3, 3),
+            "p99_ms": round(c.quantile(0.99) * 1e3, 3),
+        }
+    return out
+
+
+# -------------------------------------------- replicated tick (100k live)
+def bench_replicated_tick() -> dict:
+    """`replicated --partitions 100000`: the live-broker TICK mode at
+    partition counts the full produce harness can't boot. Two real
+    GroupManagers over loopback host N raft groups with node 0 leading
+    all of them; the measured unit is the live replication plane's tick
+    (heartbeat build + RPC + service + the fused tick frame). The claim
+    under test: per-partition tick CPU is ~flat because per-group math
+    is off the interpreter — steady per-tick wall at N must be <= 2x
+    the wall at N/20 (20x groups, <=2x time)."""
+    n = int(os.environ.get("BENCH_REPL_PARTITIONS", "100000"))
+    base = max(1000, n // 20)
+    small = asyncio.run(_live_tick_async(base))
+    big = asyncio.run(_live_tick_async(n))
+    steady_ratio = big["steady_p50_ms"] / max(small["steady_p50_ms"], 1e-6)
+    full_ratio = big["full_frame_p50_ms"] / max(
+        small["full_frame_p50_ms"], 1e-6
+    )
+    return {
+        "metric": f"replicated_live_tick_{n}_partitions",
+        # headline: steady per-tick wall growth for a 20x group-count
+        # step — <= 2.0 means per-partition cost dropped >= 10x
+        "value": round(steady_ratio, 3),
+        "unit": "x_wall_for_20x_groups",
+        "vs_baseline": round(2.0 / max(steady_ratio, 1e-6), 3),
+        "flat": bool(steady_ratio <= 2.0),
+        "partitions": n,
+        "base_partitions": base,
+        "steady_p50_ms": big["steady_p50_ms"],
+        "steady_p99_ms": big["steady_p99_ms"],
+        "full_frame_ratio": round(full_ratio, 3),
+        "per_partition_ns_steady": round(
+            big["steady_p50_ms"] * 1e6 / n, 1
+        ),
+        "tick_frame_replies": big["tick_frame_replies"],
+        "small": small,
+        "big": big,
+    }
 
 
 # ------------------------------------------------------------------- crc
@@ -866,6 +973,7 @@ async def _replicated_async() -> dict:
         # quantiles cover ONLY the measured window (warmup excluded,
         # matching lat_ms methodology).
         probe_children = probe_before = None
+        stage_children = stage_before = None
         if os.environ.get("RP_BENCH_PROBES") == "1":
             probe_children = [
                 b.kafka_server.probe.stage_hist.labels(
@@ -877,6 +985,18 @@ async def _replicated_async() -> dict:
             probe_before = [
                 (list(c._buckets), c._overflow, c._sum, c._count)
                 for c in probe_children
+            ]
+            # raft replicate-stage breakdown over the same window:
+            # coalesce -> device frame -> wire -> quorum
+            stage_children = [
+                (s, b.group_manager.probe.replicate_stage_hist.labels(
+                    stage=s))
+                for b in brokers
+                for s in _REPL_STAGES
+            ]
+            stage_before = [
+                (list(c._buckets), c._overflow, c._sum, c._count)
+                for _, c in stage_children
             ]
         # --attrib / RP_BENCH_ATTRIB=1: per-coroutine event-loop time
         # attribution over the measured window only (warmup excluded)
@@ -932,6 +1052,27 @@ async def _replicated_async() -> dict:
             out["probe_rounds"] = merged._count
             out["probe_p50_ms"] = round(merged.quantile(0.50) * 1e3, 2)
             out["probe_p99_ms"] = round(merged.quantile(0.99) * 1e3, 2)
+        if stage_children is not None:
+            from redpanda_tpu.metrics import HistogramChild
+
+            per_stage = {s: HistogramChild() for s in _REPL_STAGES}
+            for (s, c), (bb, ov, sm, cnt) in zip(
+                stage_children, stage_before
+            ):
+                m = per_stage[s]
+                for i in range(len(bb)):
+                    m._buckets[i] += c._buckets[i] - bb[i]
+                m._overflow += c._overflow - ov
+                m._sum += c._sum - sm
+                m._count += c._count - cnt
+            out["stages"] = {
+                s: {
+                    "count": m._count,
+                    "p50_ms": round(m.quantile(0.50) * 1e3, 3),
+                    "p99_ms": round(m.quantile(0.99) * 1e3, 3),
+                }
+                for s, m in per_stage.items()
+            }
         return out
     finally:
         if client is not None:
@@ -1616,6 +1757,7 @@ BENCHES = {
     "codec": bench_codec,
     "broker": bench_broker,
     "replicated": bench_replicated,
+    "replicated_tick": bench_replicated_tick,
     "replicated_mp": bench_replicated_mp,
     "omb": bench_omb,
     "slo": bench_slo,
@@ -1661,6 +1803,17 @@ def main() -> None:
         "in mp mode via the admin /metrics fleet scrape)",
     )
     ap.add_argument(
+        "--partitions",
+        type=int,
+        default=None,
+        help="partition/group count for the replicated and live_tick "
+        "benches (BENCH_REPL_PARTITIONS / BENCH_LIVE_GROUPS). With "
+        "--only replicated and >= 10000 partitions, routes to the "
+        "live-broker tick mode (replicated_tick): the full produce "
+        "harness can't boot 100k client partitions, but the live "
+        "replication plane must still tick them flat",
+    )
+    ap.add_argument(
         "--slo",
         metavar="PROFILE",
         help="SLO-graded interleaved latency-vs-throughput sweep: load "
@@ -1673,6 +1826,11 @@ def main() -> None:
         os.environ["RP_BENCH_ATTRIB"] = "1"
     if args.probes:
         os.environ["RP_BENCH_PROBES"] = "1"
+    if args.partitions is not None:
+        os.environ["BENCH_REPL_PARTITIONS"] = str(args.partitions)
+        os.environ["BENCH_LIVE_GROUPS"] = str(args.partitions)
+        if args.only == "replicated" and args.partitions >= 10000:
+            args.only = "replicated_tick"
 
     if args.cores is not None:
         os.environ["BENCH_MP_CORES"] = str(args.cores)

@@ -242,3 +242,29 @@ def heartbeat_tick(
 
 
 heartbeat_tick_jit = jax.jit(heartbeat_tick, donate_argnums=0)
+
+
+def tick_frame(
+    state: GroupState,
+    group_idx: jax.Array,
+    replica_slot: jax.Array,
+    last_dirty: jax.Array,
+    last_flushed: jax.Array,
+    seq: jax.Array,
+    hb_idx: jax.Array,
+) -> tuple[GroupState, dict[str, jax.Array]]:
+    """One fused live tick frame — the complete replication plane as a
+    single compiled program: (b) fold the tick window's accumulated
+    append-reply columns into match/flushed with the seq reordering
+    guard, (c) advance every group's commit/visible via the masked
+    quorum step, then (a) gather the next frame's heartbeat payload
+    fields for `hb_idx` from the POST-advance state. The three stages
+    the reference interleaves per group (heartbeat_manager.cc:203 +
+    consensus.cc:274/2704) collapse into one XLA dispatch; the caller
+    (raft.tick_frame.TickFrame) only handles the residue in Python."""
+    state = fold_replies(state, group_idx, replica_slot, last_dirty, last_flushed, seq)
+    state = quorum_commit_step(state)
+    return state, build_heartbeats(state, hb_idx)
+
+
+tick_frame_jit = jax.jit(tick_frame, donate_argnums=0)

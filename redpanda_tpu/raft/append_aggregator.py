@@ -20,11 +20,26 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import Callable
 
 from . import types as rt
 
 logger = logging.getLogger("raft.append_agg")
+
+# Sub-appends per APPEND_ENTRIES_BATCH frame. The follower services a
+# frame sequentially (service.append_entries_batch), so an unbounded
+# frame makes one wire call's work proportional to however many groups
+# dispatched in the window — a mass-catch-up herd (N leaderships won
+# at once) lands N sub-appends in ONE frame, the follower cannot
+# answer it inside the RPC timeout, ALL N waiters fail together, and
+# the recovery scan re-kicks them in lockstep: a livelock where only
+# the singleton fast-path winner advances per timeout cycle. Capping
+# the frame bounds each wire call's service time (the timeout applies
+# per frame; queue wait does not count, matching
+# append_entries_buffer.h's bounded-buffer semantics), so the herd
+# drains as a pipeline of small frames instead of one doomed jumbo.
+_FRAME_CAP = int(os.environ.get("RP_APPEND_FRAME_CAP", "512"))
 
 
 class AppendAggregator:
@@ -81,9 +96,15 @@ class AppendAggregator:
             # in this frame (replicate_batcher's accumulation trick
             # applied to the RPC layer)
             await asyncio.sleep(0)
-            batch = self._q.pop(peer, [])
-            if not batch:
+            q = self._q.get(peer)
+            if not q:
+                self._q.pop(peer, None)
                 break
+            if len(q) > _FRAME_CAP:
+                batch = q[:_FRAME_CAP]
+                self._q[peer] = q[_FRAME_CAP:]
+            else:
+                batch = self._q.pop(peer)
             try:
                 if len(batch) == 1:
                     payload, fut = batch[0]

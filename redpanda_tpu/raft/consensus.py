@@ -97,6 +97,7 @@ class Consensus:
         election_timeout_s: float = 0.3,
         recovery_throttle=None,
         probe=None,
+        tick_frame=None,
     ):
         self.group_id = group_id
         self.node_id = node_id
@@ -119,6 +120,12 @@ class Consensus:
             probe = fixture_probe()
         self.probe = probe
         self._observe_commit = probe.observe_commit
+        # shard tick frame (raft/tick_frame.py): when wired (via
+        # GroupManager), per-reply quorum math becomes an enqueue into
+        # the frame's pending columns; None (direct fixtures) keeps
+        # the scalar per-reply path — which doubles as the live
+        # differential oracle for the batched plane
+        self._tick_frame = tick_frame
         self._election_t0: Optional[float] = None
         # unified retry budget for the remote send loops (catch-up
         # backoff, snapshot chunks): a child of the node-wide root when
@@ -1184,6 +1191,7 @@ class Consensus:
     def _resolve_quorum_items(self, term: int, items: list) -> None:
         now = time.monotonic()
         observe = self._observe_commit
+        observe_quorum = self.probe.observe_stage_quorum
         for it in items:
             fut = it.stages.done
             if fut.done():
@@ -1195,6 +1203,8 @@ class Consensus:
                 fut.set_result((it.base, it.last))
                 # enqueue -> quorum ack (raft/probe.cc replicate done)
                 observe(now - it.t0)
+                # fsync-done -> quorum ack (the pure commit-wait tail)
+                observe_quorum(now - it.t_q0)
 
     def _fail_quorum_waiters(self, make_exc) -> None:
         waiters, self._quorum_waiters = self._quorum_waiters, []
@@ -1527,8 +1537,10 @@ class Consensus:
             if self.group_id == 0:
                 spans.add("leader.rpc_g0", 1.0)
         try:
+            t_wire = time.monotonic()
             with spans.span("leader.rpc"):
                 raw = await self._send(peer, rt.APPEND_ENTRIES, req, 5.0)
+            self.probe.observe_stage_wire(time.monotonic() - t_wire)
             rep = rt.AppendEntriesReply.decode(raw)
         except Exception:
             # quorum-first: a failed peer flips subsequent rounds to
@@ -1574,10 +1586,14 @@ class Consensus:
     def process_append_reply(
         self, peer: int, dirty: int, flushed: int, seq: int
     ) -> None:
-        """Fold one follower reply into the SoA (scalar fast path,
-        update_follower_index consensus.cc:274) and advance commit.
-        The batched tick (heartbeat manager) does the same via the
-        device kernel for whole reply batches."""
+        """Fold one follower reply into the SoA
+        (update_follower_index consensus.cc:274) and advance commit.
+        Cell bookkeeping (seq guard + match/flushed lanes) stays
+        inline — the catch-up fiber's progress detection reads these
+        synchronously — but the quorum/commit MATH defers to the shard
+        tick frame when one is wired: O(1) enqueue here, one
+        vectorized frame per window there. Direct fixtures (no frame)
+        run the scalar oracle per reply, as before."""
         row = self.row
         slot = self._slot_map.get(peer)
         if slot is None:
@@ -1592,7 +1608,10 @@ class Consensus:
         self.arrays.flushed_index[row, slot] = max(
             int(self.arrays.flushed_index[row, slot]), flushed
         )
-        if self.arrays.scalar_commit_update(row):
+        frame = self._tick_frame
+        if frame is not None:
+            frame.enqueue_reply(row, slot, dirty, flushed, seq)
+        elif self.arrays.scalar_commit_update(row):
             self._notify_commit()
 
     def on_batched_commit_advance(self) -> None:

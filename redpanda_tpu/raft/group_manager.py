@@ -65,10 +65,17 @@ class GroupManager:
         from .probe import RaftProbe
 
         self.probe = RaftProbe(metrics)
+        # shard tick frame: per-reply quorum math from every group
+        # batches into one vectorized call per dispatch window
+        # (raft/tick_frame.py); the heartbeat fold merges into it too
+        from .tick_frame import TickFrame
+
+        self.tick_frame = TickFrame(self.arrays, probe=self.probe)
         self.heartbeat_manager = HeartbeatManager(
             node_id, send, interval_s=heartbeat_interval_s
         )
         self.heartbeat_manager.probe = self.probe
+        self.heartbeat_manager.tick_frame = self.tick_frame
         self.service = RaftService(self)
         self._groups: dict[int, Consensus] = {}
         self._by_row: dict[int, Consensus] = {}
@@ -114,6 +121,7 @@ class GroupManager:
         # per-group stop() waiting out jittered sleeps
         self.recovery_throttle.retry_root.abort()
         await self.heartbeat_manager.stop()
+        self.tick_frame.close()
         for c in list(self._groups.values()):
             await c.stop()
         if self._owns_kvstore:
@@ -253,9 +261,11 @@ class GroupManager:
             election_timeout_s=election_timeout_s or self._election_timeout,
             recovery_throttle=self.recovery_throttle,
             probe=self.probe,
+            tick_frame=self.tick_frame,
         )
         self._groups[group_id] = c
         self._by_row[c.row] = c
+        self.tick_frame.register(c.row, c.on_batched_commit_advance)
         self.registry_epoch += 1
         await c.start()
         self._min_el_timeout = min(
@@ -270,6 +280,7 @@ class GroupManager:
         self.service.invalidate_heartbeat_plans()
         if c is not None:
             self._by_row.pop(c.row, None)
+            self.tick_frame.deregister(c.row)
             self.heartbeat_manager.deregister(group_id)
             await c.stop()
             self.arrays.free_row(c.row)

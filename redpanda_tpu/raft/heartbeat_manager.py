@@ -145,6 +145,10 @@ class HeartbeatManager:
         self._closed = False
         # RaftProbe set by GroupManager; None for direct fixtures
         self.probe = None
+        # shard TickFrame set by GroupManager: the tick's reply fold
+        # merges with the replicate path's pending window into one
+        # fused frame call; None (direct fixtures) folds directly
+        self.tick_frame = None
 
     def register(self, c: Consensus) -> None:
         self._groups[c.group_id] = c
@@ -548,18 +552,28 @@ class HeartbeatManager:
                         np.array([min(int(reply.last_flushed[i]), d)], np.int64)
                     )
                     seqs_acc.append(np.array([int(reply.seqs[i])], np.int64))
+        frame = self.tick_frame
         if rows_acc:
-            advanced = arrays.device_tick(
-                np.concatenate(rows_acc),
-                np.concatenate(slots_acc),
-                np.concatenate(dirty_acc),
-                np.concatenate(flushed_acc),
-                np.concatenate(seqs_acc),
-            )
-            for r in advanced:
-                c = self._by_row.get(int(r))
-                if c is not None:
-                    c.on_batched_commit_advance()
+            gr = np.concatenate(rows_acc)
+            gs = np.concatenate(slots_acc)
+            gd = np.concatenate(dirty_acc)
+            gf = np.concatenate(flushed_acc)
+            gq = np.concatenate(seqs_acc)
+            if frame is not None:
+                # merge with the replicate path's pending-reply window:
+                # one fused frame per tick covers both reply streams
+                # (advance callbacks fire inside fold_now)
+                frame.fold_now(gr, gs, gd, gf, gq)
+            else:
+                advanced = arrays.device_tick(gr, gs, gd, gf, gq)
+                for r in advanced:
+                    c = self._by_row.get(int(r))
+                    if c is not None:
+                        c.on_batched_commit_advance()
+        elif frame is not None and frame.pending:
+            # no heartbeat replies this tick, but the replicate window
+            # has pending rows: drain them on the tick cadence too
+            frame.flush()
         t_scan = 0.0
         if spans.ENABLED:
             spans.add("hb.fold", time.perf_counter() - t_fold)

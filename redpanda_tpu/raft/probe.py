@@ -55,9 +55,40 @@ class RaftProbe:
             "raft_recovery_rounds_total",
             "Throttled follower catch-up rounds (recovery_stm analog)",
         )
+        # live replicate path per-stage latency (ReplicateStages
+        # breakdown): coalesce = enqueue → flush-round pickup,
+        # frame = one tick-frame fold+commit call, wire = one
+        # AppendEntries RPC round-trip, quorum = fsync-done →
+        # quorum-commit ack. Labeled children resolved once here so
+        # the hot sites pay a single bound-method call.
+        self.replicate_stage_hist = m.histogram(
+            "raft_replicate_stage_seconds",
+            "Live replicate path stage latency "
+            "(coalesce -> frame -> wire -> quorum)",
+        )
+        self.tick_frame_flushes = m.counter(
+            "raft_tick_frame_flushes_total",
+            "Tick-frame windows folded (one vectorized call each)",
+        )
+        self.tick_frame_replies = m.counter(
+            "raft_tick_frame_replies_total",
+            "Append replies folded through tick-frame windows",
+        )
         # hot-path pre-resolved observers
         self.observe_append = self.append_hist.observe
         self.observe_commit = self.commit_hist.observe
+        self.observe_stage_coalesce = self.replicate_stage_hist.labels(
+            stage="coalesce"
+        ).observe
+        self.observe_stage_frame = self.replicate_stage_hist.labels(
+            stage="frame"
+        ).observe
+        self.observe_stage_wire = self.replicate_stage_hist.labels(
+            stage="wire"
+        ).observe
+        self.observe_stage_quorum = self.replicate_stage_hist.labels(
+            stage="quorum"
+        ).observe
 
 
 _fixture_probe: Optional[RaftProbe] = None

@@ -64,7 +64,8 @@ class ReplicateStages:
 
 class _Item:
     __slots__ = (
-        "batch", "acks", "stages", "size", "base", "last", "t0", "span",
+        "batch", "acks", "stages", "size", "base", "last", "t0", "t_q0",
+        "span",
     )
 
     def __init__(self, batch: RecordBatch, acks: int, size: int):
@@ -77,6 +78,9 @@ class _Item:
         # enqueue stamp for the commit-latency probe
         # (consensus._resolve_quorum_items observes now - t0)
         self.t0 = time.monotonic()
+        # fsync-done stamp (re-set by _flush_round): quorum-stage
+        # latency = resolve time - t_q0, the pure commit-wait tail
+        self.t_q0 = self.t0
         # requester's open trace span (the produce dispatch), captured
         # here because the flush round runs in a different task — it
         # parents the round's raft.append/raft.flush spans
@@ -185,6 +189,10 @@ class ReplicateBatcher:
         round_last = -1
         appended: list[_Item] = []
         t_append = time.monotonic()
+        # coalesce stage: enqueue -> this round picking the item up
+        observe_coalesce = c.probe.observe_stage_coalesce
+        for it in items:
+            observe_coalesce(t_append - it.t0)
         with trace.span("raft.append", parent=items[0].span, items=len(items)):
             with spans.span("batcher.append"):
                 for it in items:
@@ -212,9 +220,17 @@ class ReplicateBatcher:
             int(c.arrays.flushed_index[row, SELF_SLOT]), flushed
         )
         c.arrays.touch()
-        if c.arrays.scalar_commit_update(row):
+        # SELF-slot movement (the flush-clamp release): with a shard
+        # tick frame wired the quorum recompute batches into the next
+        # frame flush (one vectorized call for every group's round);
+        # direct fixtures keep the per-round scalar oracle
+        frame = c._tick_frame
+        if frame is not None:
+            frame.note_self(row)
+        elif c.arrays.scalar_commit_update(row):
             c._notify_commit()
         c.kick_quorum_ackers()
+        t_q0 = time.monotonic()
         quorum_waiters = []
         for it in appended:
             if it.stages.done.done():
@@ -222,6 +238,7 @@ class ReplicateBatcher:
             if it.acks == 1:
                 it.stages.done.set_result((it.base, it.last))
             else:
+                it.t_q0 = t_q0
                 quorum_waiters.append(it)
         if quorum_waiters:
             # resolved inline by consensus._notify_commit (offset-keyed

@@ -314,6 +314,15 @@ class ShardGroupArrays:
         self._free.extend(range(new - 1, old - 1, -1))
         self._cap = new
         self.voter_epoch += 1  # cached voter counts have the old shape
+        # mid-traffic compile stall fix: _grow runs on the control
+        # plane (row allocation), so compiling the device sweep at the
+        # new capacity HERE keeps the next live tick at its
+        # steady-state cost — without this, the first device_tick
+        # after a doubling paid a fresh XLA trace at the new [G, R]
+        # shape while heartbeats starved. Host backend compiles
+        # nothing, so this is free in the default configuration.
+        if self._backend() == "device":
+            self.prewarm()
 
     @property
     def capacity(self) -> int:
@@ -477,6 +486,7 @@ class ShardGroupArrays:
         last_dirty: np.ndarray,
         last_flushed: np.ndarray,
         seqs: np.ndarray,
+        force_rows: "np.ndarray | None" = None,
     ) -> np.ndarray:
         """Vectorized host fold + INCREMENTAL commit step.
 
@@ -487,19 +497,27 @@ class ShardGroupArrays:
           - fold pairs whose match/flushed actually increased,
           - rows whose SELF slot moved since last folded (local append
             or fsync completing between ticks — the flush-clamp release),
-          - rows flagged `quorum_dirty` (configuration changes).
+          - rows flagged `quorum_dirty` (configuration changes),
+          - `force_rows`: rows whose quorum inputs were already folded
+            into the lanes by the caller (the tick frame's pending-reply
+            enqueue path pre-applies cell updates inline for the
+            catch-up fiber's progress checks, so the movement detection
+            above cannot see them — the frame passes those rows here).
 
         Soundness: every OTHER mutation path (per-replicate replies,
-        catch-up, become-leader) calls scalar_commit_update itself, so
-        a row skipped here has had no quorum-input change since the
-        value this sweep last used. Steady-state ticks — the common
-        case at 50k groups — touch no rows and cost O(replies) gathers
-        only, which is what makes a 50k-group live tick fit inside one
-        50 ms heartbeat interval on a single host core.
+        catch-up, become-leader) calls scalar_commit_update itself or
+        enqueues into the tick frame (which forces its rows through
+        here), so a row skipped has had no quorum-input change since
+        the value this sweep last used. Steady-state ticks — the
+        common case at 50k groups — touch no rows and cost O(replies)
+        gathers only, which is what makes a 50k-group live tick fit
+        inside one 50 ms heartbeat interval on a single host core.
         """
         from ..models.consensus_state import SELF_SLOT
 
         changed_rows: list[np.ndarray] = []
+        if force_rows is not None and len(force_rows):
+            changed_rows.append(np.asarray(force_rows, np.int64))
         if len(group_rows):
             fresh = seqs > self.last_seq[group_rows, replica_slots]
             r, s = group_rows[fresh], replica_slots[fresh]
@@ -587,13 +605,16 @@ class ShardGroupArrays:
         last_dirty: np.ndarray,
         last_flushed: np.ndarray,
         seqs: np.ndarray,
+        force_rows: "np.ndarray | None" = None,
     ) -> np.ndarray:
         """Fold a reply batch + advance every group's commit in ONE
         call. The HOST fold is the default at every size (measured:
         the device full-fold is transfer-bound on this link — see
         _backend); RP_QUORUM_BACKEND=device routes to the compiled
         device program for locally attached chips. Returns rows whose
-        commit advanced.
+        commit advanced. `force_rows` (tick-frame pending rows whose
+        lanes were pre-applied by the caller) always recompute — see
+        host_tick.
 
         The reply batch is padded to power-of-two buckets so XLA
         compiles a handful of shapes total, not one per reply count;
@@ -601,15 +622,21 @@ class ShardGroupArrays:
         reply-reordering guard drops (ops.quorum.fold_replies)."""
         if self._backend() == "host":
             return self.host_tick(
-                group_rows, replica_slots, last_dirty, last_flushed, seqs
+                group_rows,
+                replica_slots,
+                last_dirty,
+                last_flushed,
+                seqs,
+                force_rows=force_rows,
             )
         # steady-state skip (mirrors host_tick's incremental sweep): if
-        # no reply can move match/flushed, no SELF slot moved, and no
-        # config changed, fold only the seq guard host-side and skip
-        # the device round-trip entirely
+        # no reply can move match/flushed, no SELF slot moved, no row
+        # is forced, and no config changed, fold only the seq guard
+        # host-side and skip the device round-trip entirely
         from ..models.consensus_state import SELF_SLOT as _SELF
 
-        if len(group_rows) and not self.quorum_dirty.any():
+        forced = force_rows is not None and len(force_rows) > 0
+        if len(group_rows) and not forced and not self.quorum_dirty.any():
             fresh = seqs > self.last_seq[group_rows, replica_slots]
             may_move = (
                 last_dirty[fresh]
@@ -652,15 +679,18 @@ class ShardGroupArrays:
             g_seqs[:m] = seqs
 
         # commit/visible writeback is restricted to the reply rows plus
-        # config-dirtied rows, exactly the set host_tick recomputes —
-        # the two backends must advance IDENTICAL row sets (the
-        # differential tests pin this). match/flushed/last_seq are only
-        # modified by the fold (reply pairs), so full writeback of
-        # those equals partial.
+        # config-dirtied rows plus forced rows, exactly the set
+        # host_tick recomputes — the two backends must advance
+        # IDENTICAL row sets (the differential tests pin this).
+        # match/flushed/last_seq are only modified by the fold (reply
+        # pairs), so full writeback of those equals partial.
         dirty_rows = np.flatnonzero(self.quorum_dirty)
+        parts = [group_rows, dirty_rows]
+        if forced:
+            parts.append(np.asarray(force_rows, np.int64))
         touched = (
-            np.unique(np.concatenate([group_rows, dirty_rows]))
-            if len(group_rows) or len(dirty_rows)
+            np.unique(np.concatenate(parts))
+            if any(len(p) for p in parts)
             else _EMPTY_ROWS
         )
         before = self.commit_index[touched].copy()
@@ -683,10 +713,123 @@ class ShardGroupArrays:
         self.quorum_dirty[:] = False
         return touched[self.commit_index[touched] > before]
 
+    def _gather_heartbeats(self, hb_rows: np.ndarray) -> dict:
+        """Host-side heartbeat payload field gather for a row set —
+        the (a) stage of the tick frame on the numpy backend, same
+        fields as ops.quorum.build_heartbeats."""
+        return {
+            "group": hb_rows,
+            "term": self.term[hb_rows],
+            "commit_index": self.commit_index[hb_rows],
+            "last_dirty": self.match_index[hb_rows, SELF_SLOT],
+            "last_visible": self.last_visible[hb_rows],
+        }
+
+    def frame_tick(  # rplint: hot
+        self,
+        group_rows: np.ndarray,
+        replica_slots: np.ndarray,
+        last_dirty: np.ndarray,
+        last_flushed: np.ndarray,
+        seqs: np.ndarray,
+        hb_rows: "np.ndarray | None" = None,
+        force_rows: "np.ndarray | None" = None,
+    ) -> tuple:
+        """One fused tick frame: fold the window's pending reply
+        columns, advance commits, and (optionally) gather the next
+        frame's heartbeat payload fields for `hb_rows` — the whole
+        live replication plane per tick as one call. Returns
+        (advanced_rows, hb_fields | None).
+
+        On the host backend (default — the device full-fold is
+        transfer-bound on this link, see _backend) the fold+commit
+        runs through the incremental host sweep and the field gather
+        is a handful of numpy takes. RP_QUORUM_BACKEND=device routes
+        everything through ops.quorum.tick_frame_jit: one compiled
+        program produces post-advance state AND the heartbeat vectors,
+        so the payload gather never re-uploads state."""
+        if self._backend() == "host" or hb_rows is None or not len(hb_rows):
+            advanced = self.device_tick(
+                group_rows,
+                replica_slots,
+                last_dirty,
+                last_flushed,
+                seqs,
+                force_rows=force_rows,
+            )
+            hb = (
+                self._gather_heartbeats(hb_rows)
+                if hb_rows is not None and len(hb_rows)
+                else None
+            )
+            return advanced, hb
+        from ..ops.quorum import tick_frame_jit
+
+        m = len(group_rows)
+        bucket = 8
+        while bucket < m:
+            bucket *= 2
+        g_rows = np.zeros(bucket, np.int64)
+        g_slots = np.zeros(bucket, np.int64)
+        g_dirty = np.full(bucket, I64_MIN, np.int64)
+        g_flushed = np.full(bucket, I64_MIN, np.int64)
+        g_seqs = np.full(bucket, I64_MIN, np.int64)
+        if m:
+            g_rows[:m] = group_rows
+            g_slots[:m] = replica_slots
+            g_dirty[:m] = last_dirty
+            g_flushed[:m] = last_flushed
+            g_seqs[:m] = seqs
+        # heartbeat rows padded to their own power-of-two bucket (pad
+        # gathers row 0 and is sliced off) — a handful of compiled
+        # shapes total, same scheme as the reply bucket
+        h = len(hb_rows)
+        hbucket = 8
+        while hbucket < h:
+            hbucket *= 2
+        h_rows = np.zeros(hbucket, np.int64)
+        h_rows[:h] = hb_rows
+        dirty_rows = np.flatnonzero(self.quorum_dirty)
+        parts = [group_rows, dirty_rows]
+        if force_rows is not None and len(force_rows):
+            parts.append(np.asarray(force_rows, np.int64))
+        touched = (
+            np.unique(np.concatenate(parts))
+            if any(len(p) for p in parts)
+            else _EMPTY_ROWS
+        )
+        before = self.commit_index[touched].copy()
+        state = self.to_device_state()
+        new, hb_dev = tick_frame_jit(
+            state, g_rows, g_slots, g_dirty, g_flushed, g_seqs, h_rows
+        )
+        self.commit_index[touched] = np.array(new.commit_index)[touched]  # rplint: disable=RPL002
+        self.last_visible[touched] = np.array(new.last_visible)[touched]  # rplint: disable=RPL002
+        self.match_index = np.array(new.match_index)  # rplint: disable=RPL002
+        self.flushed_index = np.array(new.flushed_index)  # rplint: disable=RPL002
+        self.last_seq = np.array(new.last_seq)  # rplint: disable=RPL002
+        self.touch()
+        self._folded_self_m[touched] = self.match_index[touched, SELF_SLOT]
+        self._folded_self_f[touched] = self.flushed_index[touched, SELF_SLOT]
+        self.quorum_dirty[:] = False
+        hb = {
+            k: np.array(v)[:h]  # rplint: disable=RPL002
+            for k, v in hb_dev.items()
+        }
+        return touched[self.commit_index[touched] > before], hb
+
     def prewarm(self) -> None:
-        """Compile the sweep kernel for the empty bucket up front so
-        the first live tick doesn't stall the event loop on XLA
-        compilation (which would starve heartbeats and trigger
-        spurious elections)."""
+        """Compile the sweep kernels for the empty reply bucket (and,
+        on the device backend, the fused frame's minimum heartbeat
+        bucket) up front so the first live tick doesn't stall the
+        event loop on XLA compilation (which would starve heartbeats
+        and trigger spurious elections). Re-invoked by _grow so a
+        capacity doubling never hands the next tick a fresh trace at
+        the new [G, R] shape (the mid-traffic compile stall)."""
         empty = np.array([], np.int64)
         self.device_tick(empty, empty, empty, empty, empty)
+        if self._backend() == "device":
+            self.frame_tick(
+                empty, empty, empty, empty, empty,
+                hb_rows=np.zeros(1, np.int64),
+            )

@@ -368,6 +368,31 @@ class PartitionShard:
             lambda: self.recorder.trees_total,
             "span trees completed on this shard",
         )
+        # per-shard tick frame (raft/tick_frame.py): window sizes tell
+        # whether the live replication plane is actually batching —
+        # replies/flush near 1.0 means the frame degenerated to the
+        # old per-reply cadence
+        tf = self.group_manager.tick_frame
+        self.metrics.gauge(
+            "shard_tick_frame_flushes_total",
+            lambda: tf.flushes,
+            "tick-frame windows folded on this shard",
+        )
+        self.metrics.gauge(
+            "shard_tick_frame_replies_total",
+            lambda: tf.replies_folded,
+            "append replies folded through this shard's tick frames",
+        )
+        self.metrics.gauge(
+            "shard_tick_frame_max_batch",
+            lambda: tf.max_batch,
+            "largest reply window one tick-frame fold covered",
+        )
+        self.metrics.gauge(
+            "shard_tick_frame_pending",
+            lambda: tf.pending,
+            "replies + forced rows awaiting the next tick-frame flush",
+        )
 
     async def start(self) -> None:
         await self.group_manager.start()

@@ -860,3 +860,124 @@ def test_rpl010_suppression(tmp_path):
         'Counter("my_requests_total", "requests")  # rplint: disable=RPL010',
     )
     assert _only(_lint_source(tmp_path, src, "kafka/mod.py"), "RPL010") == []
+
+
+# -- RPL011: tick discipline -------------------------------------------
+
+RPL011_SWEEP_IN_TICK = """
+    class HeartbeatManager:
+        def tick(self):
+            for c in self._groups.values():
+                c.build_heartbeat()
+"""
+
+
+def test_rpl011_reports_group_sweep_in_tick_fn(tmp_path):
+    (f,) = _only(
+        _lint_source(tmp_path, RPL011_SWEEP_IN_TICK, "raft/mod.py"),
+        "RPL011",
+    )
+    assert "_groups" in f.message and "O(window)" in f.message
+    assert f.qualname == "HeartbeatManager.tick"
+
+
+def test_rpl011_tick_frame_module_covered_everywhere(tmp_path):
+    src = """
+        class TickFrame:
+            def drain(self):
+                return [c.row for c in self.gm.groups()]
+    """
+    (f,) = _only(
+        _lint_source(tmp_path, src, "raft/tick_frame.py"), "RPL011"
+    )
+    assert "groups()" in f.message
+
+
+def test_rpl011_by_row_comprehension_in_ssx_tick(tmp_path):
+    src = """
+        def frame_tick_all(self):
+            rows = {r for r in self._by_row}
+            return rows
+    """
+    (f,) = _only(_lint_source(tmp_path, src, "ssx/mod.py"), "RPL011")
+    assert "_by_row" in f.message
+
+
+def test_rpl011_shard_state_exempt(tmp_path):
+    assert (
+        _only(
+            _lint_source(
+                tmp_path, RPL011_SWEEP_IN_TICK, "raft/shard_state.py"
+            ),
+            "RPL011",
+        )
+        == []
+    )
+
+
+def test_rpl011_non_tick_fn_and_non_plane_paths_clean(tmp_path):
+    sweep_outside_tick = RPL011_SWEEP_IN_TICK.replace(
+        "def tick", "def rebalance"
+    )
+    assert (
+        _only(
+            _lint_source(tmp_path, sweep_outside_tick, "raft/mod.py"),
+            "RPL011",
+        )
+        == []
+    )
+    assert (
+        _only(
+            _lint_source(
+                tmp_path, RPL011_SWEEP_IN_TICK, "cluster/mod.py"
+            ),
+            "RPL011",
+        )
+        == []
+    )
+
+
+def test_rpl011_window_bounded_residue_loop_clean(tmp_path):
+    src = """
+        class TickFrame:
+            def fold(self, advanced):
+                for r in advanced:
+                    cb = self._by_row.get(int(r))
+                    if cb is not None:
+                        cb()
+    """
+    assert (
+        _only(
+            _lint_source(tmp_path, src, "raft/tick_frame.py"), "RPL011"
+        )
+        == []
+    )
+
+
+def test_rpl011_reply_groups_attribute_clean(tmp_path):
+    src = """
+        class Service:
+            def handle_tick(self, reply):
+                for i, g in enumerate(reply.groups):
+                    self.apply(i, g)
+    """
+    assert (
+        _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL011") == []
+    )
+
+
+def test_rpl011_suppression(tmp_path):
+    src = RPL011_SWEEP_IN_TICK.replace(
+        "for c in self._groups.values():",
+        "for c in self._groups.values():  # rplint: disable=RPL011",
+    )
+    assert (
+        _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL011") == []
+    )
+
+
+def test_rpl011_baseline_is_empty():
+    """Tick discipline is fully enforced from day one: nothing
+    grandfathered."""
+    baseline = load_baseline()
+    assert [k for k in baseline if k.endswith("::RPL011")] == []

@@ -12,6 +12,7 @@ from .rpl007_native_symbols import NativeSymbolRule
 from .rpl008_trace_discipline import TraceDisciplineRule
 from .rpl009_shard_discipline import ShardDisciplineRule
 from .rpl010_metrics_discipline import MetricsDisciplineRule
+from .rpl011_tick_discipline import TickDisciplineRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -24,6 +25,7 @@ ALL_RULES = [
     TraceDisciplineRule,
     ShardDisciplineRule,
     MetricsDisciplineRule,
+    TickDisciplineRule,
 ]
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,122 @@
+"""RPL011 — tick discipline: no per-group Python sweeps inside the
+tick frame.
+
+The batched replication plane (raft/tick_frame.py + the tick methods
+it feeds) exists to take per-group quorum math off the interpreter:
+one vectorized `ShardGroupArrays.frame_tick` per dispatch window,
+regardless of how many groups are registered. The whole win dies
+quietly if a per-group Python loop creeps back into a tick-frame code
+path — `for c in self._groups.values(): ...` inside a tick restores
+O(groups) interpreter work per tick and nobody notices until the
+100k-partition bench regresses (the r5 shape: a 30 us/group residue
+loop is 3 ms/tick at 100k, 60% of the 50 ms interval gone at p99
+burst).
+
+Scope — the tick-frame code paths:
+
+  * `raft/tick_frame.py`, every scope (the batching seam itself)
+  * functions under redpanda_tpu/raft/ and redpanda_tpu/ssx/ whose
+    name contains "tick" (HeartbeatManager.tick, frame drivers, ...)
+
+with `shard_state.py` explicitly EXEMPT: the SoA owner is the one
+module allowed to touch rows in Python (its loops are over touched /
+changed rows, already bounded by the window).
+
+Flagged: a `for` loop or comprehension whose ITERABLE references the
+registered-group set — an attribute named `_groups` or `_by_row`
+(including `.values()` / `.items()` / `.keys()` views over them) or a
+`.groups()` call. Loops whose iterable is a window-bounded result
+(advanced rows, a dispatch plan, a reply batch) are fine — the rule
+looks at what is being iterated, not what the body reads, so
+`self._by_row.get(row)` lookups keyed by a bounded set don't flag.
+
+Suppress a deliberate exception with `# rplint: disable=RPL011`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext
+
+_REGISTRY_ATTRS = {"_groups", "_by_row"}
+_EXEMPT_FILES = ("shard_state.py",)
+
+
+def _path_parts(path: str) -> list[str]:
+    return path.replace("\\", "/").split("/")
+
+
+def _registry_ref(iter_node: ast.AST) -> str | None:
+    """Dotted description of a registered-group reference inside an
+    iterable expression, or None."""
+    for sub in ast.walk(iter_node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _REGISTRY_ATTRS:
+            return sub.attr
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "groups"
+        ):
+            return "groups()"
+    return None
+
+
+class TickDisciplineRule:
+    code = "RPL011"
+    name = "tick-discipline"
+
+    def check(self, ctx: ModuleContext):
+        parts = _path_parts(ctx.path)
+        fname = parts[-1]
+        if fname in _EXEMPT_FILES:
+            return
+        in_plane = "raft" in parts or "ssx" in parts
+        if not in_plane:
+            return
+        whole_file = fname == "tick_frame.py"
+        # (scope, loops-to-check) pairs: whole file for the seam
+        # module, tick-named functions elsewhere
+        scopes = []
+        if whole_file:
+            scopes.append(("", ctx.tree))
+        else:
+            for fn in ctx.functions():
+                if "tick" in fn.node.name.lower():
+                    scopes.append((fn.qualname, fn.node))
+        seen: set[int] = set()
+        for qualname, root in scopes:
+            for node in ast.walk(root):
+                if isinstance(node, ast.For):
+                    iters = [node.iter]
+                elif isinstance(
+                    node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                           ast.GeneratorExp)
+                ):
+                    iters = [g.iter for g in node.generators]
+                else:
+                    continue
+                if id(node) in seen:  # nested tick fns walk twice
+                    continue
+                for it in iters:
+                    ref = _registry_ref(it)
+                    if ref is None:
+                        continue
+                    seen.add(id(node))
+                    if ctx.suppressed(node, self.code):
+                        break
+                    yield Finding(
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.code,
+                        message=(
+                            f"per-group Python loop over {ref} in a "
+                            "tick-frame code path — the tick must stay "
+                            "O(window), not O(registered groups); batch "
+                            "through ShardGroupArrays.frame_tick or move "
+                            "the sweep off the tick"
+                        ),
+                        qualname=qualname,
+                    )
+                    break

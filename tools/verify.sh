@@ -46,6 +46,12 @@ env JAX_PLATFORMS=cpu RP_SHARDS=0 python -m pytest \
     -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+echo "== tick-frame smoke (100k-partition live replication plane) =="
+env JAX_PLATFORMS=cpu python tools/tick_frame_smoke.py
+
+echo "== tick-frame backend parity (host fallback vs device) =="
+env JAX_PLATFORMS=cpu python tools/tick_frame_smoke.py --parity --groups 4096
+
 echo "== tracing-off smoke (RP_TRACE=0) =="
 env JAX_PLATFORMS=cpu RP_TRACE=0 python tools/scrape_smoke.py --fleet
 exec env JAX_PLATFORMS=cpu RP_TRACE=0 python -m pytest \
