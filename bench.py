@@ -287,6 +287,7 @@ async def _live_tick_async(n_groups: int) -> dict:
         }
         if os.environ.get("RP_BENCH_PROBES") == "1":
             out["stages"] = _stage_quantiles(gms[0].probe)
+        out["health"] = _bench_health(gms[0])
         return out
     finally:
         for gm in gms.values():
@@ -318,6 +319,19 @@ def _stage_quantiles(probe) -> dict:
             "p99_ms": round(c.quantile(0.99) * 1e3, 3),
         }
     return out
+
+
+def _bench_health(gm) -> dict:
+    """Partition-health rollup of the bench fleet: the same reduction
+    the admin plane serves, sampled once AFTER the timed loop so the
+    sample never lands inside a measured tick."""
+    rep = gm.health_report(top_k=5)
+    return {
+        "max_follower_lag": rep["max_follower_lag"],
+        "under_replicated": rep["under_replicated"],
+        "leaderless": rep["leaderless"],
+        "shard_skew": round(gm.probe.ledger.skew(), 3),
+    }
 
 
 # -------------------------------------------- replicated tick (100k live)
@@ -355,6 +369,7 @@ def bench_replicated_tick() -> dict:
             big["steady_p50_ms"] * 1e6 / n, 1
         ),
         "tick_frame_replies": big["tick_frame_replies"],
+        "health": big.get("health"),
         "small": small,
         "big": big,
     }
@@ -1073,6 +1088,26 @@ async def _replicated_async() -> dict:
                 }
                 for s, m in per_stage.items()
             }
+        # partition-health rollup across the 3 brokers (sampled after
+        # the timed window); skew here is cross-broker load imbalance
+        from redpanda_tpu.observability.health import (
+            build_report,
+            merge_reports,
+        )
+
+        merged_health = merge_reports(
+            [
+                build_report(b.group_manager, b.load_ledger, top_k=5)
+                for b in brokers
+            ],
+            top_k=5,
+        )
+        out["health"] = {
+            "max_follower_lag": merged_health["max_follower_lag"],
+            "under_replicated": merged_health["under_replicated"],
+            "leaderless": merged_health["leaderless"],
+            "shard_skew": round(merged_health["shard_skew"], 3),
+        }
         return out
     finally:
         if client is not None:
@@ -1543,6 +1578,43 @@ async def _slo_async(prof: dict) -> dict:
                     "pass": ok,
                 }
             )
+        # optional partition-health SLO: a profile may declare
+        # slo.max_lag (entries) — graded once against the merged
+        # post-sweep fleet health (followers must have drained)
+        from redpanda_tpu.observability.health import (
+            build_report,
+            merge_reports,
+        )
+
+        fleet_health = merge_reports(
+            [
+                build_report(b.group_manager, b.load_ledger, top_k=5)
+                for b in brokers
+            ],
+            top_k=5,
+        )
+        health_out = {
+            "max_follower_lag": fleet_health["max_follower_lag"],
+            "under_replicated": fleet_health["under_replicated"],
+            "leaderless": fleet_health["leaderless"],
+            "shard_skew": round(fleet_health["shard_skew"], 3),
+        }
+        slo_out = {"p99_ms": slo_p99, "p999_ms": slo_p999}
+        slo_max_lag = slo.get("max_lag")
+        if slo_max_lag is not None:
+            slo_out["max_lag"] = int(slo_max_lag)
+            verdicts.append(
+                {
+                    "rate_per_s": "health",
+                    "max_follower_lag": health_out["max_follower_lag"],
+                    "checks": {
+                        "max_lag": health_out["max_follower_lag"]
+                        <= int(slo_max_lag)
+                    },
+                    "pass": health_out["max_follower_lag"]
+                    <= int(slo_max_lag),
+                }
+            )
         return {
             "metric": f"slo_{prof['profile']}_worst_p99_ms",
             "value": round(worst_p99, 2),
@@ -1552,8 +1624,9 @@ async def _slo_async(prof: dict) -> dict:
                 round(slo_p99 / worst_p99, 3) if worst_p99 > 0 else -1
             ),
             "slo_profile": prof["profile"],
-            "slo": {"p99_ms": slo_p99, "p999_ms": slo_p999},
+            "slo": slo_out,
             "slo_pass": all(v["pass"] for v in verdicts),
+            "health": health_out,
             "interleaved_rounds": rounds,
             "round_s": round_s,
             "brokers": n_brokers,

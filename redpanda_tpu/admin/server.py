@@ -55,6 +55,7 @@ class AdminServer(HttpServer):
         r("PUT", r"/v1/brokers/(\d+)/maintenance", self._maintenance_on)
         r("DELETE", r"/v1/brokers/(\d+)/maintenance", self._maintenance_off)
         r("GET", r"/v1/cluster/health_overview", self._health)
+        r("GET", r"/v1/cluster/partition_health", self._partition_health)
         r("GET", r"/v1/cluster/stats", self._cluster_stats)
         r("GET", r"/v1/cluster_config", self._get_config)
         r("PUT", r"/v1/cluster_config", self._put_config)
@@ -244,13 +245,54 @@ class AdminServer(HttpServer):
     async def _maintenance_off(self, m, _q, _b):
         return await self._set_maintenance(m, False)
 
+    async def _local_health_reports(self, top_k: int = 10) -> list[dict]:
+        """This node's per-shard partition-health reports: the local
+        shard's live ledger plus every worker shard over invoke_on.
+        Unreachable workers are skipped (and counted like a failed
+        fleet scrape) rather than failing the endpoint."""
+        from ..observability import health as _health
+
+        local = _health.build_report(
+            self.broker.group_manager, self.broker.load_ledger, top_k=top_k
+        )
+        for row in local["top_laggy"]:
+            row["shard"] = 0
+        for row in local["top_hot"]:
+            row["shard"] = 0
+        reports = [local]
+        router = getattr(self.broker, "shard_router", None)
+        if router is not None:
+            from ..ssx.shards import InvokeError
+
+            for sid in router.worker_shards():
+                try:
+                    reports.append(await router.obs_health(sid))
+                except InvokeError:
+                    self.broker.metrics.counter(
+                        "fleet_scrape_errors_total",
+                        "worker shard snapshots that failed during a "
+                        "fleet scrape",
+                    ).inc(shard=str(sid))
+        return reports
+
     async def _health(self, _m, _q, _b):
+        # node/membership view still comes from the health monitor, but
+        # the partition counts are derived from the live raft health
+        # lanes (leaderless/under-replicated within one tick frame)
+        # rather than the thin controller snapshot. Additive keys only:
+        # the pre-existing schema is unchanged.
+        from ..observability.health import merge_reports
+
         rep = self.broker.health_monitor.report()
+        live = merge_reports(await self._local_health_reports())
         return {
             "controller_id": rep.controller_id,
             "all_nodes": [n.node_id for n in rep.nodes],
             "nodes_down": rep.nodes_down,
-            "leaderless_partitions": rep.leaderless_partitions,
+            "leaderless_partitions": live["leaderless"],
+            "under_replicated_partitions": live["under_replicated"],
+            "max_follower_lag": live["max_follower_lag"],
+            "active_partitions": live["active"],
             "nodes": [
                 {
                     "node_id": n.node_id,
@@ -260,6 +302,25 @@ class AdminServer(HttpServer):
                 for n in rep.nodes
             ],
         }
+
+    async def _partition_health(self, _m, q, _b):
+        """Bounded partition-health detail: merged per-shard reports —
+        aggregate counters, top-k laggy/hot partitions, the fixed lag
+        distribution, and the shard skew index."""
+        from ..observability.health import lag_bucket_edges, merge_reports
+
+        try:
+            top_k = max(1, min(100, int(q.get("top_k", 10) or 10)))
+        except ValueError:
+            raise HttpError(
+                400, f"bad top_k {q.get('top_k')!r}"
+            ) from None
+        merged = merge_reports(
+            await self._local_health_reports(top_k), top_k=top_k
+        )
+        merged["node_id"] = self.broker.node_id
+        merged["lag_bucket_edges"] = lag_bucket_edges()
+        return merged
 
     async def _get_config(self, _m, _q, _b):
         cfg = self.broker.controller.cluster_config

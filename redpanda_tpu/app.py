@@ -180,8 +180,12 @@ class Broker:
         # flight recorder (observability/trace.py): per-broker ring of
         # span trees + slow-request freezer, dumped at /v1/debug/traces
         from .observability import FlightRecorder
+        from .observability.load_ledger import LoadLedger
 
         self.recorder = FlightRecorder(node_id=config.node_id)
+        # one per-NTP load ledger per broker, shared by the kafka and
+        # raft probes so produce/fetch/append rates merge per partition
+        self.load_ledger = LoadLedger()
         if object_store is None and config.cloud_storage_endpoint is not None:
             from .cloud.s3_client import S3ObjectStore, StaticCredentialsProvider
 
@@ -231,7 +235,17 @@ class Broker:
             heartbeat_interval_s=config.heartbeat_interval_s,
             kvstore=self.storage.kvs,
             metrics=self.metrics,
+            load_ledger=self.load_ledger,
         )
+        # bounded partition-health exporter over the raft health lanes
+        # + load ledger (observability/health.py is the one RPL012-
+        # exempt surface where per-NTP keys become label values)
+        from .observability.health import HealthSampler, register_exporter
+
+        self.health_sampler = HealthSampler(
+            self.group_manager, self.load_ledger
+        )
+        register_exporter(self.metrics, self.health_sampler)
         self.shard_table = ShardTable()
         # set by ssx.ShardedBroker when worker shards are active; None
         # keeps every kafka/controller shard seam on the local path

@@ -48,6 +48,9 @@ class PartitionManager:
         consensus = await self._group_manager.create_group(
             group_id, voters=replicas, log=log
         )
+        # ntp-form ledger key: raft append rates land under the same
+        # key the kafka produce/fetch hooks use for this partition
+        consensus.ledger_key = f"{ntp.ns}/{ntp.topic}/{ntp.partition}"
         p = Partition(ntp, group_id, consensus)
         p.producer_expiry_ms = self.producer_expiry_ms
         self._ntp_table[ntp] = p
@@ -60,6 +63,9 @@ class PartitionManager:
             return
         self._group_table.pop(p.group_id, None)
         p.close()
+        self._group_manager.probe.ledger.forget(
+            f"{ntp.ns}/{ntp.topic}/{ntp.partition}"
+        )
         await self._group_manager.remove_group(p.group_id)
         self._log_manager.remove(ntp)
 

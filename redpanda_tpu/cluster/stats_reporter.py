@@ -45,6 +45,21 @@ class StatsReporter:
             }
         except Exception:
             pass
+        # live partition-health plane (this shard's raft lanes + load
+        # ledger) — cheap: one vectorized refresh behind a 0.25s cache
+        try:
+            live = self.broker.health_sampler.report()
+            if health is None:
+                health = {}
+            health.update(
+                {
+                    "max_follower_lag": live["max_follower_lag"],
+                    "under_replicated": live["under_replicated"],
+                    "load_skew": live["skew"],
+                }
+            )
+        except Exception:
+            pass
         # shard-per-core liveness: until PR 6 this report silently
         # described only the parent process even under --shards N
         router = getattr(self.broker, "shard_router", None)

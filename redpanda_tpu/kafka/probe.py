@@ -25,8 +25,18 @@ _FETCH = 1
 
 
 class KafkaProbe:
-    def __init__(self, metrics: MetricsRegistry):
+    def __init__(self, metrics: MetricsRegistry, ledger=None):
         self.registry = metrics
+        # per-NTP load ledger leg (observability/load_ledger): shared
+        # with the raft probe when the broker wires one, so the
+        # hot-partition view merges produce/fetch/append rates
+        if ledger is None:
+            from ..observability.load_ledger import LoadLedger
+
+            ledger = LoadLedger()
+        self.ledger = ledger
+        self.note_produce = ledger.note_produce
+        self.note_fetch = ledger.note_fetch
         self.stage_hist = metrics.histogram(
             "kafka_request_stage_seconds",
             "Produce/fetch stage latency (decode -> dispatch -> done)",

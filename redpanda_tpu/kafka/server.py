@@ -202,7 +202,9 @@ class KafkaServer:
         # label children resolved here, hot path pays bound observes
         from .probe import KafkaProbe
 
-        self.probe = KafkaProbe(broker.metrics)
+        self.probe = KafkaProbe(
+            broker.metrics, ledger=getattr(broker, "load_ledger", None)
+        )
         # hdr_hist quantiles (latency_probe.h): bounded-relative-error
         # percentiles the log2 Prometheus buckets cannot resolve
         from ..utils.hdr_hist import HdrHist
@@ -991,6 +993,10 @@ class KafkaServer:
                             error_code=int(ErrorCode.invalid_request),
                             base_offset=-1,
                         )
+                    self.probe.note_produce(
+                        f"{ntp.ns}/{ntp.topic}/{ntp.partition}",
+                        len(p.records),
+                    )
                     fut = asyncio.ensure_future(
                         self.broker.shard_router.produce(
                             shard, ntp, bytes(p.records), acks
@@ -1034,6 +1040,9 @@ class KafkaServer:
                     "uncompressed": CompressionType.none,
                     "none": CompressionType.none,
                 }.get(want)
+            self.probe.note_produce(
+                f"{ntp.ns}/{ntp.topic}/{ntp.partition}", len(p.records)
+            )
             entries: list[tuple] = []
             try:
                 # memoryview straight from the request frame: the
@@ -1430,6 +1439,11 @@ class KafkaServer:
                         continue  # read_all answers not_leader (retriable)
                     wire = bytes(rep.records)
                     budget -= len(wire)
+                    if wire:
+                        self.probe.note_fetch(
+                            f"{ntp.ns}/{ntp.topic}/{ntp.partition}",
+                            len(wire),
+                        )
                     shard_rows[(t.topic, p.partition)] = Msg(
                         partition_index=p.partition,
                         error_code=rep.error,
@@ -1599,6 +1613,11 @@ class KafkaServer:
                         _frame_kafka(batch, kbase) for kbase, batch in pairs
                     )
                     total += len(wire)
+                    if wire:
+                        self.probe.note_fetch(
+                            f"{DEFAULT_NS}/{t.topic}/{p.partition}",
+                            len(wire),
+                        )
                     aborted = None
                     if read_committed and pairs:
                         fetch_end = (

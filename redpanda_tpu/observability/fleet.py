@@ -106,6 +106,133 @@ class RegistrySnapshot(Envelope):
     ]
 
 
+class LaggyRow(Envelope):
+    """One top-k laggy partition sample (bounded by k, never per-NTP)."""
+
+    SERDE_FIELDS = [
+        ("key", string),
+        ("group", i64),
+        ("lag", i64),
+        ("under", boolean),
+    ]
+
+
+class HotRow(Envelope):
+    """One top-k hot partition sample from the load ledger."""
+
+    SERDE_FIELDS = [
+        ("key", string),
+        ("total_bps", f64),
+        ("produce_bps", f64),
+        ("fetch_bps", f64),
+        ("append_bps", f64),
+    ]
+
+
+class HealthSnapshot(Envelope):
+    """One shard's partition-health report on the wire: aggregate
+    counts, per-kind byte rates, top-k rows and the fixed-width lag
+    distribution (observability/health.py builds and merges these)."""
+
+    SERDE_FIELDS = [
+        ("shard", i32),
+        ("node", i32),
+        ("active", u64),
+        ("max_lag", i64),
+        ("under_replicated", u64),
+        ("leaderless", u64),
+        ("skew", f64),
+        ("produce_bps", f64),
+        ("fetch_bps", f64),
+        ("append_bps", f64),
+        ("total_bps", f64),
+        ("top_laggy", vector(envelope(LaggyRow))),
+        ("top_hot", vector(envelope(HotRow))),
+        ("lag_hist", vector(u64)),
+    ]
+
+
+def health_to_envelope(rep: dict, shard: int, node: int = -1) -> HealthSnapshot:
+    """observability.health report dict -> wire envelope."""
+    rates = rep.get("rates") or {}
+    return HealthSnapshot(
+        shard=shard,
+        node=node,
+        active=rep.get("active", 0),
+        max_lag=rep.get("max_follower_lag", 0),
+        under_replicated=rep.get("under_replicated", 0),
+        leaderless=rep.get("leaderless", 0),
+        skew=rep.get("skew", 1.0),
+        produce_bps=rates.get("produce_bps", 0.0),
+        fetch_bps=rates.get("fetch_bps", 0.0),
+        append_bps=rates.get("append_bps", 0.0),
+        total_bps=rates.get("total_bps", 0.0),
+        top_laggy=[
+            LaggyRow(
+                key=r["key"],
+                group=r.get("group", -1),
+                lag=r.get("lag", 0),
+                under=bool(r.get("under_replicated")),
+            )
+            for r in rep.get("top_laggy", [])
+        ],
+        top_hot=[
+            HotRow(
+                key=r["key"],
+                total_bps=r.get("total_bps", 0.0),
+                produce_bps=r.get("produce_bps", 0.0),
+                fetch_bps=r.get("fetch_bps", 0.0),
+                append_bps=r.get("append_bps", 0.0),
+            )
+            for r in rep.get("top_hot", [])
+        ],
+        lag_hist=[int(c) for c in rep.get("lag_histogram", [])],
+    )
+
+
+def envelope_to_health(snap: HealthSnapshot) -> dict:
+    """Wire envelope -> the same dict shape health.build_report emits
+    (plus shard/node provenance), so merge_reports folds local and
+    remote shards identically."""
+    return {
+        "shard": snap.shard,
+        "node": snap.node,
+        "active": snap.active,
+        "max_follower_lag": snap.max_lag,
+        "under_replicated": snap.under_replicated,
+        "leaderless": snap.leaderless,
+        "skew": snap.skew,
+        "rates": {
+            "produce_bps": snap.produce_bps,
+            "fetch_bps": snap.fetch_bps,
+            "append_bps": snap.append_bps,
+            "total_bps": snap.total_bps,
+        },
+        "top_laggy": [
+            {
+                "key": r.key,
+                "group": r.group,
+                "lag": r.lag,
+                "under_replicated": r.under,
+                "shard": snap.shard,
+            }
+            for r in snap.top_laggy
+        ],
+        "top_hot": [
+            {
+                "key": r.key,
+                "total_bps": r.total_bps,
+                "produce_bps": r.produce_bps,
+                "fetch_bps": r.fetch_bps,
+                "append_bps": r.append_bps,
+                "shard": snap.shard,
+            }
+            for r in snap.top_hot
+        ],
+        "lag_histogram": list(snap.lag_hist),
+    }
+
+
 # ------------------------------------------------------------- snapshot
 def snapshot_registry(
     reg: MetricsRegistry, shard: int, node: int = -1

@@ -100,6 +100,10 @@ class Consensus:
         tick_frame=None,
     ):
         self.group_id = group_id
+        # load-ledger key for this replicated log; partition_manager
+        # rewrites it to the ntp form ("ns/topic/partition") so raft
+        # append rates merge with kafka produce/fetch rates per NTP
+        self.ledger_key = f"group/{group_id}"
         self.node_id = node_id
         self.config = config
         self.log = log

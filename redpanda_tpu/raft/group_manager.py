@@ -32,6 +32,7 @@ class GroupManager:
         metrics=None,
         shard_id: int = 0,
         shard_count: int = 1,
+        load_ledger=None,
     ):
         self.node_id = node_id
         self.data_dir = data_dir
@@ -64,7 +65,7 @@ class GroupManager:
         # one per partition; the families aggregate the same way)
         from .probe import RaftProbe
 
-        self.probe = RaftProbe(metrics)
+        self.probe = RaftProbe(metrics, ledger=load_ledger)
         # shard tick frame: per-reply quorum math from every group
         # batches into one vectorized call per dispatch window
         # (raft/tick_frame.py); the heartbeat fold merges into it too
@@ -224,6 +225,45 @@ class GroupManager:
 
         await asyncio.sleep(delay)
         await c.try_election()
+
+    def health_report(self, top_k: int = 10) -> dict:
+        """Partition-health rollup over this shard's raft lanes: one
+        vectorized refresh (ops.health via the selected backend), then
+        aggregate counts, the fixed lag distribution, and a top-k laggy
+        list resolved row -> group through the registry — never a walk
+        over all groups."""
+        import numpy as np
+
+        from ..observability.health import lag_histogram
+
+        a = self.arrays
+        a.health_refresh()
+        rep = a.health_totals()
+        lag = a.health_max_lag
+        lead = a.is_leader & a.row_active
+        rep["lag_histogram"] = lag_histogram(lag[lead])
+        top: list[dict] = []
+        k = min(top_k, len(lag))
+        if k and lead.any():
+            idx = np.argpartition(lag, -k)[-k:]
+            idx = idx[np.argsort(lag[idx])[::-1]]
+            for row in idx:
+                row = int(row)
+                if lag[row] <= 0:
+                    break
+                c = self._by_row.get(row)
+                if c is None:
+                    continue
+                top.append(
+                    {
+                        "key": c.ledger_key,
+                        "group": c.group_id,
+                        "lag": int(lag[row]),
+                        "under_replicated": bool(a.health_under[row]),
+                    }
+                )
+        rep["top_laggy"] = top
+        return rep
 
     async def create_group(
         self,

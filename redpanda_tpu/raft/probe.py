@@ -24,9 +24,20 @@ from ..metrics import MetricsRegistry
 
 
 class RaftProbe:
-    def __init__(self, metrics: Optional[MetricsRegistry] = None):
+    def __init__(
+        self, metrics: Optional[MetricsRegistry] = None, ledger=None
+    ):
         m = metrics if metrics is not None else MetricsRegistry()
         self.registry = m
+        # per-NTP load ledger leg (observability/load_ledger): the
+        # broker shares ONE ledger across kafka+raft probes so the
+        # hot-partition view merges produce/fetch/append rates
+        if ledger is None:
+            from ..observability.load_ledger import LoadLedger
+
+            ledger = LoadLedger()
+        self.ledger = ledger
+        self.note_append = ledger.note_append
         self.append_hist = m.histogram(
             "raft_append_seconds",
             "Leader log append per coalesced flush round",

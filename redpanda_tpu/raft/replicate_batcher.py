@@ -202,6 +202,7 @@ class ReplicateBatcher:
                         it.stages.done.set_result((it.base, it.last))
                     appended.append(it)
         c.probe.observe_append(time.monotonic() - t_append)
+        c.probe.note_append(c.ledger_key, sum(it.size for it in items))
         spans.add("batcher.round_items", float(len(items)))
         self.flush_rounds += 1
         with trace.span("raft.flush", parent=items[0].span):

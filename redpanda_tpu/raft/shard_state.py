@@ -23,6 +23,7 @@ from ..models.consensus_state import (
     SELF_SLOT,
     GroupState,
 )
+from ..ops.health import health_reduce_np
 from . import quorum_scalar as qs
 
 I64_MIN = np.int64(np.iinfo(np.int64).min)
@@ -163,6 +164,15 @@ class ShardGroupArrays:
         self.el_timeout = np.full(g, 3600.0, np.float64)
         self.el_jitter = np.zeros(g, np.float64)
         self.last_el = np.zeros(g, np.float64)
+        # health lanes (ops.health): refreshed for changed rows by the
+        # per-tick sweep (host) or the fused frame program (device);
+        # `health_refresh` recomputes all rows on demand. row_active
+        # distinguishes allocated rows from free-list residents so a
+        # recycled row never reads as a leaderless partition.
+        self.row_active = np.zeros(g, bool)
+        self.health_max_lag = np.zeros(g, np.int64)
+        self.health_under = np.zeros(g, bool)
+        self.health_leaderless = np.zeros(g, bool)
         # count of live append/catch-up fibers per follower slot — the
         # heartbeat manager suppresses beats to slots a fiber is
         # actively driving (consensus::suppress_heartbeats /
@@ -210,6 +220,7 @@ class ShardGroupArrays:
             self._grow()
         row = self._free.pop()
         self._alloc_count += 1
+        self.row_active[row] = True
         return row
 
     def free_row(self, row: int) -> None:
@@ -247,6 +258,10 @@ class ShardGroupArrays:
         self.el_jitter[row] = 0.0
         self.last_el[row] = 0.0
         self.same_cover_node[row] = -1
+        self.row_active[row] = False
+        self.health_max_lag[row] = 0
+        self.health_under[row] = False
+        self.health_leaderless[row] = False
         self.touch()
 
     def _grow(self) -> None:
@@ -280,6 +295,10 @@ class ShardGroupArrays:
             "el_jitter",
             "last_el",
             "same_cover_node",
+            "row_active",
+            "health_max_lag",
+            "health_under",
+            "health_leaderless",
         ):
             arr = getattr(self, name)
             shape = (new,) + arr.shape[1:]
@@ -479,6 +498,81 @@ class ShardGroupArrays:
             self._voter_cache = cache
         return cache[1], cache[2], cache[3]
 
+    # -- partition health (ops.health) --------------------------------
+    # Incremental in-fold refresh is bounded: beyond this touched-row
+    # count the fancy-indexed gather costs milliseconds (18 ms at 100k
+    # rows) while every lane reader calls health_refresh() anyway, so
+    # a giant fold defers to the on-read authoritative recompute.
+    HEALTH_INCR_CAP = 2048
+
+    def _health_np_rows(self, rows: np.ndarray) -> None:
+        """Refresh the health lanes for a row subset with the numpy
+        mirror of the device reduction — hooked onto the sweep's
+        changed-row set, so steady-state ticks pay nothing and hot rows
+        never read stale. Oversized sets (full-frame folds) skip: the
+        read path's health_refresh() is always authoritative."""
+        if not len(rows) or len(rows) > self.HEALTH_INCR_CAP:
+            return
+        h = health_reduce_np(
+            self.match_index[rows],
+            self.commit_index[rows],
+            self.is_voter[rows],
+            self.is_voter_old[rows],
+            self.is_leader[rows],
+            self.leader_id[rows] >= 0,
+            self.row_active[rows],
+        )
+        self.health_max_lag[rows] = h["max_lag"]
+        self.health_under[rows] = h["under_replicated"]
+        self.health_leaderless[rows] = h["leaderless"]
+
+    def health_refresh(self) -> None:
+        """Authoritative all-rows health recompute via the selected
+        backend (RP_QUORUM_BACKEND, same seam as the quorum fold).
+        Endpoints call this before reading the lanes, so the reported
+        view is never staler than the request — and leader_id changes
+        (which don't dirty the quorum sweep) are always reflected."""
+        if self._backend() == "device":
+            import jax.numpy as jnp
+
+            from ..ops.health import health_reduce_jit
+
+            h = health_reduce_jit(
+                jnp.asarray(self.match_index),
+                jnp.asarray(self.commit_index),
+                jnp.asarray(self.is_voter),
+                jnp.asarray(self.is_voter_old),
+                jnp.asarray(self.is_leader),
+                jnp.asarray(self.leader_id >= 0),
+                jnp.asarray(self.row_active),
+            )
+            # control-plane read path, not the per-tick sweep
+            self.health_max_lag = np.array(h["max_lag"])  # rplint: disable=RPL002
+            self.health_under = np.array(h["under_replicated"])  # rplint: disable=RPL002
+            self.health_leaderless = np.array(h["leaderless"])  # rplint: disable=RPL002
+            return
+        h = health_reduce_np(
+            self.match_index,
+            self.commit_index,
+            self.is_voter,
+            self.is_voter_old,
+            self.is_leader,
+            self.leader_id >= 0,
+            self.row_active,
+        )
+        self.health_max_lag[:] = h["max_lag"]
+        self.health_under[:] = h["under_replicated"]
+        self.health_leaderless[:] = h["leaderless"]
+
+    def health_totals(self) -> dict:
+        """Aggregate view over the (already refreshed) health lanes."""
+        return {
+            "max_follower_lag": int(self.health_max_lag.max(initial=0)),
+            "under_replicated": int(np.count_nonzero(self.health_under)),
+            "leaderless": int(np.count_nonzero(self.health_leaderless)),
+            "active": int(np.count_nonzero(self.row_active)),
+        }
+
     def host_tick(
         self,
         group_rows: np.ndarray,
@@ -596,6 +690,7 @@ class ShardGroupArrays:
             self.last_visible[rows],
         )
         self.commit_index[rows] = new_commit
+        self._health_np_rows(rows)
         return rows[new_commit > before]
 
     def device_tick(
@@ -711,6 +806,7 @@ class ShardGroupArrays:
         self._folded_self_m[touched] = self.match_index[touched, _SELF2]
         self._folded_self_f[touched] = self.flushed_index[touched, _SELF2]
         self.quorum_dirty[:] = False
+        self._health_np_rows(touched)
         return touched[self.commit_index[touched] > before]
 
     def _gather_heartbeats(self, hb_rows: np.ndarray) -> dict:
@@ -763,7 +859,7 @@ class ShardGroupArrays:
                 else None
             )
             return advanced, hb
-        from ..ops.quorum import tick_frame_jit
+        from ..ops.health import tick_frame_health_jit
 
         m = len(group_rows)
         bucket = 8
@@ -800,14 +896,26 @@ class ShardGroupArrays:
         )
         before = self.commit_index[touched].copy()
         state = self.to_device_state()
-        new, hb_dev = tick_frame_jit(
-            state, g_rows, g_slots, g_dirty, g_flushed, g_seqs, h_rows
+        new, hb_dev, health = tick_frame_health_jit(
+            state,
+            g_rows,
+            g_slots,
+            g_dirty,
+            g_flushed,
+            g_seqs,
+            h_rows,
+            self.leader_id >= 0,
+            self.row_active,
         )
         self.commit_index[touched] = np.array(new.commit_index)[touched]  # rplint: disable=RPL002
         self.last_visible[touched] = np.array(new.last_visible)[touched]  # rplint: disable=RPL002
         self.match_index = np.array(new.match_index)  # rplint: disable=RPL002
         self.flushed_index = np.array(new.flushed_index)  # rplint: disable=RPL002
         self.last_seq = np.array(new.last_seq)  # rplint: disable=RPL002
+        # health rode along in the same program — zero extra dispatches
+        self.health_max_lag = np.array(health["max_lag"])  # rplint: disable=RPL002
+        self.health_under = np.array(health["under_replicated"])  # rplint: disable=RPL002
+        self.health_leaderless = np.array(health["leaderless"])  # rplint: disable=RPL002
         self.touch()
         self._folded_self_m[touched] = self.match_index[touched, SELF_SLOT]
         self._folded_self_f[touched] = self.flushed_index[touched, SELF_SLOT]

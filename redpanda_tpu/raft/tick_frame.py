@@ -79,6 +79,15 @@ class TickFrame:
     def pending(self) -> int:
         return self._n + len(self._force)
 
+    def health_totals(self) -> dict:
+        """Aggregate partition-health view over this shard's lanes.
+        The per-frame sweep (host) / fused frame program (device) keeps
+        the lanes warm for every row the window touched; refresh first
+        so rows that moved OUTSIDE a frame (leadership changes, frozen
+        followers with no reply traffic) are also current."""
+        self.arrays.health_refresh()
+        return self.arrays.health_totals()
+
     # -- ingestion (hot path, O(1) each) ------------------------------
     def enqueue_reply(
         self, row: int, slot: int, dirty: int, flushed: int, seq: int

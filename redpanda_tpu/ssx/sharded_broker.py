@@ -393,6 +393,14 @@ class PartitionShard:
             lambda: tf.pending,
             "replies + forced rows awaiting the next tick-frame flush",
         )
+        # bounded partition-health gauge family (top-k + fixed-width
+        # lag distribution); the fleet scrape injects the shard label
+        from ..observability.health import HealthSampler, register_exporter
+
+        self.health_sampler = HealthSampler(
+            self.group_manager, self.group_manager.probe.ledger
+        )
+        register_exporter(self.metrics, self.health_sampler)
 
     async def start(self) -> None:
         await self.group_manager.start()
@@ -436,6 +444,15 @@ class PartitionShard:
             ).encode()
         if method == "traces":
             return fleet.dump_to_envelope(self.recorder.dump()).encode()
+        if method == "health":
+            from ..observability import health as _health
+
+            rep = _health.build_report(
+                self.group_manager, self.group_manager.probe.ledger
+            )
+            return fleet.health_to_envelope(
+                rep, self.ctx.shard_id, self._config.node_id
+            ).encode()
         raise LookupError(f"obs: no such method {method!r}")
 
     async def _create(self, req: PartitionCreate) -> bytes:
@@ -489,6 +506,9 @@ class PartitionShard:
 
         self.produce_reqs += 1
         self.produce_bytes += len(req.records)
+        self.group_manager.probe.ledger.note_produce(
+            f"{req.ns}/{req.topic}/{req.partition}", len(req.records)
+        )
         partition = self.partition_manager.get(
             _ntp_of(req.ns, req.topic, req.partition)
         )
@@ -576,6 +596,10 @@ class PartitionShard:
         )
         wire = b"".join(_frame_kafka(b, kb) for kb, b in pairs)
         self.fetch_bytes += len(wire)
+        if wire:
+            self.group_manager.probe.ledger.note_fetch(
+                f"{req.ns}/{req.topic}/{req.partition}", len(wire)
+            )
         return ShardFetchReply(
             error=0,
             high_watermark=hw,
@@ -760,6 +784,14 @@ class ShardRouter:
             shard, "obs", "traces", b"", timeout=10.0
         )
         return fleet.envelope_to_dump(fleet.TraceDump.decode(raw))
+
+    async def obs_health(self, shard: int) -> dict:
+        """One worker shard's partition-health report (serde on the
+        wire, dict once decoded — merge with health.merge_reports)."""
+        raw = await self._rt.invoke_on(
+            shard, "obs", "health", b"", timeout=10.0
+        )
+        return fleet.envelope_to_health(fleet.HealthSnapshot.decode(raw))
 
     def worker_shards(self) -> range:
         return range(1, self.n_shards)

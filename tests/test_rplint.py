@@ -981,3 +981,112 @@ def test_rpl011_baseline_is_empty():
     grandfathered."""
     baseline = load_baseline()
     assert [k for k in baseline if k.endswith("::RPL011")] == []
+
+
+# -- RPL012: cardinality discipline ------------------------------------
+
+RPL012_STAR_KWARGS = """
+class Exporter:
+    def export(self, labels):
+        self.hist.labels(**labels).observe(1.0)
+"""
+
+RPL012_HOT_IDENTITY_VALUE = """
+class Probe:
+    def on_produce(self, req):
+        self.hist.labels(api="produce", topic=req.topic)
+"""
+
+RPL012_HOT_IDENTITY_KEY = """
+class Probe:
+    def on_append(self, p, counter):
+        counter.inc(partition=p)
+"""
+
+
+def test_rpl012_star_kwargs_flagged_everywhere(tmp_path):
+    # the label KEY set being data-driven is a leak in cold dirs too
+    (f,) = _only(
+        _lint_source(tmp_path, RPL012_STAR_KWARGS, "admin/mod.py"),
+        "RPL012",
+    )
+    assert "**-unpacked" in f.message
+    (f2,) = _only(
+        _lint_source(tmp_path, RPL012_STAR_KWARGS, "kafka/mod.py"),
+        "RPL012",
+    )
+    assert f2.line == 4
+
+
+def test_rpl012_hot_path_identity_value_flagged(tmp_path):
+    (f,) = _only(
+        _lint_source(tmp_path, RPL012_HOT_IDENTITY_VALUE, "kafka/mod.py"),
+        "RPL012",
+    )
+    assert "'topic'" in f.message
+    assert "observability/health.py" in f.message
+
+
+def test_rpl012_hot_path_identity_key_flagged(tmp_path):
+    (f,) = _only(
+        _lint_source(tmp_path, RPL012_HOT_IDENTITY_KEY, "raft/mod.py"),
+        "RPL012",
+    )
+    assert "'partition'" in f.message
+
+
+def test_rpl012_bounded_labels_on_hot_path_clean(tmp_path):
+    src = """
+        class Probe:
+            def on_rpc(self, api, stage, shard):
+                self.hist.labels(api=api, stage=stage, shard=str(shard))
+                self.errors.inc(path="produce")
+    """
+    assert (
+        _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL012") == []
+    )
+
+
+def test_rpl012_identity_label_in_cold_dir_clean(tmp_path):
+    # admin/debug surfaces may label by topic: not a hot path
+    assert (
+        _only(
+            _lint_source(
+                tmp_path, RPL012_HOT_IDENTITY_VALUE, "admin/mod.py"
+            ),
+            "RPL012",
+        )
+        == []
+    )
+
+
+def test_rpl012_health_exporter_file_exempt(tmp_path):
+    # the one sanctioned surface: top-k / fixed-width only by design
+    assert (
+        _only(
+            _lint_source(
+                tmp_path,
+                RPL012_STAR_KWARGS + RPL012_HOT_IDENTITY_VALUE,
+                "observability/health.py",
+            ),
+            "RPL012",
+        )
+        == []
+    )
+
+
+def test_rpl012_suppression(tmp_path):
+    src = RPL012_HOT_IDENTITY_VALUE.replace(
+        'topic=req.topic)',
+        'topic=req.topic)  # rplint: disable=RPL012',
+    )
+    assert (
+        _only(_lint_source(tmp_path, src, "kafka/mod.py"), "RPL012") == []
+    )
+
+
+def test_rpl012_baseline_is_empty():
+    """Cardinality discipline is fully enforced from day one: nothing
+    grandfathered."""
+    baseline = load_baseline()
+    assert [k for k in baseline if k.endswith("::RPL012")] == []

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Operator CLI for the partition-health plane.
+
+Fetches `GET /v1/cluster/partition_health` from a broker's admin
+endpoint and renders the bounded report: aggregate counters, the
+shard/NTP load-skew bars, top-k laggy and hot partition tables, and
+the cumulative lag distribution. `--json` emits the raw document
+instead (pipe it to a file and replay it offline later with
+`python tools/log_viewer.py --health dump.json` — same renderer).
+
+Usage:
+    python tools/health_report.py [ADDR] [--top-k N] [--json]
+
+ADDR defaults to 127.0.0.1:9644.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_BAR_WIDTH = 30
+
+
+def _fetch(addr: str, top_k: int) -> dict:
+    import http.client
+
+    host, _, port = addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port or 9644), timeout=10)
+    try:
+        conn.request("GET", f"/v1/cluster/partition_health?top_k={top_k}")
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            raise SystemExit(
+                f"health_report: {addr} returned {resp.status}: "
+                f"{body[:200]!r}"
+            )
+        return json.loads(body)
+    finally:
+        conn.close()
+
+
+def _fmt_bps(v: float) -> str:
+    for unit in ("B/s", "KB/s", "MB/s", "GB/s"):
+        if abs(v) < 1024.0 or unit == "GB/s":
+            return f"{v:.1f} {unit}"
+        v /= 1024.0
+    return f"{v:.1f} GB/s"
+
+
+def _skew_bar(skew: float, cap: float = 8.0) -> str:
+    """Bar from 1.0 (balanced) to `cap`x (saturated): ops eyeball the
+    imbalance without reading the number first."""
+    frac = min(max(skew - 1.0, 0.0) / (cap - 1.0), 1.0)
+    n = round(frac * _BAR_WIDTH)
+    return "[" + "#" * n + "." * (_BAR_WIDTH - n) + f"] {skew:.2f}x"
+
+
+def render_report(rep: dict, out=None) -> None:
+    """Human rendering of one partition_health document (live fetch or
+    an offline --json dump; log_viewer --health reuses this)."""
+    out = out if out is not None else sys.stdout
+    p = lambda s="": print(s, file=out)  # noqa: E731
+    node = rep.get("node_id", "?")
+    shards = rep.get("shards", 1)
+    p(f"partition health @ node {node} ({shards} shard(s))")
+    p(f"  active partitions   {rep.get('active', 0)}")
+    p(f"  max follower lag    {rep.get('max_follower_lag', 0)} entries")
+    p(f"  under-replicated    {rep.get('under_replicated', 0)}")
+    p(f"  leaderless          {rep.get('leaderless', 0)}")
+    rates = rep.get("rates") or {}
+    p(
+        "  load                "
+        + "  ".join(
+            f"{k.removesuffix('_bps')} {_fmt_bps(rates.get(k, 0.0))}"
+            for k in ("produce_bps", "fetch_bps", "append_bps", "total_bps")
+        )
+    )
+    p(f"  ntp skew            {_skew_bar(rep.get('skew', 1.0))}")
+    if "shard_skew" in rep:
+        p(f"  shard skew          {_skew_bar(rep.get('shard_skew', 1.0))}")
+
+    laggy = rep.get("top_laggy") or []
+    if laggy:
+        p()
+        p(f"top laggy partitions ({len(laggy)}):")
+        w = max(len(str(r.get("key", "?"))) for r in laggy)
+        for r in laggy:
+            shard = f"  shard={r['shard']}" if "shard" in r else ""
+            under = "  UNDER-REPLICATED" if r.get("under_replicated") else ""
+            p(
+                f"  {str(r.get('key', '?')):<{w}}  group={r.get('group')}"
+                f"  lag={r.get('lag')}{shard}{under}"
+            )
+
+    hot = rep.get("top_hot") or []
+    if hot:
+        p()
+        p(f"top hot partitions ({len(hot)}):")
+        w = max(len(str(r.get("key", "?"))) for r in hot)
+        peak = max(r.get("total_bps", 0.0) for r in hot) or 1.0
+        for r in hot:
+            n = round(r.get("total_bps", 0.0) / peak * _BAR_WIDTH)
+            shard = f"  shard={r['shard']}" if "shard" in r else ""
+            p(
+                f"  {str(r.get('key', '?')):<{w}}  "
+                f"{'#' * n:<{_BAR_WIDTH}}  "
+                f"{_fmt_bps(r.get('total_bps', 0.0))}{shard}"
+            )
+
+    hist = rep.get("lag_histogram") or []
+    edges = rep.get("lag_bucket_edges")
+    if edges is None and hist:
+        from redpanda_tpu.observability.health import lag_bucket_edges
+
+        edges = lag_bucket_edges()
+    if hist and edges and hist[-1]:
+        p()
+        p(f"lag distribution ({hist[-1]} leader partitions, cumulative):")
+        prev = 0
+        for edge, cum in zip(edges, hist):
+            in_bucket = cum - prev
+            prev = cum
+            if not in_bucket:
+                continue
+            n = round(in_bucket / hist[-1] * _BAR_WIDTH)
+            p(f"  lag <= {edge:>6}  {'#' * n:<{_BAR_WIDTH}}  {in_bucket}")
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "addr",
+        nargs="?",
+        default="127.0.0.1:9644",
+        help="admin HOST:PORT (default 127.0.0.1:9644)",
+    )
+    ap.add_argument("--top-k", type=int, default=10)
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the raw partition_health JSON instead of rendering",
+    )
+    args = ap.parse_args(argv)
+    rep = _fetch(args.addr, args.top_k)
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2)
+        print()
+    else:
+        render_report(rep)
+
+
+if __name__ == "__main__":
+    main()

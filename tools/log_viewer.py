@@ -12,11 +12,17 @@ Usage:
     python tools/log_viewer.py DATA_DIR --controller       # raft0 cmds
     python tools/log_viewer.py DATA_DIR -v                 # + records
     python tools/log_viewer.py --traces traces.json        # waterfalls
+    python tools/log_viewer.py --health health.json        # health dump
 
 The --traces mode renders a flight-recorder dump (the JSON from
 `GET /v1/debug/traces`, or a file of one tree per line) as aligned
 per-request waterfalls: one row per span, indented by tree depth,
 with a bar showing where the span sits inside its root's lifetime.
+
+The --health mode replays a partition-health dump (the JSON from
+`GET /v1/cluster/partition_health`, e.g. saved via
+`tools/health_report.py --json`) through the same renderer the live
+CLI uses: top-k laggy/hot tables, skew bars, lag distribution.
 """
 
 from __future__ import annotations
@@ -278,14 +284,28 @@ def main(argv=None) -> None:
         metavar="FILE",
         help="render a /v1/debug/traces JSON dump as span waterfalls",
     )
+    ap.add_argument(
+        "--health",
+        metavar="FILE",
+        help="render a /v1/cluster/partition_health JSON dump "
+        "(tools/health_report.py --json output)",
+    )
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
     if args.traces:
         dump_traces(args.traces)
         return
+    if args.health:
+        import json
+
+        from tools.health_report import render_report
+
+        with open(args.health, "r", encoding="utf-8") as f:
+            render_report(json.load(f))
+        return
     if not args.data_dir:
-        ap.error("data_dir is required unless --traces is given")
+        ap.error("data_dir is required unless --traces or --health is given")
 
     if args.controller:
         cdir = os.path.join(args.data_dir, "group_0")

@@ -13,6 +13,7 @@ from .rpl008_trace_discipline import TraceDisciplineRule
 from .rpl009_shard_discipline import ShardDisciplineRule
 from .rpl010_metrics_discipline import MetricsDisciplineRule
 from .rpl011_tick_discipline import TickDisciplineRule
+from .rpl012_cardinality import CardinalityDisciplineRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -26,6 +27,7 @@ ALL_RULES = [
     ShardDisciplineRule,
     MetricsDisciplineRule,
     TickDisciplineRule,
+    CardinalityDisciplineRule,
 ]
 
 __all__ = ["ALL_RULES"]

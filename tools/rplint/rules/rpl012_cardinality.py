@@ -1,0 +1,122 @@
+"""RPL012 — cardinality discipline: the bounded-metrics contract.
+
+The partition-health plane (observability/health.py) exists so that a
+100k-partition broker scrapes the SAME number of /metrics samples as a
+100-partition one: per-NTP values only ever surface top-k truncated or
+as fixed-width distributions. Two shapes break that contract and both
+have the same failure mode — the registry (and every fleet
+RegistrySnapshot shipped over invoke_on) grows one child per distinct
+partition, forever:
+
+  1. `.labels(**kwargs)` / `.inc(**kwargs)` star-unpacking anywhere:
+     the label KEY set itself is data-driven, so neither the child
+     count nor the schema is bounded at author time. Every labeled
+     call site must spell its keys.
+
+  2. On hot paths (files under raft/, kafka/, storage/, rpc/), a
+     label VALUE derived from partition identity — an expression
+     mentioning an `ntp` / `topic` / `partition` / `group_id`
+     identifier — passed to `.labels(...)` / `.inc(...)`. One child
+     per NTP on a hot path is exactly the unbounded-cardinality leak
+     the top-k exporter was built to replace.
+
+observability/health.py is the ONE sanctioned surface where per-NTP
+keys become label values (everything it exports is top-k or
+fixed-width) and is exempt. Suppress a deliberate exception elsewhere
+with `# rplint: disable=RPL012`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext, dotted_name
+
+_EXEMPT_SUFFIXES = ("observability/health.py", "metrics.py")
+_HOT_DIRS = ("raft", "kafka", "storage", "rpc")
+_LABELED_CALLS = ("labels", "inc")
+_IDENTITY_MARKERS = ("ntp", "topic", "partition", "group_id")
+
+
+def _identity_slug(expr: ast.expr) -> str | None:
+    """The first partition-identity identifier mentioned anywhere in a
+    label-value expression, or None. Matches Name ids, Attribute attrs
+    and keyword-arg names so `ntp`, `req.topic`, `str(p.partition)`
+    and `f(topic=t)` all trip; plain literals and api/stage/shard
+    style values never do."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        elif isinstance(node, ast.keyword) and node.arg:
+            ident = node.arg
+        else:
+            continue
+        low = ident.lower()
+        for marker in _IDENTITY_MARKERS:
+            if marker in low:
+                return ident
+    return None
+
+
+class CardinalityDisciplineRule:
+    code = "RPL012"
+    name = "cardinality-discipline"
+
+    @staticmethod
+    def _dir_parts(ctx: ModuleContext) -> list[str]:
+        return ctx.path.replace("\\", "/").split("/")[:-1]
+
+    def check(self, ctx: ModuleContext):
+        posix = ctx.path.replace("\\", "/")
+        if posix.endswith(_EXEMPT_SUFFIXES):
+            return
+        hot = any(d in self._dir_parts(ctx) for d in _HOT_DIRS)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func).rsplit(".", 1)[-1]
+            if callee not in _LABELED_CALLS:
+                continue
+            for kw in node.keywords:
+                if kw.arg is None:  # .labels(**kwargs) star-unpacking
+                    if ctx.suppressed(node, self.code):
+                        continue
+                    yield Finding(
+                        path=ctx.path,
+                        line=kw.value.lineno,
+                        col=kw.value.col_offset,
+                        rule=self.code,
+                        message=(
+                            f"**-unpacked label set in .{callee}() — the "
+                            "label key set is data-driven, so child count "
+                            "and schema are unbounded; spell the keys at "
+                            "the call site"
+                        ),
+                    )
+                elif hot:
+                    ident = _identity_slug(kw.value)
+                    if ident is None and kw.arg:
+                        # the label KEY itself naming partition identity
+                        # (`.labels(ntp=...)`) is the same leak
+                        low = kw.arg.lower()
+                        if any(m in low for m in _IDENTITY_MARKERS):
+                            ident = kw.arg
+                    if ident is None:
+                        continue
+                    if ctx.suppressed(node, self.code):
+                        continue
+                    yield Finding(
+                        path=ctx.path,
+                        line=kw.value.lineno,
+                        col=kw.value.col_offset,
+                        rule=self.code,
+                        message=(
+                            f"label value derived from {ident!r} in "
+                            f".{callee}() on a hot path — one metric child "
+                            "per partition is unbounded cardinality; "
+                            "surface per-NTP data through the top-k "
+                            "exporter (observability/health.py) instead"
+                        ),
+                    )

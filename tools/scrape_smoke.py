@@ -252,6 +252,129 @@ async def main_fleet() -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+async def main_health() -> int:
+    """PR-8 health-plane smoke: boot one broker, produce, then assert
+    the bounded partition-health surface — /v1/cluster/partition_health
+    serves the merged report, the enriched health_overview carries the
+    live-derived counts, and the /metrics gauge family stays top-k
+    bounded."""
+    tmp = tempfile.mkdtemp(prefix="rp-health-smoke-")
+    broker = Broker(BrokerConfig(node_id=0, data_dir=tmp, members=[0]))
+    try:
+        await broker.start()
+        await broker.wait_controller_leader()
+        from redpanda_tpu.kafka.client import KafkaClient
+
+        client = KafkaClient([broker.kafka_advertised])
+        try:
+            await client.create_topic("smoke", partitions=4)
+            for p in range(4):
+                await client.produce("smoke", p, [(None, b"ping")] * 8)
+        finally:
+            await client.close()
+
+        addr = broker.admin.address
+        st, body = await _http(addr, "/v1/cluster/partition_health")
+        if st != 200:
+            print(
+                f"health smoke: partition_health returned {st}",
+                file=sys.stderr,
+            )
+            return 1
+        rep = json.loads(body)
+        for key in (
+            "active",
+            "max_follower_lag",
+            "under_replicated",
+            "leaderless",
+            "shard_skew",
+            "top_laggy",
+            "top_hot",
+            "lag_histogram",
+            "rates",
+            "node_id",
+        ):
+            if key not in rep:
+                print(
+                    f"health smoke: partition_health missing {key!r}",
+                    file=sys.stderr,
+                )
+                return 1
+        if rep["active"] < 4:
+            print(
+                f"health smoke: expected >=4 active partitions, got "
+                f"{rep['active']}",
+                file=sys.stderr,
+            )
+            return 1
+        if not rep["top_hot"]:
+            print(
+                "health smoke: load ledger saw no produce traffic",
+                file=sys.stderr,
+            )
+            return 1
+
+        st, body = await _http(addr, "/v1/cluster/health_overview")
+        overview = json.loads(body) if st == 200 else {}
+        for key in (
+            "leaderless_partitions",
+            "under_replicated_partitions",
+            "max_follower_lag",
+        ):
+            if key not in overview:
+                print(
+                    f"health smoke: health_overview missing {key!r} "
+                    f"(status {st})",
+                    file=sys.stderr,
+                )
+                return 1
+
+        st, body = await _http(addr, "/metrics")
+        text = body.decode() if st == 200 else ""
+        for family in (
+            "redpanda_tpu_partition_health_max_follower_lag",
+            "redpanda_tpu_partition_load_skew_index",
+            "redpanda_tpu_partition_health_lag_bucket",
+        ):
+            if family not in text:
+                print(
+                    f"health smoke: family {family} missing from /metrics",
+                    file=sys.stderr,
+                )
+                return 1
+        # bounded cardinality: top-k only, never one sample per NTP
+        top_lines = [
+            ln
+            for ln in text.splitlines()
+            if ln.startswith("redpanda_tpu_partition_health_top_lag{")
+            or ln.startswith("redpanda_tpu_partition_load_top_bps{")
+        ]
+        if len(top_lines) > 20:
+            print(
+                f"health smoke: {len(top_lines)} top-k sample lines "
+                "(expected <= 2 * top_k)",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            "health smoke OK: partition_health live "
+            f"(active={rep['active']}, hot={len(rep['top_hot'])}), "
+            f"overview enriched, {len(top_lines)} bounded top-k samples"
+        )
+        return 0
+    finally:
+        try:
+            await broker.stop()
+        except Exception:
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
-    entry = main_fleet if "--fleet" in sys.argv[1:] else main
+    if "--fleet" in sys.argv[1:]:
+        entry = main_fleet
+    elif "--health" in sys.argv[1:]:
+        entry = main_health
+    else:
+        entry = main
     raise SystemExit(asyncio.run(entry()))

@@ -52,6 +52,9 @@ env JAX_PLATFORMS=cpu python tools/tick_frame_smoke.py
 echo "== tick-frame backend parity (host fallback vs device) =="
 env JAX_PLATFORMS=cpu python tools/tick_frame_smoke.py --parity --groups 4096
 
+echo "== health-plane smoke (partition_health + bounded /metrics) =="
+env JAX_PLATFORMS=cpu python tools/scrape_smoke.py --health
+
 echo "== tracing-off smoke (RP_TRACE=0) =="
 env JAX_PLATFORMS=cpu RP_TRACE=0 python tools/scrape_smoke.py --fleet
 exec env JAX_PLATFORMS=cpu RP_TRACE=0 python -m pytest \
