@@ -1655,6 +1655,159 @@ def bench_slo(profile: str = "default") -> dict:
     return asyncio.run(_slo_async(_load_slo_profile(profile)))
 
 
+# ------------------------------------- tiered read path (warm/cold SLO)
+async def _tiered_async() -> dict:
+    """Tiered-storage fetch latency across the remote/local seam:
+    produce -> archive -> evict the local prefix -> fetch from offset 0.
+    Cold iterations invalidate the disk chunk cache and the in-memory
+    segment LRU first, so every archived byte re-hydrates from the
+    object store; warm iterations ride the caches. Both temperatures
+    grade their p99 against bench_profiles/slo_tiered.json. The store
+    is in-memory: the measurand is the hydration/assembly/CRC-verify
+    path, not object-store RTT."""
+    from redpanda_tpu.app import Broker, BrokerConfig
+    from redpanda_tpu.cloud import MemoryObjectStore
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.models.fundamental import kafka_ntp
+    from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+    prof = _load_slo_profile("tiered")
+    n_records = int(prof.get("records", 600))
+    record_bytes = int(prof.get("record_bytes", 512))
+    batch_records = int(prof.get("batch_records", 20))
+    reads = prof.get("reads", {})
+    n_cold = int(reads.get("cold", 25))
+    n_warm = int(reads.get("warm", 100))
+    slo = prof.get("slo", {})
+    slo_cold = float(slo.get("cold_p99_ms", 250.0))
+    slo_warm = float(slo.get("warm_p99_ms", 60.0))
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = tempfile.mkdtemp(prefix="rp_bench_tiered_", dir=shm)
+    store = MemoryObjectStore()
+    b = Broker(
+        BrokerConfig(
+            node_id=0,
+            data_dir=os.path.join(tmp, "n0"),
+            members=[0],
+            enable_admin=False,
+            node_status_interval_s=0,
+            housekeeping_interval_s=0,
+            archival_interval_s=0,
+        ),
+        loopback=LoopbackNetwork(),
+        object_store=store,
+    )
+    await b.start()
+    b.config.peer_kafka_addresses = {0: b.kafka_advertised}
+    client = None
+    try:
+        await b.wait_controller_leader()
+        client = KafkaClient([b.kafka_advertised])
+        await client.create_topic(
+            "tiered",
+            partitions=1,
+            replication_factor=1,
+            configs={
+                "redpanda.remote.write": "true",
+                "redpanda.remote.read": "true",
+                "segment.bytes": str(prof.get("segment_bytes", 4096)),
+                "retention.bytes": str(prof.get("segment_bytes", 4096)),
+            },
+        )
+        payload = bytes(
+            (i * 31 + (i >> 8)) & 0xFF for i in range(record_bytes)
+        )
+        expect = []
+        for base in range(0, n_records, batch_records):
+            batch = [
+                (b"k%06d" % i, payload)
+                for i in range(base, min(base + batch_records, n_records))
+            ]
+            await client.produce("tiered", 0, batch)
+            expect.extend(batch)
+
+        p = b.partition_manager.get(kafka_ntp("tiered", 0))
+        p.log.flush()
+        uploaded = await b.archival.run_once()
+        b.storage.log_mgr.housekeeping()
+        local_start = p.log.offsets().start_offset
+        manifest = p.archiver.manifest
+        seg_keys = [manifest.segment_key(m) for m in manifest.segments]
+
+        async def timed_fetch() -> float:
+            t0 = time.perf_counter()
+            got = await client.fetch("tiered", 0, 0, max_bytes=1 << 24)
+            dt = (time.perf_counter() - t0) * 1e3
+            # the hydrated bytes must BE the produced bytes, every read
+            assert len(got) == n_records, (len(got), n_records)
+            assert [(k, v) for _o, k, v in got] == expect
+            return dt
+
+        cold_ms: list[float] = []
+        for _ in range(n_cold):
+            for key in seg_keys:
+                await b.remote_reader.invalidate(key)
+            cold_ms.append(await timed_fetch())
+        warm_ms = [await timed_fetch() for _ in range(n_warm)]
+
+        cache = b.remote_reader.cache
+        cold_p99 = float(np.percentile(cold_ms, 99))
+        warm_p99 = float(np.percentile(warm_ms, 99))
+        verdicts = {
+            "cold_p99_ms": cold_p99 <= slo_cold,
+            "warm_p99_ms": warm_p99 <= slo_warm,
+        }
+        return {
+            "metric": "tiered_cold_fetch_p99_ms",
+            "value": round(cold_p99, 3),
+            "unit": "ms",
+            "vs_baseline": (
+                round(slo_cold / cold_p99, 3) if cold_p99 > 0 else -1
+            ),
+            "tiered": {
+                "records": n_records,
+                "record_bytes": record_bytes,
+                "segments_uploaded": uploaded,
+                "local_start_offset": local_start,
+                "cold": {
+                    "n": len(cold_ms),
+                    "p50_ms": round(float(np.percentile(cold_ms, 50)), 3),
+                    "p99_ms": round(cold_p99, 3),
+                },
+                "warm": {
+                    "n": len(warm_ms),
+                    "p50_ms": round(float(np.percentile(warm_ms, 50)), 3),
+                    "p99_ms": round(warm_p99, 3),
+                },
+                "hydrations": b.remote_reader.hydrations,
+                "cache": {
+                    "hits": cache.hits if cache else -1,
+                    "misses": cache.misses if cache else -1,
+                    "evictions": cache.evictions if cache else -1,
+                },
+                "slo": {
+                    "cold_p99_ms": slo_cold,
+                    "warm_p99_ms": slo_warm,
+                },
+                "verdicts": verdicts,
+                "slo_pass": all(verdicts.values()),
+            },
+        }
+    finally:
+        if client is not None:
+            try:
+                await client.close()
+            except Exception:
+                pass
+        await b.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_tiered() -> dict:
+    return asyncio.run(_tiered_async())
+
+
 # ------------------------------------------------- OMB-shaped mix (config #5)
 async def _omb_async() -> dict:
     """BASELINE.md benchmark config #5: OMB release-smoke shape scaled
@@ -1834,6 +1987,7 @@ BENCHES = {
     "replicated_mp": bench_replicated_mp,
     "omb": bench_omb,
     "slo": bench_slo,
+    "tiered": bench_tiered,
 }
 
 

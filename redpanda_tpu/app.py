@@ -143,6 +143,13 @@ class BrokerConfig:
     # to a small in-memory whole-segment LRU)
     cloud_storage_cache_size_bytes: int = 1 << 30
     cloud_storage_cache_chunk_size: int = 1 << 20
+    # hard bound on one partition's archived-range read inside a fetch:
+    # a wedged object store degrades that partition to a retriable
+    # KAFKA_STORAGE_ERROR row instead of stalling the whole fetch (and
+    # the local-log partitions sharing it)
+    cloud_fetch_timeout_s: float = 5.0
+    # bound on each coalesced chunk hydration in the disk cache
+    cloud_hydration_timeout_s: float = 10.0
     # adjacent-segment merging (archival housekeeping): archived
     # segments smaller than min are merged into objects up to target;
     # 0 disables (opt-in, like cloud_storage_enable_segment_merging)
@@ -436,6 +443,7 @@ class Broker:
                     os.path.join(config.data_dir, "cloud_storage_cache"),
                     max_bytes=config.cloud_storage_cache_size_bytes,
                     chunk_size=config.cloud_storage_cache_chunk_size,
+                    hydrate_timeout_s=config.cloud_hydration_timeout_s,
                 )
             self.cloud_cache = cache
             self.remote_reader = RemoteReader(
@@ -443,6 +451,16 @@ class Broker:
             )
             self.archival.on_replaced = self.remote_reader.invalidate
             self.controller.on_partition_added = self._maybe_recover_partition
+            from .cloud.probe import CloudProbe
+
+            self.cloud_probe = CloudProbe(
+                self.metrics,
+                archival=self.archival,
+                cache=cache,
+                reader=self.remote_reader,
+            )
+        else:
+            self.cloud_probe = None
         self._bind_cluster_config()
         self.pandaproxy = None
         self.schema_registry = None
@@ -606,13 +624,28 @@ class Broker:
         )
         try:
             # exists() first: a permanent miss must not spin the retry
-            # backoff inside the serial reconciliation loop
-            if not await self.archival.store.exists(key):
+            # backoff inside the serial reconciliation loop; the
+            # wait_for bounds recovery so a wedged store cannot stall
+            # the serial partition-reconciliation loop behind it
+            if not await asyncio.wait_for(
+                self.archival.store.exists(key), timeout=30.0
+            ):
                 return
-            raw = await self.archival.store.get(key)
-        except StoreError:
+            raw = await asyncio.wait_for(
+                self.archival.store.get(key), timeout=30.0
+            )
+        except (StoreError, asyncio.TimeoutError):
             return  # store unavailable; archiver heals later
-        manifest = PartitionManifest.decode(raw)
+        try:
+            manifest = PartitionManifest.decode(raw)
+        except Exception:
+            # torn store manifest: recovery must never attach dangling
+            # segment references; the leader's sync pass re-exports a
+            # whole manifest and a later recovery attempt succeeds
+            logging.getLogger("app").warning(
+                "%s: torn cloud manifest; skipping recovery", ntp
+            )
+            return
         # attach the archiver up-front so remote reads work immediately
         a = self.archival.archiver_for(partition)
         a.manifest = manifest
@@ -896,7 +929,10 @@ class Broker:
 
         if self.archival is None:
             raise RuntimeError("tiered storage is not configured")
-        raw = await self.archival.store.get(TopicManifest.key_for(ns, topic))
+        raw = await asyncio.wait_for(
+            self.archival.store.get(TopicManifest.key_for(ns, topic)),
+            timeout=30.0,
+        )
         tm = TopicManifest.decode(raw)
         config = dict(tm.config)
         config["redpanda.remote.recovery"] = "true"

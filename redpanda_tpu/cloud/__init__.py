@@ -8,17 +8,27 @@ below the local log start stream back from the uploaded segments.
 """
 
 from .object_store import (
+    CloudUnavailableError,
     FilesystemObjectStore,
     MemoryObjectStore,
     ObjectStore,
+    RetryingStore,
     StoreError,
+    StoreThrottled,
 )
+from .nemesis import NemesisObjectStore, StoreFaultSchedule, StoreRule
 from .manifest import PartitionManifest, SegmentMeta, TopicManifest
 from .archiver import NtpArchiver, ArchivalService
 from .remote_partition import RemoteReader
 
 __all__ = [
     "ArchivalService",
+    "CloudUnavailableError",
+    "NemesisObjectStore",
+    "RetryingStore",
+    "StoreFaultSchedule",
+    "StoreRule",
+    "StoreThrottled",
     "FilesystemObjectStore",
     "MemoryObjectStore",
     "NtpArchiver",

@@ -50,7 +50,14 @@ class NtpArchiver:
 
     def __init__(self, partition: "Partition", store: ObjectStore):
         self.partition = partition
-        self.store = store
+        # every archiver op must run under a retry budget + deadline
+        # (rplint RPL013): wrap raw stores, keep already-budgeted ones
+        self.store = (
+            store if isinstance(store, RetryingStore) else RetryingStore(store)
+        )
+        # observability hook: called with a degradation kind (string)
+        # when the archiver detects/repairs a fault (CloudProbe)
+        self.on_degraded: Optional[Callable] = None
         # async callback(key) invoked after a replaced segment object is
         # deleted (remote-reader cache hygiene); set by ArchivalService
         self.on_replaced: Optional[Callable] = None
@@ -109,7 +116,28 @@ class NtpArchiver:
         self._store_upto = -1
         self._store_rev = -1
         if await self.store.exists(key):
-            store_m = PartitionManifest.decode(await self.store.get(key))
+            try:
+                store_m = PartitionManifest.decode(await self.store.get(key))
+            except StoreError:
+                raise
+            except Exception as e:
+                # torn manifest: the last export was cut mid-write (a
+                # partial PUT a non-atomic backend persisted). The
+                # replicated stm still holds the previous good state —
+                # fall back to it and leave _store_upto at -1 so
+                # _export_manifest re-publishes a whole manifest over
+                # the torn object. Never decode-and-serve a dangling
+                # segment reference from the torn copy.
+                logger.warning(
+                    "%s: torn store manifest (%s); re-exporting from "
+                    "replicated state",
+                    p.ntp,
+                    e,
+                )
+                if self.on_degraded is not None:
+                    self.on_degraded("torn_manifest")
+                self._synced_term = p.consensus.term
+                return
             self._store_upto = store_m.archived_upto
             self._store_rev = int(store_m.revision)
             if store_m.archived_upto > self.archived_upto:
@@ -412,6 +440,30 @@ class NtpArchiver:
             )
             try:
                 await self.store.put(seg_key, data)
+                # fault-atomicity: verify the object landed whole BEFORE
+                # any manifest/stm references it. A faulty backend can
+                # persist a truncated body and still error (the retry
+                # loop then re-puts), or — worse — ack a short object;
+                # the head check catches both, one re-upload heals it.
+                size = await self.store.head(seg_key)
+                if size != len(data):
+                    if self.on_degraded is not None:
+                        self.on_degraded("partial_upload")
+                    logger.warning(
+                        "%s: partial upload of %s (%d/%d bytes); "
+                        "re-uploading",
+                        p.ntp,
+                        meta.name,
+                        size,
+                        len(data),
+                    )
+                    await self.store.put(seg_key, data)
+                    size = await self.store.head(seg_key)
+                    if size != len(data):
+                        raise StoreError(
+                            f"segment {meta.name} truncated in store "
+                            f"({size}/{len(data)} bytes) after re-upload"
+                        )
                 # replicate FIRST: the archived fact must be raft-agreed
                 # before anything (retention!) can act on it. A crash
                 # between the replicate and the export leaves the store
@@ -459,7 +511,11 @@ class ArchivalService:
         # async callback(key): invalidate remote-reader caches for a
         # deleted object key (set by the broker)
         self.on_replaced: Optional[Callable] = None
-        self.store = RetryingStore(store)
+        # degradation-event callback(kind) propagated to archivers
+        self.on_degraded: Optional[Callable] = None
+        self.store = (
+            store if isinstance(store, RetryingStore) else RetryingStore(store)
+        )
         self._partitions = partitions
         self._topic_table = topic_table
         self.interval_s = interval_s
@@ -520,6 +576,7 @@ class ArchivalService:
                 await self._ensure_topic_manifest(ntp.tp_ns)
                 a = self.archiver_for(p)
                 a.on_replaced = self.on_replaced
+                a.on_degraded = self.on_degraded
                 n = await a.upload_pass()
                 # merges are counted separately: callers assert on
                 # upload counts

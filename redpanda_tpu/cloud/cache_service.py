@@ -31,10 +31,16 @@ class CloudCache:
         directory: str,
         max_bytes: int = 1 << 30,
         chunk_size: int = DEFAULT_CHUNK,
+        hydrate_timeout_s: float | None = 10.0,
     ):
         self.dir = directory
         self.max_bytes = max_bytes
         self.chunk_size = chunk_size
+        # bound on each coalesced ranged fetch: a wedged object store
+        # surfaces as a StoreError here instead of a reader parked
+        # forever on the per-key hydration lock (and every follower
+        # queued behind it)
+        self.hydrate_timeout_s = hydrate_timeout_s
         # (key_hash, chunk_idx) -> size; order = LRU (oldest first)
         self._index: OrderedDict[tuple[str, int], int] = OrderedDict()
         self._bytes = 0
@@ -248,7 +254,18 @@ class CloudCache:
                 j += 1
             lo = (first + i) * cs
             hi = min((first + j) * cs, object_size)
-            blob = await fetch_range(lo, hi)
+            try:
+                if self.hydrate_timeout_s is None:
+                    blob = await fetch_range(lo, hi)
+                else:
+                    blob = await asyncio.wait_for(
+                        fetch_range(lo, hi), timeout=self.hydrate_timeout_s
+                    )
+            except asyncio.TimeoutError:
+                raise StoreError(
+                    f"hydration of {key} [{lo},{hi}) timed out after "
+                    f"{self.hydrate_timeout_s:.1f}s"
+                ) from None
             if len(blob) != hi - lo:
                 # truncated object (manifest size_bytes > stored
                 # size): StoreError so the remote read path degrades
@@ -275,3 +292,23 @@ class CloudCache:
                     os.remove(self._path(*ent))
                 except OSError:
                     pass
+
+    async def invalidate_range(self, key: str, start: int, end: int) -> None:
+        """Drop the chunks covering bytes [start, end) of `key` —
+        poisoned-chunk hygiene: when a reader finds a CRC mismatch in
+        hydrated bytes, the cached chunks that served them must go, or
+        every retry re-reads the same corruption from disk."""
+        if end <= start:
+            return
+        kh = self._hash(key)
+        cs = self.chunk_size
+        first, last = start // cs, (end - 1) // cs
+        async with self._lock:
+            for idx in range(first, last + 1):
+                ent = (kh, idx)
+                if ent in self._index:
+                    self._bytes -= self._index.pop(ent)
+                    try:
+                        os.remove(self._path(kh, idx))
+                    except OSError:
+                        pass

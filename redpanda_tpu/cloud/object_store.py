@@ -22,6 +22,22 @@ class StoreError(Exception):
     pass
 
 
+class StoreThrottled(StoreError):
+    """429-style slow-down response. Retriable, but the backoff should
+    honor the server's retry-after hint instead of hammering."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class CloudUnavailableError(StoreError):
+    """Typed degradation signal: the cloud path stayed unreachable (or
+    kept corrupting) past its bounded retries. Consumers surface this
+    as a retriable condition (Kafka: KAFKA_STORAGE_ERROR) instead of a
+    hung fetch or a bogus out-of-range."""
+
+
 class ObjectStore(Protocol):
     async def put(self, key: str, data: bytes) -> None: ...
 
@@ -35,36 +51,30 @@ class ObjectStore(Protocol):
 
     async def delete(self, key: str) -> None: ...
 
+    async def head(self, key: str) -> int: ...
+
 
 class MemoryObjectStore:
-    """In-memory bucket with optional fault injection (the test double
-    the reference builds with s3_imposter)."""
+    """In-memory bucket (the test double the reference builds with
+    s3_imposter). Fault injection lives in cloud/nemesis.py — wrap
+    with NemesisObjectStore instead of hooking the store itself."""
 
     def __init__(self):
         self._data: dict[str, bytes] = {}
-        self.fail_next: int = 0  # inject N transient failures
         self.put_count = 0
         self.get_count = 0
 
-    def _maybe_fail(self) -> None:
-        if self.fail_next > 0:
-            self.fail_next -= 1
-            raise StoreError("injected transient failure")
-
     async def put(self, key: str, data: bytes) -> None:
-        self._maybe_fail()
         self.put_count += 1
         self._data[key] = bytes(data)
 
     async def get(self, key: str) -> bytes:
-        self._maybe_fail()
         self.get_count += 1
         if key not in self._data:
             raise StoreError(f"no such key: {key}")
         return self._data[key]
 
     async def get_range(self, key: str, start: int, end: int) -> bytes:
-        self._maybe_fail()
         self.get_count += 1
         if key not in self._data:
             raise StoreError(f"no such key: {key}")
@@ -78,6 +88,11 @@ class MemoryObjectStore:
 
     async def delete(self, key: str) -> None:
         self._data.pop(key, None)
+
+    async def head(self, key: str) -> int:
+        if key not in self._data:
+            raise StoreError(f"no such key: {key}")
+        return len(self._data[key])
 
 
 class FilesystemObjectStore:
@@ -141,21 +156,30 @@ class FilesystemObjectStore:
         except FileNotFoundError:
             pass
 
+    async def head(self, key: str) -> int:
+        try:
+            return os.path.getsize(self._path(key))
+        except FileNotFoundError:
+            raise StoreError(f"no such key: {key}") from None
+
 
 class RetryingStore:
     """Exp-backoff retry wrapper (cloud_storage/remote.h over
     utils/retry_chain_node.h): every operation runs under a child of
     the store's retry-chain root, so transient StoreErrors back off
-    with jitter, per-op deadlines bound total retry time, and
-    `abort()` (archiver shutdown) cancels every in-flight retry loop
-    at once."""
+    with jitter, per-op deadlines bound total retry time, per-attempt
+    timeouts bound a hung endpoint (a stuck socket burns one attempt,
+    not the whole budget), throttle responses honor their retry-after
+    hint, and `abort()` (archiver shutdown) cancels every in-flight
+    retry loop at once."""
 
     def __init__(
         self,
         inner: ObjectStore,
         attempts: int = 4,
         base_backoff_s: float = 0.05,
-        op_deadline_s: float | None = None,
+        op_deadline_s: float | None = 30.0,
+        attempt_timeout_s: float | None = 10.0,
     ):
         from ..utils.retry_chain import RetryChainNode
 
@@ -163,6 +187,10 @@ class RetryingStore:
         self._attempts = attempts
         self._chain = RetryChainNode(base_backoff_s=base_backoff_s)
         self._op_deadline = op_deadline_s
+        self._attempt_timeout = attempt_timeout_s
+        # observability hook: called with the op name on every retry
+        # (CloudProbe wires this to the upload-retries counter)
+        self.on_retry = None
 
     def abort(self) -> None:
         self._chain.abort()
@@ -179,13 +207,36 @@ class RetryingStore:
         try:
             for attempt in range(self._attempts):
                 node.check_abort()
+                timeout = self._attempt_timeout
+                rem = node.remaining_s()
+                if rem is not None:
+                    if rem <= 0:
+                        raise StoreError(f"{op.__name__}: op deadline exhausted")
+                    timeout = min(timeout, rem) if timeout is not None else rem
                 try:
-                    return await op(*args)
-                except StoreError:
-                    if attempt == self._attempts - 1:
-                        raise
-                    if not await node.backoff():
-                        raise
+                    if timeout is None:
+                        return await op(*args)
+                    return await asyncio.wait_for(op(*args), timeout=timeout)
+                except asyncio.TimeoutError:
+                    err: StoreError = StoreError(
+                        f"{op.__name__}: attempt timed out after {timeout:.1f}s"
+                    )
+                except StoreError as e:
+                    err = e
+                if attempt == self._attempts - 1:
+                    raise err
+                if isinstance(err, StoreThrottled) and err.retry_after_s > 0:
+                    # server asked for a pause: honor it (capped by the
+                    # op deadline) before the jittered backoff
+                    pause = err.retry_after_s
+                    rem = node.remaining_s()
+                    if rem is not None:
+                        pause = min(pause, max(rem, 0.0))
+                    await asyncio.sleep(pause)
+                if self.on_retry is not None:
+                    self.on_retry(op.__name__)
+                if not await node.backoff():
+                    raise err
         except RetryChainAborted:
             # callers handle store unavailability, not chain internals
             raise StoreError("aborted (shutdown)") from None
@@ -212,3 +263,10 @@ class RetryingStore:
 
     async def delete(self, key: str) -> None:
         await self._retry(self._inner.delete, key)
+
+    async def head(self, key: str) -> int:
+        head = getattr(self._inner, "head", None)
+        if head is None:
+            # store without a head/stat op: size via full fetch
+            return len(await self._retry(self._inner.get, key))
+        return await self._retry(head, key)

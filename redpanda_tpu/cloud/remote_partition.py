@@ -25,7 +25,12 @@ from typing import Optional
 from ..models.record import HEADER_SIZE, RecordBatch, RecordBatchHeader, RecordBatchType
 from .cache_service import CloudCache
 from .manifest import PartitionManifest, SegmentMeta
-from .object_store import ObjectStore, StoreError
+from .object_store import (
+    CloudUnavailableError,
+    ObjectStore,
+    RetryingStore,
+    StoreError,
+)
 
 INDEX_STRIDE = 128 << 10  # one sample per ~128KiB of segment scanned
 
@@ -69,8 +74,21 @@ class RemoteReader:
         cache: Optional[CloudCache] = None,
         cache_max_bytes: int = 32 << 20,
     ):
-        self.store = store
+        # fetch-path discipline (rplint RPL013): every hydration runs
+        # under a retry budget + per-op deadline, so a wedged store
+        # exhausts a bounded budget and surfaces as cloud_unavailable
+        # instead of wedging the fetch
+        self.store = (
+            store
+            if isinstance(store, RetryingStore)
+            else RetryingStore(store, attempts=3, op_deadline_s=15.0)
+        )
         self.cache = cache
+        # observability hooks (CloudProbe): on_degraded(kind) when a
+        # remote read degrades; on_read(seconds, hydrated) per ranged
+        # read for the warm/cold latency histogram
+        self.on_degraded = None
+        self.on_read = None
         # fallback when no disk cache is configured: whole-object LRU
         self._mem: OrderedDict[str, bytes] = OrderedDict()
         self._mem_bytes = 0
@@ -81,8 +99,27 @@ class RemoteReader:
             OrderedDict()
         )
 
+    def _degrade(self, kind: str) -> None:
+        if self.on_degraded is not None:
+            self.on_degraded(kind)
+
     # -- hydration ----------------------------------------------------
     async def _read_range(
+        self, key: str, start: int, end: int, size: int
+    ) -> bytes:
+        if self.on_read is None:
+            return await self._read_range_inner(key, start, end, size)
+        import time
+
+        t0 = time.monotonic()
+        h0 = self.hydrations
+        data = await self._read_range_inner(key, start, end, size)
+        # cold = at least one object-store fetch happened; warm = pure
+        # cache/LRU assembly (the warm/cold split the tiered SLO grades)
+        self.on_read(time.monotonic() - t0, self.hydrations > h0)
+        return data
+
+    async def _read_range_inner(
         self, key: str, start: int, end: int, size: int
     ) -> bytes:
         if self.cache is not None:
@@ -241,8 +278,21 @@ class RemoteReader:
                         break
                     batch = RecordBatch(header, body)
                     if not batch.verify_crc():
-                        # corruption, not unavailability: surface it
-                        raise StoreError(
+                        # poisoned chunks: drop the cached bytes that
+                        # served this batch, or every retry re-reads
+                        # the same corruption from disk; then surface a
+                        # RETRIABLE error — the re-hydration heals a
+                        # torn cache, and true object corruption keeps
+                        # failing loudly instead of silently serving
+                        self._degrade("crc_mismatch")
+                        if self.cache is not None:
+                            await self.cache.invalidate_range(
+                                key, pos, pos + header.size_bytes
+                            )
+                        stale = self._mem.pop(key, None)
+                        if stale is not None:
+                            self._mem_bytes -= len(stale)
+                        raise CloudUnavailableError(
                             f"archived batch CRC mismatch at "
                             f"{header.base_offset}"
                         )
@@ -250,6 +300,19 @@ class RemoteReader:
                     consumed += header.size_bytes
                 pos += header.size_bytes
             if hydration_failed:
+                if not out:
+                    # nothing served and the store's bounded retry
+                    # budget is spent: typed degradation the fetch
+                    # handler maps to a RETRIABLE Kafka error code —
+                    # never a hung fetch, never a bogus out-of-range
+                    self._degrade("cloud_unavailable")
+                    raise CloudUnavailableError(
+                        f"archived read at kafka offset {kafka_offset} "
+                        f"failed after bounded retries ({key})"
+                    )
+                # partial progress: return what hydrated; the client
+                # continues from the next offset and retries there
+                self._degrade("partial_remote_read")
                 break
             # next segment in offset order (O(log) on the columnar
             # store; list fallback keeps .index)

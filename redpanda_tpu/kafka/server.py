@@ -1295,7 +1295,11 @@ class KafkaServer:
         remote_rows: dict[tuple[str, int], Msg] = {}
         reader = self.broker.remote_reader
         if reader is not None:
-            from ..cloud.object_store import StoreError
+            from ..cloud.object_store import CloudUnavailableError, StoreError
+
+            remote_timeout = getattr(
+                self.broker.config, "cloud_fetch_timeout_s", 5.0
+            )
 
             # ONE budget across all remote rows, mirroring the local
             # read loop's `budget - total` accounting. The hydrations
@@ -1330,12 +1334,26 @@ class KafkaServer:
                 lso = partition.last_stable_offset()
                 upto = lso if read_committed else None
                 try:
-                    pairs = await partition.read_kafka_remote(
-                        reader,
-                        p.fetch_offset,
-                        max_bytes=budget,
-                        upto_kafka=upto,
+                    # the wait_for is the wedge guard: a hung object
+                    # store burns THIS partition's bounded slot, and
+                    # local-log rows in the same fetch are served by
+                    # the poll loop untouched
+                    pairs = await asyncio.wait_for(
+                        partition.read_kafka_remote(
+                            reader,
+                            p.fetch_offset,
+                            max_bytes=budget,
+                            upto_kafka=upto,
+                        ),
+                        timeout=remote_timeout,
                     )
+                except (CloudUnavailableError, asyncio.TimeoutError):
+                    # typed degradation: the archived range exists but
+                    # the cloud path is wedged/corrupt past its retry
+                    # budget — answer a RETRIABLE storage error for
+                    # this one partition (never out_of_range, which
+                    # would teleport consumers; never a hung fetch)
+                    return "cloud_unavailable"
                 except StoreError:
                     # corrupt/missing object: fail ONE partition
                     # (out_of_range via the poll loop), not the fetch
@@ -1395,6 +1413,17 @@ class KafkaServer:
                     chunk, results
                 ):
                     if res is None or remote_budget <= 0:
+                        continue
+                    if res == "cloud_unavailable":
+                        remote_rows[(topic, p.partition)] = Msg(
+                            partition_index=p.partition,
+                            error_code=int(ErrorCode.kafka_storage_error),
+                            high_watermark=partition.high_watermark(),
+                            last_stable_offset=partition.last_stable_offset(),
+                            log_start_offset=cstart,
+                            aborted_transactions=None,
+                            records=None,
+                        )
                         continue
                     wire, aborted, lso = res
                     remote_budget -= len(wire)
@@ -1561,7 +1590,13 @@ class KafkaServer:
                     if p.fetch_offset < start or p.fetch_offset > hw:
                         remote = remote_rows.get((t.topic, p.partition))
                         if remote is not None:
-                            # served from the archived range
+                            # served from the archived range — or a
+                            # typed degradation row (retriable
+                            # KAFKA_STORAGE_ERROR) when the cloud path
+                            # was wedged; either way never a bogus
+                            # out_of_range for data the archive holds
+                            if remote.error_code:
+                                has_error = True
                             total += len(remote.records or b"")
                             parts.append(remote)
                             continue

@@ -293,6 +293,7 @@ async def run_chaos(
     tiered: bool = False,
     admin_ops: bool = False,
     nemesis=None,
+    store_faults=None,
 ) -> dict:
     """`tiered=True` runs the same fault schedule against a
     remote.write topic with aggressive segment roll + retention, with
@@ -307,13 +308,25 @@ async def run_chaos(
     fault window; it is cleared (like a heal) before the settle +
     validate phase, and its firing counts ride back in the stats. To
     replay a run byte-identically, rebuild the same schedule with the
-    same seed (see README "Fault injection")."""
+    same seed (see README "Fault injection").
+
+    `store_faults` (a cloud.nemesis.StoreFaultSchedule, tiered only)
+    arms the object-store nemesis for the fault window — partial
+    uploads, torn manifests, throttles, slow links, wedged gets — and
+    is cleared before the settle sweeps so the post-chaos validation
+    examines a healed store. Its firing counts and trace length ride
+    back in the stats; `cloud.nemesis.replay_trace` rebuilds the trace
+    byte-equal from (rules, seed, recorded op sequence)."""
     rng = random.Random(seed)
     store = None
+    if store_faults is not None and not tiered:
+        raise ValueError("store_faults requires tiered=True")
     if tiered:
-        from redpanda_tpu.cloud import MemoryObjectStore
+        from redpanda_tpu.cloud import MemoryObjectStore, NemesisObjectStore
 
         store = MemoryObjectStore()
+        if store_faults is not None:
+            store = NemesisObjectStore(store, store_faults)
     cluster = ChaosCluster(tmp_path, n=3, object_store=store)
     await cluster.start()
     if nemesis is not None:
@@ -402,6 +415,8 @@ async def run_chaos(
         cluster.heal_network()
         if nemesis is not None:
             cluster.net.clear_nemesis()  # the nemesis heals too
+        if store_faults is not None:
+            store.clear()  # the object store heals too
         await asyncio.sleep(1.0)
         producer.stop()
         fuzz_stop[0] = True
@@ -419,6 +434,10 @@ async def run_chaos(
         if nemesis is not None:
             stats["nemesis"] = dict(nemesis.injected)
             stats["nemesis_trace_len"] = len(nemesis.trace)
+        if store_faults is not None:
+            stats["store_faults"] = dict(store_faults.injected)
+            stats["store_trace_len"] = len(store_faults.trace)
+            stats["store_ops"] = len(store_faults.ops)
         if fuzz_task is not None:
             stats["admin_ops"] = admin_counts
         if tiered:
@@ -491,9 +510,15 @@ async def _validate_tiered(cluster, store, topic, partitions) -> dict:
             m = p.cloud_manifest()
             if m is not None:
                 for meta in m.segments:
-                    assert await store.exists(m.segment_key(meta)), (
+                    key = m.segment_key(meta)
+                    assert await store.exists(key), (
                         f"p{pid}: manifest references missing object "
-                        f"{m.segment_key(meta)}"
+                        f"{key}"
+                    )
+                    size = await store.head(key)
+                    assert size == meta.size_bytes, (
+                        f"p{pid}: manifest references truncated object "
+                        f"{key}: {size} of {meta.size_bytes} bytes"
                     )
         if store_upto >= 0:
             archived += 1

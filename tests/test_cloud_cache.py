@@ -337,8 +337,11 @@ def test_concurrent_same_chunk_single_fetch(tmp_path):
     asyncio.run(main())
 
 
-def test_truncated_object_partial_results(tmp_path):
-    """Object shorter than manifest size_bytes: partial data, no crash."""
+def test_truncated_object_degrades_typed(tmp_path):
+    """Object shorter than manifest size_bytes with nothing readable:
+    a typed CloudUnavailableError (retriable at the Kafka layer), not
+    a hang and not a silent empty success."""
+    from redpanda_tpu.cloud.object_store import CloudUnavailableError
     from redpanda_tpu.cloud.remote_partition import RemoteReader
 
     async def main():
@@ -350,10 +353,8 @@ def test_truncated_object_partial_results(tmp_path):
             store,
             cache=CloudCache(str(tmp_path / "c"), chunk_size=8 << 10),
         )
-        got = await rr.read_kafka(manifest, 0, max_bytes=1 << 30)
-        offs = [kbase for kbase, _b in got]
-        assert offs == sorted(offs)
-        assert len(offs) < 10  # partial — and no exception escaped
+        with pytest.raises(CloudUnavailableError):
+            await rr.read_kafka(manifest, 0, max_bytes=1 << 30)
 
     asyncio.run(main())
 

@@ -616,3 +616,104 @@ def test_two_shard_fleet_scrape_and_stitched_traces(tmp_path):
         assert multi, f"no stitched multi-process tree: {stitched!r}"
         spans = multi[-1]["spans"]
         assert {s.get("shard") for s in spans} >= {0, 1}
+
+
+def test_cloud_probe_families_move_under_tiered_load(tmp_path):
+    """The tiered read path's /metrics surface: drive produce ->
+    archive -> evict -> cold fetch (with one injected transient store
+    error so the retry counter moves) and require the cloud families
+    to show up typed and non-zero."""
+    from redpanda_tpu.cloud import (
+        MemoryObjectStore,
+        NemesisObjectStore,
+        StoreFaultSchedule,
+        StoreRule,
+    )
+
+    async def main():
+        nem = NemesisObjectStore(MemoryObjectStore())
+        b = Broker(
+            BrokerConfig(
+                node_id=0,
+                data_dir=str(tmp_path / "n0"),
+                members=[0],
+                election_timeout_s=0.15,
+                heartbeat_interval_s=0.03,
+                housekeeping_interval_s=0,
+                archival_interval_s=0,
+            ),
+            loopback=LoopbackNetwork(),
+            object_store=nem,
+        )
+        await b.start()
+        b.config.peer_kafka_addresses = {0: b.kafka_advertised}
+        try:
+            await b.wait_controller_leader()
+            client = KafkaClient([b.kafka_advertised])
+            await client.create_topic(
+                "ct",
+                partitions=1,
+                replication_factor=1,
+                configs={
+                    "redpanda.remote.write": "true",
+                    "redpanda.remote.read": "true",
+                    "segment.bytes": "400",
+                    "retention.bytes": "400",
+                },
+            )
+            for i in range(12):
+                await client.produce("ct", 0, [(b"k%d" % i, b"v%d" % i)])
+            from redpanda_tpu.models.fundamental import kafka_ntp
+
+            p = b.partition_manager.get(kafka_ntp("ct", 0))
+            p.log.flush()
+            await b.archival.run_once()
+            b.storage.log_mgr.housekeeping()
+            # one transient range-read error: the RetryingStore retry
+            # loop fires on_retry -> the counter must move
+            nem.install(
+                StoreFaultSchedule(
+                    rules=[StoreRule(op="get_range", action="error", count=1)],
+                    seed=11,
+                )
+            )
+            got = await client.fetch("ct", 0, 0, max_bytes=1 << 22)
+            assert len(got) == 12
+            await client.close()
+
+            st, text = await http(b.admin.address, "GET", "/metrics")
+            assert st == 200
+            types, samples = parse_prometheus(
+                text.decode() if isinstance(text, bytes) else text
+            )
+            assert types.get("redpanda_tpu_cloud_read_seconds") == "histogram"
+            cold = [
+                v
+                for n, l, v in samples
+                if n == "redpanda_tpu_cloud_read_seconds_count"
+                and l.get("path") == "cold"
+            ]
+            assert cold and sum(cold) > 0, "cold read never observed"
+            retries = [
+                v
+                for n, l, v in samples
+                if n == "redpanda_tpu_cloud_op_retries_total"
+            ]
+            assert retries and sum(retries) > 0, "retry counter never moved"
+            hyd = [
+                v
+                for n, _l, v in samples
+                if n == "redpanda_tpu_cloud_hydrations_total"
+            ]
+            assert hyd and hyd[0] > 0
+            for fam in (
+                "redpanda_tpu_cloud_cache_bytes",
+                "redpanda_tpu_cloud_cache_hits_total",
+                "redpanda_tpu_cloud_cache_misses_total",
+                "redpanda_tpu_cloud_degradation_events_total",
+            ):
+                assert fam in types, f"{fam} missing from /metrics"
+        finally:
+            await b.stop()
+
+    asyncio.run(main())

@@ -1090,3 +1090,134 @@ def test_rpl012_baseline_is_empty():
     grandfathered."""
     baseline = load_baseline()
     assert [k for k in baseline if k.endswith("::RPL012")] == []
+
+
+# -- RPL013: cloud await budget ----------------------------------------
+
+RPL013_BAD = """
+async def sync(self):
+    data = await self.store.get("manifest.bin")
+    return data
+"""
+
+RPL013_TIMEOUT_KWARG = """
+async def sync(self):
+    return await self.store.get("manifest.bin", timeout=5.0)
+"""
+
+RPL013_WAIT_FOR = """
+import asyncio
+
+async def sync(self):
+    return await asyncio.wait_for(self.store.get("manifest.bin"), timeout=5.0)
+"""
+
+RPL013_CHAIN_BUDGET = """
+async def sync(self, chain):
+    while True:
+        try:
+            return await self.store.get("manifest.bin")
+        except StoreError:
+            if not await chain.backoff():
+                raise
+"""
+
+RPL013_RETRYING_BINDING = """
+class Archiver:
+    def __init__(self, store):
+        self.store = (
+            store if isinstance(store, RetryingStore) else RetryingStore(store)
+        )
+
+    async def sync(self):
+        return await self.store.get("manifest.bin")
+"""
+
+
+def test_rpl013_unbounded_store_await_flagged(tmp_path):
+    findings = _only(
+        _lint_source(tmp_path, RPL013_BAD, "cloud/archiver.py"), "RPL013"
+    )
+    assert len(findings) == 1
+    assert findings[0].line == 3
+    assert "'get'" in findings[0].message
+
+
+def test_rpl013_non_store_receiver_clean(tmp_path):
+    src = """
+    async def fetch(self):
+        return await self.cache.get("k")
+    """
+    assert (
+        _only(_lint_source(tmp_path, src, "cloud/archiver.py"), "RPL013")
+        == []
+    )
+
+
+def test_rpl013_timeout_kwarg_clean(tmp_path):
+    assert (
+        _only(
+            _lint_source(tmp_path, RPL013_TIMEOUT_KWARG, "cloud/mod.py"),
+            "RPL013",
+        )
+        == []
+    )
+
+
+def test_rpl013_wait_for_wrapper_clean(tmp_path):
+    assert (
+        _only(
+            _lint_source(tmp_path, RPL013_WAIT_FOR, "app.py"), "RPL013"
+        )
+        == []
+    )
+
+
+def test_rpl013_retry_chain_budget_clean(tmp_path):
+    assert (
+        _only(
+            _lint_source(tmp_path, RPL013_CHAIN_BUDGET, "cloud/mod.py"),
+            "RPL013",
+        )
+        == []
+    )
+
+
+def test_rpl013_retrying_store_binding_clean(tmp_path):
+    """The in-file `self.store = RetryingStore(...)` idiom budgets every
+    call through that receiver — the whole point of wrapping at
+    construction time."""
+    assert (
+        _only(
+            _lint_source(
+                tmp_path, RPL013_RETRYING_BINDING, "cloud/archiver.py"
+            ),
+            "RPL013",
+        )
+        == []
+    )
+
+
+def test_rpl013_store_impl_files_exempt(tmp_path):
+    # the store implementations ARE the layer the budgets wrap
+    for rel in ("cloud/object_store.py", "cloud/nemesis.py"):
+        assert (
+            _only(_lint_source(tmp_path, RPL013_BAD, rel), "RPL013") == []
+        )
+
+
+def test_rpl013_suppression(tmp_path):
+    src = RPL013_BAD.replace(
+        'await self.store.get("manifest.bin")',
+        'await self.store.get("manifest.bin")  # rplint: disable=RPL013',
+    )
+    assert (
+        _only(_lint_source(tmp_path, src, "cloud/mod.py"), "RPL013") == []
+    )
+
+
+def test_rpl013_baseline_is_empty():
+    """Cloud budget discipline is fully enforced from day one: every
+    store call site carries its deadline or RetryingStore wrap."""
+    baseline = load_baseline()
+    assert [k for k in baseline if k.endswith("::RPL013")] == []

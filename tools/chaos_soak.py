@@ -36,15 +36,31 @@ def main() -> int:
     ap.add_argument("--duration", type=float, default=4.0,
                     help="fault window per iteration (s)")
     ap.add_argument("--tiered", action="store_true")
+    ap.add_argument("--store-faults", action="store_true",
+                    help="arm the ObjectNemesis mixed fault schedule "
+                    "(partial/torn/slow/error/throttle) on the tiered "
+                    "object store; implies --tiered")
     ap.add_argument("--seed", type=int, default=None,
                     help="reproduce one failing iteration and exit")
     args = ap.parse_args()
+    if args.store_faults:
+        args.tiered = True
 
     from chaos_harness import run_chaos
 
     shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
 
     def one(seed: int) -> dict:
+        store_faults = None
+        if args.store_faults:
+            from dataclasses import replace
+
+            from redpanda_tpu.cloud import StoreFaultSchedule
+            from tiered_smoke import default_rules
+
+            store_faults = StoreFaultSchedule(
+                rules=[replace(r) for r in default_rules()], seed=seed
+            )
         with tempfile.TemporaryDirectory(prefix="soak_", dir=shm) as d:
             return asyncio.run(
                 run_chaos(
@@ -54,6 +70,7 @@ def main() -> int:
                     faults=("partition", "crash", "transfer"),
                     tiered=args.tiered,
                     admin_ops=True,
+                    store_faults=store_faults,
                 )
             )
 
@@ -71,10 +88,16 @@ def main() -> int:
         t0 = time.monotonic()
         try:
             stats = one(seed)
+            store = ""
+            if "store_faults" in stats:
+                store = (
+                    f"store={sum(stats['store_faults'].values())}"
+                    f"/{stats['store_ops']} "
+                )
             print(
                 f"[{n:>4}] seed={seed:<12} ok  acked={stats['acked']:<5} "
                 f"admin={sum(stats.get('admin_ops', {}).values())} "
-                f"({time.monotonic()-t0:.1f}s)",
+                f"{store}({time.monotonic()-t0:.1f}s)",
                 flush=True,
             )
         except Exception:

@@ -14,6 +14,7 @@ from .rpl009_shard_discipline import ShardDisciplineRule
 from .rpl010_metrics_discipline import MetricsDisciplineRule
 from .rpl011_tick_discipline import TickDisciplineRule
 from .rpl012_cardinality import CardinalityDisciplineRule
+from .rpl013_cloud_budget import CloudAwaitBudgetRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -28,6 +29,7 @@ ALL_RULES = [
     MetricsDisciplineRule,
     TickDisciplineRule,
     CardinalityDisciplineRule,
+    CloudAwaitBudgetRule,
 ]
 
 __all__ = ["ALL_RULES"]

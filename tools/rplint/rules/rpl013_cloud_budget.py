@@ -1,0 +1,197 @@
+"""RPL013 — awaited object-store calls must carry a deadline or
+retry budget.
+
+Under ObjectNemesis schedules (cloud/nemesis.py) any object-store op
+can hang, throttle, or slow-trickle: an unbounded `await store.get(...)`
+turns one wedged upload into a stuck archiver pass or a fetch fiber
+that never answers — the exact shape the tiered chaos scenario hunts.
+Every awaited store op outside the store implementations themselves
+must be bounded by one of:
+
+  * a `timeout` keyword on the call itself;
+  * an enclosing `asyncio.wait_for(...)` / `async with
+    asyncio.timeout(...)` wrapper;
+  * a function-scope RetryChainNode budget (`utils/retry_chain.py`);
+  * the receiver being bound to a `RetryingStore(...)` in the same
+    file — RetryingStore owns per-attempt timeouts and a per-op
+    deadline, so calls through it are budgeted by construction.
+
+Scope: async functions anywhere in the tree EXCEPT the store
+implementations (cloud/object_store.py, cloud/nemesis.py and the
+s3/abs/http client stack), which are the layer the budgets wrap.
+Flagged ops: `.put .get .get_range .exists .list .delete .head` on a
+receiver whose dotted name mentions "store" (`self.store`,
+`object_store`, `self.archival.store`, ...).
+
+Deliberate pass-throughs carry `# rplint: disable=RPL013` or live in
+the ratchet baseline.
+
+Extends RPL006 (net-await-budget) from the RPC plane to the cloud
+plane; same production incident shape, different substrate.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext, dotted_name
+
+_STORE_OPS = {"put", "get", "get_range", "exists", "list", "delete", "head"}
+_EXEMPT_SUFFIXES = (
+    "cloud/object_store.py",
+    "cloud/nemesis.py",
+    "cloud/s3_client.py",
+    "cloud/abs_client.py",
+    "cloud/http_client.py",
+)
+
+
+class CloudAwaitBudgetRule:
+    code = "RPL013"
+    name = "cloud-await-budget"
+
+    def _in_scope(self, ctx: ModuleContext) -> bool:
+        return not ctx.path.endswith(_EXEMPT_SUFFIXES)
+
+    def check(self, ctx: ModuleContext):
+        if not self._in_scope(ctx):
+            return
+        retrying = self._retrying_bindings(ctx.tree)
+        for fn in ctx.functions():
+            if not fn.is_async:
+                continue
+            body = list(self._own_nodes(fn.node))
+            if self._has_chain_budget(body):
+                continue
+            guarded = self._guarded_awaits(fn.node)
+            for node in body:
+                if not isinstance(node, ast.Await):
+                    continue
+                target = self._store_target(node.value)
+                if target is None:
+                    continue
+                call, op, receiver = target
+                if self._bounded(call):
+                    continue
+                if self._receiver_retrying(receiver, retrying):
+                    continue
+                if id(node) in guarded or ctx.suppressed(node, self.code):
+                    continue
+                yield Finding(
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.code,
+                    message=(
+                        f"awaited object-store '{op}' on '{receiver}' "
+                        f"without timeout, RetryChainNode budget, or "
+                        f"RetryingStore binding in async '{fn.qualname}'"
+                    ),
+                    qualname=fn.qualname,
+                )
+
+    # -- helpers ------------------------------------------------------
+    def _own_nodes(self, func: ast.AST):
+        """Body nodes excluding nested function defs (same scoping rule
+        as RPL006: a nested helper runs wherever it's called from)."""
+        stack = list(getattr(func, "body", []))
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                stack.append(child)
+
+    def _store_target(self, expr: ast.AST):
+        """(call, op, receiver_dotted) when `expr` awaits a store op on
+        a store-ish receiver; None otherwise."""
+        if not isinstance(expr, ast.Call):
+            return None
+        if not isinstance(expr.func, ast.Attribute):
+            return None
+        op = expr.func.attr
+        if op not in _STORE_OPS:
+            return None
+        receiver = dotted_name(expr.func.value)
+        if "store" not in receiver.lower():
+            return None
+        return expr, op, receiver
+
+    @staticmethod
+    def _retrying_bindings(tree: ast.Module) -> set[str]:
+        """Attribute/name leaves assigned (possibly conditionally) from
+        a RetryingStore(...) call anywhere in the file: `self.store =
+        ... RetryingStore(store) ...` makes `store` a budgeted leaf."""
+        out: set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if value is None or not _has_retrying_call(value):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                leaf = dotted_name(t).rsplit(".", 1)[-1]
+                if leaf:
+                    out.add(leaf)
+        return out
+
+    @staticmethod
+    def _receiver_retrying(receiver: str, retrying: set[str]) -> bool:
+        return receiver.rsplit(".", 1)[-1] in retrying
+
+    def _bounded(self, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                return True
+        return False
+
+    def _has_chain_budget(self, body) -> bool:
+        for node in body:
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func).lower()
+                if name.endswith(".backoff") or "retry" in name:
+                    return True
+        return False
+
+    def _guarded_awaits(self, func: ast.AST) -> set[int]:
+        """ids of Await nodes bounded lexically: inside an async-with
+        timeout context, or whose awaited expression is itself an
+        asyncio.wait_for(...) call."""
+        out: set[int] = set()
+        for node in self._own_nodes(func):
+            if isinstance(node, ast.Await):
+                v = node.value
+                if isinstance(v, ast.Call) and "wait_for" in dotted_name(
+                    v.func
+                ):
+                    out.add(id(node))
+                continue
+            if not isinstance(node, ast.AsyncWith):
+                continue
+            if not any(
+                isinstance(item.context_expr, ast.Call)
+                and "timeout" in dotted_name(item.context_expr.func).lower()
+                for item in node.items
+            ):
+                continue
+            for sub in node.body:
+                for inner in ast.walk(sub):
+                    if isinstance(inner, ast.Await):
+                        out.add(id(inner))
+        return out
+
+
+def _has_retrying_call(value: ast.AST) -> bool:
+    """True when a Call named RetryingStore appears anywhere in the
+    assigned expression (covers `RetryingStore(s)` and the
+    `s if isinstance(s, RetryingStore) else RetryingStore(s)` idiom)."""
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Call):
+            name = dotted_name(sub.func).rsplit(".", 1)[-1]
+            if name == "RetryingStore":
+                return True
+    return False

@@ -1,0 +1,96 @@
+"""Tiered chaos smoke: one seeded ObjectNemesis run, replay-checked.
+
+verify.sh's cloud leg: boots the 3-broker chaos cluster with a tiered
+topic, arms a fixed mixed object-store fault schedule (partial
+uploads, torn manifests, slow links, transient errors, throttles) on
+top of broker faults, and holds the run to the chaos invariants (no
+acked record lost, no manifest pointing at a missing/truncated
+object). Then the determinism contract: the firing trace must replay
+byte-equal from (rules, seed, recorded op sequence) — a chaos failure
+here is a repro command, not an anecdote.
+
+Usage:
+    python tools/tiered_smoke.py [--seed N] [--duration S]
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+import tempfile
+from dataclasses import replace
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+    ),
+)
+
+
+def default_rules():
+    from redpanda_tpu.cloud import StoreRule
+
+    return [
+        StoreRule(op="put", action="partial", prob=0.15),
+        StoreRule(
+            op="put", key_glob="*manifest.bin", action="error", prob=0.1
+        ),
+        StoreRule(
+            op="get_range",
+            action="slow",
+            prob=0.1,
+            delay_s=0.0,
+            bandwidth_bps=512 * 1024,
+        ),
+        StoreRule(op="get", action="error", prob=0.1),
+        StoreRule(op="*", action="throttle", prob=0.05, delay_s=0.02),
+    ]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=515)
+    ap.add_argument("--duration", type=float, default=3.0)
+    args = ap.parse_args()
+
+    from chaos_harness import run_chaos
+    from redpanda_tpu.cloud import StoreFaultSchedule
+    from redpanda_tpu.cloud.nemesis import replay_trace
+
+    rules = default_rules()
+    sched = StoreFaultSchedule(
+        rules=[replace(r) for r in rules], seed=args.seed
+    )
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    with tempfile.TemporaryDirectory(prefix="tiered_smoke_", dir=shm) as d:
+        stats = asyncio.run(
+            run_chaos(
+                Path(d),
+                seed=args.seed,
+                duration_s=args.duration,
+                faults=("partition", "crash", "transfer"),
+                tiered=True,
+                store_faults=sched,
+            )
+        )
+    assert stats["acked"] > 0, stats
+    assert stats["tiered_archived"] >= 1, stats
+    replayed = replay_trace(rules, args.seed, sched.ops)
+    assert replayed == sched.trace, (
+        f"trace replay diverged: {len(replayed)} vs {len(sched.trace)} "
+        f"entries — determinism contract broken (seed {args.seed})"
+    )
+    print(
+        f"tiered smoke ok: seed={args.seed} acked={stats['acked']} "
+        f"archived={stats['tiered_archived']} trimmed={stats['tiered_trimmed']} "
+        f"store_ops={stats['store_ops']} faults={stats['store_faults']} "
+        f"trace={stats['store_trace_len']} (replay-equal)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -52,6 +52,9 @@ env JAX_PLATFORMS=cpu python tools/tick_frame_smoke.py
 echo "== tick-frame backend parity (host fallback vs device) =="
 env JAX_PLATFORMS=cpu python tools/tick_frame_smoke.py --parity --groups 4096
 
+echo "== tiered chaos smoke (ObjectNemesis schedule, replay-equal) =="
+env JAX_PLATFORMS=cpu python tools/tiered_smoke.py
+
 echo "== health-plane smoke (partition_health + bounded /metrics) =="
 env JAX_PLATFORMS=cpu python tools/scrape_smoke.py --health
 
