@@ -1021,11 +1021,30 @@ async def _replicated_async() -> dict:
 
             attr = LoopAttributor()
             attr.start()
+        # bracket the measured window with forced flight-data samples:
+        # the windowed history rate over exactly this span must agree
+        # with the bench's own byte count (warmup excluded both ways)
+        for b in brokers:
+            b.flightdata.sample()
+        mono_t0 = time.monotonic()
         t0 = time.perf_counter()
         await asyncio.gather(
             *(producer(i, t0 + duration_s) for i in range(n_producers))
         )
         mbps = sent / (time.perf_counter() - t0) / 1e6
+        history_mbps = None
+        try:
+            elapsed = time.monotonic() - mono_t0
+            rate = 0.0
+            for b in brokers:
+                b.flightdata.sample()
+                w = b.flightdata.counter_window(
+                    "redpanda_tpu_kafka_produce_bytes_total", elapsed
+                )
+                rate += w["total_rate"] if w else 0.0
+            history_mbps = rate / 1e6
+        except Exception as e:  # the cross-check must never sink the line
+            print(f"# history rate cross-check failed: {e}", file=sys.stderr)
         if attr is not None:
             attr.stop()
             print(
@@ -1054,6 +1073,14 @@ async def _replicated_async() -> dict:
             ),
             "cores": 1,
         }
+        if history_mbps is not None:
+            # flight-data ring vs ground truth; the bench counts record
+            # bytes client-side, the broker counter counts record-batch
+            # wire bytes, so ~1x with framing overhead in the ratio
+            out["history_mbps"] = round(history_mbps, 1)
+            out["history_vs_measured"] = (
+                round(history_mbps / mbps, 3) if mbps else -1.0
+            )
         if probe_children is not None:
             from redpanda_tpu.metrics import HistogramChild
 

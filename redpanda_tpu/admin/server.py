@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import TYPE_CHECKING
+from typing import TYPE_CHECKING, Optional
 
 from ..httpd import HttpError, HttpServer
 
@@ -115,6 +115,10 @@ class AdminServer(HttpServer):
           self._cloud_status)
         r("GET", r"/metrics", self._metrics)
         r("GET", r"/v1/shards/(\d+)/metrics", self._shard_metrics)
+        # -- flight-data plane -----------------------------------------
+        r("GET", r"/v1/metrics/history", self._metrics_history)
+        r("GET", r"/v1/alerts", self._alerts)
+        r("GET", r"/v1/debug/profile", self._debug_profile)
         # -- r4 additions toward admin_server.cc route parity ----------
         r(
             "POST",
@@ -285,7 +289,16 @@ class AdminServer(HttpServer):
 
         rep = self.broker.health_monitor.report()
         live = merge_reports(await self._local_health_reports())
+        # burn-rate alert state rides along (additive keys): a health
+        # poller sees "SLO burning" without a second request
+        alerts_mgr = getattr(self.broker, "alerts", None)
+        alert_keys = (
+            alerts_mgr.overview()
+            if alerts_mgr is not None
+            else {"alerts_firing": 0, "alerts": []}
+        )
         return {
+            **alert_keys,
             "controller_id": rep.controller_id,
             "all_nodes": [n.node_id for n in rep.nodes],
             "nodes_down": rep.nodes_down,
@@ -1487,3 +1500,149 @@ class AdminServer(HttpServer):
         except InvokeError as e:
             raise HttpError(503, f"shard {sid} unreachable: {e}") from None
         return fleet.render_snapshot(snap)
+
+    # -- flight-data plane --------------------------------------------
+    @staticmethod
+    def _parse_labels(raw: str) -> Optional[dict]:
+        """`labels=api=produce,stage=done` query form."""
+        if not raw:
+            return None
+        out = {}
+        for part in raw.split(","):
+            k, sep, v = part.partition("=")
+            if not sep or not k:
+                raise HttpError(400, f"bad labels clause {part!r}")
+            out[k.strip()] = v.strip()
+        return out
+
+    async def _metrics_history(self, _m, q, _b):
+        """Windowed queries over the metrics-history ring: counter
+        rate/delta, exact windowed histogram quantiles, gauge window
+        stats. No `family` -> the catalog. Sharded brokers merge every
+        worker's ring over invoke_on (exactly like /metrics), unless
+        `fleet=0` asks for the local shard only."""
+        from ..observability import flightdata as _fd
+
+        hist = self.broker.flightdata
+        family = (q.get("family", "") or "").strip()
+        if not family:
+            cat = hist.families()
+            cat["enabled"] = _fd.ENABLED
+            return cat
+        prefixed = f"{self.broker.metrics.prefix}_{family}"
+        if hist.kind_of(family) is None and hist.kind_of(prefixed):
+            family = prefixed  # short names accepted
+        try:
+            window_s = float(q.get("window_s", 60) or 60)
+            quant = float(q.get("q", 0.99) or 0.99)
+        except ValueError:
+            raise HttpError(400, "window_s and q must be numbers") from None
+        reduce = (q.get("reduce", "") or "").strip() or None
+        labels = self._parse_labels((q.get("labels", "") or "").strip())
+        router = getattr(self.broker, "shard_router", None)
+        if router is None or (q.get("fleet", "") or "") == "0":
+            try:
+                out = hist.query(family, window_s, reduce, quant, labels)
+            except ValueError as e:
+                raise HttpError(400, str(e)) from None
+            if out is None:
+                raise HttpError(404, f"no history for family {family!r}")
+            out["shards"] = 1
+            return out
+        # fleet merge: the local windowed view plus each worker's,
+        # counters summed by label set and histogram diff buckets
+        # merged before the quantile — exact, like render_fleet
+        from ..ssx.shards import InvokeError
+
+        wq = _fd.WindowQuery(
+            family=family, window_s=window_s, labels=labels or {}
+        )
+        replies = [_fd.window_reply(hist, 0, wq)]
+        for sid in router.worker_shards():
+            try:
+                replies.append(await router.obs_history(sid, wq))
+            except InvokeError:
+                self.broker.metrics.counter(
+                    "fleet_scrape_errors_total",
+                    "worker shard snapshots that failed during a fleet "
+                    "scrape",
+                ).inc(shard=str(sid))
+        merged = _fd.merge_window_replies(replies, q=quant)
+        if merged["kind"] is None:
+            raise HttpError(404, f"no history for family {family!r}")
+        merged["family"] = family
+        return merged
+
+    async def _alerts(self, _m, _q, _b):
+        """Burn-rate SLO alert state: firing + recently cleared alerts
+        with their breaching quantiles, hot NTPs, and auto-captured
+        profiles (observability/alerts.py)."""
+        from ..observability import alerts as _alerts_mod
+        from ..observability import flightdata as _fd
+
+        mgr = getattr(self.broker, "alerts", None)
+        if mgr is None or not (_alerts_mod.ENABLED and _fd.ENABLED):
+            return {
+                "enabled": False,
+                "rules": [],
+                "firing": [],
+                "recent": [],
+            }
+        return mgr.status()
+
+    async def _debug_profile(self, _m, q, _b):
+        """Continuous-profiler window: collapsed wall stacks over the
+        last `seconds`, per shard (workers answer over invoke_on).
+        `fmt=collapsed` renders flamegraph.pl input with a `shardN`
+        root frame; the default JSON keeps shards separate plus a
+        merged top list."""
+        from ..observability import profiler as _prof
+
+        try:
+            seconds = float(q.get("seconds", 30) or 30)
+            limit = int(q.get("limit", 50) or 50)
+        except ValueError:
+            raise HttpError(400, "seconds/limit must be numbers") from None
+        seconds = min(max(seconds, 1.0), 3600.0)
+        limit = min(max(limit, 1), 1000)
+        fmt = (q.get("fmt", "json") or "json").strip()
+        prof = getattr(self.broker, "profiler", None)
+        pq = _prof.ProfileQuery(seconds=seconds, limit=limit)
+        replies = [_prof.profile_reply(prof, 0, pq)]
+        router = getattr(self.broker, "shard_router", None)
+        if router is not None and (q.get("fleet", "") or "") != "0":
+            from ..ssx.shards import InvokeError
+
+            for sid in router.worker_shards():
+                try:
+                    replies.append(await router.obs_profile(sid, pq))
+                except InvokeError:
+                    pass
+        if fmt == "collapsed":
+            lines = []
+            for rep in replies:
+                for row in rep.rows:
+                    lines.append(f"shard{rep.shard};{row.stack} {row.count}")
+            return "\n".join(lines) + ("\n" if lines else "")
+        merged: dict[str, int] = {}
+        for rep in replies:
+            for row in rep.rows:
+                merged[row.stack] = merged.get(row.stack, 0) + row.count
+        top = sorted(merged.items(), key=lambda kv: (-kv[1], kv[0]))[:limit]
+        return {
+            "seconds": seconds,
+            "enabled": any(rep.enabled for rep in replies),
+            "samples": sum(rep.samples for rep in replies),
+            "shards": {
+                str(rep.shard): {
+                    "enabled": rep.enabled,
+                    "samples": rep.samples,
+                    "stacks": [
+                        {"stack": row.stack, "count": row.count}
+                        for row in rep.rows
+                    ],
+                }
+                for rep in replies
+            },
+            "merged": [{"stack": s, "count": n} for s, n in top],
+        }

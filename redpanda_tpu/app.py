@@ -112,6 +112,11 @@ class BrokerConfig:
     # boot graph out of the collector + rare gen2 passes. Measured
     # 3x acks=all throughput and 4x better p99 on this box.
     gc_governor: bool = True
+    # SLO declaration the live burn-rate alerting evaluates
+    # (observability/alerts.py): a bench_profiles/slo_*.json profile
+    # name or a path to one; None follows RP_SLO_PROFILE (default
+    # "default")
+    slo_profile: Optional[str] = None
     # PEM file overriding the license verification key (the built-in
     # default is the test/vendor key whose SIGNING half ships in
     # tests/data/ — a production deployment MUST set this)
@@ -253,6 +258,24 @@ class Broker:
             self.group_manager, self.load_ledger
         )
         register_exporter(self.metrics, self.health_sampler)
+        # flight-data plane (observability/flightdata|alerts|profiler):
+        # metrics-history ring with windowed reducers, live burn-rate
+        # SLO evaluation of the bench_profiles/slo_*.json declarations,
+        # and the always-on wall-stack profiler the alert auto-capture
+        # snapshots from. Each piece has its own stand-down env knob.
+        from .observability import alerts as _alerts
+        from .observability import flightdata as _flightdata
+        from .observability import profiler as _profiler
+
+        self.flightdata = _flightdata.MetricsHistory(self.metrics)
+        self.profiler = _profiler.get_profiler()
+        self.alerts = _alerts.AlertManager(
+            self.flightdata,
+            profile=config.slo_profile,
+            ledger=self.load_ledger,
+            profiler=self.profiler,
+            registry=self.metrics,
+        )
         self.shard_table = ShardTable()
         # set by ssx.ShardedBroker when worker shards are active; None
         # keeps every kafka/controller shard seam on the local path
@@ -716,6 +739,19 @@ class Broker:
         if self.archival is not None and self.config.archival_interval_s > 0:
             await self.archival.start()
         await self.stats_reporter.start()
+        # flight-data plane: history ring sampling, burn-rate alert
+        # evaluation, continuous profiler — each behind its own
+        # stand-down knob (RP_FLIGHTDATA/RP_ALERTS/RP_PROFILE)
+        from .observability import alerts as _alerts
+        from .observability import flightdata as _flightdata
+        from .observability import profiler as _profiler
+
+        if _flightdata.ENABLED:
+            self.flightdata.start()
+        if _profiler.ENABLED:
+            self.profiler.acquire()
+        if _alerts.ENABLED and _flightdata.ENABLED:
+            self.alerts.start()
         await self.transforms.start()
         if self.admin is not None:
             await self.admin.start()
@@ -823,6 +859,12 @@ class Broker:
         await self.self_test_backend.stop()
         await self.transforms.stop()
         await self.stats_reporter.stop()
+        from .observability import profiler as _profiler
+
+        await self.alerts.stop()
+        await self.flightdata.stop()
+        if _profiler.ENABLED:
+            self.profiler.release()
         if self.pandaproxy is not None:
             await self.pandaproxy.stop()
             self.pandaproxy = None

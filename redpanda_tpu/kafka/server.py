@@ -137,7 +137,7 @@ class ConnectionContext:
         "mechanism",
         "scram",
         "authenticated",
-        "session_expires_at",
+        "session_expires_mono",
         "internal",
     )
 
@@ -146,9 +146,12 @@ class ConnectionContext:
         self.mechanism: str | None = None
         self.scram = None
         self.authenticated = False
-        # unix seconds after which the SASL session is no longer valid
-        # (OAUTHBEARER: the token's exp; None = unbounded)
-        self.session_expires_at: float | None = None
+        # monotonic deadline after which the SASL session is no longer
+        # valid (OAUTHBEARER: derived from the token's exp at auth
+        # time; None = unbounded). Monotonic, not wall: the expiry
+        # check runs on every request, and a wall-clock step must not
+        # kill — or immortalize — live sessions (rplint RPL014)
+        self.session_expires_mono: float | None = None
         # True ONLY when the peer presented the broker's own certificate
         # (exact DER match) under mTLS. A flag, not a principal name, so
         # no SASL username or DN-mapping output can ever collide with it.
@@ -197,6 +200,14 @@ class KafkaServer:
         )
         self._latency_hist = broker.metrics.histogram(
             "kafka_handler_seconds", "Kafka handler latency"
+        )
+        # cumulative produce payload bytes: the flight-data history
+        # ring turns this into exact windowed ingest rates
+        # (/v1/metrics/history?family=kafka_produce_bytes_total), and
+        # bench.py cross-checks that rate against its own throughput
+        self._produce_bytes = broker.metrics.counter(
+            "kafka_produce_bytes_total",
+            "record-batch bytes accepted by produce",
         )
         # per-stage produce/fetch probe (latency_probe.h analog): all
         # label children resolved here, hot path pays bound observes
@@ -502,8 +513,8 @@ class KafkaServer:
             raise _CloseConnection(b"")
         if (
             ctx.authenticated
-            and ctx.session_expires_at is not None
-            and time.time() >= ctx.session_expires_at
+            and ctx.session_expires_mono is not None
+            and time.monotonic() >= ctx.session_expires_mono
             and hdr.api_key
             not in (API_VERSIONS.key, SASL_HANDSHAKE.key, SASL_AUTHENTICATE.key)
         ):
@@ -766,11 +777,15 @@ class KafkaServer:
         if ctx.scram.done:
             ctx.principal = f"User:{ctx.scram.username}"
             ctx.authenticated = True
-            ctx.session_expires_at = getattr(ctx.scram, "expires_at", None)
-            if ctx.session_expires_at is not None:
-                lifetime_ms = max(
-                    0, int((ctx.session_expires_at - time.time()) * 1000)
-                )
+            expires_at = getattr(ctx.scram, "expires_at", None)
+            ctx.session_expires_mono = None
+            if expires_at is not None:
+                # one wall-clock read converts the token's absolute exp
+                # into a relative lifetime; every later expiry check is
+                # monotonic-only
+                remaining = expires_at - time.time()  # rplint: disable=RPL014
+                ctx.session_expires_mono = time.monotonic() + remaining
+                lifetime_ms = max(0, int(remaining * 1000))
             logger.info("sasl: authenticated %s", ctx.principal)
         return Msg(
             error_code=0,
@@ -1148,6 +1163,7 @@ class KafkaServer:
                     await dispatch_partition(t.name, p) for p in t.partitions
                 ]
                 work.append((t.name, partition_work))
+        self._produce_bytes.inc(produced_bytes)
         throttle = self.quotas.record_and_throttle(
             "produce", hdr.client_id, produced_bytes
         )

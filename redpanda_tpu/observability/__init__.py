@@ -1,10 +1,15 @@
-"""Observability layer: flight-recorder tracing + latency probes.
+"""Observability layer: flight-recorder tracing + latency probes +
+the flight-data plane.
 
 The reference ships per-subsystem seastar probes and HdrHistograms but
 no request tracer (SURVEY §5.1); this package adds both halves for the
 port — `trace` (ring-buffered span trees with a slow-request freezer)
 feeding the admin `/v1/debug/traces` surface, with the histogram side
 living in `redpanda_tpu.metrics` + per-subsystem `*/probe.py` objects.
+On top of the point-in-time probes sits the flight-data plane:
+`flightdata` (metrics-history ring with exact windowed reducers),
+`alerts` (multi-window burn-rate SLO evaluation over that ring), and
+`profiler` (always-on wall-stack sampler with asyncio attribution).
 """
 
 from .trace import (  # noqa: F401

@@ -401,11 +401,26 @@ class PartitionShard:
             self.group_manager, self.group_manager.probe.ledger
         )
         register_exporter(self.metrics, self.health_sampler)
+        # flight-data plane, per worker shard: this shard's own history
+        # ring + profiler view, served to shard 0 over the obs service
+        # ("history"/"profile") the same way metrics/traces/health are
+        from ..observability import flightdata as _flightdata
+        from ..observability import profiler as _profiler
+
+        self.flightdata = _flightdata.MetricsHistory(self.metrics)
+        self.profiler = _profiler.get_profiler()
 
     async def start(self) -> None:
         await self.group_manager.start()
         self.ctx.register("partition", self.partition_service)
         self.ctx.register("obs", self.obs_service)
+        from ..observability import flightdata as _flightdata
+        from ..observability import profiler as _profiler
+
+        if _flightdata.ENABLED:
+            self.flightdata.start()
+        if _profiler.ENABLED:
+            self.profiler.acquire()
         self.frontend = ShardKafkaFrontend(
             self.ctx, self._config.kafka_host, self._config.kafka_port
         )
@@ -414,6 +429,11 @@ class PartitionShard:
     async def stop(self) -> None:
         if self.frontend is not None:
             await self.frontend.stop()
+        from ..observability import profiler as _profiler
+
+        await self.flightdata.stop()
+        if _profiler.ENABLED:
+            self.profiler.release()
         await self.group_manager.stop()
         self.storage.close()
 
@@ -452,6 +472,22 @@ class PartitionShard:
             )
             return fleet.health_to_envelope(
                 rep, self.ctx.shard_id, self._config.node_id
+            ).encode()
+        if method == "history":
+            from ..observability import flightdata as _fd
+
+            return _fd.window_reply(
+                self.flightdata,
+                self.ctx.shard_id,
+                _fd.WindowQuery.decode(payload),
+            ).encode()
+        if method == "profile":
+            from ..observability import profiler as _prof
+
+            return _prof.profile_reply(
+                self.profiler,
+                self.ctx.shard_id,
+                _prof.ProfileQuery.decode(payload),
             ).encode()
         raise LookupError(f"obs: no such method {method!r}")
 
@@ -792,6 +828,26 @@ class ShardRouter:
             shard, "obs", "health", b"", timeout=10.0
         )
         return fleet.envelope_to_health(fleet.HealthSnapshot.decode(raw))
+
+    async def obs_history(self, shard: int, query) -> "object":
+        """One worker shard's windowed history view (flightdata
+        WindowQuery in, WindowReply out — diff buckets on the wire so
+        the shard-0 quantile merge stays exact)."""
+        from ..observability import flightdata as _fd
+
+        raw = await self._rt.invoke_on(
+            shard, "obs", "history", query.encode(), timeout=10.0
+        )
+        return _fd.WindowReply.decode(raw)
+
+    async def obs_profile(self, shard: int, query) -> "object":
+        """One worker shard's collapsed-stack profile window."""
+        from ..observability import profiler as _prof
+
+        raw = await self._rt.invoke_on(
+            shard, "obs", "profile", query.encode(), timeout=10.0
+        )
+        return _prof.ProfileReply.decode(raw)
 
     def worker_shards(self) -> range:
         return range(1, self.n_shards)

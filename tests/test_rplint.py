@@ -1221,3 +1221,95 @@ def test_rpl013_baseline_is_empty():
     store call site carries its deadline or RetryingStore wrap."""
     baseline = load_baseline()
     assert [k for k in baseline if k.endswith("::RPL013")] == []
+
+
+# -- RPL014: clock discipline (wall-clock arithmetic on hot paths) ----
+
+RPL014_BAD = """
+import time
+
+class Session:
+    def expired(self, deadline):
+        return time.time() >= deadline
+
+    def age(self, started):
+        return time.time() - started
+"""
+
+
+def test_rpl014_wall_arithmetic_flagged(tmp_path):
+    findings = _only(
+        _lint_source(tmp_path, RPL014_BAD, "kafka/mod.py"), "RPL014"
+    )
+    assert len(findings) == 2
+    assert {f.qualname for f in findings} == {
+        "Session.expired",
+        "Session.age",
+    }
+
+
+def test_rpl014_import_aliases_followed(tmp_path):
+    src = """
+    import time as _time
+    from time import time as now
+
+    def a(t0):
+        return _time.time() - t0
+
+    def b(deadline):
+        return now() > deadline
+    """
+    findings = _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL014")
+    assert len(findings) == 2
+
+
+def test_rpl014_wall_timestamping_clean(tmp_path):
+    # Mult / bare reads are wall-clock *timestamping*, legal by contract
+    src = """
+    import time
+
+    def stamp():
+        return int(time.time() * 1000)
+
+    def record():
+        return time.time()
+    """
+    assert _only(_lint_source(tmp_path, src, "storage/mod.py"), "RPL014") == []
+
+
+def test_rpl014_monotonic_clean(tmp_path):
+    src = """
+    import time
+
+    def age(started):
+        return time.monotonic() - started
+    """
+    assert _only(_lint_source(tmp_path, src, "kafka/mod.py"), "RPL014") == []
+
+
+def test_rpl014_cold_dir_clean(tmp_path):
+    # interval math on the wall clock outside the hot dirs is out of
+    # scope (e.g. security/ token expiry works in wall time by nature)
+    assert (
+        _only(_lint_source(tmp_path, RPL014_BAD, "security/mod.py"), "RPL014")
+        == []
+    )
+
+
+def test_rpl014_suppression(tmp_path):
+    src = RPL014_BAD.replace(
+        "return time.time() - started",
+        "return time.time() - started  # rplint: disable=RPL014",
+    ).replace(
+        "return time.time() >= deadline",
+        "return time.time() >= deadline  # rplint: disable=RPL014",
+    )
+    assert _only(_lint_source(tmp_path, src, "kafka/mod.py"), "RPL014") == []
+
+
+def test_rpl014_baseline_is_empty():
+    """Clock discipline is fully enforced from day one: the hot dirs
+    measure with time.monotonic(); the single wall->monotonic rebase in
+    kafka/server.py carries its suppression as documentation."""
+    baseline = load_baseline()
+    assert [k for k in baseline if k.endswith("::RPL014")] == []

@@ -1,0 +1,266 @@
+#!/usr/bin/env python3
+"""bench_gate: grade a fresh bench summary against the BENCH_r*.json
+trajectory.
+
+The driver archives every round's bench run as BENCH_r<NN>.json
+({n, cmd, rc, tail, parsed}); the repo promises monotone-ish perf, but
+until now nothing *mechanical* compared a new run to the trajectory —
+regressions were caught by a human reading two JSON blobs. This tool
+closes that:
+
+    python bench.py --only replicated > /tmp/bench.out
+    python tools/bench_gate.py --summary /tmp/bench.out
+
+It extracts every `{"metric": ..., "value": ..., "unit": ...}` object
+from the fresh summary (the bench's machine-readable last line, or a
+file that IS that object), finds the most recent trajectory round
+carrying the same metric, and fails (exit 1) when the fresh value
+regresses past --tolerance in the unit's bad direction (throughput
+units regress down, latency units regress up).
+
+Older rounds need salvage: r03+ archives have `parsed: null` with the
+real summary as the last line of a 2000-char `tail` — truncated at the
+FRONT, so `json.loads(last_line)` fails. The gate rescues every
+balanced sub-object that survived the window instead of parsing the
+line wholesale, which recovers the per-bench extras even when the
+headline was cut.
+
+`--selftest` exercises the whole path without running a bench (a
+synthetic summary built from the trajectory must pass; a degraded copy
+must fail) — that's the verify.sh smoke leg.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# units where bigger is better; anything matching _LAT_RE is
+# smaller-is-better; other units are reported but not graded
+_THROUGHPUT_RE = re.compile(r"/s$|bps$", re.IGNORECASE)
+_LAT_RE = re.compile(r"^(ns|us|ms|s)$", re.IGNORECASE)
+
+
+def _direction(unit: str) -> int:
+    """+1 higher-better, -1 lower-better, 0 ungraded."""
+    if _THROUGHPUT_RE.search(unit or ""):
+        return 1
+    if _LAT_RE.match(unit or ""):
+        return -1
+    return 0
+
+
+def _balanced_objects(text: str):
+    """Yield every parseable top-level-balanced {...} span in `text`.
+
+    Tolerates truncated fronts (the BENCH tail window): scanning from
+    each '{' and bracket-matching recovers complete sub-objects even
+    when the enclosing object lost its opening brace to the window.
+    """
+    i, n = 0, len(text)
+    while i < n:
+        if text[i] != "{":
+            i += 1
+            continue
+        depth, j, in_str, esc = 0, i, False, False
+        while j < n:
+            c = text[j]
+            if in_str:
+                if esc:
+                    esc = False
+                elif c == "\\":
+                    esc = True
+                elif c == '"':
+                    in_str = False
+            elif c == '"':
+                in_str = True
+            elif c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        if depth == 0 and j < n:
+            span = text[i : j + 1]
+            try:
+                yield json.loads(span)
+            except ValueError:
+                pass
+            i = j + 1
+        else:
+            i += 1
+
+
+def _collect_metrics(obj, out: dict) -> None:
+    """Flatten: every sub-dict carrying metric+value becomes one row.
+    First writer wins so the outermost (headline) context sticks."""
+    if not isinstance(obj, dict):
+        return
+    name = obj.get("metric")
+    if isinstance(name, str) and isinstance(obj.get("value"), (int, float)):
+        out.setdefault(
+            name, {"value": float(obj["value"]), "unit": str(obj.get("unit", ""))}
+        )
+    for v in obj.values():
+        if isinstance(v, dict):
+            _collect_metrics(v, out)
+
+
+def load_round(path: str) -> tuple[int, dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    rnd = int(doc.get("n", 0))
+    metrics: dict = {}
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        _collect_metrics(parsed, metrics)
+    else:
+        tail = doc.get("tail") or ""
+        lines = [ln for ln in tail.strip().splitlines() if ln.strip()]
+        if lines:
+            for sub in _balanced_objects(lines[-1]):
+                _collect_metrics(sub, metrics)
+    return rnd, metrics
+
+
+def load_history(pattern: str) -> list[tuple[int, str, dict]]:
+    rounds = []
+    for path in sorted(glob.glob(pattern)):
+        try:
+            rnd, metrics = load_round(path)
+        except (OSError, ValueError) as e:
+            print(f"# bench_gate: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+            continue
+        if metrics:
+            rounds.append((rnd, os.path.basename(path), metrics))
+    rounds.sort(key=lambda r: r[0])
+    return rounds
+
+
+def load_summary(path: str) -> dict:
+    """Fresh summary: a JSON file, or raw bench stdout whose TRUE final
+    line is the summary (bench.py's _emit_summary contract)."""
+    with open(path) as f:
+        text = f.read()
+    metrics: dict = {}
+    try:
+        _collect_metrics(json.loads(text), metrics)
+        return metrics
+    except ValueError:
+        pass
+    lines = [ln for ln in text.strip().splitlines() if ln.strip()]
+    if lines:
+        for sub in _balanced_objects(lines[-1]):
+            _collect_metrics(sub, metrics)
+    return metrics
+
+
+def gate(fresh: dict, history: list, tolerance: float) -> tuple[list, list]:
+    """Returns (rows, failures); a row is a human-readable verdict."""
+    rows, failures = [], []
+    for name, cur in sorted(fresh.items()):
+        ref = None
+        for rnd, fname, metrics in reversed(history):
+            if name in metrics:
+                ref = (rnd, fname, metrics[name])
+                break
+        if ref is None:
+            rows.append(f"NEW   {name} = {cur['value']} {cur['unit']} "
+                        "(no trajectory reference)")
+            continue
+        rnd, fname, prev = ref
+        d = _direction(cur["unit"] or prev["unit"])
+        base = prev["value"]
+        if d == 0 or base == 0:
+            rows.append(f"INFO  {name}: {cur['value']} vs r{rnd:02d} {base} "
+                        f"{cur['unit']} (ungraded unit)")
+            continue
+        ratio = cur["value"] / base
+        regressed = ratio < (1.0 - tolerance) if d > 0 else ratio > (1.0 + tolerance)
+        tag = "FAIL " if regressed else "OK   "
+        line = (f"{tag} {name}: {cur['value']:g} vs r{rnd:02d}={base:g} "
+                f"{cur['unit']} ({'higher' if d > 0 else 'lower'}-better, "
+                f"x{ratio:.3f}, tol {tolerance:.0%})")
+        rows.append(line)
+        if regressed:
+            failures.append(line)
+    return rows, failures
+
+
+def selftest(pattern: str, tolerance: float) -> int:
+    history = load_history(pattern)
+    if not history:
+        print(f"bench_gate selftest: no trajectory matched {pattern}",
+              file=sys.stderr)
+        return 2
+    latest = history[-1][2]
+    graded = {n: m for n, m in latest.items() if _direction(m["unit"])}
+    if not graded:
+        print("bench_gate selftest: trajectory has no gradeable metric",
+              file=sys.stderr)
+        return 2
+    # a run matching the latest round must pass...
+    _, failures = gate(dict(latest), history, tolerance)
+    if failures:
+        print("bench_gate selftest: identical summary failed the gate:\n"
+              + "\n".join(failures), file=sys.stderr)
+        return 2
+    # ...and one regressed far past tolerance must fail
+    name, m = sorted(graded.items())[0]
+    factor = (1 - 2 * tolerance) if _direction(m["unit"]) > 0 else (1 + 2 * tolerance)
+    bad = {**latest, name: {**m, "value": m["value"] * factor}}
+    _, failures = gate(bad, history, tolerance)
+    if not failures:
+        print(f"bench_gate selftest: regressed '{name}' slipped through",
+              file=sys.stderr)
+        return 2
+    print(f"bench_gate selftest: ok ({len(history)} rounds, "
+          f"{len(graded)} graded metrics, regression on '{name}' caught)")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--summary", help="fresh summary: JSON file or raw "
+                    "bench stdout (summary = last line)")
+    ap.add_argument("--history", default=os.path.join(REPO_ROOT, "BENCH_r*.json"),
+                    help="trajectory glob (default: repo BENCH_r*.json)")
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="allowed fractional regression (default 0.25 — "
+                    "single-run benches on shared hardware are noisy)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="validate extraction+grading against the "
+                    "trajectory itself; no bench run needed")
+    args = ap.parse_args()
+
+    if args.selftest:
+        return selftest(args.history, args.tolerance)
+    if not args.summary:
+        ap.error("--summary FILE required (or --selftest)")
+
+    history = load_history(args.history)
+    fresh = load_summary(args.summary)
+    if not fresh:
+        print(f"bench_gate: no metrics found in {args.summary}", file=sys.stderr)
+        return 2
+    rows, failures = gate(fresh, history, args.tolerance)
+    print("\n".join(rows))
+    if failures:
+        print(f"\nbench_gate: {len(failures)} regression(s) past "
+              f"{args.tolerance:.0%} tolerance", file=sys.stderr)
+        return 1
+    print(f"\nbench_gate: pass ({len(rows)} metrics vs "
+          f"{len(history)} trajectory rounds)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,8 +8,14 @@ the cumulative lag distribution. `--json` emits the raw document
 instead (pipe it to a file and replay it offline later with
 `python tools/log_viewer.py --health dump.json` — same renderer).
 
+`--alerts` additionally fetches `GET /v1/alerts` and appends the
+burn-rate SLO section: rule thresholds, firing alerts with their burn
+bars / hot NTPs / captured profile stacks, and the recently-cleared
+tail. A saved alerts dump replays offline with
+`python tools/log_viewer.py --alerts dump.json` — same renderer.
+
 Usage:
-    python tools/health_report.py [ADDR] [--top-k N] [--json]
+    python tools/health_report.py [ADDR] [--top-k N] [--json] [--alerts]
 
 ADDR defaults to 127.0.0.1:9644.
 """
@@ -26,13 +32,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _BAR_WIDTH = 30
 
 
-def _fetch(addr: str, top_k: int) -> dict:
+def _fetch(addr: str, path: str) -> dict:
     import http.client
 
     host, _, port = addr.partition(":")
     conn = http.client.HTTPConnection(host, int(port or 9644), timeout=10)
     try:
-        conn.request("GET", f"/v1/cluster/partition_health?top_k={top_k}")
+        conn.request("GET", path)
         resp = conn.getresponse()
         body = resp.read()
         if resp.status != 200:
@@ -132,6 +138,89 @@ def render_report(rep: dict, out=None) -> None:
             p(f"  lag <= {edge:>6}  {'#' * n:<{_BAR_WIDTH}}  {in_bucket}")
 
 
+def _burn_bar(burn: float, cap: float = 4.0) -> str:
+    """Bar from 0 (healthy) to `cap`x the SLO threshold; 1.0 is the
+    breach line, marked so the eye finds it."""
+    frac = min(max(burn, 0.0) / cap, 1.0)
+    n = round(frac * _BAR_WIDTH)
+    mark = round(1.0 / cap * _BAR_WIDTH)
+    bar = ["#" if i < n else "." for i in range(_BAR_WIDTH)]
+    if 0 <= mark < _BAR_WIDTH:
+        bar[mark] = "|"
+    return "[" + "".join(bar) + f"] {burn:.2f}x"
+
+
+def _fmt_wall(ts) -> str:
+    if not ts:
+        return "-"
+    import datetime
+
+    return datetime.datetime.fromtimestamp(
+        ts, tz=datetime.timezone.utc
+    ).strftime("%H:%M:%SZ")
+
+
+def render_alerts(doc: dict, out=None) -> None:
+    """Human rendering of one /v1/alerts document (live fetch or an
+    offline dump; log_viewer --alerts reuses this)."""
+    out = out if out is not None else sys.stdout
+    p = lambda s="": print(s, file=out)  # noqa: E731
+    if not doc.get("enabled", False):
+        p("alerts: disabled (RP_ALERTS=0 or flight-data ring off)")
+        return
+    p(
+        f"alerts @ slo profile '{doc.get('profile')}' "
+        f"(fast {doc.get('fast_window_s')}s / slow {doc.get('slow_window_s')}s, "
+        f"{doc.get('evaluations', 0)} evaluations)"
+    )
+    for r in doc.get("rules") or []:
+        p(
+            f"  rule {r.get('name'):<18} {r.get('kind'):<9} "
+            f"threshold {r.get('threshold')} {r.get('unit', '')}".rstrip()
+        )
+
+    firing = doc.get("firing") or []
+    p()
+    if not firing:
+        p("firing: none")
+    else:
+        p(f"firing ({len(firing)}):")
+        for a in firing:
+            burn = a.get("burn") or {}
+            obs = (a.get("observed") or {}).get("fast") or {}
+            p(
+                f"  {a.get('name')}  since {_fmt_wall(a.get('fired_wall'))}"
+                f"  observed {obs.get('value', 0):.6g}"
+                f" > {(a.get('rule') or {}).get('threshold')}"
+                f" {(a.get('rule') or {}).get('unit', '')}".rstrip()
+            )
+            p(f"    burn fast  {_burn_bar(burn.get('fast', 0.0))}")
+            p(f"    burn slow  {_burn_bar(burn.get('slow', 0.0))}")
+            for ntp in a.get("hot_ntps") or []:
+                p(
+                    f"    hot {str(ntp.get('key', '?')):<24} "
+                    f"{_fmt_bps(ntp.get('total_bps', 0.0))}"
+                )
+            prof = a.get("profile") or {}
+            for s in (prof.get("stacks") or [])[:5]:
+                leaf = s.get("stack", "").rsplit(";", 2)
+                p(
+                    f"    prof {s.get('pct', 0):5.1f}%  "
+                    + ";".join(leaf[-2:])
+                )
+
+    recent = doc.get("recent") or []
+    if recent:
+        p()
+        p(f"recently cleared ({len(recent)}):")
+        for a in recent:
+            p(
+                f"  {a.get('name')}  {_fmt_wall(a.get('fired_wall'))} -> "
+                f"{_fmt_wall(a.get('cleared_wall'))} "
+                f"({a.get('duration_s', 0):.1f}s)"
+            )
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -146,13 +235,24 @@ def main(argv=None) -> None:
         action="store_true",
         help="emit the raw partition_health JSON instead of rendering",
     )
+    ap.add_argument(
+        "--alerts",
+        action="store_true",
+        help="also fetch /v1/alerts and append the burn-rate SLO section",
+    )
     args = ap.parse_args(argv)
-    rep = _fetch(args.addr, args.top_k)
+    rep = _fetch(args.addr, f"/v1/cluster/partition_health?top_k={args.top_k}")
+    alerts = _fetch(args.addr, "/v1/alerts") if args.alerts else None
     if args.json:
+        if alerts is not None:
+            rep = {**rep, "alerts": alerts}
         json.dump(rep, sys.stdout, indent=2)
         print()
     else:
         render_report(rep)
+        if alerts is not None:
+            print()
+            render_alerts(alerts)
 
 
 if __name__ == "__main__":

@@ -13,6 +13,7 @@ Usage:
     python tools/log_viewer.py DATA_DIR -v                 # + records
     python tools/log_viewer.py --traces traces.json        # waterfalls
     python tools/log_viewer.py --health health.json        # health dump
+    python tools/log_viewer.py --alerts alerts.json        # SLO alerts
 
 The --traces mode renders a flight-recorder dump (the JSON from
 `GET /v1/debug/traces`, or a file of one tree per line) as aligned
@@ -23,6 +24,10 @@ The --health mode replays a partition-health dump (the JSON from
 `GET /v1/cluster/partition_health`, e.g. saved via
 `tools/health_report.py --json`) through the same renderer the live
 CLI uses: top-k laggy/hot tables, skew bars, lag distribution.
+
+The --alerts mode does the same for a burn-rate SLO dump (the JSON
+from `GET /v1/alerts`): rules, firing alerts with burn bars, hot NTPs
+and captured profile stacks, recently-cleared tail.
 """
 
 from __future__ import annotations
@@ -290,6 +295,11 @@ def main(argv=None) -> None:
         help="render a /v1/cluster/partition_health JSON dump "
         "(tools/health_report.py --json output)",
     )
+    ap.add_argument(
+        "--alerts",
+        metavar="FILE",
+        help="render a /v1/alerts JSON dump (burn-rate SLO section)",
+    )
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -304,8 +314,19 @@ def main(argv=None) -> None:
         with open(args.health, "r", encoding="utf-8") as f:
             render_report(json.load(f))
         return
+    if args.alerts:
+        import json
+
+        from tools.health_report import render_alerts
+
+        with open(args.alerts, "r", encoding="utf-8") as f:
+            render_alerts(json.load(f))
+        return
     if not args.data_dir:
-        ap.error("data_dir is required unless --traces or --health is given")
+        ap.error(
+            "data_dir is required unless --traces, --health or "
+            "--alerts is given"
+        )
 
     if args.controller:
         cdir = os.path.join(args.data_dir, "group_0")

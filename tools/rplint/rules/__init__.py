@@ -15,6 +15,7 @@ from .rpl010_metrics_discipline import MetricsDisciplineRule
 from .rpl011_tick_discipline import TickDisciplineRule
 from .rpl012_cardinality import CardinalityDisciplineRule
 from .rpl013_cloud_budget import CloudAwaitBudgetRule
+from .rpl014_clock_discipline import ClockDisciplineRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -30,6 +31,7 @@ ALL_RULES = [
     TickDisciplineRule,
     CardinalityDisciplineRule,
     CloudAwaitBudgetRule,
+    ClockDisciplineRule,
 ]
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,122 @@
+"""RPL014 — clock discipline: wall-clock arithmetic forbidden on hot
+paths.
+
+`time.time()` is not monotonic: NTP slews and steps move it backwards
+and forwards while the broker runs. Any *duration* or *deadline* math
+built on it — `time.time() - started`, `time.time() >= expires_at` —
+silently mismeasures across a clock step, which on the request path
+turns into sessions expiring early, latency samples going negative, or
+timeouts never firing (the flight-data plane's whole windowed-history
+contract assumes `time.monotonic()` for intervals and keeps wall time
+purely as an annotation).
+
+Scope — the hot directories where an interval mismeasure reaches the
+data path: `redpanda_tpu/raft/`, `redpanda_tpu/kafka/`,
+`redpanda_tpu/storage/`, `redpanda_tpu/rpc/`.
+
+Flagged: an (alias-aware) call to `time.time` appearing DIRECTLY as an
+operand of a `-` binop, or directly as a side of a `<`/`<=`/`>`/`>=`
+comparison. Both shapes are interval/ordering math on the wall clock.
+Direct context only, on purpose: wall-clock *timestamping* stays
+legal — `int(time.time() * 1000)` persisted into a record batch or a
+group-metadata snapshot is wall time by contract (Kafka timestamps,
+offset-retention epochs), and flagging it would just breed
+suppressions. The one legitimate conversion shape — reading a token's
+absolute expiry once and rebasing it onto the monotonic clock —
+carries `# rplint: disable=RPL014` as its documentation (see
+kafka/server.py's SASL session-lifetime rebase).
+
+The fix is mechanical: measure with `time.monotonic()` and keep wall
+time only for values that leave the process.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext, dotted_name
+
+_HOT_DIRS = ("raft", "kafka", "storage", "rpc")
+_ORDERING_OPS = (ast.Lt, ast.LtE, ast.Gt, ast.GtE)
+
+
+def _wall_clock_names(tree: ast.Module) -> set[str]:
+    """Dotted call names that resolve to time.time in this module,
+    following import aliases (`import time as _time`,
+    `from time import time as now`)."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    names.add(f"{alias.asname or 'time'}.time")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "time" and node.level == 0:
+                for alias in node.names:
+                    if alias.name == "time":
+                        names.add(alias.asname or "time")
+    return names
+
+
+class ClockDisciplineRule:
+    code = "RPL014"
+    name = "clock-discipline"
+
+    def check(self, ctx: ModuleContext):
+        parts = ctx.path.replace("\\", "/").split("/")
+        if not any(d in parts for d in _HOT_DIRS):
+            return
+        wall_names = _wall_clock_names(ctx.tree)
+        if not wall_names:
+            return
+        scopes = [("", ctx.tree)]
+        scopes += [(fn.qualname, None) for fn in ctx.functions()]
+        # qualname lookup: deepest function whose span contains the node
+        fn_spans = [
+            (fn.qualname, fn.node.lineno, fn.node.end_lineno or fn.node.lineno)
+            for fn in ctx.functions()
+        ]
+
+        def enclosing(node: ast.AST) -> str:
+            best = ""
+            best_start = 0
+            for qn, lo, hi in fn_spans:
+                if lo <= node.lineno <= hi and lo >= best_start:
+                    best, best_start = qn, lo
+            return best
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                operands = [node.left, node.right]
+                shape = "'-' arithmetic"
+            elif isinstance(node, ast.Compare) and any(
+                isinstance(op, _ORDERING_OPS) for op in node.ops
+            ):
+                operands = [node.left, *node.comparators]
+                shape = "ordering comparison"
+            else:
+                continue
+            hit = next(
+                (
+                    op
+                    for op in operands
+                    if isinstance(op, ast.Call)
+                    and dotted_name(op.func) in wall_names
+                ),
+                None,
+            )
+            if hit is None or ctx.suppressed(node, self.code):
+                continue
+            yield Finding(
+                path=ctx.path,
+                line=hit.lineno,
+                col=hit.col_offset,
+                rule=self.code,
+                message=(
+                    f"wall-clock {shape} on a hot path — "
+                    "time.time() is not monotonic across NTP steps; "
+                    "measure durations/deadlines with time.monotonic() "
+                    "and keep wall time for persisted annotations only"
+                ),
+                qualname=enclosing(hit),
+            )

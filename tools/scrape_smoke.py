@@ -370,11 +370,143 @@ async def main_health() -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+async def main_alerts() -> int:
+    """PR-10 flight-data smoke: boot one broker, produce, then assert
+    the metrics-history ring answers windowed queries, the burn-rate
+    alert surface is live (or degrades to enabled:false under
+    RP_ALERTS=0 / RP_FLIGHTDATA=0), and the continuous profiler serves
+    collapsed stacks (or enabled:false under RP_PROFILE=0). The same
+    leg runs both ways in verify.sh — full plane on, then stand-down —
+    so a half-disabled state can't 500 an operator surface."""
+    from redpanda_tpu.observability import alerts as _alerts
+    from redpanda_tpu.observability import flightdata as _fd
+    from redpanda_tpu.observability import profiler as _prof
+
+    tmp = tempfile.mkdtemp(prefix="rp-alerts-smoke-")
+    os.environ.setdefault("RP_FLIGHTDATA_INTERVAL_S", "0.2")
+    broker = Broker(BrokerConfig(node_id=0, data_dir=tmp, members=[0]))
+    try:
+        await broker.start()
+        await broker.wait_controller_leader()
+        from redpanda_tpu.kafka.client import KafkaClient
+
+        client = KafkaClient([broker.kafka_advertised])
+        try:
+            await client.create_topic("smoke", partitions=1)
+            for _ in range(4):
+                await client.produce("smoke", 0, [(None, b"ping")] * 8)
+                await asyncio.sleep(0.25)
+        finally:
+            await client.close()
+        await asyncio.sleep(0.5)  # let the ring take post-traffic samples
+
+        addr = broker.admin.address
+        st, body = await _http(addr, "/v1/metrics/history")
+        if st != 200:
+            print(f"alerts smoke: history catalog returned {st}",
+                  file=sys.stderr)
+            return 1
+        cat = json.loads(body)
+        if cat.get("enabled") != _fd.ENABLED:
+            print(
+                f"alerts smoke: catalog enabled={cat.get('enabled')} but "
+                f"RP_FLIGHTDATA resolves {_fd.ENABLED}",
+                file=sys.stderr,
+            )
+            return 1
+        mode = []
+        if _fd.ENABLED:
+            if cat.get("depth", 0) < 1 or not cat.get("families"):
+                print("alerts smoke: flight-data ring empty after traffic",
+                      file=sys.stderr)
+                return 1
+            st, body = await _http(
+                addr,
+                "/v1/metrics/history?family=kafka_produce_bytes_total"
+                "&window_s=10",
+            )
+            win = json.loads(body) if st == 200 else {}
+            if st != 200 or win.get("total_delta", 0) <= 0:
+                print(
+                    f"alerts smoke: windowed produce-bytes query dead "
+                    f"(status {st}, {body[:120]!r})",
+                    file=sys.stderr,
+                )
+                return 1
+            mode.append(f"history delta={win['total_delta']:.0f}B")
+        else:
+            mode.append("history off")
+
+        st, body = await _http(addr, "/v1/alerts")
+        if st != 200:
+            print(f"alerts smoke: /v1/alerts returned {st}", file=sys.stderr)
+            return 1
+        al = json.loads(body)
+        want_alerts = _alerts.ENABLED and _fd.ENABLED
+        if al.get("enabled") != want_alerts:
+            print(
+                f"alerts smoke: /v1/alerts enabled={al.get('enabled')}, "
+                f"expected {want_alerts}",
+                file=sys.stderr,
+            )
+            return 1
+        if want_alerts:
+            names = [r["name"] for r in al.get("rules", [])]
+            if "produce_p99" not in names:
+                print(f"alerts smoke: SLO rules missing: {names}",
+                      file=sys.stderr)
+                return 1
+            mode.append(f"{len(names)} rules, {len(al.get('firing', []))} "
+                        "firing")
+        else:
+            mode.append("alerts off")
+
+        st, body = await _http(addr, "/v1/debug/profile?seconds=10&limit=5")
+        if st != 200:
+            print(f"alerts smoke: /v1/debug/profile returned {st}",
+                  file=sys.stderr)
+            return 1
+        prof = json.loads(body)
+        if prof.get("enabled") != _prof.ENABLED:
+            print(
+                f"alerts smoke: profiler enabled={prof.get('enabled')}, "
+                f"RP_PROFILE resolves {_prof.ENABLED}",
+                file=sys.stderr,
+            )
+            return 1
+        if _prof.ENABLED:
+            if prof.get("samples", 0) <= 0 or not prof.get("merged"):
+                print("alerts smoke: profiler live but no samples",
+                      file=sys.stderr)
+                return 1
+            mode.append(f"profiler {prof['samples']} samples")
+        else:
+            mode.append("profiler off")
+
+        st, body = await _http(addr, "/v1/cluster/health_overview")
+        overview = json.loads(body) if st == 200 else {}
+        if "alerts_firing" not in overview:
+            print("alerts smoke: health_overview missing alerts_firing",
+                  file=sys.stderr)
+            return 1
+
+        print("alerts smoke OK: " + ", ".join(mode))
+        return 0
+    finally:
+        try:
+            await broker.stop()
+        except Exception:
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
     if "--fleet" in sys.argv[1:]:
         entry = main_fleet
     elif "--health" in sys.argv[1:]:
         entry = main_health
+    elif "--alerts" in sys.argv[1:]:
+        entry = main_alerts
     else:
         entry = main
     raise SystemExit(asyncio.run(entry()))

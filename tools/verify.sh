@@ -58,6 +58,16 @@ env JAX_PLATFORMS=cpu python tools/tiered_smoke.py
 echo "== health-plane smoke (partition_health + bounded /metrics) =="
 env JAX_PLATFORMS=cpu python tools/scrape_smoke.py --health
 
+echo "== bench gate selftest (trajectory extraction + grading) =="
+python tools/bench_gate.py --selftest
+
+echo "== flight-data smoke (history ring + alerts + profiler) =="
+env JAX_PLATFORMS=cpu python tools/scrape_smoke.py --alerts
+
+echo "== flight-data stand-down smoke (RP_ALERTS=0 RP_PROFILE=0) =="
+env JAX_PLATFORMS=cpu RP_ALERTS=0 RP_PROFILE=0 \
+    python tools/scrape_smoke.py --alerts
+
 echo "== tracing-off smoke (RP_TRACE=0) =="
 env JAX_PLATFORMS=cpu RP_TRACE=0 python tools/scrape_smoke.py --fleet
 exec env JAX_PLATFORMS=cpu RP_TRACE=0 python -m pytest \
