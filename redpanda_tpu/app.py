@@ -41,6 +41,7 @@ from .rpc.loopback import LoopbackNetwork, LoopbackTransport
 from .rpc.server import RpcServer
 from .rpc.transport import TcpTransport
 from .storage.log_manager import StorageApi
+from .utils.tasks import cancel_and_wait
 
 
 @dataclasses.dataclass
@@ -848,13 +849,8 @@ class Broker:
         if getattr(self, "_gc_governor", None) is not None:
             self._gc_governor.stop()
             self._gc_governor = None
-        if self._join_task is not None:
-            self._join_task.cancel()
-            try:
-                await self._join_task
-            except asyncio.CancelledError:
-                pass
-            self._join_task = None
+        join_task, self._join_task = self._join_task, None
+        await cancel_and_wait(join_task)
         await self.node_status.stop()
         await self.self_test_backend.stop()
         await self.transforms.stop()
@@ -865,23 +861,18 @@ class Broker:
         await self.flightdata.stop()
         if _profiler.ENABLED:
             self.profiler.release()
-        if self.pandaproxy is not None:
-            await self.pandaproxy.stop()
-            self.pandaproxy = None
-        if self.schema_registry is not None:
-            await self.schema_registry.stop()
-            self.schema_registry = None
+        pandaproxy, self.pandaproxy = self.pandaproxy, None
+        if pandaproxy is not None:
+            await pandaproxy.stop()
+        schema_registry, self.schema_registry = self.schema_registry, None
+        if schema_registry is not None:
+            await schema_registry.stop()
         if self.admin is not None:
             await self.admin.stop()
         if self.archival is not None:
             await self.archival.stop()
-        if self._housekeeping_task is not None:
-            self._housekeeping_task.cancel()
-            try:
-                await self._housekeeping_task
-            except asyncio.CancelledError:
-                pass
-            self._housekeeping_task = None
+        hk_task, self._housekeeping_task = self._housekeeping_task, None
+        await cancel_and_wait(hk_task)
         await self.kafka_server.stop()
         await self.metadata_dissemination.stop()
         await self.tx_coordinator.stop()

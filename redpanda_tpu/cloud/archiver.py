@@ -27,6 +27,7 @@ from ..models.record import (
     RecordBatchType,
 )
 from ..raft.consensus import NotLeaderError, ReplicateTimeout
+from ..utils.tasks import cancel_and_wait
 from .manifest import PartitionManifest, SegmentMeta
 from .object_store import ObjectStore, RetryingStore, StoreError
 
@@ -550,13 +551,8 @@ class ArchivalService:
         # cancel every in-flight upload retry loop (retry_chain root
         # abort), then the scheduler task
         self.store.abort()
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        task, self._task = self._task, None
+        await cancel_and_wait(task)
 
     async def _loop(self) -> None:
         while True:
@@ -579,10 +575,14 @@ class ArchivalService:
                 a.on_degraded = self.on_degraded
                 n = await a.upload_pass()
                 # merges are counted separately: callers assert on
-                # upload counts
-                self.merges += await a.housekeeping_pass(
+                # upload counts. The await must settle BEFORE the +=
+                # touches self.merges: `self.merges += await ...` reads
+                # the counter, suspends, and writes the stale sum back,
+                # losing every merge another unit counted meanwhile.
+                merged = await a.housekeeping_pass(
                     self.merge_min_bytes, self.merge_target_bytes
                 )
+                self.merges += merged
                 return n
 
             # one partition's upload pass = one unit through the

@@ -57,14 +57,21 @@ class _SegmentView:
             return b""
         end = min(pos + n, self.size)
         ws = self._win_start
-        if not (ws <= pos and end <= ws + len(self._win)):
+        win = self._win
+        if not (ws <= pos and end <= ws + len(win)):
             win_end = min(max(end, pos + VIEW_WINDOW), self.size)
-            self._win = await self._r._read_range(
+            win = await self._r._read_range(
                 self.key, pos, win_end, self.size
             )
-            self._win_start = ws = pos
+            # last-writer-wins window cache: a concurrent read() can
+            # overwrite it across our await (worst case the window
+            # thrashes and the next miss refetches) — data is always
+            # sliced from the locals above, never from self after the
+            # suspension
+            self._win = win  # rplint: disable=RPL015
+            self._win_start = ws = pos  # rplint: disable=RPL015
         off = pos - ws
-        return self._win[off : off + (end - pos)]
+        return win[off : off + (end - pos)]
 
 
 class RemoteReader:

@@ -15,6 +15,7 @@ from typing import Callable
 
 from ..rpc.server import Service, method
 from ..utils import serde
+from ..utils.tasks import cancel_and_wait
 
 logger = logging.getLogger("cluster.node_status")
 
@@ -63,13 +64,8 @@ class NodeStatusBackend:
         self._task = asyncio.ensure_future(self._loop())
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        task, self._task = self._task, None
+        await cancel_and_wait(task)
 
     async def _loop(self) -> None:
         req = _Ping(node_id=self.node_id).encode()

@@ -12,6 +12,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+
+from ..utils.tasks import cancel_and_wait
 from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -95,13 +97,8 @@ class StatsReporter:
             self._task = asyncio.ensure_future(self._loop())
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        task, self._task = self._task, None
+        await cancel_and_wait(task)
 
     async def _loop(self) -> None:
         while True:

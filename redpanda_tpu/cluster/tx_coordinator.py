@@ -36,6 +36,7 @@ from ..models.record import RecordBatch, RecordBatchBuilder, RecordBatchType
 from ..raft.consensus import NotLeaderError, ReplicateTimeout
 from ..rpc.server import Service, method
 from ..utils import serde
+from ..utils.locks import LockMap
 from ..kafka.protocol import ErrorCode
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -164,8 +165,8 @@ class TxCoordinator:
         self.n_partitions = n_partitions
         self._txs: dict[int, dict[str, TxMeta]] = {}  # pid shard -> txs
         self._replayed: dict[int, int] = {}  # pid -> replay term
-        self._replay_locks: dict[int, asyncio.Lock] = {}
-        self._tx_locks: dict[str, asyncio.Lock] = {}  # per tx-id op lock
+        self._replay_locks = LockMap()
+        self._tx_locks = LockMap()  # per tx-id op lock
         self._create_lock = asyncio.Lock()
         self.service = TxGatewayService(broker)
         self._expire_task: Optional[asyncio.Task] = None
@@ -185,6 +186,10 @@ class TxCoordinator:
                 await t
             except (asyncio.CancelledError, Exception):
                 pass
+        # per-key registries: drop every parked lock (a holder that is
+        # still draining keeps its entry and finishes clean)
+        self._tx_locks.prune()
+        self._replay_locks.prune()
 
     # -- mapping ------------------------------------------------------
     def partition_for(self, tx_id: str) -> int:
@@ -257,7 +262,7 @@ class TxCoordinator:
         term = p.consensus.term
         if self._replayed.get(pid) == term:
             return True
-        lock = self._replay_locks.setdefault(pid, asyncio.Lock())
+        lock = self._replay_locks.lock(pid)
         async with lock:
             p = self._local_partition_pid(pid)
             if p is None:
@@ -325,7 +330,7 @@ class TxCoordinator:
 
     async def _resume(self, meta: TxMeta) -> None:
         try:
-            lock = self._tx_locks.setdefault(meta.tx_id, asyncio.Lock())
+            lock = self._tx_locks.lock(meta.tx_id)
             async with lock:
                 if meta.status not in (TX_PREPARING_COMMIT, TX_PREPARING_ABORT):
                     return
@@ -522,7 +527,7 @@ class TxCoordinator:
         shard = await self._shard_for(tx_id)
         if shard is None:
             return -1, -1, int(_E.not_coordinator)
-        lock = self._tx_locks.setdefault(tx_id, asyncio.Lock())
+        lock = self._tx_locks.lock(tx_id)
         async with lock:
             meta = shard.get(tx_id)
             now = int(time.time() * 1000)
@@ -596,7 +601,7 @@ class TxCoordinator:
         shard = await self._shard_for(tx_id)
         if shard is None:
             return int(_E.not_coordinator)
-        lock = self._tx_locks.setdefault(tx_id, asyncio.Lock())
+        lock = self._tx_locks.lock(tx_id)
         async with lock:
             meta = shard.get(tx_id)
             code = self._check_producer(meta, pid, epoch)
@@ -628,7 +633,7 @@ class TxCoordinator:
         shard = await self._shard_for(tx_id)
         if shard is None:
             return int(_E.not_coordinator)
-        lock = self._tx_locks.setdefault(tx_id, asyncio.Lock())
+        lock = self._tx_locks.lock(tx_id)
         async with lock:
             meta = shard.get(tx_id)
             code = self._check_producer(meta, pid, epoch)
@@ -659,7 +664,7 @@ class TxCoordinator:
         shard = await self._shard_for(tx_id)
         if shard is None:
             return int(_E.not_coordinator)
-        lock = self._tx_locks.setdefault(tx_id, asyncio.Lock())
+        lock = self._tx_locks.lock(tx_id)
         async with lock:
             meta = shard.get(tx_id)
             code = self._check_producer(meta, pid, epoch)
@@ -731,9 +736,7 @@ class TxCoordinator:
                                 meta.tx_id,
                                 now - meta.update_ms,
                             )
-                            lock = self._tx_locks.setdefault(
-                                meta.tx_id, asyncio.Lock()
-                            )
+                            lock = self._tx_locks.lock(meta.tx_id)
                             async with lock:
                                 if meta.status != TX_ONGOING:
                                     continue

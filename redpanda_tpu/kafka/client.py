@@ -17,6 +17,7 @@ from collections import deque
 from typing import Optional, Sequence
 
 from ..models.record import RecordBatch, RecordBatchBuilder
+from ..utils.locks import LockMap
 from .protocol import (
     API_VERSIONS,
     CREATE_TOPICS,
@@ -222,10 +223,13 @@ class BrokerConnection:
                 if not fut.done():
                     fut.set_result(payload)
         except asyncio.CancelledError:
-            self._dead = "closed"
+            # _dead is a monotonic poison flag (None -> reason): any
+            # writer's value is terminal, readers only check is-dead,
+            # so the read loop needn't take the serial-request lock
+            self._dead = "closed"  # rplint: disable=RPL016
             raise
         except Exception as e:
-            self._dead = str(e) or type(e).__name__
+            self._dead = str(e) or type(e).__name__  # rplint: disable=RPL016
             while self._pending:
                 _corr, fut = self._pending.popleft()
                 if not fut.done():
@@ -431,7 +435,7 @@ class KafkaClient:
         self._gssapi_factory = gssapi_factory
         self._serial_reads = serial_reads
         self._conns: dict[tuple[str, int], BrokerConnection] = {}
-        self._conn_locks: dict[tuple[str, int], asyncio.Lock] = {}
+        self._conn_locks = LockMap()
         self._brokers: dict[int, tuple[str, int]] = {}
         self._leaders: dict[tuple[str, int], int] = {}  # (topic,part)→node
         self._topic_errors: dict[str, int] = {}
@@ -450,7 +454,7 @@ class KafkaClient:
         # per-address serialization: concurrent callers racing a
         # reconnect would each open a socket and the loser's
         # connection (+ read task) would leak
-        lock = self._conn_locks.setdefault(addr, asyncio.Lock())
+        lock = self._conn_locks.lock(addr)
         async with lock:
             conn = self._conns.get(addr)
             if conn is not None and conn._dead is not None:
@@ -490,6 +494,8 @@ class KafkaClient:
         for conn in self._conns.values():
             await conn.close()
         self._conns.clear()
+        # connect locks for addresses nobody is dialing are dead weight
+        self._conn_locks.prune()
 
     # -- metadata ----------------------------------------------------
     async def metadata(self, topics: Optional[list[str]] = None) -> Msg:
@@ -1087,7 +1093,10 @@ class GroupClient:
         resp = await self._coord_request(JOIN_GROUP, req, v)
         if resp.error_code != 0:
             raise KafkaClientError(resp.error_code, f"join {self.group_id}")
-        self.member_id = resp.member_id
+        # the member-id handoff IS the protocol: send the old id, store
+        # the coordinator's reply; join/sync are serialized by the
+        # consumer state machine, never raced on one GroupClient
+        self.member_id = resp.member_id  # rplint: disable=RPL015
         self.generation = resp.generation_id
         return resp
 

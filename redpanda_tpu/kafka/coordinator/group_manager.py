@@ -20,6 +20,7 @@ from ...models.fundamental import DEFAULT_NS, NTP
 from ...models.record import RecordBatch, RecordBatchBuilder, RecordBatchType
 from ...raft.consensus import NotLeaderError, ReplicateTimeout
 from ...utils import serde
+from ...utils.locks import LockMap
 from ..protocol import ErrorCode
 from .group import Group, GroupState
 
@@ -162,7 +163,7 @@ class GroupCoordinator:
         # interleave across the `await g.close()` suspension and the
         # loser's shard assignment would discard groups created by
         # requests running between the two assignments
-        self._replay_locks: dict[int, asyncio.Lock] = {}
+        self._replay_locks = LockMap()
         self._create_lock = asyncio.Lock()
         self._expire_task: Optional[asyncio.Task] = None
         self._closed = False
@@ -181,6 +182,7 @@ class GroupCoordinator:
         for shard in self._groups.values():
             for g in shard.values():
                 await g.close()
+        self._replay_locks.prune()
 
     # -- mapping (coordinator_ntp_mapper.h) --------------------------
     def partition_for(self, group_id: str) -> int:
@@ -264,7 +266,7 @@ class GroupCoordinator:
         term = p.consensus.term
         if self._replayed.get(pid) == term:
             return pid
-        lock = self._replay_locks.setdefault(pid, asyncio.Lock())
+        lock = self._replay_locks.lock(pid)
         async with lock:
             # re-check under the lock: a concurrent request may have
             # completed the replay, or leadership may have moved

@@ -32,6 +32,7 @@ from collections import deque
 from typing import Callable, Optional
 
 from .flightdata import MetricsHistory
+from ..utils.tasks import cancel_and_wait
 
 logger = logging.getLogger("alerts")
 
@@ -341,13 +342,8 @@ class AlertManager:
             self._task = asyncio.ensure_future(self._run())
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        task, self._task = self._task, None
+        await cancel_and_wait(task)
 
     # -- surfacing ----------------------------------------------------
     def status(self) -> dict:

@@ -55,6 +55,7 @@ from ..utils.serde import (
     vector,
 )
 from .fleet import HistSeries
+from ..utils.tasks import cancel_and_wait
 
 ENABLED = os.environ.get("RP_FLIGHTDATA", "1") != "0"
 
@@ -205,13 +206,8 @@ class MetricsHistory:
             self._task = asyncio.ensure_future(self._run())
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        task, self._task = self._task, None
+        await cancel_and_wait(task)
 
     # -- introspection ------------------------------------------------
     def span_s(self) -> float:

@@ -27,6 +27,8 @@ import json
 import logging
 from typing import Callable, Optional
 
+from .utils.tasks import cancel_and_wait
+
 logger = logging.getLogger("rp.operator")
 
 GROUP = "redpanda.tpu"
@@ -501,13 +503,8 @@ class Operator:
         return pems
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        task, self._task = self._task, None
+        await cancel_and_wait(task)
 
     async def _loop(self) -> None:
         while True:

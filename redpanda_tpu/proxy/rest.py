@@ -16,6 +16,7 @@ import secrets
 from typing import TYPE_CHECKING, Optional
 
 from ..httpd import HttpError, HttpServer
+from ..utils.tasks import cancel_and_wait
 
 if TYPE_CHECKING:  # pragma: no cover
     from ..app import Broker
@@ -232,13 +233,8 @@ class PandaproxyServer(HttpServer):
 
     async def stop(self) -> None:
         await super().stop()
-        if self._gc_task is not None:
-            self._gc_task.cancel()
-            try:
-                await self._gc_task
-            except asyncio.CancelledError:
-                pass
-            self._gc_task = None
+        gc_task, self._gc_task = self._gc_task, None
+        await cancel_and_wait(gc_task)
         for inst in list(self._instances.values()):
             await inst.close()
         self._instances.clear()

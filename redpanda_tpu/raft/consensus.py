@@ -48,6 +48,7 @@ from ..storage.kvstore import KeySpace, KvStore, KvStoreClosed
 from ..storage.log import Log
 from ..utils import native as native_mod
 from ..utils import serde, spans
+from ..utils.locks import LockMap
 from ..utils.retry_chain import RetryChainAborted, RetryChainNode
 from . import quorum_scalar as qs
 from . import types as rt
@@ -150,7 +151,7 @@ class Consensus:
         self._voted_for: Optional[int] = None
         self._slot_map: dict[int, int] = {}
         self._next_index: dict[int, int] = {}
-        self._peer_locks: dict[int, asyncio.Lock] = {}
+        self._peer_locks = LockMap()  # one catch-up fiber per follower
         self._commit_event = asyncio.Event()
         self._leadership_waiters: list[asyncio.Event] = []
         # offset-keyed quorum waiters (heap by round-last offset):
@@ -380,7 +381,11 @@ class Consensus:
             self.arrays.last_seq[row, slot] = last_seq
             self.arrays.next_seq[row, slot] = next_seq
             self.arrays.touch()
-            self._peer_locks.setdefault(peer, asyncio.Lock())
+            self._peer_locks.lock(peer)
+        # reclaim registry entries for peers the config change dropped
+        # (a held lock survives: its catch-up fiber finishes first and
+        # the entry falls to the next prune)
+        self._peer_locks.prune(keep=peers)
         # slots past the new peer set hold stale lanes: neutralize them
         for slot in range(len(peers) + 1, self.arrays.replica_slots):
             self.arrays.match_index[row, slot] = int(NO_OFFSET)
@@ -1371,7 +1376,7 @@ class Consensus:
         (replicate_entries_stm.cc dispatch_one + recovery_stm). Drives
         the follower to the leader's dirty offset, backing off
         next_index on log mismatch."""
-        lock = self._peer_locks.setdefault(peer, asyncio.Lock())
+        lock = self._peer_locks.lock(peer)
         if lock.locked():
             return  # a fiber is already driving this follower
         async with lock:
@@ -1888,3 +1893,19 @@ class Consensus:
                 self.config = cfg
                 self._rebuild_slots()
                 self._persist_config()
+
+
+# RP_SAN=1: version-track the raft attrs whose rebinds span awaits
+# (election/vote, snapshot install, shutdown) — no-op otherwise
+from ..utils import rpsan as _rpsan  # noqa: E402
+
+_rpsan.instrument(
+    Consensus,
+    ("_role", "_voted_for", "_snap_index", "_snap_term", "_accum_size",
+     "_closed"),
+    # _step_down's resets never derive from an earlier read: they are
+    # guarded by `term > self.term`, checked loop-atomically (sync)
+    # with the write, so clobbering a vote from a STRICTLY older term
+    # is exactly raft's per-term vote reset, not a torn write
+    reset_writers={"_voted_for": ("_step_down",), "_role": ("_step_down",)},
+)

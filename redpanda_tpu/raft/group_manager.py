@@ -18,6 +18,7 @@ from .consensus import Consensus
 from .heartbeat_manager import HeartbeatManager
 from .service import RaftService
 from .shard_state import ShardGroupArrays
+from ..utils.tasks import cancel_and_wait
 
 
 class GroupManager:
@@ -108,15 +109,8 @@ class GroupManager:
         self._started = True
 
     async def stop(self) -> None:
-        import asyncio
-
-        if self._sweeper_task is not None:
-            self._sweeper_task.cancel()
-            try:
-                await self._sweeper_task
-            except asyncio.CancelledError:
-                pass
-            self._sweeper_task = None
+        sweeper, self._sweeper_task = self._sweeper_task, None
+        await cancel_and_wait(sweeper)
         # abort the node-wide retry tree FIRST: every group's catch-up
         # backoff / snapshot retry wakes immediately instead of the
         # per-group stop() waiting out jittered sleeps
@@ -324,3 +318,13 @@ class GroupManager:
             self.heartbeat_manager.deregister(group_id)
             await c.stop()
             self.arrays.free_row(c.row)
+
+
+# RP_SAN=1: sweeper-vs-registration rebinds (rows cache, election
+# floor, lifecycle flags). No-op when RP_SAN is unset.
+from ..utils import rpsan as _rpsan  # noqa: E402
+
+_rpsan.instrument(
+    GroupManager,
+    ("_rows_cache", "_min_el_timeout", "_started", "registry_epoch"),
+)

@@ -659,3 +659,11 @@ class HeartbeatManager:
             )
             c.arrays.touch()  # match_index + last_seq are SAME lanes
             c.kick_catch_up(peer)
+
+
+# RP_SAN=1: the plan cache is rebuilt inside the tick and invalidated
+# by topology callbacks — exactly the cross-task rebind shape the
+# sanitizer watches. No-op when RP_SAN is unset.
+from ..utils import rpsan as _rpsan  # noqa: E402
+
+_rpsan.instrument(HeartbeatManager, ("_plan", "_closed"))

@@ -161,7 +161,11 @@ class ReplicateBatcher:
                 # reschedule (single-producer-per-partition shape)
                 if self._items_ewma > 1.1 or len(self._items) > 1:
                     await asyncio.sleep(0)
-                items, self._items = self._items, []
+                # the sleep(0) above is the coalescing point: producers
+                # append across it ON PURPOSE, and this single-statement
+                # swap then takes every item that landed (submit()
+                # guarantees one flush task per batcher)
+                items, self._items = self._items, []  # rplint: disable=RPL015
                 self._items_ewma += 0.05 * (len(items) - self._items_ewma)
                 for it in items:
                     self._pending_bytes -= it.size

@@ -27,6 +27,8 @@ import time
 from collections import deque
 from typing import Any, Awaitable, Callable
 
+from .utils.tasks import cancel_and_wait
+
 logger = logging.getLogger("resource_mgmt")
 
 # the reference's share table (cpu_scheduling.h:23-40)
@@ -98,13 +100,8 @@ class FairScheduler:
     async def stop(self) -> None:
         self._stopped = True
         self._wakeup.set()
-        if self._runner is not None:
-            self._runner.cancel()
-            try:
-                await self._runner
-            except asyncio.CancelledError:
-                pass
-            self._runner = None
+        runner, self._runner = self._runner, None
+        await cancel_and_wait(runner)
         # fail queued units so callers never hang on shutdown
         for g in self.groups.values():
             while g.queue:

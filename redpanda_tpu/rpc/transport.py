@@ -16,6 +16,7 @@ import logging
 from typing import Optional, Protocol
 
 from . import tracectx
+from ..utils.tasks import cancel_and_wait
 from .types import (
     HEADER_SIZE,
     FrameHeader,
@@ -134,20 +135,15 @@ class TcpTransport:
             raise RpcError(Status.TIMEOUT, f"method {method_id} timed out")
 
     async def close(self) -> None:
-        if self._reader_task is not None:
-            self._reader_task.cancel()
+        reader_task, self._reader_task = self._reader_task, None
+        await cancel_and_wait(reader_task)
+        writer, self._writer = self._writer, None
+        if writer is not None:
+            writer.close()
             try:
-                await self._reader_task
-            except asyncio.CancelledError:
-                pass
-            self._reader_task = None
-        if self._writer is not None:
-            self._writer.close()
-            try:
-                await self._writer.wait_closed()
+                await writer.wait_closed()
             except Exception:
                 pass
-            self._writer = None
         self._fail_pending(ConnectionError("transport closed"))
 
 
@@ -213,11 +209,20 @@ class ReconnectTransport:
         try:
             return await t.call(method_id, payload, timeout)
         except ConnectionError:
-            self._transport = None
+            await self._drop(t)
             await t.close()
             raise
 
+    async def _drop(self, t) -> None:
+        # retire a broken transport under the connect lock, and only if
+        # it is still the installed one — a concurrent _ensure() may
+        # already have replaced it with a fresh connection that a bare
+        # `self._transport = None` would throw away
+        async with self._lock:
+            if self._transport is t:
+                self._transport = None
+
     async def close(self) -> None:
-        if self._transport is not None:
-            await self._transport.close()
-            self._transport = None
+        t, self._transport = self._transport, None
+        if t is not None:
+            await t.close()

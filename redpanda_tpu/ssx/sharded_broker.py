@@ -942,12 +942,12 @@ class ShardedBroker:
         )
 
     async def stop(self) -> None:
-        if self.broker is not None:
-            await self.broker.stop()
-            self.broker = None
-        if self.runtime is not None:
-            await self.runtime.stop()
-            self.runtime = None
+        broker, self.broker = self.broker, None
+        if broker is not None:
+            await broker.stop()
+        runtime, self.runtime = self.runtime, None
+        if runtime is not None:
+            await runtime.stop()
         if self._reserve_sock is not None:
             self._reserve_sock.close()
             self._reserve_sock = None

@@ -120,3 +120,12 @@ class FlushCoalescer:
                         fut.set_exception(err)
         finally:
             self._running = False
+
+
+# RP_SAN=1: the pending/running pair is the classic coalescer handoff
+# (submit appends, the drain task swaps) — NOT _ewma_s, which is
+# class-level state a descriptor would be clobbered by. No-op when
+# RP_SAN is unset.
+from ..utils import rpsan as _rpsan  # noqa: E402
+
+_rpsan.instrument(FlushCoalescer, ("_pending", "_running"))

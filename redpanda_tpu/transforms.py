@@ -121,9 +121,9 @@ class TransformService:
             except (asyncio.CancelledError, Exception):
                 pass
         self._fibers.clear()
-        if self._client is not None:
-            await self._client.close()
-            self._client = None
+        client, self._client = self._client, None
+        if client is not None:
+            await client.close()
 
     async def _get_client(self):
         if self._client is None:

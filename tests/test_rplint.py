@@ -1313,3 +1313,355 @@ def test_rpl014_baseline_is_empty():
     kafka/server.py carries its suppression as documentation."""
     baseline = load_baseline()
     assert [k for k in baseline if k.endswith("::RPL014")] == []
+
+
+# -- RPL015: await-atomicity (whole-program) ---------------------------
+
+
+RPL015_RMW = """\
+class Archiver:
+    async def housekeep(self):
+        self.merges += await self.pass_once()
+"""
+
+RPL015_CTA = """\
+class Pool:
+    async def ensure(self):
+        if self.conn is None:
+            await self.dial()
+            self.conn = object()
+"""
+
+RPL015_LOCKED = """\
+class Pool:
+    async def ensure(self):
+        async with self._conn_lock:
+            if self.conn is None:
+                await self.dial()
+                self.conn = object()
+"""
+
+
+def test_rpl015_torn_rmw_flagged(tmp_path):
+    found = _only(_lint_source(tmp_path, RPL015_RMW), "RPL015")
+    assert len(found) == 1
+    f = found[0]
+    assert f.line == 3
+    assert f.attr == "merges"
+    assert f.qualname == "Archiver.housekeep"
+    assert "read-modify-write" in f.message
+
+
+def test_rpl015_check_then_act_flagged(tmp_path):
+    found = _only(_lint_source(tmp_path, RPL015_CTA), "RPL015")
+    assert len(found) == 1
+    assert found[0].attr == "conn"
+    assert "check-then-act" in found[0].message
+
+
+def test_rpl015_common_lock_clean(tmp_path):
+    # the whole read->await->write window under one lock: atomic
+    assert _only(_lint_source(tmp_path, RPL015_LOCKED), "RPL015") == []
+
+
+def test_rpl015_async_with_is_a_suspension(tmp_path):
+    # the suspension point is an `async with` (its __aenter__ awaits),
+    # not a bare await — and the entered CM is not a lock over the attr
+    src = """\
+    class Writer:
+        async def push(self):
+            if self.batch is None:
+                async with self.sem_throttle:
+                    self.batch = []
+    """
+    found = _only(_lint_source(tmp_path, src), "RPL015")
+    assert [f.attr for f in found] == ["batch"]
+
+
+def test_rpl015_recheck_after_await_clean(tmp_path):
+    # the fix the rule's message recommends: re-read after the last
+    # suspension, decide from the fresh value
+    src = """\
+    class Pool:
+        async def ensure(self):
+            if self.conn is None:
+                await self.dial()
+                if self.conn is None:
+                    self.conn = object()
+    """
+    # the rewrite keeps a dep pair (fresh re-read at the same
+    # suspension count as the write) and drops the torn one
+    found = _only(_lint_source(tmp_path, src), "RPL015")
+    assert found == []
+
+
+def test_rpl015_locked_convention_callee_clean(tmp_path):
+    # writes inside *_locked functions inherit the callers' guards
+    src = """\
+    class C:
+        async def refresh(self):
+            async with self._state_lock:
+                await self._refresh_locked()
+
+        async def _refresh_locked(self):
+            if self.cache is None:
+                await self.load()
+                self.cache = object()
+    """
+    assert _only(_lint_source(tmp_path, src), "RPL015") == []
+
+
+def test_rpl015_sync_function_clean(tmp_path):
+    # no suspension points in a sync function: loop-atomic
+    src = """\
+    class C:
+        def bump(self):
+            self.total += self.step()
+    """
+    assert _only(_lint_source(tmp_path, src), "RPL015") == []
+
+
+def test_rpl015_lock_setdefault_flagged(tmp_path):
+    src = """\
+    import asyncio
+
+    class C:
+        async def op(self, key):
+            lock = self._locks.setdefault(key, asyncio.Lock())
+            async with lock:
+                pass
+    """
+    found = _only(_lint_source(tmp_path, src), "RPL015")
+    assert len(found) == 1
+    assert "LockMap" in found[0].message
+
+
+def test_rpl015_suppression(tmp_path):
+    src = RPL015_RMW.replace(
+        "self.merges += await self.pass_once()",
+        "self.merges += await self.pass_once()  # rplint: disable=RPL015",
+    )
+    assert _only(_lint_source(tmp_path, src), "RPL015") == []
+
+
+def test_rpl015_whole_program_across_files(tmp_path):
+    # pass-1 summaries span files: the *_locked callee lives in the
+    # same class but the census is built program-wide
+    found = _lint_source(tmp_path, RPL015_RMW, "pkg/a.py")
+    other = _lint_source(tmp_path, RPL015_LOCKED, "pkg/b.py")
+    assert len(_only(found, "RPL015")) == 1
+    assert _only(other, "RPL015") == []
+
+
+def test_rpl015_baseline_is_empty():
+    """Await-atomicity holds tree-wide from day one: every real torn
+    window was fixed (swap-then-await stops, hoisted awaits before
+    +=), the intentional ones carry inline suppressions."""
+    baseline = load_baseline()
+    assert [k for k in baseline if k.endswith("::RPL015")] == []
+
+
+# -- RPL016: lock consistency (whole-program) --------------------------
+
+
+RPL016_BAD = """\
+class Broker:
+    async def append(self, n):
+        async with self._append_lock:
+            base = self.next_offset
+            await self.write(base, n)
+            self.next_offset = base + n
+
+    async def truncate(self, off):
+        await self.drop_tail(off)
+        self.next_offset = off
+"""
+
+
+def test_rpl016_bare_vs_locked_flagged(tmp_path):
+    found = _only(_lint_source(tmp_path, RPL016_BAD), "RPL016")
+    assert len(found) == 1
+    f = found[0]
+    assert f.attr == "next_offset"
+    assert f.qualname == "Broker.next_offset"
+    # anchored at the bare write, every participant listed
+    assert f.line == 10
+    assert "Broker.append:6" in f.message
+    assert "Broker.truncate:10" in f.message
+
+
+def test_rpl016_one_finding_per_attr(tmp_path):
+    src = RPL016_BAD + """\
+
+    async def reset(self):
+        await self.drop_tail(0)
+        self.next_offset = 0
+"""
+    found = _only(_lint_source(tmp_path, src), "RPL016")
+    assert len(found) == 1
+    assert "Broker.reset:14" in found[0].message
+
+
+def test_rpl016_agreeing_lock_clean(tmp_path):
+    src = RPL016_BAD.replace(
+        "    async def truncate(self, off):\n"
+        "        await self.drop_tail(off)\n"
+        "        self.next_offset = off\n",
+        "    async def truncate(self, off):\n"
+        "        async with self._append_lock:\n"
+        "            await self.drop_tail(off)\n"
+        "            self.next_offset = off\n",
+    )
+    assert _only(_lint_source(tmp_path, src), "RPL016") == []
+
+
+def test_rpl016_disagreeing_locks_flagged(tmp_path):
+    src = RPL016_BAD.replace(
+        "    async def truncate(self, off):\n"
+        "        await self.drop_tail(off)\n"
+        "        self.next_offset = off\n",
+        "    async def truncate(self, off):\n"
+        "        async with self._other_lock:\n"
+        "            await self.drop_tail(off)\n"
+        "            self.next_offset = off\n",
+    )
+    found = _only(_lint_source(tmp_path, src), "RPL016")
+    assert len(found) == 1
+    assert "_append_lock" in found[0].message
+    assert "_other_lock" in found[0].message
+
+
+def test_rpl016_bare_without_suspension_clean(tmp_path):
+    # a bare rebind with no await before it is loop-atomic
+    src = RPL016_BAD.replace(
+        "    async def truncate(self, off):\n"
+        "        await self.drop_tail(off)\n"
+        "        self.next_offset = off\n",
+        "    async def truncate(self, off):\n"
+        "        self.next_offset = off\n"
+        "        await self.drop_tail(off)\n",
+    )
+    assert _only(_lint_source(tmp_path, src), "RPL016") == []
+
+
+def test_rpl016_init_writes_exempt(tmp_path):
+    src = """\
+    class Broker:
+        def __init__(self):
+            self.next_offset = 0
+
+        async def append(self, n):
+            async with self._append_lock:
+                base = self.next_offset
+                await self.write(base, n)
+                self.next_offset = base + n
+    """
+    assert _only(_lint_source(tmp_path, src), "RPL016") == []
+
+
+def test_rpl016_locked_convention_abstains(tmp_path):
+    # a *_locked callee with no resolvable caller guard is trusted by
+    # name rather than invent disagreement
+    src = RPL016_BAD.replace(
+        "    async def truncate(self, off):",
+        "    async def _truncate_locked(self, off):",
+    )
+    assert _only(_lint_source(tmp_path, src), "RPL016") == []
+
+
+def test_rpl016_single_function_is_rpl015_territory(tmp_path):
+    src = """\
+    class Broker:
+        async def append(self, n):
+            async with self._append_lock:
+                self.next_offset = n
+            await self.write(n, n)
+            self.next_offset = n + 1
+    """
+    assert _only(_lint_source(tmp_path, src), "RPL016") == []
+
+
+def test_rpl016_suppression_on_bare_site(tmp_path):
+    src = RPL016_BAD.replace(
+        "        self.next_offset = off",
+        "        self.next_offset = off  # rplint: disable=RPL016",
+    )
+    assert _only(_lint_source(tmp_path, src), "RPL016") == []
+
+
+def test_rpl016_json_payload(tmp_path):
+    f = _only(_lint_source(tmp_path, RPL016_BAD), "RPL016")[0]
+    d = f.to_dict()
+    assert d["rule"] == "RPL016"
+    assert d["attr"] == "next_offset"
+    assert d["guards"]["Broker.append:6"] == ["self._append_lock"]
+    assert d["guards"]["Broker.truncate:10"] == []
+    assert Finding.from_dict(d) == f
+
+
+def test_rpl016_baseline_is_empty():
+    baseline = load_baseline()
+    assert [k for k in baseline if k.endswith("::RPL016")] == []
+
+
+# -- whole-program engine: cache, jobs, CLI surfaces -------------------
+
+
+def test_cache_warm_run_identical(tmp_path, monkeypatch):
+    from tools.rplint import cache as cache_mod
+
+    monkeypatch.setattr(cache_mod, "CACHE_DIR", str(tmp_path / "cache"))
+    path = tmp_path / "mod.py"
+    path.write_text(textwrap.dedent(RPL016_BAD))
+    cold = run_paths([str(path)], cache=True)
+    warm = run_paths([str(path)], cache=True)
+    assert warm == cold
+    assert any(f.rule == "RPL016" for f in warm)
+    # a content change invalidates the entry
+    path.write_text(textwrap.dedent(RPL016_BAD).replace("truncate", "shrink"))
+    changed = run_paths([str(path)], cache=True)
+    assert any("shrink" in f.message for f in _only(changed, "RPL016"))
+
+
+def test_jobs_matches_serial(tmp_path):
+    for i in range(4):
+        p = tmp_path / f"m{i}.py"
+        p.write_text(textwrap.dedent(RPL015_RMW))
+    serial = run_paths([str(tmp_path)])
+    fanned = run_paths([str(tmp_path)], jobs=2)
+    assert fanned == serial
+    assert len(_only(serial, "RPL015")) == 4
+
+
+def test_cli_format_json(tmp_path):
+    path = tmp_path / "mod.py"
+    path.write_text(textwrap.dedent(RPL015_RMW))
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.rplint", "--format", "json",
+         "--no-cache", str(path)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert out.returncode == 1
+    import json as _json
+
+    payload = _json.loads(out.stdout)
+    assert payload["version"] == 1
+    assert payload["count"] == len(payload["findings"]) >= 1
+    f = next(x for x in payload["findings"] if x["rule"] == "RPL015")
+    assert set(f) >= {"rule", "path", "line", "col", "qualname", "attr",
+                      "guards", "message"}
+
+
+def test_cli_explain():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.rplint", "--explain", "RPL015"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert out.returncode == 0
+    assert "await-atomicity" in out.stdout
+    assert "Minimal offending example" in out.stdout
+    bad = subprocess.run(
+        [sys.executable, "-m", "tools.rplint", "--explain", "RPL999"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert bad.returncode == 2

@@ -47,6 +47,10 @@ def main() -> int:
         args.tiered = True
 
     from chaos_harness import run_chaos
+    from redpanda_tpu.utils import rpsan
+
+    if rpsan.enabled():
+        print("rpsan armed: torn-write reports fail the iteration")
 
     shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
 
@@ -62,7 +66,7 @@ def main() -> int:
                 rules=[replace(r) for r in default_rules()], seed=seed
             )
         with tempfile.TemporaryDirectory(prefix="soak_", dir=shm) as d:
-            return asyncio.run(
+            stats = asyncio.run(
                 run_chaos(
                     Path(d),
                     seed=seed,
@@ -73,6 +77,18 @@ def main() -> int:
                     store_faults=store_faults,
                 )
             )
+        # RP_SAN=1: a torn write anywhere in the iteration is a failure
+        # in its own right, even if every acked record validated
+        if rpsan.enabled():
+            reps = rpsan.reports()
+            rpsan.reset()
+            if reps:
+                raise AssertionError(
+                    f"rpsan: {len(reps)} torn-write report(s): "
+                    + "; ".join(r.render() for r in reps)
+                )
+            stats["rpsan_reports"] = 0
+        return stats
 
     if args.seed is not None:
         stats = one(args.seed)

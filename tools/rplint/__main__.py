@@ -7,22 +7,48 @@ Exit codes:
     1  findings reported
     2  internal error (unparseable file, bad baseline, bad usage)
 
-With no paths the default scan root is `redpanda_tpu`.
+With no paths the default scan root is `redpanda_tpu`. Whole-program
+pass-1 summaries and per-file findings are cached by content hash
+under tools/rplint/.cache/ (any edit to the linter itself invalidates
+everything); `--no-cache` recomputes from scratch and `--jobs N`
+fans the per-file work over N processes.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from .engine import (
     BASELINE_PATH,
     LintError,
     apply_baseline,
+    default_rules,
     load_baseline,
     run_paths,
     save_baseline,
 )
+
+
+def _explain(code: str) -> int:
+    import importlib
+    import inspect
+
+    for rule in default_rules():
+        if rule.code == code.upper():
+            mod = importlib.import_module(type(rule).__module__)
+            print(f"{rule.code} ({rule.name})")
+            print("=" * (len(rule.code) + len(rule.name) + 3))
+            print(inspect.cleandoc(mod.__doc__ or "(no rationale recorded)"))
+            example = getattr(mod, "EXAMPLE", None)
+            if example:
+                print("\nMinimal offending example:\n")
+                for line in example.rstrip().splitlines():
+                    print(f"    {line}")
+            return 0
+    print(f"rplint: error: unknown rule: {code}", file=sys.stderr)
+    return 2
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -51,20 +77,49 @@ def main(argv: list[str] | None = None) -> int:
         metavar="RPL001,RPL002",
         help="comma-separated subset of rule codes to run",
     )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="finding output format (json: stable machine-readable schema)",
+    )
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=0,
+        metavar="N",
+        help="fan per-file analysis over N processes (default: serial)",
+    )
+    parser.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="ignore and do not write tools/rplint/.cache/",
+    )
+    parser.add_argument(
+        "--explain",
+        metavar="RPLxxx",
+        help="print a rule's rationale + a minimal offending example, exit",
+    )
     args = parser.parse_args(argv)
+
+    if args.explain:
+        return _explain(args.explain)
 
     try:
         rules = None
         if args.rules:
-            from .engine import default_rules
-
             wanted = {r.strip().upper() for r in args.rules.split(",")}
             rules = [r for r in default_rules() if r.code in wanted]
             unknown = wanted - {r.code for r in rules}
             if unknown:
                 raise LintError(f"unknown rule(s): {', '.join(sorted(unknown))}")
 
-        findings = run_paths(list(args.paths), rules=rules)
+        findings = run_paths(
+            list(args.paths),
+            rules=rules,
+            jobs=args.jobs,
+            cache=not args.no_cache,
+        )
 
         if args.update_baseline:
             save_baseline(findings)
@@ -76,8 +131,20 @@ def main(argv: list[str] | None = None) -> int:
         if args.baseline:
             findings = apply_baseline(findings, load_baseline())
 
-        for f in findings:
-            print(f.render())
+        if args.format == "json":
+            print(
+                json.dumps(
+                    {
+                        "version": 1,
+                        "count": len(findings),
+                        "findings": [f.to_dict() for f in findings],
+                    },
+                    indent=2,
+                )
+            )
+        else:
+            for f in findings:
+                print(f.render())
         if findings:
             print(f"rplint: {len(findings)} finding(s)", file=sys.stderr)
             return 1

@@ -1,0 +1,73 @@
+"""Content-hash cache for the whole-program engine.
+
+One JSON entry per source file under `tools/rplint/.cache/`, keyed by
+sha1(relpath, file bytes, tool hash). The tool hash covers every
+`.py` in tools/rplint itself, so ANY change to the engine, a rule, or
+the summarizer invalidates the whole cache — no version constant to
+forget to bump. An entry stores the pass-1 FileSummary plus the
+per-file findings of the full default rule set (findings are
+rule-subset-filtered at report time), so a warm run does no parsing
+at all: hash, load, run pass 2.
+
+Entries are written atomically (tmp + rename) and any unreadable or
+stale entry silently recomputes — the cache can be deleted at will
+(`--no-cache` skips it entirely).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+
+CACHE_DIR = os.path.join(os.path.dirname(__file__), ".cache")
+_TOOL_HASH: str | None = None
+
+
+def tool_hash() -> str:
+    """Digest of the linter's own sources (memoized per process)."""
+    global _TOOL_HASH
+    if _TOOL_HASH is None:
+        h = hashlib.sha1()
+        tool_dir = os.path.dirname(__file__)
+        for root, dirs, files in os.walk(tool_dir):
+            dirs[:] = sorted(d for d in dirs if d not in (".cache", "__pycache__"))
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(root, name)
+                h.update(os.path.relpath(full, tool_dir).encode())
+                with open(full, "rb") as f:
+                    h.update(f.read())
+        _TOOL_HASH = h.hexdigest()
+    return _TOOL_HASH
+
+
+def entry_key(rel_path: str, content: bytes) -> str:
+    h = hashlib.sha1()
+    h.update(tool_hash().encode())
+    h.update(rel_path.encode())
+    h.update(b"\0")
+    h.update(content)
+    return h.hexdigest()
+
+
+def load(key: str) -> dict | None:
+    path = os.path.join(CACHE_DIR, key + ".json")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+
+
+def store(key: str, payload: dict) -> None:
+    try:
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=CACHE_DIR, suffix=".tmp")
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(payload, f, separators=(",", ":"))
+        os.replace(tmp, os.path.join(CACHE_DIR, key + ".json"))
+    except OSError:
+        pass  # cache is best-effort; a full run is always correct

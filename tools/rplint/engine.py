@@ -36,6 +36,11 @@ class Finding:
     rule: str
     message: str
     qualname: str = ""  # enclosing function, "" at module level
+    # race-rule payload (RPL015/016): the attribute and the guard sets
+    # per site, so --format json is machine-triageable without parsing
+    # the message
+    attr: str = ""
+    guards: tuple = ()  # ((label, (guard, ...)), ...)
 
     @property
     def key(self) -> str:
@@ -44,6 +49,33 @@ class Finding:
 
     def render(self) -> str:
         return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "qualname": self.qualname,
+            "attr": self.attr,
+            "guards": {label: list(g) for label, g in self.guards},
+            "message": self.message,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(
+            path=d["path"],
+            line=d["line"],
+            col=d["col"],
+            rule=d["rule"],
+            message=d["message"],
+            qualname=d.get("qualname", ""),
+            attr=d.get("attr", ""),
+            guards=tuple(
+                (label, tuple(g)) for label, g in d.get("guards", {}).items()
+            ),
+        )
 
 
 @dataclass
@@ -131,10 +163,13 @@ def _collect_suppressions(source: str) -> dict[int, set[str]]:
     return out
 
 
-def parse_module(abs_path: str, rel_path: str) -> ModuleContext:
+def parse_module(
+    abs_path: str, rel_path: str, source: str | None = None
+) -> ModuleContext:
     try:
-        with open(abs_path, "r", encoding="utf-8") as f:
-            source = f.read()
+        if source is None:
+            with open(abs_path, "r", encoding="utf-8") as f:
+                source = f.read()
         tree = ast.parse(source, filename=rel_path)
     except (OSError, SyntaxError, ValueError) as e:
         raise LintError(f"{rel_path}: cannot parse: {e}") from e
@@ -185,18 +220,108 @@ def default_rules() -> list:
     return [cls() for cls in ALL_RULES]
 
 
+def _analyze_file(
+    abs_path: str, rel_path: str, use_cache: bool
+) -> tuple[dict, list[dict]]:
+    """Per-file unit of work (also the multiprocessing worker body):
+    pass-1 summary + findings of the FULL default per-file rule set,
+    both as plain dicts. Cached by content hash when `use_cache`."""
+    from . import cache as cache_mod
+    from .program import summarize_module
+
+    try:
+        with open(abs_path, "rb") as f:
+            content = f.read()
+    except OSError as e:
+        raise LintError(f"{rel_path}: cannot read: {e}") from e
+    key = ""
+    if use_cache:
+        key = cache_mod.entry_key(rel_path, content)
+        payload = cache_mod.load(key)
+        if payload is not None:
+            return payload["summary"], payload["findings"]
+    try:
+        source = content.decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise LintError(f"{rel_path}: cannot decode: {e}") from e
+    ctx = parse_module(abs_path, rel_path, source=source)
+    findings: list[Finding] = []
+    for rule in default_rules():
+        if getattr(rule, "whole_program", False):
+            continue
+        findings.extend(rule.check(ctx) or ())
+    summary = summarize_module(ctx).to_dict()
+    findings_d = [f.to_dict() for f in findings]
+    if use_cache:
+        cache_mod.store(key, {"summary": summary, "findings": findings_d})
+    return summary, findings_d
+
+
+def _analyze_worker(args: tuple) -> tuple[dict, list[dict]]:
+    return _analyze_file(*args)
+
+
 def run_paths(
-    paths: list[str], rules: list | None = None
+    paths: list[str],
+    rules: list | None = None,
+    jobs: int = 0,
+    cache: bool = False,
 ) -> list[Finding]:
     """Lint every python file under `paths`; returns raw findings
-    (suppressions applied, baseline NOT applied)."""
+    (suppressions applied, baseline NOT applied).
+
+    Two passes: per-file rules run against each module's AST; rules
+    marked `whole_program = True` run once, afterwards, over the
+    ProgramIndex of pass-1 summaries (tools/rplint/program.py).
+
+    `cache`/`jobs` take the batch path, which always evaluates the
+    full default per-file rule set (then filters to the requested
+    codes) so cache entries are rule-subset independent; custom rule
+    objects outside the registry need the default serial path."""
     if rules is None:
         rules = default_rules()
+    file_rules = [r for r in rules if not getattr(r, "whole_program", False)]
+    prog_rules = [r for r in rules if getattr(r, "whole_program", False)]
+    files = iter_python_files(paths)
     findings: list[Finding] = []
-    for abs_path, rel_path in iter_python_files(paths):
-        ctx = parse_module(abs_path, rel_path)
-        for rule in rules:
-            findings.extend(rule.check(ctx))
+    summaries: list = []
+
+    if cache or jobs > 1:
+        from .program import FileSummary
+
+        want = {r.code for r in file_rules}
+        work = [(a, r, cache) for a, r in files]
+        if jobs > 1 and len(work) > 1:
+            import concurrent.futures as cf
+
+            with cf.ProcessPoolExecutor(max_workers=jobs) as pool:
+                results = list(pool.map(_analyze_worker, work, chunksize=8))
+        else:
+            results = [_analyze_file(*w) for w in work]
+        for summary_d, file_findings in results:
+            if prog_rules:
+                summaries.append(FileSummary.from_dict(summary_d))
+            findings.extend(
+                Finding.from_dict(d)
+                for d in file_findings
+                if d["rule"] in want
+            )
+    else:
+        from .program import summarize_module
+
+        for abs_path, rel_path in files:
+            ctx = parse_module(abs_path, rel_path)
+            for rule in file_rules:
+                findings.extend(rule.check(ctx) or ())
+            if prog_rules:
+                summaries.append(summarize_module(ctx))
+
+    if prog_rules:
+        from .program import ProgramIndex
+
+        program = ProgramIndex(summaries)
+        for rule in prog_rules:
+            findings.extend(rule.check_program(program))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 

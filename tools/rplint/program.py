@@ -1,0 +1,534 @@
+"""Pass 1 of the whole-program rplint engine: per-function summaries.
+
+The per-file rules (RPL001-014) are pattern checks — one AST, one
+answer. Races are not: await-atomicity (RPL015) needs to know, at
+every attribute access, which locks are held and whether the event
+loop could have run between a read and its dependent write; lock
+consistency (RPL016) needs the census of every write site of an
+attribute across the entire package. This module builds exactly that
+once per file, as plain serializable data, so pass 2 (the rules in
+rpl015/rpl016) never re-reads source and the whole pass-1 product can
+be cached by content hash (tools/rplint/cache.py).
+
+Per async/sync function the summary records:
+
+  may-suspend set   every statement that can yield to the event loop:
+                    `await`, `async with` (__aenter__ AND __aexit__
+                    both await), `async for` (one suspension per
+                    fence around the body). A monotonically increasing
+                    suspension counter stamps every event, so "a
+                    suspension happened between A and B" is an integer
+                    compare in pass 2.
+  locks held        `with` / `async with` regions whose context
+                    expression is lock-like — a dotted name containing
+                    lock/mutex/semaphore, a subscript into such a map
+                    (`self._peer_locks[k]` -> "self._peer_locks[]"),
+                    a per-key registry get (`.lock(k)` / `.hold(k)` /
+                    `.setdefault(k, ...)` -> same normalization), or a
+                    local variable assigned from one of those shapes.
+  attr census       every `self.<attr>` read and REBIND write
+                    (`self.x = ...` / `self.x op= ...`) with line,
+                    suspension stamp and guard set. Container mutation
+                    (`self.x[k] = v`, `.append`) is deliberately out
+                    of scope: the SoA lanes are governed by RPL001/011
+                    and item-level tracking would drown the signal.
+  write deps        for each write, the reads it depends on: direct
+                    reads in the assigned expression, reads captured
+                    earlier into a local that the expression uses
+                    (taint through straight-line locals), and reads in
+                    the tests of enclosing `if`/`while` statements
+                    (check-then-act). Each dep keeps the ORIGINAL
+                    read's suspension stamp and guard set.
+  call census       every `self.<method>()` call with the guard set
+                    held at the call site — pass 2 resolves the
+                    `*_locked` naming convention through it: a
+                    function named `foo_locked` inherits the
+                    intersection of the guards its callers held.
+
+Approximations, chosen for linter pragmatics and documented here so
+triage can reason about them: statements are walked in source order
+(an `if`'s body and orelse are treated as sequential, loop back-edges
+are ignored), expression evaluation order is the AST's in-order walk,
+and taint does not flow through containers or calls. Suppressions
+(`# rplint: disable=RPL01x`) are resolved in pass 1 and stored on each
+event, so cached summaries stay self-contained.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .engine import ModuleContext, dotted_name
+
+SUMMARY_VERSION = 3
+
+_RACE_RULES = ("RPL015", "RPL016")
+_LOCKY_RE = re.compile(r"lock|mutex|semaphore", re.IGNORECASE)
+# fresh-constructor shapes: a lock nobody else can hold guards nothing
+_CTOR_RE = re.compile(
+    r"^(asyncio|threading|multiprocessing)\."
+    r"(Lock|RLock|Semaphore|BoundedSemaphore|Condition|Event)\(\)$"
+)
+_REGISTRY_SUFFIXES = (".setdefault()", ".lock()", ".hold()", ".get()")
+_INIT_NAMES = ("__init__", "__new__", "__post_init__", "__init_subclass__")
+
+
+@dataclass(frozen=True)
+class ReadRef:
+    """One `self.<attr>` load: where, under which locks, and how many
+    suspension points the function had passed by then."""
+
+    attr: str
+    line: int
+    s: int  # suspension counter at the read
+    guards: tuple  # sorted guard names held at the read
+
+    def to_dict(self) -> dict:
+        return {"a": self.attr, "l": self.line, "s": self.s, "g": list(self.guards)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ReadRef":
+        return cls(d["a"], d["l"], d["s"], tuple(d["g"]))
+
+
+@dataclass(frozen=True)
+class WriteSite:
+    attr: str
+    line: int
+    col: int
+    s: int  # suspension counter at the store
+    guards: tuple
+    sup: tuple  # rplint codes disabled on the statement's lines
+    deps: tuple  # ReadRef the assigned value / enclosing test depends on
+    aug: bool  # augmented assignment (x op= ...)
+
+    def to_dict(self) -> dict:
+        return {
+            "a": self.attr, "l": self.line, "c": self.col, "s": self.s,
+            "g": list(self.guards), "sup": list(self.sup),
+            "d": [r.to_dict() for r in self.deps], "aug": self.aug,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "WriteSite":
+        return cls(
+            d["a"], d["l"], d["c"], d["s"], tuple(d["g"]), tuple(d["sup"]),
+            tuple(ReadRef.from_dict(r) for r in d["d"]), d["aug"],
+        )
+
+
+@dataclass(frozen=True)
+class LockDefault:
+    """`self.<map>.setdefault(key, asyncio.Lock())` — the per-key lock
+    registry shape RPL015 routes through utils.locks.LockMap."""
+
+    attr: str  # dotted receiver, e.g. "self._peer_locks"
+    line: int
+    col: int
+    sup: tuple
+
+    def to_dict(self) -> dict:
+        return {"a": self.attr, "l": self.line, "c": self.col, "sup": list(self.sup)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LockDefault":
+        return cls(d["a"], d["l"], d["c"], tuple(d["sup"]))
+
+
+@dataclass(frozen=True)
+class FuncSummary:
+    path: str
+    qualname: str
+    cls: str  # innermost enclosing class name, "" at module level
+    name: str
+    line: int
+    is_async: bool
+    may_suspend: bool
+    suspend_lines: tuple
+    reads: tuple  # ReadRef census
+    writes: tuple  # WriteSite census
+    lockdefaults: tuple
+    calls: tuple  # (callee_method_name, guards_tuple) for self.<m>() calls
+
+    @property
+    def is_init(self) -> bool:
+        return self.name in _INIT_NAMES
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path, "qn": self.qualname, "cls": self.cls,
+            "name": self.name, "line": self.line, "async": self.is_async,
+            "susp": self.may_suspend, "sl": list(self.suspend_lines),
+            "r": [r.to_dict() for r in self.reads],
+            "w": [w.to_dict() for w in self.writes],
+            "ld": [d.to_dict() for d in self.lockdefaults],
+            "calls": [[c, list(g)] for c, g in self.calls],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FuncSummary":
+        return cls(
+            path=d["path"], qualname=d["qn"], cls=d["cls"], name=d["name"],
+            line=d["line"], is_async=d["async"], may_suspend=d["susp"],
+            suspend_lines=tuple(d["sl"]),
+            reads=tuple(ReadRef.from_dict(r) for r in d["r"]),
+            writes=tuple(WriteSite.from_dict(w) for w in d["w"]),
+            lockdefaults=tuple(LockDefault.from_dict(x) for x in d["ld"]),
+            calls=tuple((c, tuple(g)) for c, g in d["calls"]),
+        )
+
+
+@dataclass
+class FileSummary:
+    path: str
+    functions: list = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "version": SUMMARY_VERSION,
+            "path": self.path,
+            "functions": [f.to_dict() for f in self.functions],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FileSummary":
+        if d.get("version") != SUMMARY_VERSION:
+            raise ValueError("summary version mismatch")
+        return cls(
+            path=d["path"],
+            functions=[FuncSummary.from_dict(f) for f in d["functions"]],
+        )
+
+
+def _normalize_guard(dotted: str) -> str:
+    """Collapse the per-key registry access shapes onto one identity:
+    `self._peer_locks[k]`, `.setdefault(k, ...)`, `.lock(k)`,
+    `.hold(k)` and `.get(k)` all guard *some key of the same map* —
+    "self._peer_locks[]". Distinct keys sharing one identity is the
+    conservative direction: it can only merge guards, i.e. suppress
+    findings, never invent disagreement."""
+    for suf in _REGISTRY_SUFFIXES:
+        if dotted.endswith(suf):
+            base = dotted[: -len(suf)]
+            return base + "[]"
+    return dotted
+
+
+def _guard_of(expr: ast.AST, lock_locals: dict) -> str | None:
+    """Guard identity of a with-item context expression (or of an
+    assignment RHS when recording lock locals), None if not lock-like."""
+    if isinstance(expr, ast.Name):
+        return lock_locals.get(expr.id)
+    dotted = dotted_name(expr)
+    if _CTOR_RE.match(dotted):
+        return None  # a freshly constructed lock is held by nobody else
+    norm = _normalize_guard(dotted)
+    if _LOCKY_RE.search(norm):
+        return norm
+    return None
+
+
+def _is_lock_ctor(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Call) and bool(_CTOR_RE.match(dotted_name(expr)))
+
+
+class _FunctionSummarizer:
+    """One linear, source-order walk of a function body producing the
+    event streams described in the module docstring."""
+
+    def __init__(self, ctx: ModuleContext, scope) -> None:
+        self.ctx = ctx
+        self.scope = scope
+        self.s = 0  # suspension counter
+        self.guards: list[str] = []  # active lock region stack
+        self.lock_locals: dict[str, str] = {}
+        self.taints: dict[str, tuple] = {}  # local -> ReadRefs it captured
+        self.check_deps: list[list[ReadRef]] = []  # if/while test reads
+        self.reads: list[ReadRef] = []
+        self.writes: list[WriteSite] = []
+        self.lockdefaults: list[LockDefault] = []
+        self.calls: list[tuple] = []
+        self.suspend_lines: set[int] = set()
+
+    # -- helpers ------------------------------------------------------
+    def _guard_snapshot(self) -> tuple:
+        return tuple(sorted(set(self.guards)))
+
+    def _sup(self, node: ast.AST) -> tuple:
+        start = getattr(node, "lineno", 0)
+        end = getattr(node, "end_lineno", start)
+        out: set[str] = set()
+        for line in range(start, end + 1):
+            out |= self.ctx.suppressions.get(line, set()) & set(_RACE_RULES)
+        return tuple(sorted(out))
+
+    def _suspend(self, line: int) -> None:
+        self.s += 1
+        self.suspend_lines.add(line)
+
+    # -- expression walk (approximate evaluation order) ---------------
+    def expr(self, node: ast.AST | None, sink: list[ReadRef]) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Await):
+            self.expr(node.value, sink)
+            self._suspend(node.lineno)
+            return
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.ctx, ast.Load)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            ref = ReadRef(node.attr, node.lineno, self.s, self._guard_snapshot())
+            self.reads.append(ref)
+            sink.append(ref)
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            sink.extend(self.taints.get(node.id, ()))
+            return
+        if isinstance(node, ast.Call):
+            self._note_call(node, sink)
+            # fall through: walk func + args below
+        if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # separate scope; summarized on its own
+        for child in ast.iter_child_nodes(node):
+            self.expr(child, sink)
+
+    def _note_call(self, node: ast.Call, sink: list[ReadRef]) -> None:
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            # self.<method>(...) — the *_locked inheritance census
+            self.calls.append((func.attr, self._guard_snapshot()))
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "setdefault"
+            and len(node.args) == 2
+            and _is_lock_ctor(node.args[1])
+        ):
+            recv = dotted_name(func.value)
+            if recv.startswith("self."):
+                self.lockdefaults.append(
+                    LockDefault(recv, node.lineno, node.col_offset, self._sup(node))
+                )
+
+    # -- statement walk ------------------------------------------------
+    def walk_body(self, stmts: list[ast.stmt]) -> None:
+        for st in stmts:
+            self.stmt(st)
+
+    def _emit_write(
+        self, target: ast.AST, stmt: ast.stmt, deps: list[ReadRef], aug: bool
+    ) -> None:
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            all_deps = list(deps)
+            for frame in self.check_deps:
+                all_deps.extend(frame)
+            self.writes.append(
+                WriteSite(
+                    attr=target.attr,
+                    line=target.lineno,
+                    col=target.col_offset,
+                    s=self.s,
+                    guards=self._guard_snapshot(),
+                    sup=self._sup(stmt),
+                    deps=tuple(all_deps),
+                    aug=aug,
+                )
+            )
+        elif isinstance(target, ast.Name):
+            # plain local rebind: record taint (what reads the value
+            # captured) and whether it now names a lock
+            self.taints[target.id] = tuple(deps)
+            g = None
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)) and stmt.value is not None:
+                g = _guard_of(stmt.value, self.lock_locals)
+            if g is not None:
+                self.lock_locals[target.id] = g
+            else:
+                self.lock_locals.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._emit_write(elt, stmt, deps, aug)
+        # subscript/starred targets: container mutation, out of scope
+
+    def stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested scopes summarized separately
+        if isinstance(st, ast.Assign):
+            deps: list[ReadRef] = []
+            self.expr(st.value, deps)
+            for target in st.targets:
+                self._emit_write(target, st, deps, aug=False)
+            return
+        if isinstance(st, ast.AnnAssign):
+            deps = []
+            self.expr(st.value, deps)
+            if st.value is not None:
+                self._emit_write(st.target, st, deps, aug=False)
+            return
+        if isinstance(st, ast.AugAssign):
+            deps = []
+            if (
+                isinstance(st.target, ast.Attribute)
+                and isinstance(st.target.value, ast.Name)
+                and st.target.value.id == "self"
+            ):
+                # x op= v loads the target BEFORE evaluating v's awaits
+                ref = ReadRef(
+                    st.target.attr, st.lineno, self.s, self._guard_snapshot()
+                )
+                self.reads.append(ref)
+                deps.append(ref)
+            self.expr(st.value, deps)
+            if isinstance(st.target, ast.Name):
+                old = self.taints.get(st.target.id, ())
+                self.taints[st.target.id] = tuple(old) + tuple(deps)
+                return
+            self._emit_write(st.target, st, deps, aug=True)
+            return
+        if isinstance(st, ast.Expr):
+            self.expr(st.value, [])
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            treads: list[ReadRef] = []
+            self.expr(st.test, treads)
+            self.check_deps.append(treads)
+            self.walk_body(st.body)
+            self.walk_body(st.orelse)
+            self.check_deps.pop()
+            return
+        if isinstance(st, ast.For):
+            self.expr(st.iter, [])
+            self.walk_body(st.body)
+            self.walk_body(st.orelse)
+            return
+        if isinstance(st, ast.AsyncFor):
+            self.expr(st.iter, [])
+            self._suspend(st.lineno)  # __anext__
+            self.walk_body(st.body)
+            self._suspend(st.lineno)
+            self.walk_body(st.orelse)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            is_async = isinstance(st, ast.AsyncWith)
+            pushed = 0
+            for item in st.items:
+                self.expr(item.context_expr, [])
+                g = _guard_of(item.context_expr, self.lock_locals)
+                if g is not None:
+                    self.guards.append(g)
+                    pushed += 1
+                if item.optional_vars is not None and isinstance(
+                    item.optional_vars, ast.Name
+                ):
+                    if g is not None:
+                        self.lock_locals[item.optional_vars.id] = g
+            if is_async:
+                self._suspend(st.lineno)  # __aenter__
+            self.walk_body(st.body)
+            if is_async:
+                self._suspend(st.lineno)  # __aexit__
+            for _ in range(pushed):
+                self.guards.pop()
+            return
+        if isinstance(st, ast.Try):
+            self.walk_body(st.body)
+            for handler in st.handlers:
+                self.walk_body(handler.body)
+            self.walk_body(st.orelse)
+            self.walk_body(st.finalbody)
+            return
+        if isinstance(st, (ast.Return, ast.Raise)):
+            self.expr(getattr(st, "value", None) or getattr(st, "exc", None), [])
+            return
+        if isinstance(st, ast.Delete):
+            return
+        # fallback (assert, global, pass, ...): walk child expressions
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self.expr(child, [])
+
+    def run(self) -> FuncSummary:
+        node = self.scope.node
+        self.walk_body(node.body)
+        cls = ""
+        for parent in reversed(self.scope.parents):
+            if isinstance(parent, ast.ClassDef):
+                cls = parent.name
+                break
+        return FuncSummary(
+            path=self.ctx.path,
+            qualname=self.scope.qualname,
+            cls=cls,
+            name=node.name,
+            line=node.lineno,
+            is_async=self.scope.is_async,
+            may_suspend=self.s > 0,
+            suspend_lines=tuple(sorted(self.suspend_lines)),
+            reads=tuple(self.reads),
+            writes=tuple(self.writes),
+            lockdefaults=tuple(self.lockdefaults),
+            calls=tuple(self.calls),
+        )
+
+
+def summarize_module(ctx: ModuleContext) -> FileSummary:
+    out = FileSummary(path=ctx.path)
+    for scope in ctx.functions():
+        out.functions.append(_FunctionSummarizer(ctx, scope).run())
+    return out
+
+
+class ProgramIndex:
+    """Pass-2 view over every file's summaries: flat function list,
+    per-(file, class) grouping, and the `*_locked` guard inheritance
+    resolver."""
+
+    def __init__(self, files: list[FileSummary]) -> None:
+        self.functions: list[FuncSummary] = [
+            fn for f in files for fn in f.functions
+        ]
+        self._by_cls: dict[tuple, list[FuncSummary]] = {}
+        for fn in self.functions:
+            self._by_cls.setdefault((fn.path, fn.cls), []).append(fn)
+        self._inherited: dict[tuple, frozenset] = {}
+
+    def class_functions(self, path: str, cls: str) -> list[FuncSummary]:
+        return self._by_cls.get((path, cls), [])
+
+    def inherited_guards(self, fs: FuncSummary) -> frozenset:
+        """Guards a `*_locked` function's body may assume: the
+        convention token (the name IS a contract: callers must hold
+        the lock) plus the intersection of the guard sets actually
+        held at every discovered `self.<name>()` call site in the same
+        class — the whole-program part. Non-convention functions
+        inherit nothing."""
+        key = (fs.path, fs.cls, fs.name)
+        cached = self._inherited.get(key)
+        if cached is not None:
+            return cached
+        if not fs.name.endswith("_locked"):
+            out = frozenset()
+        else:
+            caller_guards: list[set] = []
+            for g in self.class_functions(fs.path, fs.cls):
+                if g.qualname == fs.qualname:
+                    continue
+                for callee, guards in g.calls:
+                    if callee == fs.name:
+                        caller_guards.append(set(guards))
+            inter = set.intersection(*caller_guards) if caller_guards else set()
+            out = frozenset({f"<locked:{fs.name}>"} | inter)
+        self._inherited[key] = out
+        return out

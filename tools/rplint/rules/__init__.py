@@ -16,6 +16,8 @@ from .rpl011_tick_discipline import TickDisciplineRule
 from .rpl012_cardinality import CardinalityDisciplineRule
 from .rpl013_cloud_budget import CloudAwaitBudgetRule
 from .rpl014_clock_discipline import ClockDisciplineRule
+from .rpl015_await_atomicity import AwaitAtomicityRule
+from .rpl016_lock_consistency import LockConsistencyRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -32,6 +34,8 @@ ALL_RULES = [
     CardinalityDisciplineRule,
     CloudAwaitBudgetRule,
     ClockDisciplineRule,
+    AwaitAtomicityRule,
+    LockConsistencyRule,
 ]
 
 __all__ = ["ALL_RULES"]

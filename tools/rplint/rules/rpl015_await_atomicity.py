@@ -1,0 +1,147 @@
+"""RPL015 — await-atomicity: no torn read-modify-write or
+check-then-act across a suspension point without a common lock.
+
+Every raft safety invariant in this codebase — term monotonicity,
+commit-index monotonicity, single-leader-per-term — is protected by
+asyncio lock discipline, not by the GIL: between any two `await`s the
+event loop can run arbitrary other coroutines over the same shared
+state. The classic asyncio race is therefore
+
+    if self._leader_id is None:        # read (check)
+        winner = await self._elect()   # suspension — world may change
+        self._leader_id = winner       # write (act) — torn
+
+or the same shape as a read-modify-write (`self._seq = self._seq +
+await f()`, `self._seq += await f()`, or captured through a local:
+`v = self._pos; await ...; self._pos = v + n`). If no lock is held in
+common across the read and the write, another coroutine's write during
+the suspension is silently overwritten.
+
+Flagged (whole-program pass 2 over the pass-1 summaries,
+tools/rplint/program.py): inside an `async def`, a write to
+`self.<attr>` whose value (directly, through a tainted local, or
+through the test of an enclosing `if`/`while` — check-then-act)
+depends on a read of the SAME attribute, with at least one suspension
+point between the read and the write, and with no guard common to both
+sides. Guards are `with`/`async with` regions over lock-like
+expressions plus the `*_locked` naming convention: a function named
+`foo_locked` inherits the intersection of the guards its call sites
+hold (and the convention token itself, so the name alone certifies
+the body).
+
+Also flagged, same rule (the audited lock-acquisition shape):
+`self.<map>.setdefault(key, asyncio.Lock())`. The get-or-create is
+loop-atomic in CPython, but a bare dict gives the registry no
+lifecycle — entries leak per key forever and teardown/reconfiguration
+cannot tell a parked lock from a held one. utils/locks.py `LockMap`
+is the one audited home for per-key locks (`.lock(key)`, `.prune()`,
+`.discard()`); route new registries through it.
+
+The fix for a torn sequence is mechanical: hold one lock across the
+whole read→await→write window, or re-read (re-check) the attribute
+after the last await before acting. Intentional exceptions carry
+`# rplint: disable=RPL015` with a one-line justification.
+"""
+
+from __future__ import annotations
+
+from ..engine import Finding
+
+EXAMPLE = '''\
+class Broker:
+    async def elect(self):
+        if self._leader_id is None:            # read (check)
+            winner = await self.run_vote()     # suspension point
+            self._leader_id = winner           # RPL015: torn check-then-act
+
+    async def ok_locked_version(self):
+        async with self._state_lock:           # common lock held across
+            if self._leader_id is None:        # the whole window: clean
+                winner = await self.run_vote()
+                self._leader_id = winner
+'''
+
+
+def _fmt_guards(guards) -> str:
+    return "{" + ", ".join(guards) + "}" if guards else "no lock"
+
+
+class AwaitAtomicityRule:
+    code = "RPL015"
+    name = "await-atomicity"
+    whole_program = True
+
+    def check(self, ctx):
+        return ()  # whole-program rule: findings come from check_program
+
+    def check_program(self, program):
+        for fs in program.functions:
+            inherited = program.inherited_guards(fs)
+            if fs.is_async:
+                yield from self._check_writes(fs, inherited)
+            for ld in fs.lockdefaults:
+                if self.code in ld.sup:
+                    continue
+                yield Finding(
+                    path=fs.path,
+                    line=ld.line,
+                    col=ld.col,
+                    rule=self.code,
+                    qualname=fs.qualname,
+                    attr=ld.attr,
+                    message=(
+                        f"per-key asyncio.Lock registry via "
+                        f"{ld.attr}.setdefault(key, asyncio.Lock()) — a bare "
+                        "dict has no lock lifecycle (entries leak per key, "
+                        "teardown cannot tell parked from held); use "
+                        "utils.locks.LockMap (.lock(key)/.prune()/.discard())"
+                    ),
+                )
+
+    def _check_writes(self, fs, inherited):
+        seen: set[tuple] = set()
+        for w in fs.writes:
+            if self.code in w.sup:
+                continue
+            # the recommended fix, recognized: a dep read of the same
+            # attr at the write's own suspension count means the value/
+            # condition was re-checked after the last await — the
+            # re-read and the write are loop-atomic, older stale reads
+            # are superseded
+            if any(d.attr == w.attr and d.s == w.s for d in w.deps):
+                continue
+            wg = set(w.guards) | inherited
+            for dep in w.deps:
+                if dep.attr != w.attr or w.s <= dep.s:
+                    continue
+                if (set(dep.guards) | inherited) & wg:
+                    continue
+                key = (w.line, w.col, w.attr)
+                if key in seen:
+                    break
+                seen.add(key)
+                shape = (
+                    "read-modify-write" if (w.aug or dep.line == w.line)
+                    else "check-then-act"
+                )
+                yield Finding(
+                    path=fs.path,
+                    line=w.line,
+                    col=w.col,
+                    rule=self.code,
+                    qualname=fs.qualname,
+                    attr=w.attr,
+                    guards=(
+                        ("read", dep.guards),
+                        ("write", w.guards),
+                    ),
+                    message=(
+                        f"torn {shape} of self.{w.attr}: read at line "
+                        f"{dep.line} ({_fmt_guards(dep.guards)}), suspension "
+                        f"point(s) before the write here "
+                        f"({_fmt_guards(w.guards)}) — no common lock; hold "
+                        "one lock across the read→await→write "
+                        "window or re-check after the last await"
+                    ),
+                )
+                break

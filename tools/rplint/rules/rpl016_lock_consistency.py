@@ -1,0 +1,136 @@
+"""RPL016 — lock consistency: every write site of a shared attribute
+must agree on its guard.
+
+A lock only protects an invariant if EVERY writer holds it. The
+failure shape this rule exists for: `self._next_offset` is written
+under `self._append_lock` in the replication path (so the multi-await
+append sequence is atomic), while a second coroutine writes it bare on
+the other side of one of its own awaits — the lock-holder's critical
+section is torn open mid-await by a writer that never took the lock,
+and no single function looks wrong in review.
+
+Flagged (whole-program pass 2 over the pass-1 census,
+tools/rplint/program.py): a (class, attribute) whose REBIND write
+sites, across the entire package, include at least one site guarded by
+a lock in an `async def` AND at least one disagreeing site — either
+bare *after a suspension point* in another `async def`, or guarded by
+a different lock with no common guard — reported ONCE per attribute
+with every participating site listed.
+
+Scope, chosen deliberately and documented so triage can trust the
+empty baseline:
+
+* only `self.<attr>` rebinds count — container mutation is governed
+  by RPL001/RPL011 and the touch()/SoA discipline, not locks;
+* `__init__`-family and sync functions are exempt: before start there
+  is no concurrency, and a sync function cannot be preempted on one
+  event loop, so its writes are loop-atomic (a sync bare write can
+  still tear a lock-holder's window — if triage proves one does, fix
+  it there; this rule optimizes for signal);
+* a bare write in an async function with NO suspension point is
+  likewise loop-atomic and exempt;
+* sites inside `*_locked` functions whose call sites give them a
+  non-empty inherited guard participate with those guards; if the
+  convention token is all they have, the name is trusted and the site
+  abstains rather than invent disagreement.
+
+The fix is to hold the same lock at every async write site (or to
+funnel the writes into one owner coroutine); intentional exceptions
+carry `# rplint: disable=RPL016` on the disagreeing site with a
+justification.
+"""
+
+from __future__ import annotations
+
+from ..engine import Finding
+
+EXAMPLE = '''\
+class Broker:
+    async def append(self, n):
+        async with self._append_lock:          # writer 1: guarded
+            base = self._next_offset
+            await self.write_batch(base, n)
+            self._next_offset = base + n
+
+    async def truncate(self, offset):
+        await self.drop_tail(offset)
+        self._next_offset = offset             # RPL016: bare across an
+                                               # await vs _append_lock
+'''
+
+
+def _fmt(guards) -> str:
+    return "{" + ", ".join(guards) + "}" if guards else "bare"
+
+
+class LockConsistencyRule:
+    code = "RPL016"
+    name = "lock-consistency"
+    whole_program = True
+
+    def check(self, ctx):
+        return ()  # whole-program rule: findings come from check_program
+
+    def check_program(self, program):
+        census: dict[tuple, list] = {}
+        for fs in program.functions:
+            if not fs.cls or fs.is_init or not fs.is_async:
+                continue
+            inherited = program.inherited_guards(fs)
+            for w in fs.writes:
+                eff = frozenset(w.guards) | inherited
+                census.setdefault((fs.path, fs.cls, w.attr), []).append(
+                    (fs.qualname, w, eff)
+                )
+        for (path, cls, attr), sites in sorted(census.items()):
+            finding = self._check_attr(path, cls, attr, sites)
+            if finding is not None:
+                yield finding
+
+    def _check_attr(self, path, cls, attr, sites):
+        participants = []  # (qualname, write, effective_guards)
+        for qualname, w, eff in sites:
+            if self.code in w.sup:
+                continue  # suppressed site: intentional, abstains
+            wildcard = any(g.startswith("<locked:") for g in eff)
+            if eff and not wildcard:
+                participants.append((qualname, w, eff))
+            elif wildcard:
+                continue  # *_locked convention trusted, abstains
+            elif w.s > 0:
+                # bare rebind after a suspension point: the shape that
+                # tears another writer's critical section
+                participants.append((qualname, w, frozenset()))
+        if len(participants) < 2:
+            return None
+        if len({qn for qn, _, _ in participants}) < 2:
+            return None  # single function: RPL015 territory
+        if not any(eff for _, _, eff in participants):
+            return None  # nobody claims a lock: no discipline to break
+        common = frozenset.intersection(*(eff for _, _, eff in participants))
+        if common:
+            return None
+        participants.sort(key=lambda p: (p[1].line, p[0]))
+        bare = [p for p in participants if not p[2]]
+        anchor = bare[0] if bare else participants[0]
+        listing = "; ".join(
+            f"{qn}:{w.line} {_fmt(sorted(eff))}" for qn, w, eff in participants
+        )
+        return Finding(
+            path=path,
+            line=anchor[1].line,
+            col=anchor[1].col,
+            rule=self.code,
+            qualname=f"{cls}.{attr}",
+            attr=attr,
+            guards=tuple(
+                (f"{qn}:{w.line}", tuple(sorted(eff)))
+                for qn, w, eff in participants
+            ),
+            message=(
+                f"write sites of self.{attr} disagree on their guard — "
+                f"{listing} — a lock only protects the attribute if every "
+                "async writer holds it; hold a common lock at each site or "
+                "funnel writes into one owner"
+            ),
+        )

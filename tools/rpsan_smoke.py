@@ -1,0 +1,98 @@
+"""verify.sh race-sanitizer smoke: boot a 3-broker cluster with the
+runtime async race sanitizer armed (RP_SAN=1), drive one raft
+election plus a produce round on every partition, shut down, and
+fail if rpsan recorded a single torn-write report.
+
+Exit 0 = the instrumented hot paths (Consensus role/vote transitions,
+HeartbeatManager plan cache, GroupManager sweeper state, flush
+coalescer handoff) completed an election + replication round with no
+coroutine carrying a stale read across a suspension point. The
+seeded positive case (a race that MUST report) lives in
+tests/test_rpsan.py; this gate is the negative: production code under
+the sanitizer is clean.
+"""
+
+import asyncio
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+os.environ["RP_SAN"] = "1"  # must precede any redpanda_tpu import
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+    ),
+)
+
+N_PARTITIONS = 3
+
+
+async def main() -> int:
+    from chaos_harness import ChaosCluster
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.utils import rpsan
+
+    assert rpsan.enabled(), "RP_SAN=1 did not arm the sanitizer"
+    assert rpsan.INSTRUMENTED, "no classes instrumented under RP_SAN=1"
+
+    with tempfile.TemporaryDirectory(prefix="rpsan_smoke_") as d:
+        cluster = ChaosCluster(Path(d), n=3)
+        await cluster.start()  # includes waiting out a controller election
+        try:
+            client = KafkaClient(cluster.addresses())
+            try:
+                deadline = time.monotonic() + 30
+                while True:
+                    try:
+                        await client.create_topic(
+                            "sanity",
+                            partitions=N_PARTITIONS,
+                            replication_factor=3,
+                        )
+                        break
+                    except Exception:
+                        if time.monotonic() > deadline:
+                            raise
+                        await asyncio.sleep(0.2)
+                for p in range(N_PARTITIONS):
+                    while True:
+                        try:
+                            off = await asyncio.wait_for(
+                                client.produce(
+                                    "sanity",
+                                    p,
+                                    [(b"k%d" % p, b"v%d" % p)],
+                                    acks=-1,
+                                ),
+                                timeout=5.0,
+                            )
+                            assert off >= 0
+                            break
+                        except asyncio.TimeoutError:
+                            if time.monotonic() > deadline:
+                                raise
+            finally:
+                await client.close()
+        finally:
+            await cluster.stop()
+
+    reps = rpsan.reports()
+    classes = ", ".join(sorted(c for c, _ in rpsan.INSTRUMENTED))
+    if reps:
+        print(f"rpsan smoke: {len(reps)} torn-write report(s):")
+        for r in reps:
+            print("  " + r.render())
+        return 1
+    print(
+        f"rpsan smoke OK: election + {N_PARTITIONS}-partition produce "
+        f"round, 0 reports ({classes} instrumented)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(asyncio.run(main()))

@@ -12,6 +12,9 @@ cd "$(dirname "$0")/.."
 echo "== rplint (baseline gate) =="
 python -m tools.rplint --baseline redpanda_tpu
 
+echo "== rplint race rules (RPL015/016 whole-program, empty by construction) =="
+python -m tools.rplint --rules RPL015,RPL016 redpanda_tpu tools tests
+
 echo "== native build =="
 if make -s -C native; then
     echo "built native/build/libredpanda_native.so"
@@ -54,6 +57,9 @@ env JAX_PLATFORMS=cpu python tools/tick_frame_smoke.py --parity --groups 4096
 
 echo "== tiered chaos smoke (ObjectNemesis schedule, replay-equal) =="
 env JAX_PLATFORMS=cpu python tools/tiered_smoke.py
+
+echo "== race sanitizer smoke (RP_SAN=1 election + produce, 0 reports) =="
+env JAX_PLATFORMS=cpu python tools/rpsan_smoke.py
 
 echo "== health-plane smoke (partition_health + bounded /metrics) =="
 env JAX_PLATFORMS=cpu python tools/scrape_smoke.py --health
