@@ -1238,6 +1238,74 @@ def _hist_window(after, before):
     return w
 
 
+def _scrape_placement(port: int) -> dict | None:
+    """One admin /v1/placement scrape (sharded brokers only)."""
+    import json as _json
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/placement", timeout=10
+        ) as r:
+            return _json.loads(r.read().decode())
+    except Exception:
+        return None
+
+
+def _placement_block(placements: list) -> dict:
+    """Fleet placement summary for the bench headline: moves executed,
+    the freeze-window p99 those moves cost, and the shard skew the
+    rebalancer saw before/after acting. The nested metric/value/unit
+    rows feed tools/bench_gate.py (freeze p99 and skew grade
+    lower-better)."""
+    live = [p for p in placements if p]
+    moves = sum(p.get("table", {}).get("moves_executed", 0) for p in live)
+    freeze_p99 = max(
+        [
+            float((p.get("mover") or {}).get("stats", {}).get(
+                "freeze_p99_ms", 0.0
+            ))
+            for p in live
+        ]
+        or [0.0]
+    )
+    skew_now = max(
+        [float((p.get("rebalancer") or {}).get("skew", 1.0)) for p in live]
+        or [1.0]
+    )
+    rebalances = [
+        v
+        for p in live
+        for v in (p.get("rebalancer") or {}).get("history", [])
+    ]
+    if rebalances:
+        skew_before = max(float(v.get("skew_before", 1.0)) for v in rebalances)
+        skew_after = float(rebalances[-1].get("skew_after", skew_now))
+    else:
+        skew_before = skew_after = skew_now
+    return {
+        "pinned": os.environ.get("RP_PLACEMENT_PIN", "0") == "1",
+        "brokers_scraped": len(live),
+        "rebalances": len(rebalances),
+        "skew_before": round(skew_before, 3),
+        "moves": {
+            "metric": "placement_moves_executed",
+            "value": moves,
+            "unit": "moves",
+        },
+        "freeze_p99": {
+            "metric": "placement_move_freeze_p99_ms",
+            "value": round(freeze_p99, 3),
+            "unit": "ms",
+        },
+        "skew": {
+            "metric": "placement_shard_skew",
+            "value": round(skew_after, 3),
+            "unit": "skew",
+        },
+    }
+
+
 # ------------------------------------- replicated, multi-process (config #3mp)
 async def _replicated_mp_async(n_cores: int) -> dict:
     """The same 3-broker acks=all replicated produce, but with the
@@ -1265,6 +1333,12 @@ async def _replicated_mp_async(n_cores: int) -> dict:
     avail = sorted(os.sched_getaffinity(0))
     pin = avail[: max(1, n_cores)]
     broker_cores = [pin[i % len(pin)] for i in range(3)]
+    # per-broker shard count: >1 engages the placement layer (spread +
+    # live moves + alert-driven rebalance); RP_PLACEMENT_PIN=1 keeps
+    # the shards but restores the v1 shard-0 pin as the A/B baseline
+    n_shards = int(
+        os.environ.get("BENCH_MP_SHARDS", os.environ.get("RP_SHARDS", "1"))
+    )
 
     socks, ports = [], []
     for _ in range(9):
@@ -1295,7 +1369,8 @@ async def _replicated_mp_async(n_cores: int) -> dict:
                     "--admin-port", str(admin[i]),
                     "--pin-core", str(broker_cores[i]),
                     "--log-level", "WARNING",
-                ],
+                ]
+                + (["--shards", str(n_shards)] if n_shards > 1 else []),
                 cwd=repo,
                 stderr=log,
             )
@@ -1399,8 +1474,16 @@ async def _replicated_mp_async(n_cores: int) -> dict:
             # processes we fork; the client shares those cores too)
             "cores": len(set(broker_cores)),
             "broker_cores": broker_cores,
+            "shards": n_shards,
             "transport": "tcp",
         }
+        if n_shards > 1:
+            out["placement"] = _placement_block(
+                [
+                    await asyncio.to_thread(_scrape_placement, p)
+                    for p in admin
+                ]
+            )
         if probe_before is not None:
             from redpanda_tpu.metrics import HistogramChild
 

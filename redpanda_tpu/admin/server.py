@@ -119,6 +119,14 @@ class AdminServer(HttpServer):
         r("GET", r"/v1/metrics/history", self._metrics_history)
         r("GET", r"/v1/alerts", self._alerts)
         r("GET", r"/v1/debug/profile", self._debug_profile)
+        # -- placement layer -------------------------------------------
+        r("GET", r"/v1/placement", self._placement)
+        r(
+            "POST",
+            r"/v1/placement/move/([^/]+)/([^/]+)/(\d+)",
+            self._placement_move,
+        )
+        r("POST", r"/v1/placement/rebalance", self._placement_rebalance)
         # -- r4 additions toward admin_server.cc route parity ----------
         r(
             "POST",
@@ -1589,6 +1597,56 @@ class AdminServer(HttpServer):
                 "recent": [],
             }
         return mgr.status()
+
+    # -- placement layer ----------------------------------------------
+    async def _placement(self, _m, _q, _b):
+        """Placement-layer state: the live ntp/group → shard map with
+        lane bindings, move budget/stats, and the rebalancer's verdict
+        history (placement/)."""
+        table = self.broker.shard_table
+        out = {
+            "table": table.describe(),
+            "entries": table.entries(),
+            "mover": None,
+            "rebalancer": None,
+        }
+        mover = getattr(self.broker, "placement_mover", None)
+        if mover is not None:
+            out["mover"] = mover.describe()
+        reb = getattr(self.broker, "placement_rebalancer", None)
+        if reb is not None:
+            out["rebalancer"] = reb.describe()
+        return out
+
+    async def _placement_move(self, m, q, b):
+        """Trigger one live partition move (smoke/operator entry
+        point): POST /v1/placement/move/<ns>/<topic>/<pid>?shard=K."""
+        from ..models.fundamental import NTP
+        from ..placement import MoveError
+
+        mover = getattr(self.broker, "placement_mover", None)
+        if mover is None:
+            raise HttpError(400, "placement mover not active (1 shard?)")
+        body = self._json_body(b) if b else {}
+        shard = q.get("shard", body.get("shard"))
+        if shard is None:
+            raise HttpError(400, "target shard required (?shard=K)")
+        ntp = NTP(m.group(1), m.group(2), int(m.group(3)))
+        try:
+            return await mover.move(ntp, int(shard))
+        except MoveError as e:
+            raise HttpError(400, str(e)) from None
+
+    async def _placement_rebalance(self, _m, _q, b):
+        """Trigger one bounded rebalance pass using the ledger's
+        current hot-NTP list (same path an alert fires)."""
+        reb = getattr(self.broker, "placement_rebalancer", None)
+        if reb is None:
+            raise HttpError(400, "rebalancer not active (1 shard?)")
+        led = getattr(self.broker, "load_ledger", None)
+        hot = led.top(8) if led is not None else []
+        await reb.sample()
+        return await reb.rebalance_once(hot_ntps=hot, reason="manual")
 
     async def _debug_profile(self, _m, q, _b):
         """Continuous-profiler window: collapsed wall stacks over the

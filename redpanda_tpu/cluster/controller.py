@@ -1137,18 +1137,17 @@ class Controller:
     def _shard_for_new(self, d) -> int:
         """Worker shard that should own a new partition, or 0 (local).
 
-        v1 sharding policy (ssx/sharded_broker.py): only sole-replica
-        data partitions in the default namespace spread across shards —
-        internal/coordinator topics (tx, groups) and replicated raft
-        groups keep the shard-0 path, where the full rpc/dissemination
-        machinery lives."""
+        The policy lives in the placement layer now
+        (PlacementTable.assign): internal/coordinator topics keep the
+        shard-0 path, every default-namespace data partition spreads —
+        replicated groups included (the raft shard seam forwards their
+        inbound RPC; RP_PLACEMENT_PIN=1 restores the v1 shard-0 pin
+        for A/B baselines)."""
         if self.shard_router is None:
             return 0
-        if list(d.replicas) != [self.node_id]:
-            return 0
-        if d.ntp.ns != DEFAULT_NS or d.ntp.topic.startswith("__"):
-            return 0
-        return self.shard_router.shard_of(d.group)
+        return self._shards.assign(
+            d.ntp, d.group, list(d.replicas), self.node_id
+        )
 
     async def _backend_loop(self) -> None:
         """Turn topic_table deltas into local partition create/remove
@@ -1180,10 +1179,13 @@ class Controller:
                     if d.kind == "add" and self.node_id in d.replicas:
                         shard = self._shard_for_new(d)
                         if shard:
-                            # shard-owned: create on the worker shard,
-                            # record ownership, and advertise ourselves
-                            # as leader (the shard's single-voter group
-                            # elects itself; metadata must not wait)
+                            # shard-owned: create on the worker shard
+                            # and record ownership. Single-voter groups
+                            # elect themselves instantly — advertise us
+                            # as leader so metadata doesn't wait; for
+                            # replicated groups the real leader arrives
+                            # via the worker's leader-hint relay
+                            # (ssx/sharded_broker.py placement service)
                             await self.shard_router.create_partition(
                                 shard,
                                 d.ntp,
@@ -1192,7 +1194,10 @@ class Controller:
                                 self._log_config_for(d.ntp),
                             )
                             self._shards.insert(d.ntp, d.group, shard)
-                            if self.leaders_table is not None:
+                            if (
+                                self.leaders_table is not None
+                                and list(d.replicas) == [self.node_id]
+                            ):
                                 self.leaders_table.update(d.ntp, self.node_id)
                             continue
                         p = await self._pm.manage(

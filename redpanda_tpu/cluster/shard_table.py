@@ -1,43 +1,15 @@
 """ntp/group → shard lookup (reference: src/v/cluster/shard_table.h:26-46).
 
-With the ssx shard runtime active (ssx/sharded_broker.py) this table
-is load-bearing: the controller backend records which worker shard owns
-each data partition, and the kafka layer resolves a shard before
-touching a partition — exactly as produce.cc:249 does — forwarding
-non-local ones through `invoke_on`. Single-process brokers keep every
-entry at shard 0 and the table stays a pass-through seam.
+The implementation moved to the placement layer (PR 12): the broker's
+`shard_table` is now a full `placement.PlacementTable` — same
+insert/erase/shard_for/shard_for_group/counts surface this module
+always had, plus the placement policy (`assign`), the lane map, and
+the live-move rebind (`record_move`). This module stays as the compat
+import site so existing callers and fixtures keep working.
 """
 
 from __future__ import annotations
 
-from ..models.fundamental import NTP
+from ..placement.table import PlacementTable as ShardTable
 
-
-class ShardTable:
-    def __init__(self, shard_count: int = 1):
-        # ssx.ShardedBroker overwrites this with the live shard count;
-        # everything else treats it as read-only topology metadata
-        self.shard_count = shard_count
-        self._ntp: dict[NTP, int] = {}
-        self._group: dict[int, int] = {}
-
-    def insert(self, ntp: NTP, group_id: int, shard: int = 0) -> None:
-        self._ntp[ntp] = shard
-        self._group[group_id] = shard
-
-    def erase(self, ntp: NTP, group_id: int) -> None:
-        self._ntp.pop(ntp, None)
-        self._group.pop(group_id, None)
-
-    def shard_for(self, ntp: NTP) -> int | None:
-        return self._ntp.get(ntp)
-
-    def shard_for_group(self, group_id: int) -> int | None:
-        return self._group.get(group_id)
-
-    def counts(self) -> dict[int, int]:
-        """partitions per shard (admin/bench attribution)."""
-        out: dict[int, int] = {}
-        for shard in self._ntp.values():
-            out[shard] = out.get(shard, 0) + 1
-        return out
+__all__ = ["ShardTable"]

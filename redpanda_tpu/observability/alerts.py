@@ -128,6 +128,21 @@ class AlertRule:
 
 _STAGE_FAMILY = "redpanda_tpu_kafka_request_stage_seconds"
 _LAG_FAMILY = "redpanda_tpu_partition_health_max_follower_lag"
+_SKEW_FAMILY = "redpanda_tpu_placement_shard_skew"
+
+
+def shard_skew_rule(threshold: Optional[float] = None) -> AlertRule:
+    """Gauge rule over the placement layer's cross-shard skew index
+    (1.0 = balanced). Firing hands the alert — hot NTPs attached — to
+    the Rebalancer via on_fire (the placement closed loop). Tunable:
+    RP_SKEW_ALERT_THRESHOLD."""
+    if threshold is None:
+        threshold = _env_float("RP_SKEW_ALERT_THRESHOLD", 2.0)
+    return AlertRule(
+        "shard_skew", "gauge", _SKEW_FAMILY, None,
+        0.0, float(threshold), "ratio",
+        "cross-shard load skew index vs the rebalance threshold",
+    )
 
 
 def rules_from_slo(slo: dict) -> list[AlertRule]:
@@ -210,6 +225,10 @@ class AlertManager:
         self._clock = clock
         self._wall = wall_clock
         self.active: dict[str, dict] = {}
+        # async callbacks invoked (from the evaluation loop) with each
+        # alert dict on its firing transition — e.g. the placement
+        # Rebalancer's bounded rebalance (alert-closed loop)
+        self.on_fire: list = []
         self.recent: deque[dict] = deque(maxlen=history_len)
         self.evaluations = 0
         self._task: Optional[asyncio.Task] = None
@@ -333,9 +352,18 @@ class AlertManager:
         while True:
             await asyncio.sleep(self.interval_s)
             try:
-                self.evaluate()
+                transitions = self.evaluate()
             except Exception:
                 logger.exception("alert evaluation failed")
+                continue
+            for alert in transitions:
+                if alert.get("state") != "firing":
+                    continue
+                for hook in list(self.on_fire):
+                    try:
+                        await hook(alert)
+                    except Exception:
+                        logger.exception("alert on_fire hook failed")
 
     def start(self) -> None:
         if self._task is None:

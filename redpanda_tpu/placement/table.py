@@ -1,0 +1,157 @@
+"""The single placement authority: raft group → (process shard, device
+lane slot) (reference: src/v/cluster/shard_table.h:26-46 +
+shard_placement_table.h).
+
+Before this layer existed, placement was decided twice and
+independently — `ssx.shards.shard_of` hashed groups onto process
+shards (replicated and internal groups pinned to shard 0), while the
+vmap'd tick frame batched groups into device lanes with no record of
+the pairing. The PlacementTable owns both coordinates now:
+
+- the POLICY (`assign`): which shard a NEW group lands on. The v1
+  shard-0 pin for replicated groups is retired — any default-namespace
+  data partition spreads, whether its replica set is `[node_id]` or a
+  full quorum (inbound raft RPC for worker-owned replicated groups
+  forwards through the RaftService shard seam). `RP_PLACEMENT_PIN=1`
+  restores the v1 behavior for A/B baselines.
+- the MAP (`insert`/`erase`/`shard_for`/`shard_for_group`): live
+  ntp/group → shard, mutated only by the controller backend and the
+  PartitionMover. This subsumes the old `cluster.shard_table.
+  ShardTable` interface, so every existing lookup site keeps working.
+- the LANE (`bind_lane`/`lane_for`): the ShardGroupArrays row the
+  group's raft lanes occupy on its owning shard, reported at group
+  creation and REBOUND by live moves (the target allocates a fresh
+  row; the source frees its old one).
+
+rplint RPL017 (placement-discipline) enforces that `compute_shard` —
+the one modulo over the shard count — is computed nowhere else:
+everyone asks this table.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..models.fundamental import DEFAULT_NS, NTP
+
+
+def compute_shard(group_id: int, n_shards: int) -> int:
+    """Deterministic raft-group → shard hash for NEW groups. Group 0
+    (the controller) and negative fixture ids are pinned to shard 0,
+    which runs the full broker; data groups spread round-robin. This
+    is a DEFAULT, not an invariant: live moves rebind groups, so only
+    the PlacementTable map is authoritative after creation."""
+    if n_shards <= 1 or group_id <= 0:
+        return 0
+    return group_id % n_shards
+
+
+def pin_replicated() -> bool:
+    """A/B knob: RP_PLACEMENT_PIN=1 restores the v1 shard-0 pin for
+    replicated (multi-replica) groups."""
+    return os.environ.get("RP_PLACEMENT_PIN", "0") == "1"
+
+
+class PlacementTable:
+    """ntp/group → (shard, lane row). Drop-in superset of the old
+    cluster.shard_table.ShardTable (the compat alias lives there)."""
+
+    def __init__(self, shard_count: int = 1):
+        # ssx.ShardedBroker overwrites this with the live shard count;
+        # everything else treats it as read-only topology metadata
+        self.shard_count = shard_count
+        self._ntp: dict[NTP, int] = {}
+        self._group: dict[int, int] = {}
+        self._gid_of: dict[NTP, int] = {}
+        self._lane: dict[int, int] = {}
+        # bumped on every map mutation; the RaftService forwarding seam
+        # caches per-sender "all groups local" verdicts against it
+        self.epoch = 0
+        self.moves_executed = 0
+
+    # -- policy -------------------------------------------------------
+    def assign(self, ntp: NTP, group_id: int, replicas, node_id: int) -> int:
+        """Shard for a NEW partition (Controller._shard_for_new's
+        policy, unified here). Internal/coordinator topics (tx,
+        consumer groups) and non-default namespaces keep the shard-0
+        path, where the full coordinator machinery lives; everything
+        else spreads."""
+        if self.shard_count <= 1:
+            return 0
+        if ntp.ns != DEFAULT_NS or ntp.topic.startswith("__"):
+            return 0
+        if pin_replicated() and list(replicas) != [node_id]:
+            return 0
+        return compute_shard(group_id, self.shard_count)
+
+    # -- map ----------------------------------------------------------
+    def insert(self, ntp: NTP, group_id: int, shard: int = 0) -> None:
+        self._ntp[ntp] = shard
+        self._group[group_id] = shard
+        self._gid_of[ntp] = group_id
+        self.epoch += 1
+
+    def erase(self, ntp: NTP, group_id: int) -> None:
+        self._ntp.pop(ntp, None)
+        self._group.pop(group_id, None)
+        self._gid_of.pop(ntp, None)
+        self._lane.pop(group_id, None)
+        self.epoch += 1
+
+    def shard_for(self, ntp: NTP) -> int | None:
+        return self._ntp.get(ntp)
+
+    def shard_for_group(self, group_id: int) -> int | None:
+        return self._group.get(group_id)
+
+    def record_move(self, ntp: NTP, group_id: int, shard: int) -> None:
+        """Rebind after a completed live move (PartitionMover only)."""
+        self.insert(ntp, group_id, shard)
+        self.moves_executed += 1
+
+    # -- lane ---------------------------------------------------------
+    def bind_lane(self, group_id: int, row: int) -> None:
+        """Record the ShardGroupArrays row the group occupies on its
+        owning shard (reported at creation / move commit)."""
+        if row >= 0:
+            self._lane[group_id] = row
+        else:
+            self._lane.pop(group_id, None)
+
+    def lane_for(self, group_id: int) -> int | None:
+        return self._lane.get(group_id)
+
+    # -- attribution --------------------------------------------------
+    def counts(self) -> dict[int, int]:
+        """partitions per shard (admin/bench attribution)."""
+        out: dict[int, int] = {}
+        for shard in self._ntp.values():
+            out[shard] = out.get(shard, 0) + 1
+        return out
+
+    def group_of(self, ntp: NTP) -> int | None:
+        return self._gid_of.get(ntp)
+
+    def entries(self) -> list[dict]:
+        """Admin surface: the full map with lane bindings."""
+        out = []
+        for ntp, shard in self._ntp.items():
+            gid = self._gid_of.get(ntp)
+            out.append(
+                {
+                    "ntp": f"{ntp.ns}/{ntp.topic}/{ntp.partition}",
+                    "group": gid,
+                    "shard": shard,
+                    "lane": self._lane.get(gid, -1) if gid is not None else -1,
+                }
+            )
+        return out
+
+    def describe(self) -> dict:
+        return {
+            "shard_count": self.shard_count,
+            "partitions": len(self._ntp),
+            "counts": {str(k): v for k, v in sorted(self.counts().items())},
+            "moves_executed": self.moves_executed,
+            "epoch": self.epoch,
+        }

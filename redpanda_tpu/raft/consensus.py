@@ -85,6 +85,32 @@ class _VoteState(serde.Envelope):
 SendFn = Callable[[int, int, bytes, float], Awaitable[bytes]]
 
 
+def seed_group_state(
+    kvstore: KvStore,
+    group_id: int,
+    *,
+    term: int,
+    voted_for: int,
+    config_raw: bytes,
+) -> None:
+    """Pre-stage a moved group's raft hard state so the Consensus built
+    by the adopting shard restores it at start() exactly as if it had
+    always lived there (placement/host.py move_begin)."""
+    st = _VoteState(
+        term=int(term),
+        voted_for=int(voted_for) if voted_for is not None else -1,
+    )
+    kvstore.put(KeySpace.consensus, f"vote/{group_id}".encode(), st.encode())
+    if config_raw:
+        kvstore.put(KeySpace.consensus, f"cfg/{group_id}".encode(), config_raw)
+
+
+def unseed_group_state(kvstore: KvStore, group_id: int) -> None:
+    """Roll back seed_group_state on move abort."""
+    kvstore.remove(KeySpace.consensus, f"vote/{group_id}".encode())
+    kvstore.remove(KeySpace.consensus, f"cfg/{group_id}".encode())
+
+
 class Consensus:
     def __init__(
         self,
@@ -188,6 +214,10 @@ class Consensus:
         self._config_history: list[tuple[int, GroupConfiguration]] = []
         self._initial_config = config
         self._closed = False
+        # live-move quiesce (placement/mover.py): while frozen the
+        # group accepts no replicate/append/vote traffic — writers get
+        # retriable errors and the log stays byte-stable for shipping
+        self._frozen = False
         # -- raft snapshot state (consensus.cc install_snapshot +
         # recovery_stm.cc snapshot fallback) --------------------------
         self._snapshot_path = os.path.join(log.directory, "snapshot")
@@ -510,6 +540,38 @@ class Consensus:
         self._notify_commit()  # release waiters
         self._fail_quorum_waiters(lambda: ReplicateTimeout("node stopped"))
 
+    # ------------------------------------------------- live-move quiesce
+    async def freeze(self, drain_timeout_s: float = 5.0) -> None:
+        """Quiesce for a live shard move: stop accepting writes/votes
+        (_frozen guards), park the election sweeper, drain in-flight
+        replication, and flush so the on-disk log is the full state."""
+        self._frozen = True
+        loop = asyncio.get_event_loop()
+        # park the sweeper — a frozen group must not campaign while its
+        # hard state is being shipped
+        self.arrays.el_timeout[self.row] = 1e9
+        self._last_heartbeat = loop.time()
+        self.arrays.touch()
+        deadline = loop.time() + drain_timeout_s
+        while self._batcher._pending_bytes > 0 or self._quorum_waiters:
+            if loop.time() >= deadline:
+                self._fail_quorum_waiters(
+                    lambda: NotLeaderError(self.leader_id)
+                )
+                break
+            await asyncio.sleep(0.005)
+        if self._tick_frame is not None:
+            self._tick_frame.flush()
+        await self.log.flush_async()
+
+    def thaw(self) -> None:
+        """Undo freeze() after a move rollback: resume service on the
+        source copy as if the move never started."""
+        self._frozen = False
+        self.arrays.el_timeout[self.row] = self._election_timeout
+        self._last_heartbeat = asyncio.get_event_loop().time()
+        self.arrays.touch()
+
     # ------------------------------------------------------ properties
     # hot per-group scalars live as lanes in the shard SoA so the
     # node-batched heartbeat service can read/write them for every
@@ -807,6 +869,15 @@ class Consensus:
     # ---------------------------------------------------------- voting
     async def handle_vote(self, req: rt.VoteRequest) -> rt.VoteReply:
         async with self._vote_lock:
+            if self._frozen:
+                # mid-move: granting could double-vote once the moved
+                # copy restarts from the shipped hard state
+                return rt.VoteReply(
+                    group=self.group_id,
+                    term=self.term,
+                    granted=False,
+                    log_ok=False,
+                )
             if req.term < self.term:
                 return rt.VoteReply(
                     group=self.group_id, term=self.term, granted=False, log_ok=False
@@ -866,6 +937,8 @@ class Consensus:
         `payload` is the serialized AppendEntriesRequest envelope;
         returns encoded reply bytes, or None ⇒ caller decodes and
         dispatches through handle_append_entries as usual."""
+        if self._frozen:
+            return None  # decode route answers with the frozen reply
         async with self._append_lock:
             return self.native_append_frame(payload)
 
@@ -884,6 +957,13 @@ class Consensus:
         self, req: rt.AppendEntriesRequest
     ) -> rt.AppendEntriesReply:
         row = self.row
+        if self._frozen:
+            # mid-move: the log must stay byte-stable while it ships;
+            # GROUP_UNAVAILABLE makes the leader back off and retry
+            # (against the new shard once the route rebinds)
+            return self._reply(
+                rt.AppendEntriesReply.GROUP_UNAVAILABLE, int(req.seq)
+            )
         # 1. term checks (consensus.cc:1752-1774)
         if req.term < self.term:
             return self._reply(rt.AppendEntriesReply.FAILURE, int(req.seq))
@@ -1134,7 +1214,9 @@ class Consensus:
         future resolves with (base, last) in log order and `done`
         resolves at the requested ack level. Concurrent calls coalesce
         into one append+fsync+dispatch round (replicate_batcher)."""
-        if self.role != Role.LEADER:
+        if self.role != Role.LEADER or self._frozen:
+            # frozen ⇒ retriable exactly like a moving leader: the
+            # client re-routes once the placement table rebinds
             raise NotLeaderError(self.leader_id)
         batch = (
             builder_or_batch.build()
@@ -1902,7 +1984,7 @@ from ..utils import rpsan as _rpsan  # noqa: E402
 _rpsan.instrument(
     Consensus,
     ("_role", "_voted_for", "_snap_index", "_snap_term", "_accum_size",
-     "_closed"),
+     "_closed", "_frozen"),
     # _step_down's resets never derive from an earlier read: they are
     # guarded by `term > self.term`, checked loop-atomically (sync)
     # with the write, so clobbering a vote from a STRICTLY older term

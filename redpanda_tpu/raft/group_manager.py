@@ -269,18 +269,9 @@ class GroupManager:
     ) -> Consensus:
         if group_id in self._groups:
             raise ValueError(f"group {group_id} exists")
-        if self.shard_id > 0:
-            # worker shards own exactly their deterministic slice of
-            # the group-id space; shard 0 may host anything (internal
-            # topics, replicated groups — see Controller._shard_for_new)
-            from ..ssx import shard_of
-
-            owner = shard_of(group_id, self.shard_count)
-            if owner != self.shard_id:
-                raise ValueError(
-                    f"group {group_id} belongs to shard {owner}, "
-                    f"not shard {self.shard_id}"
-                )
+        # no shard-ownership assertion here: which shard hosts a group
+        # is the PlacementTable's call (placement/table.py), and live
+        # moves deliberately land groups away from their hash-home
         if log is None:
             log_dir = os.path.join(self.data_dir, f"group_{group_id}")
             log = Log(log_dir, config=log_config)
@@ -306,6 +297,29 @@ class GroupManager:
             self._min_el_timeout, float(c._election_timeout)
         )
         self.heartbeat_manager.register(c)
+        return c
+
+    async def freeze_group(self, group_id: int) -> Consensus:
+        """Quiesce a group for a live shard move: stop heartbeating it
+        (the peer's SAME covers stay valid — the group just goes silent)
+        and freeze the consensus instance. Returns it so the move host
+        can read the manifest fields."""
+        c = self._groups.get(group_id)
+        if c is None:
+            raise LookupError(f"group {group_id} not hosted here")
+        self.heartbeat_manager.deregister(group_id)
+        self.service.invalidate_heartbeat_plans()
+        await c.freeze()
+        return c
+
+    def thaw_group(self, group_id: int) -> Consensus:
+        """Roll back freeze_group after a failed move."""
+        c = self._groups.get(group_id)
+        if c is None:
+            raise LookupError(f"group {group_id} not hosted here")
+        c.thaw()
+        self.heartbeat_manager.register(c)
+        self.service.invalidate_heartbeat_plans()
         return c
 
     async def remove_group(self, group_id: int) -> None:

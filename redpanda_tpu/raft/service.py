@@ -53,9 +53,56 @@ class RaftService(Service):
         self._same_rows: dict[int, "object"] = {}
         # per-sender dense-row slice (None = sparse; see _resolve_batch)
         self._hb_row_slice: dict[int, "object"] = {}
+        # placement shard seam (ssx/sharded_broker.py): groups the
+        # placement table hosts on a worker shard of THIS node get
+        # their frames forwarded there. All three hooks are set
+        # together by ShardedBroker.start(); unset = single-process
+        # broker, every group local.
+        #   shard_resolver(group_id) -> owning shard (0/None = local)
+        #   shard_forward(shard, method_id, payload) -> reply bytes
+        #   shard_epoch() -> placement table epoch (split-plan cache)
+        self.shard_resolver = None
+        self.shard_forward = None
+        self.shard_epoch = None
+        # per-sender heartbeat split plan: (registry_epoch, placement
+        # epoch, request-groups key, per-position shards or None when
+        # every group is local)
+        self._fwd_hb: dict[int, tuple] = {}
+        # senders whose last full frame was split across shards: their
+        # SAME frames bind to the FULL frame's crc, which no single
+        # shard saw — always demand a full exchange
+        self._split_senders: set[int] = set()
 
     def _consensus(self, group_id: int):
         return self._gm.get(group_id)
+
+    # -- placement shard seam -----------------------------------------
+    def _worker_shard_of(self, group_id: int) -> int:
+        """Owning worker shard for a group NOT hosted locally, or 0."""
+        if self.shard_resolver is None:
+            return 0
+        s = self.shard_resolver(int(group_id))
+        return int(s) if s else 0
+
+    async def _maybe_forward(
+        self, group_id: int, method_id: int, payload: bytes
+    ) -> bytes | None:
+        """Forward a single-group frame to the owning worker shard.
+        None = not forwardable (truly unknown group or forward failed);
+        the caller answers with its usual unavailable reply."""
+        if self.shard_forward is None:
+            return None
+        shard = self._worker_shard_of(group_id)
+        if shard <= 0:
+            return None
+        try:
+            return await self.shard_forward(shard, method_id, payload)
+        except Exception:
+            logger.exception(
+                "raft forward of method %d (group %d) to shard %d failed",
+                method_id, group_id, shard,
+            )
+            return None
 
     def invalidate_heartbeat_plans(self) -> None:
         """Called on group removal so stale plans don't pin stopped
@@ -130,6 +177,9 @@ class RaftService(Service):
         req = rt.VoteRequest.decode(payload)
         c = self._consensus(int(req.group))
         if c is None:
+            out = await self._maybe_forward(int(req.group), rt.VOTE, payload)
+            if out is not None:
+                return out
             return rt.VoteReply(
                 group=int(req.group), term=-1, granted=False, log_ok=False
             ).encode()
@@ -159,6 +209,11 @@ class RaftService(Service):
         req = rt.AppendEntriesRequest.decode(payload)
         c = self._consensus(int(req.group))
         if c is None:
+            out = await self._maybe_forward(
+                int(req.group), rt.APPEND_ENTRIES, payload
+            )
+            if out is not None:
+                return out
             return rt.AppendEntriesReply(
                 group=int(req.group),
                 node_id=self._gm.node_id,
@@ -185,6 +240,14 @@ class RaftService(Service):
         from ..models.consensus_state import SELF_SLOT
 
         import struct as _struct
+
+        # placement split: frames naming worker-owned groups fan out
+        # per shard and re-merge; all-local senders fall through to the
+        # vectorized fast path below (verdict cached per sender)
+        if self.shard_forward is not None:
+            out = await self._heartbeat_split(payload)
+            if out is not None:
+                return out
 
         gm = self._gm
         arrays = gm.arrays
@@ -425,6 +488,110 @@ class RaftService(Service):
             self._reply_cache.pop(sender, None)
         return out
 
+    async def _heartbeat_split(self, payload: bytes) -> bytes | None:
+        """Split a node heartbeat batch across the shards that own its
+        groups. None = every group is local (the caller's vectorized
+        path handles the frame). The split plan is cached per
+        (sender, n) — keyed on registry/placement epochs and a crc of
+        the group-id vector — so steady-state split frames skip the
+        per-group resolution. The local subset recurses into
+        heartbeat() as its own (smaller) frame, so the reply/SAME
+        caches keep working on the local half."""
+        import asyncio
+        import struct as _struct
+        import zlib
+
+        import numpy as np
+
+        gm = self._gm
+        # layout (types.py): 6B envelope header, node_id i32 @6,
+        # target i32 @10, groups vector count u32 @14, gids @18
+        sender = _struct.unpack_from("<i", payload, 6)[0]
+        n = _struct.unpack_from("<I", payload, 14)[0]
+        groups_raw = bytes(payload[18 : 18 + 8 * n])
+        key = (
+            gm.registry_epoch,
+            self.shard_epoch() if self.shard_epoch is not None else 0,
+            zlib.crc32(groups_raw),
+        )
+        ent = self._fwd_hb.get((sender, n))
+        if ent is not None and ent[0] == key:
+            shards = ent[1]
+        else:
+            gids = np.frombuffer(groups_raw, "<q")
+            shards = np.zeros(n, np.int64)
+            for i, g in enumerate(gids.tolist()):
+                if gm.get(g) is None:
+                    s = self._worker_shard_of(g)
+                    if s > 0:
+                        shards[i] = s
+            if not shards.any():
+                shards = None
+            self._fwd_hb[(sender, n)] = (key, shards)
+            if shards is None:
+                self._split_senders.discard(sender)
+            else:
+                self._split_senders.add(sender)
+        if shards is None:
+            return None
+        req = rt.HeartbeatRequest.decode(payload)
+        gids = np.asarray(req.groups, np.int64)
+        t_req = np.asarray(req.terms, np.int64)
+        prevs = np.asarray(req.prev_log_indices, np.int64)
+        pterms = np.asarray(req.prev_log_terms, np.int64)
+        commits = np.asarray(req.commit_indices, np.int64)
+        seqs = np.asarray(req.seqs, np.int64)
+        terms_out = np.full(n, -1, np.int64)
+        dirty_out = np.full(n, -1, np.int64)
+        flushed_out = np.full(n, -1, np.int64)
+        statuses = np.full(
+            n, rt.AppendEntriesReply.GROUP_UNAVAILABLE, np.int64
+        )
+
+        async def do(shard: int, idx) -> None:
+            sub = rt.HeartbeatRequest(
+                node_id=req.node_id,
+                target_node_id=req.target_node_id,
+                groups=gids[idx],
+                terms=t_req[idx],
+                prev_log_indices=prevs[idx],
+                prev_log_terms=pterms[idx],
+                commit_indices=commits[idx],
+                seqs=seqs[idx],
+            ).encode()
+            try:
+                if shard == 0:
+                    raw = await self.heartbeat(sub)
+                else:
+                    raw = await self.shard_forward(shard, rt.HEARTBEAT, sub)
+            except Exception:
+                logger.exception(
+                    "heartbeat forward to shard %d failed", shard
+                )
+                return  # those positions stay GROUP_UNAVAILABLE
+            rep = rt.HeartbeatReply.decode(raw)
+            terms_out[idx] = np.asarray(rep.terms, np.int64)
+            dirty_out[idx] = np.asarray(rep.last_dirty, np.int64)
+            flushed_out[idx] = np.asarray(rep.last_flushed, np.int64)
+            statuses[idx] = np.asarray(rep.statuses, np.int64)
+
+        tasks = []
+        local_idx = np.flatnonzero(shards == 0)
+        if len(local_idx):
+            tasks.append(do(0, local_idx))
+        for s in np.unique(shards[shards > 0]).tolist():
+            tasks.append(do(int(s), np.flatnonzero(shards == s)))
+        await asyncio.gather(*tasks)
+        return rt.HeartbeatReply(
+            node_id=gm.node_id,
+            groups=gids,
+            terms=terms_out,
+            last_dirty=dirty_out,
+            last_flushed=flushed_out,
+            seqs=seqs,
+            statuses=statuses,
+        ).encode()
+
     @method(rt.HEARTBEAT_SAME)
     async def heartbeat_same(self, payload: bytes) -> bytes:
         """Quiesced steady-state heartbeat: O(1) validation instead of
@@ -437,6 +604,11 @@ class RaftService(Service):
         import asyncio
 
         node_id, n, counter, crc = rt.decode_same_req(payload)
+        if node_id in self._split_senders:
+            # this sender's full frames are split across shards: the
+            # SAME crc binds to the full frame, which no single shard
+            # validated — demand the full exchange every time
+            return rt.encode_same_reply(rt.SAME_NEED_FULL, counter)
         ent = self._same_armed.get(node_id)
         arrays = self._gm.arrays
         if (
@@ -471,18 +643,90 @@ class RaftService(Service):
         on the replicated bench (groups in one frame are independent,
         so the yield is safe; the multiplexed reply waits for all of
         them either way)."""
+        items = rt.decode_multi(payload)
+        # placement split: fan sub-batches out to the worker shards
+        # that own their groups, re-multiplex replies in order
+        if self.shard_forward is not None:
+            by_shard: dict[int, list[int]] = {}
+            for i, item in enumerate(items):
+                gid = struct.unpack_from("<q", item, 6)[0]
+                if self._gm.get(int(gid)) is None:
+                    shard = self._worker_shard_of(int(gid))
+                    if shard > 0:
+                        by_shard.setdefault(shard, []).append(i)
+            if by_shard:
+                return await self._append_batch_split(items, by_shard)
         replies: list[bytes] = []
-        for n, item in enumerate(rt.decode_multi(payload)):
+        for n, item in enumerate(items):
             if n and (n & 7) == 0:
                 await asyncio.sleep(0)
             replies.append(await self.append_entries(item))
         return rt.encode_multi(replies)
+
+    async def _append_batch_split(
+        self, items: list[bytes], by_shard: dict[int, list[int]]
+    ) -> bytes:
+        replies: list[bytes | None] = [None] * len(items)
+        forwarded = {i for idxs in by_shard.values() for i in idxs}
+
+        async def fwd(shard: int, idxs: list[int]) -> None:
+            sub = rt.encode_multi([items[i] for i in idxs])
+            try:
+                out = rt.decode_multi(
+                    await self.shard_forward(
+                        shard, rt.APPEND_ENTRIES_BATCH, sub
+                    )
+                )
+                if len(out) != len(idxs):
+                    raise ValueError("sub-batch reply count mismatch")
+            except Exception:
+                logger.exception(
+                    "append batch forward to shard %d failed", shard
+                )
+                # fallback below answers GROUP_UNAVAILABLE per item
+                out = [None] * len(idxs)
+            for i, rep in zip(idxs, out):
+                replies[i] = rep
+
+        async def local() -> None:
+            n = 0
+            for i, item in enumerate(items):
+                if i in forwarded:
+                    continue
+                if n and (n & 7) == 0:
+                    await asyncio.sleep(0)
+                n += 1
+                replies[i] = await self.append_entries(item)
+
+        await asyncio.gather(
+            local(), *(fwd(s, idxs) for s, idxs in by_shard.items())
+        )
+        out: list[bytes] = []
+        for i, rep in enumerate(replies):
+            if rep is None:
+                req = rt.AppendEntriesRequest.decode(items[i])
+                rep = rt.AppendEntriesReply(
+                    group=int(req.group),
+                    node_id=self._gm.node_id,
+                    term=-1,
+                    last_dirty_log_index=-1,
+                    last_flushed_log_index=-1,
+                    seq=int(req.seq),
+                    status=rt.AppendEntriesReply.GROUP_UNAVAILABLE,
+                ).encode()
+            out.append(rep)
+        return rt.encode_multi(out)
 
     @method(rt.INSTALL_SNAPSHOT)
     async def install_snapshot(self, payload: bytes) -> bytes:
         req = rt.InstallSnapshotRequest.decode(payload)
         c = self._consensus(int(req.group))
         if c is None:
+            out = await self._maybe_forward(
+                int(req.group), rt.INSTALL_SNAPSHOT, payload
+            )
+            if out is not None:
+                return out
             return rt.InstallSnapshotReply(
                 group=int(req.group), term=-1, bytes_stored=0, success=False
             ).encode()
@@ -494,6 +738,12 @@ class RaftService(Service):
         the group; it drives the timeout_now handshake to the target."""
         req = rt.TransferLeadershipRequest.decode(payload)
         c = self._consensus(int(req.group))
+        if c is None:
+            out = await self._maybe_forward(
+                int(req.group), rt.TRANSFER_LEADERSHIP, payload
+            )
+            if out is not None:
+                return out
         if c is None or not c.is_leader():
             return rt.TransferLeadershipReply(
                 group=int(req.group), success=False, error="not leader here"
@@ -521,5 +771,10 @@ class RaftService(Service):
         req = rt.TimeoutNowRequest.decode(payload)
         c = self._consensus(int(req.group))
         if c is None:
+            out = await self._maybe_forward(
+                int(req.group), rt.TIMEOUT_NOW, payload
+            )
+            if out is not None:
+                return out
             return rt.TimeoutNowReply(group=int(req.group), term=-1).encode()
         return (await c.handle_timeout_now(req)).encode()

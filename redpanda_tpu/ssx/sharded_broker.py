@@ -22,13 +22,22 @@ Division of labor:
   envelopes (`kafka.raw`) — `smp_service_group` style cross-core
   request passing.
 
-v1 scope (documented, asserted): single-node sharded brokers — shard
-placement is local, so replicas for shard-owned groups are `[node_id]`
-and cross-broker replication of those groups stays on shard 0.
-Transactions and consumer groups live on shard 0 (their coordinator
-partitions map there by `shard_of`'s group-0 pinning plus the internal
-topic's low group ids only when they land on shard 0; sharded data
-partitions serve plain produce/fetch).
+Placement (PR 12): which shard hosts a group is decided by the
+placement layer (`placement/table.py`), not a hash baked in here. The
+controller asks `PlacementTable.assign` for new partitions — any
+default-namespace data partition spreads, replicated or not (the v1
+shard-0 pin for replicated groups is retired; `RP_PLACEMENT_PIN=1`
+restores it for A/B baselines) — and the live map can change at
+runtime: `placement/mover.py` moves partitions between shards through
+the `move_*` methods of the `partition` service below. Inbound raft
+RPC for worker-owned groups forwards through the RaftService shard
+seam (raft/service.py `shard_forward`) to each worker's `raft`
+service; worker-shard leadership flows back to shard 0 as
+`LeaderHintBatch` on the parent's `placement` service, feeding
+metadata dissemination. Transactions and consumer groups still live
+on shard 0: their coordinator topics are internal (`__`-prefixed),
+which `PlacementTable.assign` keeps on the full broker where the
+coordinator machinery runs.
 """
 
 from __future__ import annotations
@@ -61,7 +70,6 @@ from .shards import (
     ShardRuntime,
     bind_reuse_port,
     reserve_reuse_port,
-    shard_of,
     standdown_reason,
 )
 
@@ -324,6 +332,22 @@ class PartitionShard:
         self.partition_manager = PartitionManager(
             self.storage.log_mgr, self.group_manager
         )
+        from ..placement.host import MoveHost
+
+        # this shard's side of the live-move protocol (source AND
+        # target), reached via the `move_*` methods of the partition
+        # service below
+        self.move_host = MoveHost(
+            self.partition_manager, self.group_manager, self.storage.log_mgr
+        )
+        # inbound raft frames forwarded from shard 0's RPC server for
+        # groups this shard owns (RaftService shard seam)
+        self._raft_methods = {
+            mid: fn
+            for mid, (_name, fn) in
+            self.group_manager.service.rpc_methods().items()
+        }
+        self._hint_task: Optional[asyncio.Task] = None
         self.frontend: Optional[ShardKafkaFrontend] = None
         self.produce_reqs = 0
         self.produce_bytes = 0
@@ -414,6 +438,11 @@ class PartitionShard:
         await self.group_manager.start()
         self.ctx.register("partition", self.partition_service)
         self.ctx.register("obs", self.obs_service)
+        self.ctx.register("raft", self.raft_service)
+        # leadership relay: worker-shard raft leadership must reach
+        # shard 0's metadata plane (leaders table + cross-broker
+        # dissemination) — poll the local groups and push deltas
+        self._hint_task = asyncio.ensure_future(self._leader_hint_loop())
         from ..observability import flightdata as _flightdata
         from ..observability import profiler as _profiler
 
@@ -427,6 +456,13 @@ class PartitionShard:
         await self.frontend.start()
 
     async def stop(self) -> None:
+        hint_task, self._hint_task = self._hint_task, None
+        if hint_task is not None:
+            hint_task.cancel()
+            try:
+                await hint_task
+            except asyncio.CancelledError:
+                pass
         if self.frontend is not None:
             await self.frontend.stop()
         from ..observability import profiler as _profiler
@@ -453,7 +489,67 @@ class PartitionShard:
             )
         if method == "stats":
             return self._stats()
+        if method.startswith("move_"):
+            # live-move protocol endpoint (placement/host.py)
+            return await self.move_host.handle(method, payload)
         raise LookupError(f"partition: no such method {method!r}")
+
+    async def raft_service(self, method: str, payload: bytes) -> bytes:
+        """Inbound raft RPC for groups this shard owns, forwarded raw
+        from shard 0's RaftService (the placement shard seam)."""
+        if method != "call":
+            raise LookupError(f"raft: no such method {method!r}")
+        from ..placement.envelopes import RaftForward
+
+        req = RaftForward.decode(payload)
+        fn = self._raft_methods.get(int(req.method))
+        if fn is None:
+            raise LookupError(f"raft: no method id {req.method}")
+        return await fn(bytes(req.payload))
+
+    async def _leader_hint_loop(self) -> None:
+        from ..placement.envelopes import LeaderHint, LeaderHintBatch
+
+        last: dict[int, tuple] = {}
+        while True:
+            await asyncio.sleep(0.2)
+            hints = []
+            for ntp, p in self.partition_manager.partitions().items():
+                c = p.consensus
+                leader = c.leader_id
+                state = (c.term, leader if leader is not None else -1, c.row)
+                if last.get(p.group_id) == state:
+                    continue
+                last[p.group_id] = state
+                hints.append(
+                    LeaderHint(
+                        ns=ntp.ns,
+                        topic=ntp.topic,
+                        partition=ntp.partition,
+                        group=p.group_id,
+                        term=state[0],
+                        leader=state[1],
+                        row=state[2],
+                    )
+                )
+            if not hints:
+                continue
+            try:
+                await self.ctx.invoke_on(
+                    0,
+                    "placement",
+                    "leader_update",
+                    LeaderHintBatch(
+                        shard=self.ctx.shard_id,
+                        hints=[h.encode() for h in hints],
+                    ).encode(),
+                    timeout=5.0,
+                )
+            except (InvokeError, ConnectionError, OSError, RuntimeError):
+                # parent busy or tearing down: forget what we claimed
+                # to have sent so the delta goes out next tick
+                for h in hints:
+                    last.pop(h.group, None)
 
     async def obs_service(self, method: str, payload: bytes) -> bytes:
         """Fleet observability plane: this shard's registry snapshot and
@@ -706,8 +802,25 @@ class ShardRouter:
         self._rt = runtime
         self.n_shards = n_shards
 
-    def shard_of(self, group_id: int) -> int:
-        return shard_of(group_id, self.n_shards)
+    async def move_invoke(self, shard: int, method: str, payload: bytes) -> bytes:
+        """One live-move protocol frame to a worker shard's MoveHost
+        (PartitionMover's transport)."""
+        return await self._rt.invoke_on(
+            shard, "partition", method, payload, timeout=30.0
+        )
+
+    async def raft_invoke(self, shard: int, method_id: int, payload: bytes) -> bytes:
+        """One raw raft frame to the worker shard that owns its group
+        (RaftService shard seam)."""
+        from ..placement.envelopes import RaftForward
+
+        return await self._rt.invoke_on(
+            shard,
+            "raft",
+            "call",
+            RaftForward(method=method_id, payload=payload).encode(),
+            timeout=10.0,
+        )
 
     async def create_partition(
         self, shard: int, ntp, group: int, replicas, log_cfg
@@ -891,6 +1004,11 @@ class ShardedBroker:
         self.failed = asyncio.Event()
         self._reserve_sock = None
         self._fwd_ctx: dict[int, object] = {}
+        # placement layer (live moves + alert-driven rebalance); wired
+        # in start() once the broker and runtime exist
+        self.move_host = None
+        self.mover = None
+        self.rebalancer = None
 
     async def start(self) -> None:
         from ..app import Broker
@@ -918,6 +1036,7 @@ class ShardedBroker:
         self.runtime = ShardRuntime(self.n_shards, self._shard_child_main)
         self.runtime.register("rpc.out", self._rpc_out_service)
         self.runtime.register("kafka", self._kafka_service)
+        self.runtime.register("placement", self._placement_service)
         self.runtime.on_crash = self._on_shard_crash
         await self.runtime.start()
         # the Broker is constructed AFTER the fork: children must not
@@ -927,10 +1046,44 @@ class ShardedBroker:
         self.broker.shard_router = self.router
         self.broker.shard_table.shard_count = self.n_shards
         self.broker.controller.shard_router = self.router
+        # placement layer: the broker's shard_table IS the
+        # PlacementTable (cluster/shard_table.py) — wire the live-move
+        # coordinator, the alert-driven rebalancer, and the raft shard
+        # seam so worker-owned groups are fully replicable
+        from ..placement import MoveHost, PartitionMover, Rebalancer
+
+        table = self.broker.shard_table
+        self.move_host = MoveHost(
+            self.broker.partition_manager,
+            self.broker.group_manager,
+            self.broker.storage.log_mgr,
+        )
+        self.mover = PartitionMover(table, self.move_host, router=self.router)
+        self.rebalancer = Rebalancer(self.broker, self.mover, table)
+        self.broker.placement_mover = self.mover
+        self.broker.placement_rebalancer = self.rebalancer
+        svc = self.broker.group_manager.service
+        svc.shard_resolver = table.shard_for_group
+        svc.shard_forward = self.router.raft_invoke
+        svc.shard_epoch = lambda: table.epoch
         # invoke_on continuations served on shard 0 record into the
         # broker's flight recorder, same ring the admin surface reads
         self.runtime.ctx.recorder = self.broker.recorder
         await self.broker.start()
+        # the closed loop: skew is a first-class gauge (feeds the
+        # flight-data ring), the shard_skew rule judges it, and the
+        # firing transition hands the alert to the rebalancer
+        from ..observability import alerts as _alerts
+
+        self.broker.metrics.gauge(
+            "placement_shard_skew",
+            self.rebalancer.skew,
+            "cross-shard byte-rate skew index (1.0 = balanced)",
+        )
+        if self.broker.alerts is not None:
+            self.broker.alerts.rules.append(_alerts.shard_skew_rule())
+            self.broker.alerts.on_fire.append(self.rebalancer.on_alert)
+        self.rebalancer.start()
         self._reserve_sock.close()
         self._reserve_sock = None
         self.active = True
@@ -942,6 +1095,9 @@ class ShardedBroker:
         )
 
     async def stop(self) -> None:
+        rebalancer, self.rebalancer = self.rebalancer, None
+        if rebalancer is not None:
+            await rebalancer.stop()
         broker, self.broker = self.broker, None
         if broker is not None:
             await broker.stop()
@@ -979,6 +1135,28 @@ class ShardedBroker:
         return await self.broker.send_rpc(
             req.node, req.method, bytes(req.payload), req.timeout
         )
+
+    async def _placement_service(self, method: str, payload: bytes) -> bytes:
+        """Parent-side placement endpoints: worker shards push their
+        raft leadership deltas here so shard 0's metadata plane (the
+        leaders table AND cross-broker dissemination gossip) covers
+        worker-owned groups, and the lane map tracks their rows."""
+        if method != "leader_update":
+            raise LookupError(f"placement: no such method {method!r}")
+        if self.broker is None:
+            raise RuntimeError("broker not started")
+        from ..placement.envelopes import LeaderHint, LeaderHintBatch
+
+        batch = LeaderHintBatch.decode(payload)
+        table = self.broker.shard_table
+        md = self.broker.metadata_dissemination
+        for raw in batch.hints:
+            h = LeaderHint.decode(bytes(raw))
+            ntp = _ntp_of(h.ns, h.topic, h.partition)
+            table.bind_lane(h.group, h.row)
+            if h.leader >= 0:
+                md.apply_hint(ntp, int(h.term), int(h.leader))
+        return b""
 
     async def _kafka_service(self, method: str, payload: bytes) -> bytes:
         from ..kafka.server import (

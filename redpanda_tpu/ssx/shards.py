@@ -91,15 +91,11 @@ class ShardReady(Envelope):
 
 
 # ------------------------------------------------------------------ util
-def shard_of(group_id: int, n_shards: int) -> int:
-    """Deterministic raft-group → shard assignment. Group 0 (the
-    controller) and the internal coordinator groups (negative ids in
-    some fixtures) are pinned to shard 0, which runs the full broker;
-    data groups spread round-robin so each shard owns a stable slice
-    (shard_placement_table analog, without rebalancing)."""
-    if n_shards <= 1 or group_id <= 0:
-        return 0
-    return group_id % n_shards
+# Placement moved to its own layer (PR 12): the deterministic
+# group → shard hash lives in placement/table.py and actual routing
+# goes through the PlacementTable, which live moves can rebind.
+# Re-exported here only for legacy callers/tests of the v1 name.
+from ..placement.table import compute_shard as shard_of  # noqa: E402, F401
 
 
 def pin_to_core(shard_id: int) -> Optional[int]:

@@ -1604,6 +1604,94 @@ def test_rpl016_baseline_is_empty():
     assert [k for k in baseline if k.endswith("::RPL016")] == []
 
 
+# -- RPL017: placement discipline --------------------------------------
+
+
+RPL017_BAD = """\
+class Router:
+    def route(self, group_id):
+        return shard_of(group_id, self.n_shards)
+
+    def lane(self, group_id):
+        return group_id % self.shard_count
+
+
+def compute_shard(group_id, n):
+    return group_id % n
+"""
+
+
+def test_rpl017_call_modulo_and_def_flagged(tmp_path):
+    found = _only(_lint_source(tmp_path, RPL017_BAD), "RPL017")
+    assert len(found) == 3  # direct call, inline %, shadow def
+    lines = sorted(f.line for f in found)
+    assert lines == [3, 6, 9]
+    by_line = {f.line: f.message for f in found}
+    assert "shard_of()" in by_line[3]
+    assert "% shard_count" in by_line[6]
+    assert "def compute_shard()" in by_line[9]
+
+
+def test_rpl017_attribute_call_flagged(tmp_path):
+    src = """\
+    async def pick(runtime, gid):
+        return runtime.shard_of(gid)
+    """
+    found = _only(_lint_source(tmp_path, src), "RPL017")
+    assert len(found) == 1
+    assert found[0].line == 2
+
+
+def test_rpl017_placement_package_exempt(tmp_path):
+    assert (
+        _only(
+            _lint_source(
+                tmp_path,
+                RPL017_BAD,
+                relpath="redpanda_tpu/placement/table.py",
+            ),
+            "RPL017",
+        )
+        == []
+    )
+
+
+def test_rpl017_table_lookups_and_plain_modulo_clean(tmp_path):
+    src = """\
+    def route(table, gid, items):
+        s = table.shard_for_group(gid)
+        lane = table.lane_for(gid)
+        bucket = gid % 7
+        wrap = gid % len(items)
+        return s, lane, bucket, wrap
+    """
+    assert _only(_lint_source(tmp_path, src), "RPL017") == []
+
+
+def test_rpl017_import_only_clean(tmp_path):
+    # the ssx compat re-export: importing routes nothing
+    src = """\
+    from ..placement.table import compute_shard as shard_of  # noqa
+    """
+    assert _only(_lint_source(tmp_path, src), "RPL017") == []
+
+
+def test_rpl017_suppression(tmp_path):
+    src = RPL017_BAD.replace(
+        "        return shard_of(group_id, self.n_shards)",
+        "        return shard_of(group_id, self.n_shards)"
+        "  # rplint: disable=RPL017",
+    )
+    found = _only(_lint_source(tmp_path, src), "RPL017")
+    assert sorted(f.line for f in found) == [6, 9]
+
+
+def test_rpl017_baseline_is_empty():
+    """Placement discipline is fully enforced: nothing grandfathered."""
+    baseline = load_baseline()
+    assert [k for k in baseline if k.endswith("::RPL017")] == []
+
+
 # -- whole-program engine: cache, jobs, CLI surfaces -------------------
 
 

@@ -42,9 +42,12 @@ import sys
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # units where bigger is better; anything matching _LAT_RE is
-# smaller-is-better; other units are reported but not graded
+# smaller-is-better ("skew" is the placement layer's cross-shard load
+# skew index, 1.0 = balanced — a rebalance that leaves the fleet MORE
+# skewed than the trajectory is a regression the same way a latency
+# bump is); other units are reported but not graded
 _THROUGHPUT_RE = re.compile(r"/s$|bps$", re.IGNORECASE)
-_LAT_RE = re.compile(r"^(ns|us|ms|s)$", re.IGNORECASE)
+_LAT_RE = re.compile(r"^(ns|us|ms|s|skew)$", re.IGNORECASE)
 
 
 def _direction(unit: str) -> int:

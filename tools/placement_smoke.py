@@ -1,0 +1,184 @@
+"""verify.sh placement smoke: boot a 2-shard ShardedBroker, force one
+LIVE partition move through the admin endpoint while a producer is
+pumping records into that exact partition, then prove the three things
+a live move must never break:
+
+  1. zero committed-record loss and zero duplication — every acked
+     record is fetchable exactly once after the move;
+  2. the placement table rebound (admin /v1/placement shows the new
+     shard and the move accounted);
+  3. the merged fleet /metrics stays exact — one skew gauge, scrape
+     still serves after the partition changed shards.
+
+Exit 0 = live moves work end-to-end in this environment. The full
+protocol matrix (per-stage fault rollback, budget, rebalancer) lives
+in tests/test_placement.py; this is the "does a real move under real
+produce traffic hold the invariants" gate.
+"""
+
+import asyncio
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_PARTITIONS = 4
+TOPIC = "mvsmoke"
+
+
+def _get(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return json.loads(r.read().decode())
+
+
+def _post(port: int, path: str) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method="POST", data=b""
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read().decode())
+
+
+def _metrics(port: int) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ) as r:
+        return r.read().decode()
+
+
+async def main() -> None:
+    from redpanda_tpu.app import BrokerConfig
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.ssx.sharded_broker import ShardedBroker
+
+    tmp = tempfile.mkdtemp(prefix="placement_smoke_")
+    cfg = BrokerConfig(
+        node_id=0,
+        data_dir=tmp,
+        members=[0],
+        election_timeout_s=0.3,
+        heartbeat_interval_s=0.05,
+    )
+    sb = ShardedBroker(cfg, n_shards=2)
+    await sb.start()
+    try:
+        assert sb.active, f"unexpected stand-down: {sb.standdown}"
+        admin = sb.broker.admin.port
+        c = KafkaClient([("127.0.0.1", sb.kafka_port)])
+        try:
+            deadline = time.monotonic() + 30
+            while True:
+                try:
+                    await c.create_topic(
+                        TOPIC, partitions=N_PARTITIONS, replication_factor=1
+                    )
+                    break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
+            for p in range(N_PARTITIONS):
+                while True:
+                    try:
+                        await c.produce(TOPIC, p, [(b"seed", b"v%d" % p)])
+                        break
+                    except Exception:
+                        if time.monotonic() > deadline:
+                            raise
+                        await asyncio.sleep(0.2)
+
+            # pick the mover from the live table
+            plc = await asyncio.to_thread(_get, admin, "/v1/placement")
+            entry = next(
+                e for e in plc["entries"]
+                if e["ntp"].startswith(f"kafka/{TOPIC}/")
+            )
+            ns, topic, pid = entry["ntp"].split("/")
+            pid = int(pid)
+            src, dst = entry["shard"], 1 - entry["shard"]
+
+            # produce INTO the moving partition while the move runs;
+            # keys are unique per attempt, `acked` records what the
+            # broker acknowledged — the exactly-once ledger
+            acked: list[bytes] = []
+            stop = asyncio.Event()
+
+            async def pump() -> None:
+                i = 0
+                while not stop.is_set():
+                    key = b"k%06d" % i
+                    i += 1
+                    try:
+                        await c.produce(TOPIC, pid, [(key, b"v")])
+                        acked.append(key)
+                    except Exception:
+                        # freeze window / leadership handoff: retry
+                        # with a FRESH key so an ambiguous outcome can
+                        # never double-count
+                        await asyncio.sleep(0.05)
+                    await asyncio.sleep(0)
+
+            pump_task = asyncio.ensure_future(pump())
+            await asyncio.sleep(0.3)
+            moved = await asyncio.to_thread(
+                _post, admin,
+                f"/v1/placement/move/{ns}/{topic}/{pid}?shard={dst}",
+            )
+            assert moved.get("moved"), moved
+            assert moved["from"] == src and moved["to"] == dst, moved
+            await asyncio.sleep(0.3)
+            stop.set()
+            await pump_task
+            assert acked, "producer never landed a record"
+
+            # 1. fetch parity: every acked record exactly once, in order
+            got: list[bytes] = []
+            off = 0
+            while True:
+                rows = await c.fetch(TOPIC, pid, off)
+                if not rows:
+                    break
+                got.extend(k for _o, k, _v in rows)
+                off = rows[-1][0] + 1
+            body = [k for k in got if k != b"seed"]
+            assert len(body) == len(set(body)), "duplicated records"
+            missing = set(acked) - set(body)
+            assert not missing, f"lost {len(missing)} acked records"
+
+            # 2. the table rebound and the move is accounted
+            plc = await asyncio.to_thread(_get, admin, "/v1/placement")
+            entry = next(
+                e for e in plc["entries"]
+                if e["ntp"] == f"{ns}/{topic}/{pid}"
+            )
+            assert entry["shard"] == dst, entry
+            assert plc["table"]["moves_executed"] >= 1, plc["table"]
+            assert plc["mover"]["stats"]["ok"] >= 1, plc["mover"]
+            # the alert loop is wired (skew sampling + on_fire hook)
+            assert plc["rebalancer"] is not None, plc
+
+            # 3. merged fleet /metrics stays exact post-move
+            text = await asyncio.to_thread(_metrics, admin)
+            skew_lines = [
+                ln for ln in text.splitlines()
+                if ln.startswith("redpanda_tpu_placement_shard_skew")
+                and not ln.startswith("#")
+            ]
+            assert len(skew_lines) == 1, skew_lines
+        finally:
+            await c.close()
+    finally:
+        await sb.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("PLACEMENT-SMOKE-OK")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

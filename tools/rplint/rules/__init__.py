@@ -18,6 +18,7 @@ from .rpl013_cloud_budget import CloudAwaitBudgetRule
 from .rpl014_clock_discipline import ClockDisciplineRule
 from .rpl015_await_atomicity import AwaitAtomicityRule
 from .rpl016_lock_consistency import LockConsistencyRule
+from .rpl017_placement_discipline import PlacementDisciplineRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -36,6 +37,7 @@ ALL_RULES = [
     ClockDisciplineRule,
     AwaitAtomicityRule,
     LockConsistencyRule,
+    PlacementDisciplineRule,
 ]
 
 __all__ = ["ALL_RULES"]

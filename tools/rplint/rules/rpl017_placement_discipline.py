@@ -1,0 +1,132 @@
+"""RPL017 — placement discipline: the group → shard mapping is
+computed in redpanda_tpu/placement/ and nowhere else.
+
+PR 12 unified two ad-hoc placement planes (the ssx `shard_of` hash
+and the tick-frame lane slots) into one PlacementTable that live
+partition moves REBIND at runtime. That only works if every consumer
+*looks the mapping up* (`table.shard_for(ntp)`,
+`table.shard_for_group(gid)`, `table.lane_for(gid)`, or the
+RaftService `shard_resolver` hook) instead of re-deriving it. A stray
+`gid % n_shards` or a direct `shard_of(gid, n)` call elsewhere is a
+second source of truth that is *silently correct until the first
+move*: the hash says shard 1, the table says shard 2, and a frame
+routed by the hash lands on a shard that no longer hosts the group —
+the classic post-rebalance "NOT_LEADER storm from one stale router"
+shape, unreproducible without a move in flight.
+
+Flagged outside redpanda_tpu/placement/:
+
+  * any CALL of `shard_of(...)` / `compute_shard(...)` (bare name or
+    attribute) — lookups must go through the table / resolver hook
+  * any DEF named `shard_of` / `compute_shard` — no re-forking the
+    policy under the blessed names
+  * a modulo whose right operand is shard-count-shaped
+    (`n_shards`, `shard_count`, `num_shards`, `nshards`) — the
+    hash re-derived inline without even naming it
+
+Importing the symbols (e.g. the ssx/shards.py compat re-export) is
+fine: an import that is never called routes nothing.
+
+Suppress a deliberate exception with `# rplint: disable=RPL017`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext
+
+_EXEMPT_PREFIX = "redpanda_tpu/placement/"
+_POLICY_FUNCS = {"shard_of", "compute_shard"}
+_SHARD_COUNT_NAMES = {"n_shards", "shard_count", "num_shards", "nshards"}
+
+EXAMPLE = """\
+# anywhere outside redpanda_tpu/placement/
+shard = shard_of(group_id, self.n_shards)      # RPL017: stale after a move
+lane = group_id % self.shard_count             # RPL017: inline re-derivation
+# instead:
+shard = broker.shard_table.shard_for_group(group_id)
+"""
+
+
+def _call_name(node: ast.Call) -> str | None:
+    """The terminal name of the called expression, for exact match."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _shard_count_ref(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Name) and node.id in _SHARD_COUNT_NAMES:
+        return node.id
+    if isinstance(node, ast.Attribute) and node.attr in _SHARD_COUNT_NAMES:
+        return node.attr
+    return None
+
+
+class PlacementDisciplineRule:
+    code = "RPL017"
+    name = "placement-discipline"
+
+    def check(self, ctx: ModuleContext):
+        path = ctx.path.replace("\\", "/")
+        if _EXEMPT_PREFIX in path or path.startswith("placement/"):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                called = _call_name(node)
+                if called in _POLICY_FUNCS:
+                    if ctx.suppressed(node, self.code):
+                        continue
+                    yield Finding(
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.code,
+                        message=(
+                            f"direct {called}() call outside placement/ — "
+                            "the hash is only the INITIAL assignment; live "
+                            "moves rebind groups, so route via "
+                            "PlacementTable.shard_for_group / shard_for or "
+                            "the RaftService shard_resolver hook"
+                        ),
+                    )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name in _POLICY_FUNCS:
+                    if ctx.suppressed(node, self.code):
+                        continue
+                    yield Finding(
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.code,
+                        message=(
+                            f"def {node.name}() outside placement/ — the "
+                            "placement policy has exactly one "
+                            "implementation (placement/table.py); a "
+                            "shadow copy diverges silently on the first "
+                            "policy change or live move"
+                        ),
+                        qualname=node.name,
+                    )
+            elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+                ref = _shard_count_ref(node.right)
+                if ref is None:
+                    continue
+                if ctx.suppressed(node, self.code):
+                    continue
+                yield Finding(
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.code,
+                    message=(
+                        f"inline `... % {ref}` outside placement/ — "
+                        "re-deriving the shard hash bypasses the "
+                        "PlacementTable and goes stale the moment a live "
+                        "move rebinds the group"
+                    ),
+                )

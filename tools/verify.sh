@@ -40,6 +40,9 @@ env JAX_PLATFORMS=cpu RP_NATIVE=0 python -m pytest \
 echo "== shard mp smoke (2-shard broker, fork + invoke_on seam) =="
 env JAX_PLATFORMS=cpu python tools/shard_smoke.py
 
+echo "== placement smoke (live move mid-produce, fetch parity, merged /metrics) =="
+env JAX_PLATFORMS=cpu python tools/placement_smoke.py
+
 echo "== fleet scrape smoke (merged /metrics + stitched traces) =="
 env JAX_PLATFORMS=cpu python tools/scrape_smoke.py --fleet
 
