@@ -278,6 +278,9 @@ class Broker:
             registry=self.metrics,
         )
         self.shard_table = ShardTable()
+        # (chip, row) → group residue resolution for the tick frame:
+        # the table is the one map that survives live lane rebinds
+        self.group_manager.tick_frame.attach_table(self.shard_table, shard=0)
         # set by ssx.ShardedBroker when worker shards are active; None
         # keeps every kafka/controller shard seam on the local path
         self.shard_router = None

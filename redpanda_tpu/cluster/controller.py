@@ -1207,6 +1207,11 @@ class Controller:
                             log_config=self._log_config_for(d.ntp),
                         )
                         self._shards.insert(d.ntp, d.group)
+                        row = p.consensus.row
+                        self._shards.bind_lane(
+                            d.group, row,
+                            chip=self._gm.arrays.chip_of(row),
+                        )
                         if self.on_partition_added is not None:
                             await self.on_partition_added(d.ntp, p)
                     elif d.kind == "del" and self.node_id in d.replicas:
@@ -1252,13 +1257,17 @@ class Controller:
         update stages + raft change_configuration."""
         if self.node_id in d.replicas:
             if self._pm.get(d.ntp) is None:
-                await self._pm.manage(
+                p = await self._pm.manage(
                     d.ntp,
                     d.group,
                     d.old_replicas,
                     log_config=self._log_config_for(d.ntp),
                 )
                 self._shards.insert(d.ntp, d.group)
+                row = p.consensus.row
+                self._shards.bind_lane(
+                    d.group, row, chip=self._gm.arrays.chip_of(row)
+                )
         if self._pm.get(d.ntp) is None:
             return  # not hosting; nothing to converge
         prev = self._move_tasks.pop(d.ntp, None)

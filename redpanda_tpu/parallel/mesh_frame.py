@@ -1,0 +1,225 @@
+"""Mesh-resident live tick frame: the fused fold + commit + health
+program over lane tensors sharded across the device mesh.
+
+This is the multichip dryrun (`cluster_step.py`, MULTICHIP_r01-r05)
+promoted to the LIVE replication plane: every `[G, ...]` lane tensor of
+a shard's `ShardGroupArrays` is placed with `NamedSharding`/
+`PartitionSpec` over `make_mesh()` — each device owns an equal
+contiguous block of lane rows (a **chip block**) — and one compiled
+program runs the whole frame:
+
+  * append-reply fold (seq-guarded scatter)      — chip-local,
+  * masked-quorum commit/visible advance         — chip-local,
+  * health reduction (ops.health.health_reduce)  — chip-local,
+  * fleet totals (advanced / lag / under / leaderless / active)
+    — the **one cross-chip fold per frame**: GSPMD lowers the
+    `jnp.sum`/`jnp.max` over the sharded row axis to a single
+    all-reduce (the psum of per-chip partials), exactly the
+    `cluster_step.py` committed-count pattern.
+
+Everything row-wise stays inside its chip block because the math is
+elementwise/per-row over the sharded axis — XLA partitions it with no
+communication; only the totals reduction crosses the ICI. The
+heartbeat gather is NOT in this program: on the mesh backend it is
+served from the authoritative host mirrors (chip-local by
+construction), so the device program carries zero gather traffic.
+
+On a CPU-only box the mesh is forced with
+`XLA_FLAGS=--xla_force_host_platform_device_count=8`; the same program
+rides ICI unchanged on a real slice. `RP_MESH_DEVICES=n` caps the mesh
+to the first n visible devices (the differential suite sweeps 1/2/8).
+
+Capacity padding: `NamedSharding` needs the row axis divisible by the
+device count. `ShardGroupArrays` capacities (64 · 2^k) always divide
+8, but arbitrary device counts are padded with neutral rows
+(is_leader/voters/active all False — they cannot advance, contribute
+zero to every total) and sliced off on readback, so results are
+byte-identical to the host fold.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.consensus_state import GroupState
+from ..ops import quorum as q
+from ..ops.health import health_reduce
+from .mesh import group_sharding, make_mesh
+
+
+def mesh_device_count() -> int:
+    """Device count for the live mesh backend: RP_MESH_DEVICES if set,
+    else every visible device."""
+    n = int(os.environ.get("RP_MESH_DEVICES", "0") or 0)
+    return n if n > 0 else len(jax.devices())
+
+
+def mesh_tick_frame(
+    state: GroupState,
+    group_idx: jax.Array,
+    replica_slot: jax.Array,
+    last_dirty: jax.Array,
+    last_flushed: jax.Array,
+    seq: jax.Array,
+    leader_known: jax.Array,  # [G] bool
+    active: jax.Array,        # [G] bool
+) -> tuple[GroupState, dict[str, jax.Array], dict[str, jax.Array]]:
+    """One mesh frame: fold + commit advance + health, all chip-local,
+    plus the fleet totals whose reduction over the sharded row axis is
+    the frame's single cross-chip fold."""
+    before = state.commit_index
+    state = q.heartbeat_tick(
+        state, group_idx, replica_slot, last_dirty, last_flushed, seq
+    )
+    health = health_reduce(
+        state.match_index,
+        state.commit_index,
+        state.is_voter,
+        state.is_voter_old,
+        state.is_leader,
+        leader_known,
+        active,
+    )
+    totals = {
+        "advanced": jnp.sum(
+            (state.commit_index > before).astype(jnp.int64)
+        ),
+        "max_follower_lag": jnp.max(health["max_lag"], initial=0),
+        "under_replicated": jnp.sum(
+            health["under_replicated"].astype(jnp.int64)
+        ),
+        "leaderless": jnp.sum(health["leaderless"].astype(jnp.int64)),
+        "active": jnp.sum(active.astype(jnp.int64)),
+    }
+    return state, health, totals
+
+
+def mesh_health(
+    match: jax.Array,
+    commit: jax.Array,
+    is_voter: jax.Array,
+    is_voter_old: jax.Array,
+    is_leader: jax.Array,
+    leader_known: jax.Array,
+    active: jax.Array,
+) -> tuple[dict[str, jax.Array], dict[str, jax.Array]]:
+    """Health-only mesh program (the read-path refresh — no reply fold,
+    no commit movement), same one-cross-chip-fold discipline."""
+    health = health_reduce(
+        match, commit, is_voter, is_voter_old, is_leader, leader_known, active
+    )
+    totals = {
+        "max_follower_lag": jnp.max(health["max_lag"], initial=0),
+        "under_replicated": jnp.sum(
+            health["under_replicated"].astype(jnp.int64)
+        ),
+        "leaderless": jnp.sum(health["leaderless"].astype(jnp.int64)),
+        "active": jnp.sum(active.astype(jnp.int64)),
+    }
+    return health, totals
+
+
+class MeshFrame:
+    """One shard's mesh placement + compiled frame programs. Lazily
+    constructed by ShardGroupArrays the first time the `mesh` backend
+    runs a full frame; the host mirrors stay authoritative (control-
+    plane writes are numpy), so each full frame places fresh — the
+    steady path never reaches the device at all (incremental chip-local
+    sweep, see shard_state._mesh_tick)."""
+
+    def __init__(self, n_devices: int | None = None):
+        n = n_devices if n_devices is not None else mesh_device_count()
+        self.mesh = make_mesh(n)
+        self.n_devices = n
+        self._sharding = group_sharding(self.mesh)
+        self._frame = jax.jit(mesh_tick_frame)
+        self._health = jax.jit(mesh_health)
+
+    def _place(self, a: np.ndarray) -> jax.Array:
+        """Pad the row axis to a multiple of the device count with
+        neutral rows and place with the group sharding."""
+        g = a.shape[0]
+        pad = (-g) % self.n_devices
+        if pad:
+            a = np.concatenate(
+                [a, np.zeros((pad,) + a.shape[1:], a.dtype)]
+            )
+        return jax.device_put(np.ascontiguousarray(a), self._sharding)
+
+    def place_state(self, arrays) -> GroupState:
+        """ShardGroupArrays host lanes -> padded, mesh-sharded
+        GroupState."""
+        return GroupState(
+            term=self._place(arrays.term),
+            is_leader=self._place(arrays.is_leader),
+            commit_index=self._place(arrays.commit_index),
+            term_start=self._place(arrays.term_start),
+            last_visible=self._place(arrays.last_visible),
+            match_index=self._place(arrays.match_index),
+            flushed_index=self._place(arrays.flushed_index),
+            is_voter=self._place(arrays.is_voter),
+            is_voter_old=self._place(arrays.is_voter_old),
+            last_seq=self._place(arrays.last_seq),
+        )
+
+    def run(
+        self,
+        arrays,
+        g_rows: np.ndarray,
+        g_slots: np.ndarray,
+        g_dirty: np.ndarray,
+        g_flushed: np.ndarray,
+        g_seqs: np.ndarray,
+    ) -> tuple[dict, dict, dict]:
+        """One full mesh frame over `arrays`' lanes. Reply columns are
+        replicated (they are tiny); the state is sharded. Returns host
+        numpy (state lanes, health lanes) sliced back to capacity, and
+        the fleet totals as python ints."""
+        cap = arrays.capacity
+        state = self.place_state(arrays)
+        new, health, totals = self._frame(
+            state,
+            jnp.asarray(g_rows),
+            jnp.asarray(g_slots),
+            jnp.asarray(g_dirty),
+            jnp.asarray(g_flushed),
+            jnp.asarray(g_seqs),
+            self._place(arrays.leader_id >= 0),
+            self._place(arrays.row_active),
+        )
+        out = {
+            "commit_index": np.array(new.commit_index)[:cap],
+            "last_visible": np.array(new.last_visible)[:cap],
+            "match_index": np.array(new.match_index)[:cap],
+            "flushed_index": np.array(new.flushed_index)[:cap],
+            "last_seq": np.array(new.last_seq)[:cap],
+        }
+        health_np = {
+            "max_lag": np.array(health["max_lag"])[:cap],
+            "under_replicated": np.array(health["under_replicated"])[:cap],
+            "leaderless": np.array(health["leaderless"])[:cap],
+        }
+        return out, health_np, {k: int(v) for k, v in totals.items()}
+
+    def run_health(self, arrays) -> tuple[dict, dict]:
+        """Health-only refresh through the mesh (the read path)."""
+        cap = arrays.capacity
+        health, totals = self._health(
+            self._place(arrays.match_index),
+            self._place(arrays.commit_index),
+            self._place(arrays.is_voter),
+            self._place(arrays.is_voter_old),
+            self._place(arrays.is_leader),
+            self._place(arrays.leader_id >= 0),
+            self._place(arrays.row_active),
+        )
+        health_np = {
+            "max_lag": np.array(health["max_lag"])[:cap],
+            "under_replicated": np.array(health["under_replicated"])[:cap],
+            "leaderless": np.array(health["leaderless"])[:cap],
+        }
+        return health_np, {k: int(v) for k, v in totals.items()}

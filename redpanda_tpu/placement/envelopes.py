@@ -108,11 +108,42 @@ class MoveCommitReply(Envelope):
         ("row", i32),
         ("dirty_offset", i64),
         ("committed_offset", i64),
+        ("chip", i32),  # mesh device holding the row (0 off-mesh)
     ]
+    SERDE_DEFAULTS = {"chip": 0}
 
 
 class MoveAck(Envelope):
     SERDE_FIELDS = [("ok", boolean), ("error", string)]
+
+
+class LaneMove(Envelope):
+    """Coordinator → owning shard: migrate `group`'s lane row into
+    `dst_chip`'s block of the shard's device mesh (freeze → lane
+    evacuate → lane adopt → rebind, all within one ShardGroupArrays —
+    the (chip, lane) half of a placement move)."""
+
+    SERDE_FIELDS = [
+        ("ns", string),
+        ("topic", string),
+        ("partition", i32),
+        ("group", i64),
+        ("dst_chip", i32),
+    ]
+
+
+class LaneMoveReply(Envelope):
+    """Lane-move reply: the rebound (chip, row) slot plus where the
+    lane came from (src echo — the coordinator's idempotence check)."""
+
+    SERDE_FIELDS = [
+        ("ok", boolean),
+        ("error", string),
+        ("row", i32),
+        ("chip", i32),
+        ("src_row", i32),
+        ("src_chip", i32),
+    ]
 
 
 class RaftForward(Envelope):
@@ -136,7 +167,9 @@ class LeaderHint(Envelope):
         ("term", i64),
         ("leader", i32),  # -1 = leaderless
         ("row", i32),     # lane row on the owning shard
+        ("chip", i32),    # mesh device holding the row (0 off-mesh)
     ]
+    SERDE_DEFAULTS = {"chip": 0}
 
 
 class LeaderHintBatch(Envelope):

@@ -30,6 +30,8 @@ import os
 
 from ..models.fundamental import NTP
 from .envelopes import (
+    LaneMove,
+    LaneMoveReply,
     MoveAck,
     MoveBegin,
     MoveChunk,
@@ -92,7 +94,75 @@ class MoveHost:
             return (await self.commit(MoveRef.decode(payload))).encode()
         if method == "move_abort":
             return (await self.abort(MoveRef.decode(payload))).encode()
+        if method == "move_lane":
+            return (await self.lane_move(LaneMove.decode(payload))).encode()
         raise LookupError(f"move: no such method {method!r}")
+
+    # -- lane migration (same shard, across mesh chips) ----------------
+    async def lane_move(self, req: LaneMove) -> LaneMoveReply:
+        """Migrate a group's lane row into another chip's block of this
+        shard's device mesh: freeze → lane evacuate → lane adopt →
+        rebind, then thaw. No log bytes move — the raft log and every
+        derived state stay put; only the SoA row (and with it the
+        NamedSharding device owning it) changes. Any fault before the
+        rebind rolls back (free the staged row, thaw the source — the
+        source row never stopped being canonical)."""
+
+        def err(msg: str) -> LaneMoveReply:
+            return LaneMoveReply(
+                ok=False, error=msg, row=-1, chip=-1,
+                src_row=-1, src_chip=-1,
+            )
+
+        ntp = NTP(req.ns, req.topic, req.partition)
+        p = self._pm.get(ntp)
+        if p is None or p.group_id != req.group:
+            return err("partition not hosted here")
+        arrays = self._gm.arrays
+        if req.dst_chip < 0 or req.dst_chip >= arrays.chip_count():
+            return err(
+                f"no such chip {req.dst_chip} "
+                f"(mesh has {arrays.chip_count()})"
+            )
+        src_row = p.consensus.row
+        src_chip = arrays.chip_of(src_row)
+        if src_chip == req.dst_chip:
+            return LaneMoveReply(
+                ok=True, error="", row=src_row, chip=src_chip,
+                src_row=src_row, src_chip=src_chip,
+            )
+        frozen = False
+        dst = -1
+        try:
+            self._check_fault("lane_freeze")
+            await self._gm.freeze_group(req.group)
+            frozen = True
+            self._check_fault("lane_evacuate")
+            dst = self._gm.stage_lane(req.group, req.dst_chip)
+            self._check_fault("lane_adopt")
+            self._check_fault("lane_rebind")
+            self._gm.commit_lane(req.group, dst)
+            self._gm.thaw_group(req.group)
+            return LaneMoveReply(
+                ok=True, error="", row=dst, chip=req.dst_chip,
+                src_row=src_row, src_chip=src_chip,
+            )
+        except Exception as e:
+            if dst >= 0:
+                try:
+                    self._gm.abort_lane(dst)
+                except Exception:
+                    logger.exception("lane abort for group %d", req.group)
+            if frozen:
+                try:
+                    self._gm.thaw_group(req.group)
+                except Exception:
+                    logger.exception("lane thaw for group %d", req.group)
+            logger.warning(
+                "lane move of group %d chip %d -> %d rolled back: %s",
+                req.group, src_chip, req.dst_chip, e,
+            )
+            return err(f"lane move failed: {e}")
 
     # -- source side --------------------------------------------------
     async def freeze(self, ref: MoveRef) -> MoveManifest:
@@ -271,6 +341,7 @@ class MoveHost:
                 row=p.consensus.row,
                 dirty_offset=offs.dirty_offset,
                 committed_offset=offs.committed_offset,
+                chip=self._gm.arrays.chip_of(p.consensus.row),
             )
         except Exception as e:
             logger.exception("move commit failed for %s", ntp)

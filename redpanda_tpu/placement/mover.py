@@ -27,6 +27,8 @@ import numpy as np
 
 from ..models.fundamental import NTP
 from .envelopes import (
+    LaneMove,
+    LaneMoveReply,
     MoveAck,
     MoveBegin,
     MoveChunk,
@@ -266,7 +268,7 @@ class PartitionMover:
         # point of no return: rebind the table BEFORE retiring the
         # source so there is never a moment with no route
         self.table.record_move(ntp, group, dst)
-        self.table.bind_lane(group, com.row)
+        self.table.bind_lane(group, com.row, chip=com.chip)
         freeze_ms = (self._clock() - t0) * 1e3
         self.stats.freeze_ms.append(freeze_ms)
         self.stats.ok += 1
@@ -290,6 +292,77 @@ class PartitionMover:
             "from": src,
             "to": dst,
             "batches": shipped,
+            "freeze_ms": round(freeze_ms, 3),
+        }
+
+    async def move_lane(
+        self,
+        ntp: NTP,
+        dst_chip: int,
+        *,
+        charge_budget: bool = True,
+    ) -> dict:
+        """Migrate `ntp`'s lane row into `dst_chip`'s block of its
+        owning shard's device mesh — the (chip, lane) half of the
+        placement coordinate. The freeze → evacuate → adopt → rebind
+        protocol runs entirely on the owning shard (no log bytes
+        cross anything); only the table rebind happens here, and only
+        after the shard acks. Raises MoveError on failure with the
+        source state intact."""
+        group = self.table.group_of(ntp)
+        shard = self.table.shard_for(ntp)
+        if group is None or shard is None:
+            raise MoveError(f"{ntp} not in the placement table")
+        if group in self._moving:
+            raise MoveError(f"group {group} already moving")
+        if charge_budget and not self.budget.try_acquire():
+            raise MoveBudgetExhausted(
+                f"move budget exhausted ({self.budget.describe()})"
+            )
+        self._moving.add(group)
+        t0 = self._clock()
+        try:
+            rep = LaneMoveReply.decode(
+                await self._call(
+                    shard,
+                    "move_lane",
+                    LaneMove(
+                        ns=ntp.ns,
+                        topic=ntp.topic,
+                        partition=ntp.partition,
+                        group=group,
+                        dst_chip=dst_chip,
+                    ).encode(),
+                )
+            )
+            if not rep.ok:
+                self.stats.rolled_back += 1
+                raise MoveError(f"lane move on shard {shard}: {rep.error}")
+        finally:
+            self._moving.discard(group)
+        if rep.chip == rep.src_chip and rep.row == rep.src_row:
+            return {
+                "moved": False,
+                "reason": "already there",
+                "chip": rep.chip,
+            }
+        self.table.bind_lane(group, rep.row, chip=rep.chip)
+        freeze_ms = (self._clock() - t0) * 1e3
+        self.stats.freeze_ms.append(freeze_ms)
+        self.stats.ok += 1
+        logger.info(
+            "lane-moved %s (group %d) shard %d chip %d -> %d "
+            "(row %d -> %d), freeze window %.1f ms",
+            ntp, group, shard, rep.src_chip, rep.chip,
+            rep.src_row, rep.row, freeze_ms,
+        )
+        return {
+            "moved": True,
+            "group": group,
+            "shard": shard,
+            "from_chip": rep.src_chip,
+            "to_chip": rep.chip,
+            "row": rep.row,
             "freeze_ms": round(freeze_ms, 3),
         }
 

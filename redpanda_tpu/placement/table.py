@@ -18,10 +18,15 @@ the pairing. The PlacementTable owns both coordinates now:
   ntp/group → shard, mutated only by the controller backend and the
   PartitionMover. This subsumes the old `cluster.shard_table.
   ShardTable` interface, so every existing lookup site keeps working.
-- the LANE (`bind_lane`/`lane_for`): the ShardGroupArrays row the
-  group's raft lanes occupy on its owning shard, reported at group
-  creation and REBOUND by live moves (the target allocates a fresh
-  row; the source frees its old one).
+- the LANE (`bind_lane`/`lane_for`/`chip_lane_for`): the device lane
+  slot the group's raft lanes occupy on its owning shard, reported at
+  group creation and REBOUND by live moves (the target allocates a
+  fresh row; the source frees its old one). Since the mesh backend the
+  slot is a **(chip, lane)** pair — the device of the mesh whose block
+  holds the row, plus the row itself (back-compat: chip defaults to 0,
+  `lane_for` still answers with the bare row). `group_at` is the
+  reverse map the TickFrame uses to resolve changed (chip, row)
+  addresses back to groups after a lane rebind.
 
 rplint RPL017 (placement-discipline) enforces that `compute_shard` —
 the one modulo over the shard count — is computed nowhere else:
@@ -63,7 +68,14 @@ class PlacementTable:
         self._ntp: dict[NTP, int] = {}
         self._group: dict[int, int] = {}
         self._gid_of: dict[NTP, int] = {}
-        self._lane: dict[int, int] = {}
+        # group → (chip, row, shard): the device lane slot, plus the
+        # shard the binding was made under so the reverse map can be
+        # unkeyed exactly on rebind even after a cross-shard move
+        self._lane: dict[int, tuple[int, int, int]] = {}
+        # (shard, chip, row) → group: the TickFrame's changed-row
+        # resolution path (rows are per-shard, chips per-mesh — the
+        # triple is the only collision-free key broker-wide)
+        self._row_group: dict[tuple[int, int, int], int] = {}
         # bumped on every map mutation; the RaftService forwarding seam
         # caches per-sender "all groups local" verdicts against it
         self.epoch = 0
@@ -95,7 +107,7 @@ class PlacementTable:
         self._ntp.pop(ntp, None)
         self._group.pop(group_id, None)
         self._gid_of.pop(ntp, None)
-        self._lane.pop(group_id, None)
+        self._unbind_lane(group_id)
         self.epoch += 1
 
     def shard_for(self, ntp: NTP) -> int | None:
@@ -110,16 +122,36 @@ class PlacementTable:
         self.moves_executed += 1
 
     # -- lane ---------------------------------------------------------
-    def bind_lane(self, group_id: int, row: int) -> None:
-        """Record the ShardGroupArrays row the group occupies on its
-        owning shard (reported at creation / move commit)."""
+    def _unbind_lane(self, group_id: int) -> None:
+        old = self._lane.pop(group_id, None)
+        if old is not None:
+            self._row_group.pop((old[2], old[0], old[1]), None)
+
+    def bind_lane(self, group_id: int, row: int, chip: int = 0) -> None:
+        """Record the (chip, lane) slot the group occupies on its
+        owning shard (reported at creation / move commit / lane
+        migration). `chip` is the mesh device whose block holds the
+        row — 0 off the mesh backend. row < 0 unbinds."""
+        self._unbind_lane(group_id)
         if row >= 0:
-            self._lane[group_id] = row
-        else:
-            self._lane.pop(group_id, None)
+            shard = self._group.get(group_id, 0)
+            self._lane[group_id] = (chip, row, shard)
+            self._row_group[(shard, chip, row)] = group_id
 
     def lane_for(self, group_id: int) -> int | None:
-        return self._lane.get(group_id)
+        e = self._lane.get(group_id)
+        return e[1] if e is not None else None
+
+    def chip_lane_for(self, group_id: int) -> tuple[int, int] | None:
+        """The full (chip, lane) device slot."""
+        e = self._lane.get(group_id)
+        return (e[0], e[1]) if e is not None else None
+
+    def group_at(self, chip: int, row: int, shard: int = 0) -> int | None:
+        """Reverse lane resolution: which group occupies (chip, row)
+        on `shard`. The TickFrame's changed-row residue resolves
+        through this so callbacks survive a live lane rebind."""
+        return self._row_group.get((shard, chip, row))
 
     # -- attribution --------------------------------------------------
     def counts(self) -> dict[int, int]:
@@ -137,12 +169,14 @@ class PlacementTable:
         out = []
         for ntp, shard in self._ntp.items():
             gid = self._gid_of.get(ntp)
+            lane = self._lane.get(gid) if gid is not None else None
             out.append(
                 {
                     "ntp": f"{ntp.ns}/{ntp.topic}/{ntp.partition}",
                     "group": gid,
                     "shard": shard,
-                    "lane": self._lane.get(gid, -1) if gid is not None else -1,
+                    "lane": lane[1] if lane is not None else -1,
+                    "chip": lane[0] if lane is not None else -1,
                 }
             )
         return out

@@ -290,7 +290,9 @@ class GroupManager:
         )
         self._groups[group_id] = c
         self._by_row[c.row] = c
-        self.tick_frame.register(c.row, c.on_batched_commit_advance)
+        self.tick_frame.register(
+            c.row, c.on_batched_commit_advance, group_id=group_id
+        )
         self.registry_epoch += 1
         await c.start()
         self._min_el_timeout = min(
@@ -322,13 +324,57 @@ class GroupManager:
         self.service.invalidate_heartbeat_plans()
         return c
 
+    # -- cross-chip lane migration (mesh backend) ----------------------
+    def stage_lane(self, group_id: int, dst_chip: int) -> int:
+        """Lane evacuate + adopt: copy a FROZEN group's lane row into a
+        fresh row inside `dst_chip`'s block. The source row stays
+        canonical; the copy is disposable until commit_lane swaps the
+        binding (abort_lane frees it with nothing lost). Returns the
+        staged row; raises if the chip's block is exhausted (the caller
+        rolls back — reserve() a larger capacity first)."""
+        c = self._groups.get(group_id)
+        if c is None:
+            raise LookupError(f"group {group_id} not hosted here")
+        dst = self.arrays.alloc_row_on_chip(dst_chip)
+        self.arrays.migrate_row(c.row, dst)
+        return dst
+
+    def abort_lane(self, dst_row: int) -> None:
+        """Roll back stage_lane: drop the disposable copy."""
+        self.arrays.free_row(dst_row)
+
+    def commit_lane(self, group_id: int, dst_row: int) -> int:
+        """Lane rebind: swap the (still frozen) group onto its staged
+        row and retire the source row. Registry, tick-frame callbacks
+        and heartbeat plans all re-key atomically under the event loop
+        — after this the move is final. Returns the old row."""
+        c = self._groups.get(group_id)
+        if c is None:
+            raise LookupError(f"group {group_id} not hosted here")
+        src = c.row
+        # re-copy: freeze parks elections/heartbeats but inbound vote
+        # lanes can still be touched between stage and commit — the
+        # rebind must carry the latest state, not the staged snapshot
+        self.arrays.migrate_row(src, dst_row)
+        self._by_row.pop(src, None)
+        self.tick_frame.deregister(src, group_id=group_id)
+        c.row = dst_row
+        self._by_row[dst_row] = c
+        self.tick_frame.register(
+            dst_row, c.on_batched_commit_advance, group_id=group_id
+        )
+        self.arrays.free_row(src)
+        self.registry_epoch += 1
+        self.service.invalidate_heartbeat_plans()
+        return src
+
     async def remove_group(self, group_id: int) -> None:
         c = self._groups.pop(group_id, None)
         self.registry_epoch += 1
         self.service.invalidate_heartbeat_plans()
         if c is not None:
             self._by_row.pop(c.row, None)
-            self.tick_frame.deregister(c.row)
+            self.tick_frame.deregister(c.row, group_id=group_id)
             self.heartbeat_manager.deregister(group_id)
             await c.stop()
             self.arrays.free_row(c.row)

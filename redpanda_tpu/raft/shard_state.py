@@ -180,6 +180,19 @@ class ShardGroupArrays:
         # timestamp: suppression lifts the moment the fiber exits, so
         # the tick's recovery-fallback role is preserved exactly.
         self.hb_suppress = np.zeros((g, r), np.int32, order="F")
+        # mesh backend (RP_QUORUM_BACKEND=mesh): lazily constructed
+        # MeshFrame (parallel/mesh_frame), per-chip changed-row
+        # counters, and the fleet totals from the last full frame's
+        # one cross-chip fold
+        self._mesh_frame = None
+        self._chip_changed: "np.ndarray | None" = None
+        self._mesh_totals: dict | None = None
+        self._last_fold_us = 0.0
+        # full changed-row set of the last incremental sweep (the
+        # advanced-rows return is a subset); per-chip attribution and
+        # the mesh tick read it
+        self._last_changed = _EMPTY_ROWS
+        self._reserving = False
 
     def touch(self) -> None:
         """Invalidate armed SAME-frame heartbeat state (see mut_epoch)."""
@@ -264,42 +277,47 @@ class ShardGroupArrays:
         self.health_leaderless[row] = False
         self.touch()
 
+    # every per-row lane, in one place: _grow resizes them all and
+    # migrate_row (cross-chip lane moves) copies them all — adding a
+    # lane without listing it here breaks both the same way
+    ROW_LANES = (
+        "term",
+        "is_leader",
+        "commit_index",
+        "term_start",
+        "last_visible",
+        "match_index",
+        "flushed_index",
+        "is_voter",
+        "is_voter_old",
+        "last_seq",
+        "next_seq",
+        "tb_start",
+        "tb_term",
+        "tb_count",
+        "last_hb",
+        "log_start",
+        "snap_index",
+        "is_follower",
+        "leader_id",
+        "quorum_dirty",
+        "_folded_self_m",
+        "_folded_self_f",
+        "hb_suppress",
+        "el_timeout",
+        "el_jitter",
+        "last_el",
+        "same_cover_node",
+        "row_active",
+        "health_max_lag",
+        "health_under",
+        "health_leaderless",
+    )
+
     def _grow(self) -> None:
         old = self._cap
         new = old * 2
-        for name in (
-            "term",
-            "is_leader",
-            "commit_index",
-            "term_start",
-            "last_visible",
-            "match_index",
-            "flushed_index",
-            "is_voter",
-            "is_voter_old",
-            "last_seq",
-            "next_seq",
-            "tb_start",
-            "tb_term",
-            "tb_count",
-            "last_hb",
-            "log_start",
-            "snap_index",
-            "is_follower",
-            "leader_id",
-            "quorum_dirty",
-            "_folded_self_m",
-            "_folded_self_f",
-            "hb_suppress",
-            "el_timeout",
-            "el_jitter",
-            "last_el",
-            "same_cover_node",
-            "row_active",
-            "health_max_lag",
-            "health_under",
-            "health_leaderless",
-        ):
+        for name in self.ROW_LANES:
             arr = getattr(self, name)
             shape = (new,) + arr.shape[1:]
             order = (
@@ -340,7 +358,25 @@ class ShardGroupArrays:
         # after a doubling paid a fresh XLA trace at the new [G, R]
         # shape while heartbeats starved. Host backend compiles
         # nothing, so this is free in the default configuration.
-        if self._backend() == "device":
+        if not self._reserving and self._backend() in ("device", "mesh"):
+            self.prewarm()
+
+    def reserve(self, capacity: int) -> None:
+        """Pre-size the row space (control plane, ahead of traffic).
+        Mesh deployments MUST pre-size: chip blocks are derived from
+        the current capacity (chip_of_rows), so a mid-flight grow
+        would remap every (chip, lane) address the placement table
+        holds. One prewarm at the final capacity instead of one per
+        doubling."""
+        if capacity <= self._cap:
+            return
+        self._reserving = True
+        try:
+            while self._cap < capacity:
+                self._grow()
+        finally:
+            self._reserving = False
+        if self._backend() in ("device", "mesh"):
             self.prewarm()
 
     @property
@@ -463,9 +499,239 @@ class ShardGroupArrays:
         import os
 
         forced = os.environ.get("RP_QUORUM_BACKEND")
-        if forced in ("host", "device"):
+        if forced in ("host", "device", "mesh"):
             return forced
         return "host"
+
+    # -- mesh backend: (chip, lane) addressing ------------------------
+    # Reply windows at or past this size run the real sharded mesh
+    # program; smaller windows take the incremental chip-local host
+    # sweep (identical math, differentially pinned) so a steady tick
+    # never pays a device dispatch. RP_MESH_FULL=1 forces the mesh
+    # program on every frame (the parity suites and the bench's
+    # fold_us measurement).
+    MESH_FULL_THRESHOLD = 4096
+
+    @property
+    def mesh_frame(self):
+        mf = self._mesh_frame
+        if mf is None:
+            from ..parallel.mesh_frame import MeshFrame
+
+            mf = self._mesh_frame = MeshFrame()
+        return mf
+
+    def chip_count(self) -> int:
+        """Devices in the live mesh (1 off the mesh backend)."""
+        if self._backend() != "mesh":
+            return 1
+        return self.mesh_frame.n_devices
+
+    def chip_block(self) -> int:
+        """Rows per chip under the CURRENT capacity — NamedSharding's
+        even contiguous block over the (padded) row axis. The chip of
+        a row is derived, not stored: chip = row // chip_block()."""
+        n = self.chip_count()
+        return -(-self._cap // n) if n > 1 else self._cap
+
+    def chip_of_rows(self, rows) -> np.ndarray:
+        """Vectorized row → chip resolution (the derived half of the
+        (chip, lane) address the placement table records)."""
+        rows = np.asarray(rows, np.int64)
+        n = self.chip_count()
+        if n <= 1:
+            return np.zeros(len(rows), np.int64)
+        return rows // self.chip_block()
+
+    def chip_of(self, row: int) -> int:
+        """Scalar row → chip (control-plane convenience: leader hints,
+        move replies, admin attribution)."""
+        n = self.chip_count()
+        return int(row) // self.chip_block() if n > 1 else 0
+
+    def alloc_row_on_chip(self, chip: int) -> int:
+        """Allocate a row inside one chip's block (the lane-adopt step
+        of a cross-chip migration). Unlike alloc_row this NEVER grows:
+        growing would remap every existing (chip, lane) address (see
+        reserve), so an exhausted block is a hard error the mover
+        surfaces as a rollback."""
+        n = self.chip_count()
+        if chip < 0 or chip >= n:
+            raise ValueError(f"no such chip {chip} (mesh has {n})")
+        block = self.chip_block()
+        lo, hi = chip * block, min((chip + 1) * block, self._cap)
+        # _free is stored descending, so the smallest free rows — the
+        # density-preserving choice — sit at the END; scan from there
+        for i in range(len(self._free) - 1, -1, -1):
+            row = self._free[i]
+            if lo <= row < hi:
+                del self._free[i]
+                self._alloc_count += 1
+                self.row_active[row] = True
+                return row
+        raise RuntimeError(
+            f"chip {chip} lane block [{lo}, {hi}) exhausted "
+            f"(reserve() a larger capacity before moving lanes in)"
+        )
+
+    def migrate_row(self, src: int, dst: int) -> None:
+        """Copy every per-row lane src → dst (the evacuate/adopt core
+        of a cross-chip lane move; control plane — the caller froze the
+        group). The src row is NOT freed here: until the caller commits
+        the swap, src stays canonical and dst is a disposable copy, so
+        rollback is free_row(dst) with nothing lost."""
+        for name in self.ROW_LANES:
+            arr = getattr(self, name)
+            arr[dst] = arr[src]
+        # force a quorum recompute at dst and refresh every epoch a
+        # row rewrite can invalidate (same set reset_row bumps)
+        self.quorum_dirty[dst] = True
+        self._folded_self_m[dst] = I64_MIN
+        self._folded_self_f[dst] = I64_MIN
+        self.tb_epoch += 1
+        self.voter_epoch += 1
+        self.touch()
+
+    def _note_chip_changed(self, rows: np.ndarray) -> None:
+        if not len(rows):
+            return
+        n = self.chip_count()
+        cc = self._chip_changed
+        if cc is None or len(cc) != n:
+            cc = self._chip_changed = np.zeros(n, np.int64)
+        cc += np.bincount(self.chip_of_rows(rows), minlength=n)
+
+    def mesh_totals(self) -> dict | None:
+        """Fleet view from the last full mesh frame's single cross-chip
+        fold (None before the first full frame)."""
+        return self._mesh_totals
+
+    def lane_attribution(self) -> list[dict]:
+        """Per-chip lane attribution for the bench/admin JSON: active
+        groups, cumulative changed rows, and the last full-fold wall µs
+        (one SPMD program — each chip runs the same frame, so the wall
+        time is per-frame, reported on every chip row)."""
+        n = self.chip_count()
+        active_rows = np.flatnonzero(self.row_active)
+        groups = (
+            np.bincount(self.chip_of_rows(active_rows), minlength=n)
+            if len(active_rows)
+            else np.zeros(n, np.int64)
+        )
+        cc = self._chip_changed
+        if cc is None or len(cc) != n:
+            cc = np.zeros(n, np.int64)
+        return [
+            {
+                "chip": c,
+                "groups": int(groups[c]),
+                "changed_rows": int(cc[c]),
+                "fold_us": round(self._last_fold_us, 1),
+            }
+            for c in range(n)
+        ]
+
+    def _mesh_tick(
+        self,
+        group_rows: np.ndarray,
+        replica_slots: np.ndarray,
+        last_dirty: np.ndarray,
+        last_flushed: np.ndarray,
+        seqs: np.ndarray,
+        force_rows: "np.ndarray | None" = None,
+    ) -> np.ndarray:
+        """Mesh-backend tick: small windows run the incremental host
+        sweep — chip-local BY CONSTRUCTION, since every changed row
+        lives in exactly one chip block and the fold never mixes rows —
+        while big/forced windows run the real sharded mesh program
+        (one device dispatch, one cross-chip totals fold)."""
+        import os
+
+        full = (
+            os.environ.get("RP_MESH_FULL", "0") == "1"
+            or len(group_rows) >= self.MESH_FULL_THRESHOLD
+        )
+        if not full:
+            advanced = self.host_tick(
+                group_rows,
+                replica_slots,
+                last_dirty,
+                last_flushed,
+                seqs,
+                force_rows=force_rows,
+            )
+            self._note_chip_changed(self._last_changed)
+            return advanced
+        return self._mesh_full_frame(
+            group_rows,
+            replica_slots,
+            last_dirty,
+            last_flushed,
+            seqs,
+            force_rows=force_rows,
+        )
+
+    def _mesh_full_frame(
+        self,
+        group_rows: np.ndarray,
+        replica_slots: np.ndarray,
+        last_dirty: np.ndarray,
+        last_flushed: np.ndarray,
+        seqs: np.ndarray,
+        force_rows: "np.ndarray | None" = None,
+    ) -> np.ndarray:
+        """The real sharded program: place the lanes over the mesh, run
+        fold + commit + health chip-local with ONE cross-chip totals
+        fold, write back. Same touched-row discipline as the device
+        backend, so all three backends advance IDENTICAL row sets."""
+        import time
+
+        m = len(group_rows)
+        bucket = 8
+        while bucket < m:
+            bucket *= 2
+        g_rows = np.zeros(bucket, np.int64)
+        g_slots = np.zeros(bucket, np.int64)
+        g_dirty = np.full(bucket, I64_MIN, np.int64)
+        g_flushed = np.full(bucket, I64_MIN, np.int64)
+        g_seqs = np.full(bucket, I64_MIN, np.int64)
+        if m:
+            g_rows[:m] = group_rows
+            g_slots[:m] = replica_slots
+            g_dirty[:m] = last_dirty
+            g_flushed[:m] = last_flushed
+            g_seqs[:m] = seqs
+        dirty_rows = np.flatnonzero(self.quorum_dirty)
+        parts = [np.asarray(group_rows, np.int64), dirty_rows]
+        if force_rows is not None and len(force_rows):
+            parts.append(np.asarray(force_rows, np.int64))
+        touched = (
+            np.unique(np.concatenate(parts))
+            if any(len(p) for p in parts)
+            else _EMPTY_ROWS
+        )
+        before = self.commit_index[touched].copy()
+        t0 = time.perf_counter()
+        new, health, totals = self.mesh_frame.run(
+            self, g_rows, g_slots, g_dirty, g_flushed, g_seqs
+        )
+        self._last_fold_us = (time.perf_counter() - t0) * 1e6
+        self.commit_index[touched] = new["commit_index"][touched]
+        self.last_visible[touched] = new["last_visible"][touched]
+        self.match_index = new["match_index"]
+        self.flushed_index = new["flushed_index"]
+        self.last_seq = new["last_seq"]
+        self.health_max_lag = health["max_lag"]
+        self.health_under = health["under_replicated"]
+        self.health_leaderless = health["leaderless"]
+        self.touch()
+        self._folded_self_m[touched] = self.match_index[touched, SELF_SLOT]
+        self._folded_self_f[touched] = self.flushed_index[touched, SELF_SLOT]
+        self.quorum_dirty[:] = False
+        self._mesh_totals = totals
+        self._last_changed = touched
+        self._note_chip_changed(touched)
+        return touched[self.commit_index[touched] > before]
 
     @staticmethod
     def _masked_quorum_np(
@@ -481,6 +747,27 @@ class ShardGroupArrays:
         idx = np.clip(r - n + (n - 1) // 2, 0, r - 1)
         val = np.take_along_axis(ordered, idx[:, None], axis=-1)[:, 0]
         return np.where(n > 0, val, I64_MIN)
+
+    @staticmethod
+    def _masked_quorum_np2(
+        a: np.ndarray, b: np.ndarray, mask: np.ndarray, n: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """_masked_quorum_np over TWO value planes sharing one voter
+        mask/count — the sweep's committed (commit quorum) and match
+        (dirty/visibility quorum) lanes. One stacked sort instead of
+        two: the sort is the incremental sweep's largest single cost
+        at mesh scale."""
+        g, r = a.shape
+        filled = np.where(mask, np.stack((a, b)), I64_MIN)
+        ordered = np.sort(filled, axis=-1)
+        idx = np.clip(r - n + (n - 1) // 2, 0, r - 1)
+        val = np.take_along_axis(
+            ordered,
+            np.broadcast_to(idx[None, :, None], (2, g, 1)),
+            axis=-1,
+        )[:, :, 0]
+        out = np.where(n > 0, val, I64_MIN)
+        return out[0], out[1]
 
     def _voter_counts(self) -> tuple[np.ndarray, "np.ndarray | None", bool]:
         """(n_voters, n_voters_old | None, any_joint), recomputed only
@@ -505,20 +792,32 @@ class ShardGroupArrays:
     # a giant fold defers to the on-read authoritative recompute.
     HEALTH_INCR_CAP = 2048
 
-    def _health_np_rows(self, rows: np.ndarray) -> None:
+    def _health_np_rows(
+        self,
+        rows: np.ndarray,
+        *,
+        match: "np.ndarray | None" = None,
+        commit: "np.ndarray | None" = None,
+        voters: "np.ndarray | None" = None,
+        voters_old: "np.ndarray | None" = None,
+        leaders: "np.ndarray | None" = None,
+    ) -> None:
         """Refresh the health lanes for a row subset with the numpy
         mirror of the device reduction — hooked onto the sweep's
         changed-row set, so steady-state ticks pay nothing and hot rows
         never read stale. Oversized sets (full-frame folds) skip: the
-        read path's health_refresh() is always authoritative."""
+        read path's health_refresh() is always authoritative. Callers
+        that already gathered a lane pass it through the keywords (the
+        sweep's lanes are post-write, exactly what the reduction
+        reads) — the row gathers dominate the incremental path."""
         if not len(rows) or len(rows) > self.HEALTH_INCR_CAP:
             return
         h = health_reduce_np(
-            self.match_index[rows],
-            self.commit_index[rows],
-            self.is_voter[rows],
-            self.is_voter_old[rows],
-            self.is_leader[rows],
+            self.match_index[rows] if match is None else match,
+            self.commit_index[rows] if commit is None else commit,
+            self.is_voter[rows] if voters is None else voters,
+            self.is_voter_old[rows] if voters_old is None else voters_old,
+            self.is_leader[rows] if leaders is None else leaders,
             self.leader_id[rows] >= 0,
             self.row_active[rows],
         )
@@ -532,7 +831,20 @@ class ShardGroupArrays:
         Endpoints call this before reading the lanes, so the reported
         view is never staler than the request — and leader_id changes
         (which don't dirty the quorum sweep) are always reflected."""
-        if self._backend() == "device":
+        backend = self._backend()
+        if backend == "mesh":
+            # read path, not the per-tick sweep: the health-only mesh
+            # program (no reply fold, no commit movement) refreshes
+            # the lanes and the fleet totals in one dispatch
+            health, totals = self.mesh_frame.run_health(self)
+            self.health_max_lag = health["max_lag"]
+            self.health_under = health["under_replicated"]
+            self.health_leaderless = health["leaderless"]
+            self._mesh_totals = dict(
+                self._mesh_totals or {}, **totals
+            )
+            return
+        if backend == "device":
             import jax.numpy as jnp
 
             from ..ops.health import health_reduce_jit
@@ -615,14 +927,28 @@ class ShardGroupArrays:
         if len(group_rows):
             fresh = seqs > self.last_seq[group_rows, replica_slots]
             r, s = group_rows[fresh], replica_slots[fresh]
-            pre_m = self.match_index[r, s].copy()
-            pre_f = self.flushed_index[r, s].copy()
-            np.maximum.at(self.match_index, (r, s), last_dirty[fresh])
-            np.maximum.at(self.flushed_index, (r, s), last_flushed[fresh])
-            np.maximum.at(self.last_seq, (r, s), seqs[fresh])
-            moved = (self.match_index[r, s] > pre_m) | (
-                self.flushed_index[r, s] > pre_f
-            )
+            pre_m = self.match_index[r, s]
+            pre_f = self.flushed_index[r, s]
+            # one reply per lane per window is the overwhelming steady
+            # shape: unique (row, slot) pairs fold with plain
+            # gather/scatter maxima. Duplicate pairs (catch-up bursts
+            # re-acking a lane inside one window) take np.maximum.at,
+            # whose unbuffered element loop costs ~10x the vector pair.
+            key = r * self.replica_slots + s
+            if len(key) == 0 or len(np.unique(key)) == len(key):
+                new_m = np.maximum(pre_m, last_dirty[fresh])
+                new_f = np.maximum(pre_f, last_flushed[fresh])
+                self.match_index[r, s] = new_m
+                self.flushed_index[r, s] = new_f
+                self.last_seq[r, s] = seqs[fresh]  # fresh => strictly up
+                moved = (new_m > pre_m) | (new_f > pre_f)
+            else:
+                np.maximum.at(self.match_index, (r, s), last_dirty[fresh])
+                np.maximum.at(self.flushed_index, (r, s), last_flushed[fresh])
+                np.maximum.at(self.last_seq, (r, s), seqs[fresh])
+                moved = (self.match_index[r, s] > pre_m) | (
+                    self.flushed_index[r, s] > pre_f
+                )
             if moved.any():
                 changed_rows.append(r[moved])
             # self-slot movement since the last fold over these rows
@@ -637,9 +963,11 @@ class ShardGroupArrays:
             changed_rows.append(np.flatnonzero(self.quorum_dirty))
             self.quorum_dirty[:] = False
         if not changed_rows:
+            self._last_changed = _EMPTY_ROWS
             return _EMPTY_ROWS
         self.touch()
         rows = np.unique(np.concatenate(changed_rows))
+        self._last_changed = rows
         self._folded_self_m[rows] = self.match_index[rows, SELF_SLOT]
         self._folded_self_f[rows] = self.flushed_index[rows, SELF_SLOT]
 
@@ -655,42 +983,52 @@ class ShardGroupArrays:
         # joint consensus is transient (reconfig windows); skip the
         # old-config quorum sorts when no changed row is joint
         any_joint = bool(voters_old.any())
-        m_cur = self._masked_quorum_np(committed, voters, n_cur)
+        m_cur, d_cur = self._masked_quorum_np2(committed, match, voters, n_cur)
         if any_joint:
             n_old = n_old_all[rows] if n_old_all is not None else (
                 voters_old.sum(axis=-1, dtype=np.int64)
             )
-            m_old = self._masked_quorum_np(committed, voters_old, n_old)
+            m_old, d_old = self._masked_quorum_np2(
+                committed, match, voters_old, n_old
+            )
             majority = np.where(n_old > 0, np.minimum(m_cur, m_old), m_cur)
         else:
             majority = m_cur
         majority = np.minimum(majority, flushed[:, SELF_SLOT])
+        leaders = self.is_leader[rows]
         advance = (
-            self.is_leader[rows]
+            leaders
             & (n_cur > 0)
             & (majority > before)
             & (majority >= self.term_start[rows])
         )
         new_commit = np.where(advance, majority, before)
-        d_cur = self._masked_quorum_np(match, voters, n_cur)
         if any_joint:
-            d_old = self._masked_quorum_np(match, voters_old, n_old)
             majority_dirty = np.where(
                 n_old > 0, np.minimum(d_cur, d_old), d_cur
             )
         else:
             majority_dirty = d_cur
         majority_dirty = np.minimum(majority_dirty, match[:, SELF_SLOT])
+        last_vis = self.last_visible[rows]
         self.last_visible[rows] = np.where(
-            self.is_leader[rows] & (n_cur > 0),
-            np.maximum(
-                self.last_visible[rows],
-                np.maximum(new_commit, majority_dirty),
-            ),
-            self.last_visible[rows],
+            leaders & (n_cur > 0),
+            np.maximum(last_vis, np.maximum(new_commit, majority_dirty)),
+            last_vis,
         )
         self.commit_index[rows] = new_commit
-        self._health_np_rows(rows)
+        # health refresh reuses the lanes this sweep already gathered —
+        # the changed-row gathers are the steady tick's dominant cost
+        # at mesh scale (1M rows: random-row gathers are cache-miss
+        # bound), so never pay them twice in one fold
+        self._health_np_rows(
+            rows,
+            match=match,
+            commit=new_commit,
+            voters=voters,
+            voters_old=voters_old,
+            leaders=leaders,
+        )
         return rows[new_commit > before]
 
     def device_tick(
@@ -715,8 +1053,18 @@ class ShardGroupArrays:
         compiles a handful of shapes total, not one per reply count;
         padding entries carry seq = i64 min, which the fold's
         reply-reordering guard drops (ops.quorum.fold_replies)."""
-        if self._backend() == "host":
+        backend = self._backend()
+        if backend == "host":
             return self.host_tick(
+                group_rows,
+                replica_slots,
+                last_dirty,
+                last_flushed,
+                seqs,
+                force_rows=force_rows,
+            )
+        if backend == "mesh":
+            return self._mesh_tick(
                 group_rows,
                 replica_slots,
                 last_dirty,
@@ -843,8 +1191,29 @@ class ShardGroupArrays:
         is a handful of numpy takes. RP_QUORUM_BACKEND=device routes
         everything through ops.quorum.tick_frame_jit: one compiled
         program produces post-advance state AND the heartbeat vectors,
-        so the payload gather never re-uploads state."""
-        if self._backend() == "host" or hb_rows is None or not len(hb_rows):
+        so the payload gather never re-uploads state.
+        RP_QUORUM_BACKEND=mesh shards the lanes across the device mesh
+        (parallel/mesh_frame): fold/commit/health stay chip-local with
+        one cross-chip totals fold per frame, and the heartbeat gather
+        is served from the host mirrors (chip-local by construction —
+        no device gather traffic at all)."""
+        backend = self._backend()
+        if backend == "mesh":
+            advanced = self._mesh_tick(
+                group_rows,
+                replica_slots,
+                last_dirty,
+                last_flushed,
+                seqs,
+                force_rows=force_rows,
+            )
+            hb = (
+                self._gather_heartbeats(hb_rows)
+                if hb_rows is not None and len(hb_rows)
+                else None
+            )
+            return advanced, hb
+        if backend == "host" or hb_rows is None or not len(hb_rows):
             advanced = self.device_tick(
                 group_rows,
                 replica_slots,
@@ -935,8 +1304,16 @@ class ShardGroupArrays:
         capacity doubling never hands the next tick a fresh trace at
         the new [G, R] shape (the mid-traffic compile stall)."""
         empty = np.array([], np.int64)
+        backend = self._backend()
+        if backend == "mesh":
+            # compile the sharded frame + health programs at the
+            # current capacity (also folds any pending dirty rows,
+            # matching the host/device prewarm semantics)
+            self._mesh_full_frame(empty, empty, empty, empty, empty)
+            self.health_refresh()
+            return
         self.device_tick(empty, empty, empty, empty, empty)
-        if self._backend() == "device":
+        if backend == "device":
             self.frame_tick(
                 empty, empty, empty, empty, empty,
                 hb_rows=np.zeros(1, np.int64),

@@ -47,6 +47,14 @@ class TickFrame:
         self.arrays = arrays
         self.probe = probe
         self._cbs: dict[int, object] = {}
+        # group-keyed callbacks + the placement table: when a table is
+        # attached, changed-row resolution goes (chip, row) → group
+        # through it (the mesh's chip coordinate is derived from the
+        # row's block; a live lane move rebinds both), falling back to
+        # the row-keyed map for rows the table doesn't cover
+        self._gcbs: dict[int, object] = {}
+        self._table = None
+        self._table_shard = 0
         cap = 64
         self._cap = cap
         self._n = 0
@@ -66,14 +74,28 @@ class TickFrame:
         self.max_batch = 0
 
     # -- registration (control plane) ---------------------------------
-    def register(self, row: int, on_advance) -> None:
+    def register(self, row: int, on_advance, group_id: int | None = None) -> None:
         """Route commit advances for `row` to `on_advance` (the
-        group's waiter-resolution residue)."""
+        group's waiter-resolution residue). With `group_id` the
+        callback is also group-keyed, so table-mediated (chip, row) →
+        group resolution survives a lane rebind."""
         self._cbs[int(row)] = on_advance
+        if group_id is not None:
+            self._gcbs[int(group_id)] = on_advance
 
-    def deregister(self, row: int) -> None:
+    def deregister(self, row: int, group_id: int | None = None) -> None:
         self._cbs.pop(int(row), None)
+        if group_id is not None:
+            self._gcbs.pop(int(group_id), None)
         self._force.discard(int(row))
+
+    def attach_table(self, table, shard: int = 0) -> None:
+        """Wire the placement table in: advanced-row residue resolves
+        (chip, row) → group through it from now on. `shard` is this
+        frame's shard id — rows are per-shard, so the reverse lookup
+        keys on it."""
+        self._table = table
+        self._table_shard = int(shard)
 
     @property
     def pending(self) -> int:
@@ -190,6 +212,24 @@ class TickFrame:
         cbs = self._cbs
         # residue loop: ADVANCED rows only (bounded by this window's
         # quorum movements), never a sweep over registered groups
+        table = self._table
+        if table is not None and len(advanced):
+            # (chip, row) → group through the placement table: the
+            # chip is derived from the row's block, and group_at
+            # confirms the row still belongs to the group that bound
+            # it (a live lane move rebinds both atomically under the
+            # frame's single-threaded event loop)
+            chips = self.arrays.chip_of_rows(advanced)
+            gcbs = self._gcbs
+            shard = self._table_shard
+            for c, r in zip(chips, advanced):
+                gid = table.group_at(int(c), int(r), shard)
+                cb = gcbs.get(gid) if gid is not None else None
+                if cb is None:
+                    cb = cbs.get(int(r))
+                if cb is not None:
+                    cb()
+            return advanced
         for r in advanced:
             cb = cbs.get(int(r))
             if cb is not None:

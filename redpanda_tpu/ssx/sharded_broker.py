@@ -514,6 +514,7 @@ class PartitionShard:
         while True:
             await asyncio.sleep(0.2)
             hints = []
+            arrays = self.group_manager.arrays
             for ntp, p in self.partition_manager.partitions().items():
                 c = p.consensus
                 leader = c.leader_id
@@ -530,6 +531,7 @@ class PartitionShard:
                         term=state[0],
                         leader=state[1],
                         row=state[2],
+                        chip=arrays.chip_of(state[2]),
                     )
                 )
             if not hints:
@@ -1153,7 +1155,7 @@ class ShardedBroker:
         for raw in batch.hints:
             h = LeaderHint.decode(bytes(raw))
             ntp = _ntp_of(h.ns, h.topic, h.partition)
-            table.bind_lane(h.group, h.row)
+            table.bind_lane(h.group, h.row, chip=h.chip)
             if h.leader >= 0:
                 md.apply_hint(ntp, int(h.term), int(h.leader))
         return b""

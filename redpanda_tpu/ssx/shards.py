@@ -94,8 +94,25 @@ class ShardReady(Envelope):
 # Placement moved to its own layer (PR 12): the deterministic
 # group → shard hash lives in placement/table.py and actual routing
 # goes through the PlacementTable, which live moves can rebind.
-# Re-exported here only for legacy callers/tests of the v1 name.
-from ..placement.table import compute_shard as shard_of  # noqa: E402, F401
+# The v1 `shard_of` name survives only as a deprecation shim (module
+# __getattr__, so importing it warns); rplint RPL017 forbids new uses.
+
+
+def __getattr__(name: str):
+    if name == "shard_of":
+        import warnings
+
+        warnings.warn(
+            "ssx.shards.shard_of is deprecated: placement is decided by "
+            "placement.PlacementTable (use placement.table.compute_shard "
+            "only for the new-group default)",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+        from ..placement.table import compute_shard
+
+        return compute_shard
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def pin_to_core(shard_id: int) -> Optional[int]:

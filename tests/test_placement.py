@@ -90,15 +90,27 @@ def test_table_map_lane_epoch():
     assert t.group_of(ntp) == 11
     t.bind_lane(11, 5)
     assert t.lane_for(11) == 5
+    # back-compat: bare bind_lane lands on chip 0
+    assert t.chip_lane_for(11) == (0, 5)
+    assert t.group_at(0, 5, shard=2) == 11
     t.record_move(ntp, 11, 3)
     assert t.shard_for(ntp) == 3
     assert t.moves_executed == 1
     assert t.epoch == e0 + 2
     [entry] = t.entries()
-    assert entry == {"ntp": "kafka/a/0", "group": 11, "shard": 3, "lane": 5}
+    assert entry == {
+        "ntp": "kafka/a/0", "group": 11, "shard": 3, "lane": 5, "chip": 0,
+    }
     assert t.counts() == {3: 1}
+    # lane rebind onto another chip: old (chip, row) key released
+    t.bind_lane(11, 9, chip=3)
+    assert t.chip_lane_for(11) == (3, 9)
+    assert t.group_at(0, 5, shard=2) is None
+    assert t.group_at(3, 9, shard=3) == 11
     t.bind_lane(11, -1)  # source freed its row
     assert t.lane_for(11) is None
+    assert t.chip_lane_for(11) is None
+    assert t.group_at(3, 9, shard=3) is None
     t.erase(ntp, 11)
     assert t.shard_for(ntp) is None
     assert t.shard_for_group(11) is None
@@ -324,6 +336,96 @@ async def _budget_exhaustion_blocks_moves(tmp_path):
         clock[0] = 31.0  # window slides: the move back is admitted
         out = await mover.move(ntp, 0)
         assert out["moved"] and table.shard_for(ntp) == 0
+
+
+async def _lane_move_across_chips(tmp_path, monkeypatch):
+    monkeypatch.setenv("RP_QUORUM_BACKEND", "mesh")
+    monkeypatch.setenv("RP_MESH_DEVICES", "2")
+    broker = Broker(
+        BrokerConfig(
+            node_id=0,
+            data_dir=str(tmp_path / "lane"),
+            members=[0],
+            election_timeout_s=0.15,
+            heartbeat_interval_s=0.03,
+        ),
+        loopback=LoopbackNetwork(),
+    )
+    await broker.start()
+    try:
+        ntp = kafka_ntp("lane", 0)
+        p = await _seed_partition(broker, ntp)
+        committed = _record_values(p.log)
+        arrays = broker.group_manager.arrays
+        assert arrays.chip_count() == 2
+        host = MoveHost(
+            broker.partition_manager,
+            broker.group_manager,
+            broker.storage.log_mgr,
+        )
+        # the broker's own table — already attached to the tick frame,
+        # so the post-move replicate exercises (chip, row) → group
+        # residue resolution through the REBOUND binding
+        table = broker.shard_table
+        table.insert(ntp, GROUP, shard=0)
+        src_row = p.consensus.row
+        src_chip = arrays.chip_of(src_row)
+        table.bind_lane(GROUP, src_row, chip=src_chip)
+        mover = PartitionMover(
+            table, host, budget=MoveBudget(moves_per_window=100)
+        )
+        dst_chip = 1 - src_chip
+
+        def arm(stage):
+            def hook(s):
+                if s == stage:
+                    raise MoveFault(f"injected at {s}")
+            host.fault = hook
+
+        alloc_before = arrays._alloc_count
+        for stage in (
+            "lane_freeze", "lane_evacuate", "lane_adopt", "lane_rebind"
+        ):
+            arm(stage)
+            with pytest.raises(MoveError):
+                await mover.move_lane(ntp, dst_chip)
+            host.fault = None
+            # rollback: same row, no leaked staged rows, still serving
+            assert p.consensus.row == src_row, stage
+            assert arrays._alloc_count == alloc_before, stage
+            assert table.chip_lane_for(GROUP) == (src_chip, src_row), stage
+            await _wait_leader(p)
+            await p.replicate(
+                data_batch(b"post-%s-" % stage.encode()), acks=-1
+            )
+            committed.append(b"post-%s-0" % stage.encode())
+            assert _record_values(p.log) == committed, stage
+        assert mover.stats.rolled_back == 4
+
+        out = await mover.move_lane(ntp, dst_chip)
+        assert out["moved"] and out["to_chip"] == dst_chip
+        new_row = p.consensus.row
+        assert new_row != src_row
+        assert arrays.chip_of(new_row) == dst_chip
+        assert arrays._alloc_count == alloc_before  # src freed
+        assert table.chip_lane_for(GROUP) == (dst_chip, new_row)
+        assert table.group_at(dst_chip, new_row) == GROUP
+        assert table.group_at(src_chip, src_row) is None
+        # the rebound lane still serves: quorum advance + the
+        # table-mediated commit-advance residue both work post-rebind
+        await _wait_leader(p)
+        await p.replicate(data_batch(b"post-move-"), acks=-1)
+        committed.append(b"post-move-0")
+        assert _record_values(p.log) == committed
+        # idempotence: moving to the chip it lives on is a no-op
+        out2 = await mover.move_lane(ntp, dst_chip)
+        assert not out2["moved"] and out2["chip"] == dst_chip
+    finally:
+        await broker.stop()
+
+
+def test_lane_move_fault_matrix(tmp_path, monkeypatch):
+    asyncio.run(_lane_move_across_chips(tmp_path, monkeypatch))
 
 
 def test_budget_exhaustion_blocks_moves(tmp_path):

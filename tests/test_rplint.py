@@ -1753,3 +1753,89 @@ def test_cli_explain():
         capture_output=True, text=True, cwd=REPO_ROOT,
     )
     assert bad.returncode == 2
+
+
+# -- RPL018: mesh discipline -------------------------------------------
+
+RPL018_PUT_IN_TICK = """
+    class ShardFrame:
+        def frame_tick(self, rows):
+            placed = jax.device_put(self.commit_index)
+            return self.program(placed, rows)
+"""
+
+
+def test_rpl018_device_put_in_tick_fn(tmp_path):
+    (f,) = _only(
+        _lint_source(tmp_path, RPL018_PUT_IN_TICK, "raft/mod.py"),
+        "RPL018",
+    )
+    assert "device_put" in f.message and "one cross-chip fold" in f.message
+    assert f.qualname == "ShardFrame.frame_tick"
+
+
+def test_rpl018_tick_frame_module_covered_everywhere(tmp_path):
+    src = """
+        class TickFrame:
+            def drain(self, out):
+                out.block_until_ready()
+                return jax.device_get(out)
+    """
+    found = _only(
+        _lint_source(tmp_path, src, "raft/tick_frame.py"), "RPL018"
+    )
+    assert {f.message.split(" in a per-tick")[0] for f in found} == {
+        ".block_until_ready()", "device_get"
+    }
+
+
+def test_rpl018_ops_and_parallel_exempt(tmp_path):
+    for rel in ("ops/mod.py", "parallel/mesh_frame.py"):
+        assert (
+            _only(_lint_source(tmp_path, RPL018_PUT_IN_TICK, rel), "RPL018")
+            == []
+        )
+
+
+def test_rpl018_shard_state_tick_paths_covered(tmp_path):
+    # unlike RPL011, the SoA owner is NOT exempt: its tick methods are
+    # exactly where a steady-path transfer would hide
+    (f,) = _only(
+        _lint_source(tmp_path, RPL018_PUT_IN_TICK, "raft/shard_state.py"),
+        "RPL018",
+    )
+    assert f.qualname == "ShardFrame.frame_tick"
+
+
+def test_rpl018_fold_now_covered_non_tick_clean(tmp_path):
+    src = """
+        class TickFrame:
+            def fold_now(self, rows):
+                return jax.device_put(rows)
+    """
+    (f,) = _only(_lint_source(tmp_path, src, "ssx/mod.py"), "RPL018")
+    assert f.qualname == "TickFrame.fold_now"
+    control_plane = RPL018_PUT_IN_TICK.replace("def frame_tick", "def prewarm")
+    assert (
+        _only(
+            _lint_source(tmp_path, control_plane, "raft/mod.py"), "RPL018"
+        )
+        == []
+    )
+
+
+def test_rpl018_suppression(tmp_path):
+    src = RPL018_PUT_IN_TICK.replace(
+        "placed = jax.device_put(self.commit_index)",
+        "placed = jax.device_put(self.commit_index)  # rplint: disable=RPL018",
+    )
+    assert (
+        _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL018") == []
+    )
+
+
+def test_rpl018_baseline_is_empty():
+    """Mesh discipline is fully enforced from day one: nothing
+    grandfathered."""
+    baseline = load_baseline()
+    assert [k for k in baseline if k.endswith("::RPL018")] == []

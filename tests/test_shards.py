@@ -24,12 +24,12 @@ from redpanda_tpu.raft import GroupManager, Role
 from redpanda_tpu.rpc import LoopbackNetwork, LoopbackTransport
 from redpanda_tpu.rpc.server import RpcServer
 from redpanda_tpu.rpc.transport import TcpTransport
+from redpanda_tpu.placement.table import compute_shard
 from redpanda_tpu.ssx import (
     InvokeError,
     ShardRuntime,
     bind_reuse_port,
     reserve_reuse_port,
-    shard_of,
 )
 
 
@@ -60,9 +60,9 @@ def test_shard_of_is_stable_and_in_range():
     for n in (2, 3, 4, 8):
         seen = set()
         for g in range(1, 500):
-            s = shard_of(g, n)
+            s = compute_shard(g, n)
             assert 0 <= s < n
-            assert s == shard_of(g, n)  # pure: same inputs, same shard
+            assert s == compute_shard(g, n)  # pure: same inputs, same shard
             seen.add(s)
         # every shard gets work under a dense group-id space
         assert seen == set(range(n))
@@ -70,10 +70,27 @@ def test_shard_of_is_stable_and_in_range():
 
 def test_shard_of_degenerate_inputs_pin_to_shard0():
     # no shards / single shard / controller-style non-positive groups
-    assert shard_of(7, 1) == 0
-    assert shard_of(7, 0) == 0
-    assert shard_of(0, 4) == 0
-    assert shard_of(-3, 4) == 0
+    assert compute_shard(7, 1) == 0
+    assert compute_shard(7, 0) == 0
+    assert compute_shard(0, 4) == 0
+    assert compute_shard(-3, 4) == 0
+
+
+def test_shard_of_legacy_name_warns_and_still_computes():
+    """The v1 `shard_of` re-export is a deprecation shim now: every
+    use warns, routes to compute_shard, and rplint RPL017 forbids new
+    call sites."""
+    import warnings
+
+    from redpanda_tpu import ssx
+    from redpanda_tpu.ssx import shards as ssx_shards
+
+    for mod in (ssx, ssx_shards):
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            fn = mod.shard_of
+        assert [w for w in caught if w.category is DeprecationWarning]
+        assert fn(7331, 4) == compute_shard(7331, 4)
 
 
 # ------------------------------------------------- invoke_on round-trip

@@ -45,9 +45,12 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # smaller-is-better ("skew" is the placement layer's cross-shard load
 # skew index, 1.0 = balanced — a rebalance that leaves the fleet MORE
 # skewed than the trajectory is a regression the same way a latency
-# bump is); other units are reported but not graded
+# bump is; "x_wall_*" is a flatness ratio — per-tick wall growth for
+# an NX group-count step, e.g. the replicated_tick and mesh_flat
+# steady ratios — where growing past the trajectory means the plane
+# got LESS flat); other units are reported but not graded
 _THROUGHPUT_RE = re.compile(r"/s$|bps$", re.IGNORECASE)
-_LAT_RE = re.compile(r"^(ns|us|ms|s|skew)$", re.IGNORECASE)
+_LAT_RE = re.compile(r"^(ns|us|ms|s|skew)$|^x_wall", re.IGNORECASE)
 
 
 def _direction(unit: str) -> int:
@@ -199,6 +202,45 @@ def gate(fresh: dict, history: list, tolerance: float) -> tuple[list, list]:
 
 
 def selftest(pattern: str, tolerance: float) -> int:
+    # unit-direction contract first: the mesh_flat block grades three
+    # lower-better families (x_wall_* flatness ratios, fold µs, lane
+    # skew) next to the existing throughput/latency units
+    unit_cases = {
+        "GB/s": 1, "records/s": 1, "mbps": 1,
+        "ns": -1, "us": -1, "ms": -1, "skew": -1,
+        "x_wall_for_10x_groups": -1, "x_wall_for_20x_groups": -1,
+        "count": 0, "": 0,
+    }
+    for unit, want in unit_cases.items():
+        if _direction(unit) != want:
+            print(f"bench_gate selftest: unit '{unit}' graded "
+                  f"{_direction(unit)}, want {want}", file=sys.stderr)
+            return 2
+    # synthetic mesh_flat round: grading must hold even before the
+    # trajectory carries the mesh metrics
+    mesh_round = {
+        "mesh_flat_steady_ratio_1000000_partitions":
+            {"value": 1.5, "unit": "x_wall_for_10x_groups"},
+        "mesh_full_fold_us_1000000_partitions":
+            {"value": 600000.0, "unit": "us"},
+        "mesh_lane_balance_skew_1000000_partitions":
+            {"value": 1.0, "unit": "skew"},
+    }
+    mesh_hist = [(0, "synthetic-mesh", mesh_round)]
+    _, failures = gate(dict(mesh_round), mesh_hist, tolerance)
+    if failures:
+        print("bench_gate selftest: identical mesh summary failed:\n"
+              + "\n".join(failures), file=sys.stderr)
+        return 2
+    worse = {k: {**m, "value": m["value"] * (1 + 2 * tolerance)}
+             for k, m in mesh_round.items()}
+    _, failures = gate(worse, mesh_hist, tolerance)
+    if len(failures) != len(mesh_round):
+        print(f"bench_gate selftest: only {len(failures)}/"
+              f"{len(mesh_round)} degraded mesh metrics caught",
+              file=sys.stderr)
+        return 2
+
     history = load_history(pattern)
     if not history:
         print(f"bench_gate selftest: no trajectory matched {pattern}",
@@ -216,17 +258,29 @@ def selftest(pattern: str, tolerance: float) -> int:
         print("bench_gate selftest: identical summary failed the gate:\n"
               + "\n".join(failures), file=sys.stderr)
         return 2
-    # ...and one regressed far past tolerance must fail
-    name, m = sorted(graded.items())[0]
-    factor = (1 - 2 * tolerance) if _direction(m["unit"]) > 0 else (1 + 2 * tolerance)
-    bad = {**latest, name: {**m, "value": m["value"] * factor}}
-    _, failures = gate(bad, history, tolerance)
-    if not failures:
-        print(f"bench_gate selftest: regressed '{name}' slipped through",
-              file=sys.stderr)
-        return 2
+    # ...and a regression far past tolerance must fail — one probe per
+    # distinct unit, so every graded unit family in the trajectory is
+    # exercised in its bad direction
+    probes = {}
+    for name, m in sorted(graded.items()):
+        probes.setdefault(m["unit"], (name, m))
+    caught = []
+    for unit, (name, m) in sorted(probes.items()):
+        factor = (
+            (1 - 2 * tolerance) if _direction(unit) > 0
+            else (1 + 2 * tolerance)
+        )
+        bad = {**latest, name: {**m, "value": m["value"] * factor}}
+        _, failures = gate(bad, history, tolerance)
+        if not failures:
+            print(f"bench_gate selftest: regressed '{name}' ({unit}) "
+                  "slipped through", file=sys.stderr)
+            return 2
+        caught.append(name)
     print(f"bench_gate selftest: ok ({len(history)} rounds, "
-          f"{len(graded)} graded metrics, regression on '{name}' caught)")
+          f"{len(graded)} graded metrics, {len(mesh_round)} synthetic "
+          f"mesh metrics, regressions caught on {len(caught)} unit "
+          f"probes: {', '.join(caught)})")
     return 0
 
 

@@ -1,0 +1,221 @@
+"""verify.sh mesh smoke: the mesh replication backend on a LIVE
+2-broker cluster, not just lane replays.
+
+Two legs, selected by RP_QUORUM_BACKEND (the verify.sh legs set it):
+
+  * mesh leg (RP_QUORUM_BACKEND=mesh, 8 forced host devices): boot two
+    brokers over loopback RPC, produce acks=-1 into every partition
+    with RP_MESH_FULL=1 so every fold runs the REAL NamedSharding
+    program, and assert (a) the mesh is actually live (chip_count > 1,
+    per-chip lane attribution sums to the active groups, the one
+    cross-chip totals fold ran), then (b) replay the identical
+    scenario under RP_QUORUM_BACKEND=host and require byte-identical
+    fetch ledgers and end offsets — the live-cluster analog of the
+    tick_frame_smoke --parity lane replay.
+
+  * stand-down leg (RP_QUORUM_BACKEND=host): same live scenario, then
+    assert the mesh machinery stayed COLD — chip_count() == 1 and the
+    MeshFrame was never constructed — so the default path cannot
+    silently pay mesh placement costs.
+
+Exit 0 = the selected backend serves real replicated traffic with the
+same committed bytes the host oracle produces.
+"""
+
+import asyncio
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# must precede any jax import (the brokers import it lazily); verify.sh
+# passes these too, but the tool has to be runnable standalone
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+TOPIC = "meshsmoke"
+N_PARTITIONS = 4
+RECORDS_PER_PARTITION = 24
+
+
+async def run_scenario(backend: str, mesh_full: bool) -> dict:
+    """One full live run under `backend`: 2 brokers, rf=1 topic,
+    produce + fetch everything back. Returns the user-visible ledger
+    (bytes per partition) plus the broker-side mesh observations."""
+    from redpanda_tpu.app import Broker, BrokerConfig
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+    os.environ["RP_QUORUM_BACKEND"] = backend
+    if mesh_full:
+        os.environ["RP_MESH_FULL"] = "1"
+    else:
+        os.environ.pop("RP_MESH_FULL", None)
+
+    tmp = tempfile.mkdtemp(prefix=f"mesh_smoke_{backend}_")
+    net = LoopbackNetwork()
+    members = [0, 1]
+    brokers = [
+        Broker(
+            BrokerConfig(
+                node_id=i,
+                data_dir=os.path.join(tmp, f"node{i}"),
+                members=members,
+                election_timeout_s=0.15,
+                heartbeat_interval_s=0.03,
+            ),
+            loopback=net,
+        )
+        for i in members
+    ]
+    try:
+        for b in brokers:
+            await b.start()
+        addrs = {b.node_id: b.kafka_advertised for b in brokers}
+        for b in brokers:
+            b.config.peer_kafka_addresses = addrs
+        await brokers[0].wait_controller_leader()
+
+        c = KafkaClient([b.kafka_advertised for b in brokers])
+        try:
+            deadline = time.monotonic() + 30
+            while True:
+                try:
+                    # rf must be odd; with 2 brokers the partitions
+                    # spread across both nodes at rf=1, which is the
+                    # point: both brokers' tick frames serve traffic
+                    await c.create_topic(
+                        TOPIC,
+                        partitions=N_PARTITIONS,
+                        replication_factor=1,
+                    )
+                    break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise
+                    await asyncio.sleep(0.2)
+
+            for p in range(N_PARTITIONS):
+                for i in range(0, RECORDS_PER_PARTITION, 8):
+                    batch = [
+                        (b"k%06d" % (i + j), b"v%d.%d" % (p, i + j))
+                        for j in range(8)
+                    ]
+                    while True:
+                        try:
+                            await c.produce(TOPIC, p, batch, acks=-1)
+                            break
+                        except Exception:
+                            if time.monotonic() > deadline:
+                                raise
+                            await asyncio.sleep(0.2)
+
+            ledger: dict[int, bytes] = {}
+            ends: dict[int, int] = {}
+            for p in range(N_PARTITIONS):
+                rows = []
+                off = 0
+                while True:
+                    got = await c.fetch(TOPIC, p, off)
+                    if not got:
+                        break
+                    rows.extend(got)
+                    off = rows[-1][0] + 1
+                assert len(rows) == RECORDS_PER_PARTITION, (
+                    f"{backend}: partition {p} fetched {len(rows)} rows, "
+                    f"expected {RECORDS_PER_PARTITION}"
+                )
+                ledger[p] = b"|".join(
+                    b"%d:%s:%s" % (o, k, v) for o, k, v in rows
+                )
+                ends[p] = await c.list_offset(TOPIC, p, -1)
+        finally:
+            await c.close()
+
+        mesh = []
+        for b in brokers:
+            arrays = b.group_manager.arrays
+            mesh.append(
+                {
+                    "node": b.node_id,
+                    "chips": arrays.chip_count(),
+                    "attribution": arrays.lane_attribution(),
+                    "totals": arrays.mesh_totals(),
+                    "mesh_cold": arrays._mesh_frame is None,
+                    "active_groups": int(arrays.row_active.sum()),
+                }
+            )
+        return {"ledger": ledger, "ends": ends, "mesh": mesh}
+    finally:
+        for b in brokers:
+            await b.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+async def main() -> None:
+    backend = os.environ.get("RP_QUORUM_BACKEND", "host")
+
+    if backend == "mesh":
+        got = await run_scenario("mesh", mesh_full=True)
+        for m in got["mesh"]:
+            assert m["chips"] > 1, (
+                f"node {m['node']}: mesh backend selected but "
+                f"chip_count() == {m['chips']} — forced devices not live"
+            )
+            per_chip = sum(a["groups"] for a in m["attribution"])
+            assert per_chip == m["active_groups"], (
+                f"node {m['node']}: per-chip lane attribution "
+                f"({per_chip}) != active groups ({m['active_groups']})"
+            )
+            assert m["active_groups"] > 0, f"node {m['node']}: no groups"
+            # acks=-1 produce drove folds through the forced full mesh
+            # frame: the one cross-chip totals fold must have run
+            assert m["totals"] is not None, (
+                f"node {m['node']}: no mesh totals — the full mesh "
+                "frame never ran despite RP_MESH_FULL=1"
+            )
+
+        # parity replay: identical scenario, host oracle backend
+        want = await run_scenario("host", mesh_full=False)
+        assert got["ledger"] == want["ledger"], (
+            "fetch ledger diverged mesh vs host: "
+            + ", ".join(
+                f"p{p}" for p in got["ledger"]
+                if got["ledger"][p] != want["ledger"].get(p)
+            )
+        )
+        assert got["ends"] == want["ends"], (
+            f"end offsets diverged mesh vs host: "
+            f"{got['ends']} != {want['ends']}"
+        )
+        chips = got["mesh"][0]["chips"]
+        print(
+            f"MESH-SMOKE-OK: mesh backend ({chips} chips), "
+            f"{N_PARTITIONS}x{RECORDS_PER_PARTITION} records rf=1, "
+            "fetch ledger + end offsets byte-identical vs host"
+        )
+        return
+
+    got = await run_scenario(backend, mesh_full=False)
+    for m in got["mesh"]:
+        assert m["chips"] == 1, (
+            f"node {m['node']}: chip_count() == {m['chips']} under "
+            f"RP_QUORUM_BACKEND={backend} — stand-down leaked mesh"
+        )
+        assert m["mesh_cold"], (
+            f"node {m['node']}: MeshFrame was constructed under "
+            f"RP_QUORUM_BACKEND={backend} — the default path must "
+            "never touch mesh placement"
+        )
+    print(
+        f"MESH-SMOKE-OK: {backend} stand-down, "
+        f"{N_PARTITIONS}x{RECORDS_PER_PARTITION} records rf=1, "
+        "mesh machinery cold"
+    )
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

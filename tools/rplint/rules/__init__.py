@@ -19,6 +19,7 @@ from .rpl014_clock_discipline import ClockDisciplineRule
 from .rpl015_await_atomicity import AwaitAtomicityRule
 from .rpl016_lock_consistency import LockConsistencyRule
 from .rpl017_placement_discipline import PlacementDisciplineRule
+from .rpl018_mesh_discipline import MeshDisciplineRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -38,6 +39,7 @@ ALL_RULES = [
     AwaitAtomicityRule,
     LockConsistencyRule,
     PlacementDisciplineRule,
+    MeshDisciplineRule,
 ]
 
 __all__ = ["ALL_RULES"]

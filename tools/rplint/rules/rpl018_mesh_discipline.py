@@ -1,0 +1,111 @@
+"""RPL018 — mesh discipline: no host↔device transfers inside the
+per-tick path outside ops/ + parallel/.
+
+The mesh backend's contract is ONE cross-chip fold per tick frame: all
+lane math stays chip-local, totals reduce once, and the only code
+allowed to stage transfers (`jax.device_put`), read results back
+(`jax.device_get` / `np.array(x)` on a device array), or synchronize
+(`.block_until_ready()`) is the device-program layer itself — `ops/`
+(the kernels) and `parallel/` (mesh placement + the compiled frame).
+
+A `device_put` smuggled into a tick method elsewhere is a per-tick
+host→device copy that rides the steady path forever: at 1M partitions
+it's the difference between the flat per-tick wall the bench grades
+and a transfer-bound plane that degrades with every chip added. Same
+for `.block_until_ready()` — a sneaky full-pipeline sync point that
+serializes the frame against every in-flight program.
+
+Scope — the per-tick code paths, everywhere under redpanda_tpu/
+EXCEPT `ops/` and `parallel/`:
+
+  * `raft/tick_frame.py`, every scope (the batching seam itself)
+  * functions whose name contains "tick" (host_tick, device_tick,
+    frame_tick, _mesh_tick, heartbeat ticks, ...) or is `fold_now`
+    (the frame entry the heartbeat plane drives)
+
+Flagged inside those scopes: any reference to `device_put` or
+`device_get` (bare or dotted) and any `.block_until_ready` access.
+
+Suppress a deliberate exception with `# rplint: disable=RPL018`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext
+
+EXAMPLE = """\
+class ShardFrame:
+    def frame_tick(self, rows):
+        placed = jax.device_put(self.commit_index)   # RPL018
+        out = self._program(placed, rows)
+        out.block_until_ready()                      # RPL018
+        return jax.device_get(out)                   # RPL018
+"""
+
+_TRANSFER_NAMES = {"device_put", "device_get"}
+_SYNC_ATTR = "block_until_ready"
+_EXEMPT_DIRS = {"ops", "parallel"}
+_TICK_FN_NAMES = {"fold_now"}
+
+
+def _path_parts(path: str) -> list[str]:
+    return path.replace("\\", "/").split("/")
+
+
+def _transfer_ref(node: ast.AST) -> str | None:
+    """The offending transfer/sync name referenced by `node`, or
+    None."""
+    if isinstance(node, ast.Name) and node.id in _TRANSFER_NAMES:
+        return node.id
+    if isinstance(node, ast.Attribute):
+        if node.attr in _TRANSFER_NAMES:
+            return node.attr
+        if node.attr == _SYNC_ATTR:
+            return f".{_SYNC_ATTR}()"
+    return None
+
+
+class MeshDisciplineRule:
+    code = "RPL018"
+    name = "mesh-discipline"
+
+    def check(self, ctx: ModuleContext):
+        parts = _path_parts(ctx.path)
+        fname = parts[-1]
+        if _EXEMPT_DIRS.intersection(parts):
+            return
+        # (scope, root) pairs: whole file for the seam module,
+        # tick-named functions everywhere else
+        scopes = []
+        if fname == "tick_frame.py":
+            scopes.append(("", ctx.tree))
+        else:
+            for fn in ctx.functions():
+                name = fn.node.name
+                if "tick" in name.lower() or name in _TICK_FN_NAMES:
+                    scopes.append((fn.qualname, fn.node))
+        seen: set[int] = set()
+        for qualname, root in scopes:
+            for node in ast.walk(root):
+                ref = _transfer_ref(node)
+                if ref is None or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                if ctx.suppressed(node, self.code):
+                    continue
+                yield Finding(
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.code,
+                    message=(
+                        f"{ref} in a per-tick path outside ops/ + "
+                        "parallel/ — the mesh plane does exactly one "
+                        "cross-chip fold per frame; host↔device "
+                        "transfers belong in the device-program layer "
+                        "(ops/, parallel/), not on the tick"
+                    ),
+                    qualname=qualname,
+                )

@@ -77,6 +77,14 @@ echo "== flight-data stand-down smoke (RP_ALERTS=0 RP_PROFILE=0) =="
 env JAX_PLATFORMS=cpu RP_ALERTS=0 RP_PROFILE=0 \
     python tools/scrape_smoke.py --alerts
 
+echo "== mesh backend smoke (8 forced devices, live parity vs host) =="
+env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    RP_QUORUM_BACKEND=mesh python tools/mesh_smoke.py
+
+echo "== mesh stand-down smoke (RP_QUORUM_BACKEND=host) =="
+env JAX_PLATFORMS=cpu RP_QUORUM_BACKEND=host python tools/mesh_smoke.py
+
 echo "== tracing-off smoke (RP_TRACE=0) =="
 env JAX_PLATFORMS=cpu RP_TRACE=0 python tools/scrape_smoke.py --fleet
 exec env JAX_PLATFORMS=cpu RP_TRACE=0 python -m pytest \
