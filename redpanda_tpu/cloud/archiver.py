@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import TYPE_CHECKING, Callable, Optional
 
 from ..cluster import archival_stm
@@ -35,6 +36,30 @@ if TYPE_CHECKING:  # pragma: no cover
     from ..cluster.partition import Partition
 
 logger = logging.getLogger("cloud.archiver")
+
+
+def _archive_compression() -> str:
+    """Segment-object compression for uploads: RP_ARCHIVE_COMPRESSION=
+    zstd compresses each segment on the way to the store (through the
+    registry, so RP_ZSTD_BACKEND=tpu makes it the fused device path);
+    default "none" stores segments verbatim. Read at call time so the
+    bench A/B and tests flip it per-pass. Decoding is driven by the
+    manifest's per-segment size_compressed, NOT this knob — mixed
+    buckets (some segments compressed, some not) always hydrate
+    correctly."""
+    return os.environ.get("RP_ARCHIVE_COMPRESSION", "none").strip().lower()
+
+
+def _compress_segment(data: bytes) -> bytes:
+    from .. import compression
+
+    return compression.compress(data, compression.CompressionType.zstd)
+
+
+def _uncompress_segment(blob: bytes) -> bytes:
+    from .. import compression
+
+    return compression.uncompress(blob, compression.CompressionType.zstd)
 
 
 class NtpArchiver:
@@ -296,26 +321,50 @@ class NtpArchiver:
         try:
             for m in run:
                 data = await self.store.get(f"{prefix}/{m.name}")
-                if len(data) != int(m.size_bytes):
+                # the stored object is size_compressed bytes when the
+                # segment was archived compressed, size_bytes otherwise
+                comp = int(getattr(m, "size_compressed", 0))
+                want = comp or int(m.size_bytes)
+                if len(data) != want:
                     logger.warning(
                         "%s: merge aborted: %s is %d bytes, manifest "
                         "says %d",
                         ntp,
                         m.name,
                         len(data),
-                        m.size_bytes,
+                        want,
                     )
                     return 0
+                if comp:
+                    data = _uncompress_segment(data)
+                    if len(data) != int(m.size_bytes):
+                        logger.warning(
+                            "%s: merge aborted: %s inflates to %d "
+                            "bytes, manifest says %d",
+                            ntp,
+                            m.name,
+                            len(data),
+                            m.size_bytes,
+                        )
+                        return 0
                 datas.append(data)
-        except StoreError as e:
+        except (StoreError, ValueError) as e:
             logger.warning("%s: merge download failed: %s", ntp, e)
             return 0
         first, last = run[0], run[-1]
+        body = b"".join(datas)
+        blob = body
+        size_compressed = 0
+        suffix = "m.seg"
+        if _archive_compression() == "zstd":
+            blob = _compress_segment(body)
+            size_compressed = len(blob)
+            suffix = "m.seg.zst"
         merged = SegmentMeta(
             base_offset=first.base_offset,
             last_offset=last.last_offset,
             term=last.term,
-            size_bytes=sum(len(d) for d in datas),
+            size_bytes=len(body),
             base_timestamp=first.base_timestamp,
             max_timestamp=max(int(m.max_timestamp) for m in run),
             delta_offset=first.delta_offset,
@@ -324,11 +373,13 @@ class NtpArchiver:
             # a re-run of the same merge recreates the same name with
             # identical content, so the orphan window is idempotent
             name_hint=(
-                f"{first.base_offset}-{last.last_offset}-{last.term}.m.seg"
+                f"{first.base_offset}-{last.last_offset}-{last.term}"
+                f".{suffix}"
             ),
+            size_compressed=size_compressed,
         )
         try:
-            await self.store.put(f"{prefix}/{merged.name}", b"".join(datas))
+            await self.store.put(f"{prefix}/{merged.name}", blob)
             await self._replicate_cmd(archival_stm.REPLACE, merged.encode())
             self.partition.archival.apply_committed(
                 p.consensus.commit_index
@@ -422,6 +473,16 @@ class NtpArchiver:
             delta = (
                 (base - 1) - p.translator.to_kafka(base - 1) if base > 0 else 0
             )
+            # size_bytes stays the LOGICAL segment size (retention math,
+            # batch-walk offsets); the object body may be a zstd frame
+            # whose length the manifest records as size_compressed
+            blob = data
+            size_compressed = 0
+            name_hint = ""
+            if _archive_compression() == "zstd":
+                blob = _compress_segment(data)
+                size_compressed = len(blob)
+                name_hint = f"{base}-{seg.term}.seg.zst"
             meta = SegmentMeta(
                 base_offset=base,
                 last_offset=seg.dirty_offset,
@@ -433,6 +494,8 @@ class NtpArchiver:
                 delta_offset_end=(
                     seg.dirty_offset - p.translator.to_kafka(seg.dirty_offset)
                 ),
+                name_hint=name_hint,
+                size_compressed=size_compressed,
             )
             ntp = p.ntp
             seg_key = (
@@ -440,14 +503,14 @@ class NtpArchiver:
                 f"/{meta.name}"
             )
             try:
-                await self.store.put(seg_key, data)
+                await self.store.put(seg_key, blob)
                 # fault-atomicity: verify the object landed whole BEFORE
                 # any manifest/stm references it. A faulty backend can
                 # persist a truncated body and still error (the retry
                 # loop then re-puts), or — worse — ack a short object;
                 # the head check catches both, one re-upload heals it.
                 size = await self.store.head(seg_key)
-                if size != len(data):
+                if size != len(blob):
                     if self.on_degraded is not None:
                         self.on_degraded("partial_upload")
                     logger.warning(
@@ -456,14 +519,14 @@ class NtpArchiver:
                         p.ntp,
                         meta.name,
                         size,
-                        len(data),
+                        len(blob),
                     )
-                    await self.store.put(seg_key, data)
+                    await self.store.put(seg_key, blob)
                     size = await self.store.head(seg_key)
-                    if size != len(data):
+                    if size != len(blob):
                         raise StoreError(
                             f"segment {meta.name} truncated in store "
-                            f"({size}/{len(data)} bytes) after re-upload"
+                            f"({size}/{len(blob)} bytes) after re-upload"
                         )
                 # replicate FIRST: the archived fact must be raft-agreed
                 # before anything (retention!) can act on it. A crash

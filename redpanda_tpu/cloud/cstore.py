@@ -39,6 +39,9 @@ _FIELDS = (
     "max_timestamp",
     "delta_offset",
     "delta_offset_end",
+    # appended in manifest v3 (device-zstd archival): MUST stay last —
+    # _Chunk.kfirst hardcodes delta_offset at column index 6
+    "size_compressed",
 )
 _NF = len(_FIELDS)
 CHUNK = 1024

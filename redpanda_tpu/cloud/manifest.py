@@ -17,10 +17,11 @@ from ..utils import serde
 class SegmentMeta(serde.Envelope):
     """One uploaded segment (partition_manifest.h segment_meta)."""
 
-    # v2 appends name_hint; compat stays 1, so v1 readers accept v2
-    # blobs and skip the tail via the envelope size (decode fills
-    # SERDE_DEFAULTS for the missing field when reading v1 blobs)
-    SERDE_VERSION = 2
+    # v2 appends name_hint, v3 appends size_compressed; compat stays 1,
+    # so older readers accept newer blobs and skip the tail via the
+    # envelope size (decode fills SERDE_DEFAULTS for missing fields
+    # when reading older blobs)
+    SERDE_VERSION = 3
 
     SERDE_FIELDS = [
         ("base_offset", serde.i64),  # raft space
@@ -39,9 +40,15 @@ class SegmentMeta(serde.Envelope):
         # object NEVER collides with the key of a segment it replaced
         # (adjacent_segment_merger.cc); "" = derive from base/term
         ("name_hint", serde.string),
+        # uploaded object size when the archiver compressed the segment
+        # (RP_ARCHIVE_COMPRESSION=zstd): the remote reader hydrates the
+        # whole object, length-checks against THIS, and decompresses;
+        # size_bytes stays the logical/uncompressed size everywhere
+        # (retention accounting, batch offsets). 0 = stored verbatim.
+        ("size_compressed", serde.i64),
     ]
 
-    SERDE_DEFAULTS = {"name_hint": ""}
+    SERDE_DEFAULTS = {"name_hint": "", "size_compressed": 0}
 
     @property
     def name(self) -> str:

@@ -43,12 +43,22 @@ class _SegmentView:
     through the CloudCache (or, with no cache, a whole-object LRU).
     A window of the most recent VIEW_WINDOW bytes is memoized so the
     sequential batch walk (two small reads per batch) costs one cache
-    access per window, not per read."""
+    access per window, not per read.
 
-    def __init__(self, reader: "RemoteReader", key: str, size: int):
+    Segments archived compressed (manifest size_compressed > 0) bypass
+    the chunk window entirely: chunks of a zstd frame are not
+    independently decodable, so the first read hydrates the WHOLE
+    object, decompresses it (device-side under RP_ZSTD_BACKEND=tpu),
+    and serves every position from the decoded-body LRU. `size` is
+    always the UNCOMPRESSED size — batch positions live in that space."""
+
+    def __init__(
+        self, reader: "RemoteReader", key: str, size: int, comp_size: int = 0
+    ):
         self._r = reader
         self.key = key
         self.size = size
+        self._comp = comp_size
         self._win_start = 0
         self._win = b""
 
@@ -56,6 +66,10 @@ class _SegmentView:
         if pos >= self.size:
             return b""
         end = min(pos + n, self.size)
+        if self._comp:
+            return await self._r._read_range_zstd(
+                self.key, self._comp, self.size, pos, end
+            )
         ws = self._win_start
         win = self._win
         if not (ws <= pos and end <= ws + len(win)):
@@ -151,6 +165,52 @@ class RemoteReader:
             self._mem.move_to_end(key)
         return data[start:end]
 
+    # -- compressed-segment hydration ---------------------------------
+    async def _read_range_zstd(
+        self, key: str, comp_size: int, size: int, start: int, end: int
+    ) -> bytes:
+        """Ranged read over a compressed archived segment: whole-object
+        hydrate + decompress on first touch, then every range slices
+        the decoded-body LRU. Length mismatches and codec failures
+        (including the decompress bomb guard) surface as StoreError so
+        read_kafka degrades them exactly like a truncated object."""
+        import time
+
+        t0 = time.monotonic()
+        h0 = self.hydrations
+        body = self._mem.get(key)
+        if body is None:
+            blob = await self.store.get(key)
+            self.hydrations += 1
+            if len(blob) != comp_size:
+                raise StoreError(
+                    f"compressed segment {key} is {len(blob)} bytes, "
+                    f"manifest says {comp_size}"
+                )
+            from ..compression import CompressionType, uncompress
+
+            try:
+                body = uncompress(blob, CompressionType.zstd)
+            except (ValueError, RuntimeError) as e:
+                raise StoreError(
+                    f"compressed segment {key} failed to decode: {e}"
+                ) from e
+            if len(body) != size:
+                raise StoreError(
+                    f"compressed segment {key} inflates to {len(body)} "
+                    f"bytes, manifest says {size}"
+                )
+            self._mem[key] = body
+            self._mem_bytes += len(body)
+            while self._mem_bytes > self._mem_max and len(self._mem) > 1:
+                _k, ev = self._mem.popitem(last=False)
+                self._mem_bytes -= len(ev)
+        else:
+            self._mem.move_to_end(key)
+        if self.on_read is not None:
+            self.on_read(time.monotonic() - t0, self.hydrations > h0)
+        return body[start:end]
+
     # -- kafka-space location -----------------------------------------
     @staticmethod
     def kafka_start(meta: SegmentMeta) -> int:
@@ -236,7 +296,12 @@ class RemoteReader:
         meta = self.find_segment(manifest, kafka_offset)
         while meta is not None and consumed < max_bytes:
             key = manifest.segment_key(meta)
-            view = _SegmentView(self, key, int(meta.size_bytes))
+            view = _SegmentView(
+                self,
+                key,
+                int(meta.size_bytes),
+                int(getattr(meta, "size_compressed", 0)),
+            )
             delta = int(meta.delta_offset)
             pos = 0
             seek = self._index_seek(key, kafka_offset)

@@ -13,6 +13,7 @@ slots behind), device-side codecs can be registered at runtime via
 from __future__ import annotations
 
 import enum
+import os
 import threading
 import zlib
 from typing import Callable
@@ -22,7 +23,7 @@ try:
 except ImportError:  # gated: image may lack the wheel; zstd raises at use
     zstandard = None
 
-from . import lz4_codec, snappy_codec
+from . import lz4_codec, snappy_codec, zstd_frame
 
 
 class CompressionType(enum.IntEnum):
@@ -65,15 +66,69 @@ def _zstd_ctx() -> tuple:
     return ctx
 
 
+# zstd leg selection (the ISSUE 14 seam): RP_ZSTD_BACKEND=tpu routes
+# through the device kernel (ops/zstd.py via tpu_backend); "host" — the
+# default and the differential oracle — keeps the zstandard contexts.
+# Read at call time so tests and the bench A/B can flip it per-call.
+def _zstd_backend() -> str:
+    return os.environ.get("RP_ZSTD_BACKEND", "host").strip().lower()
+
+
+# Decompress-bomb guard: a hostile archived chunk must not balloon
+# memory on hydration. Frames that declare a content size are capped AT
+# that size (a frame inflating past its own header is corruption, never
+# an allocation); frames without one are refused past this output
+# limit. Applied by BOTH legs before any codec context is touched.
+_ZSTD_NOSIZE_LIMIT_DEFAULT = 1 << 26  # 64 MiB
+
+
+def _zstd_nosize_limit() -> int:
+    return int(
+        os.environ.get("RP_ZSTD_NOSIZE_LIMIT", _ZSTD_NOSIZE_LIMIT_DEFAULT)
+    )
+
+
+def zstd_declared_size(data: bytes) -> "int | None":
+    """Declared frame content size, or None (absent / unparseable)."""
+    return zstd_frame.frame_content_size(data)
+
+
 def _zstd_compress(data: bytes) -> bytes:
+    if _zstd_backend() == "tpu":
+        from . import tpu_backend
+
+        return tpu_backend.compress_zstd(data)
+    return _zstd_compress_host(data)
+
+
+def _zstd_compress_host(data: bytes) -> bytes:
     return _zstd_ctx()[0].compress(data)
 
 
 def _zstd_uncompress(data: bytes) -> bytes:
-    # Content size may be absent from the frame header; use the
-    # streaming API (mirrors the reference's streaming zstd workspaces,
-    # src/v/compression/stream_zstd.h).
-    return _zstd_ctx()[1].decompressobj().decompress(data)
+    if _zstd_backend() == "tpu":
+        from . import tpu_backend
+
+        return tpu_backend.uncompress_zstd(data)
+    return _zstd_uncompress_host(data)
+
+
+def _zstd_uncompress_host(data: bytes) -> bytes:
+    declared = zstd_declared_size(data)
+    limit = _zstd_nosize_limit()
+    d = _zstd_ctx()[1]
+    if declared is None:
+        # No declared size: the streaming path is unbounded, so inflate
+        # through decompress() whose max_output_size errors out instead
+        # of allocating past the configured ceiling.
+        return d.decompress(data, max_output_size=limit)
+    out = d.decompress(data, max_output_size=max(declared, 1))
+    if len(out) != declared:
+        raise ValueError(
+            f"zstd frame regenerated {len(out)} bytes, header declared "
+            f"{declared}"
+        )
+    return out
 
 
 _COMPRESSORS: dict[CompressionType, Callable[[bytes], bytes]] = {

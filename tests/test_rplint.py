@@ -1839,3 +1839,98 @@ def test_rpl018_baseline_is_empty():
     grandfathered."""
     baseline = load_baseline()
     assert [k for k in baseline if k.endswith("::RPL018")] == []
+
+
+# -- RPL019: codec discipline ------------------------------------------
+
+RPL019_BAD = """\
+import zstandard
+
+
+def hydrate(blob):
+    body = zstandard.ZstdDecompressor().decompress(blob)
+    return body
+"""
+
+
+def test_rpl019_import_and_call_flagged_on_hot_path(tmp_path):
+    found = _only(
+        _lint_source(tmp_path, RPL019_BAD, "cloud/mod.py"), "RPL019"
+    )
+    msgs = [f.message for f in found]
+    assert any("import zstandard" in m for m in msgs)
+    assert any("bomb guard" in m for m in msgs)
+    assert len(found) == 2
+
+
+def test_rpl019_private_zstd_call_flagged(tmp_path):
+    src = """
+        from redpanda_tpu import compression
+
+
+        def upload(data):
+            return compression._zstd_compress(data)
+    """
+    (f,) = _only(_lint_source(tmp_path, src, "storage/mod.py"), "RPL019")
+    assert "_zstd_compress()" in f.message
+    assert "compression/-private" in f.message
+
+
+def test_rpl019_registry_calls_clean(tmp_path):
+    src = """
+        from ..compression import CompressionType, compress, uncompress
+
+
+        def roundtrip(data):
+            blob = compress(data, CompressionType.zstd)
+            return uncompress(blob, CompressionType.zstd)
+    """
+    assert _only(_lint_source(tmp_path, src, "cloud/mod.py"), "RPL019") == []
+
+
+def test_rpl019_compression_package_exempt(tmp_path):
+    assert (
+        _only(
+            _lint_source(
+                tmp_path,
+                RPL019_BAD,
+                "redpanda_tpu/compression/tpu_backend.py",
+            ),
+            "RPL019",
+        )
+        == []
+    )
+
+
+def test_rpl019_non_hot_paths_out_of_scope(tmp_path):
+    # ops/ legitimately reuses the *device* zstd kernel; tools and
+    # tests feed the differential oracle — neither is a hot path
+    for rel in ("ops/fused2.py", "tools_local/mod.py", "mod.py"):
+        assert _only(_lint_source(tmp_path, RPL019_BAD, rel), "RPL019") == []
+
+
+def test_rpl019_from_import_flagged(tmp_path):
+    src = """
+        from zstandard import ZstdCompressor
+    """
+    (f,) = _only(_lint_source(tmp_path, src, "kafka/mod.py"), "RPL019")
+    assert "from zstandard import" in f.message
+
+
+def test_rpl019_suppression(tmp_path):
+    src = RPL019_BAD.replace(
+        "import zstandard",
+        "import zstandard  # rplint: disable=RPL019",
+    ).replace(
+        "body = zstandard.ZstdDecompressor().decompress(blob)",
+        "body = zstandard.ZstdDecompressor().decompress(blob)"
+        "  # rplint: disable=RPL019",
+    )
+    assert _only(_lint_source(tmp_path, src, "raft/mod.py"), "RPL019") == []
+
+
+def test_rpl019_baseline_is_empty():
+    """Codec discipline holds from day one: the archiver and remote
+    partition hot paths only ever touch the public registry."""
+    baseline = load_baseline()
+    assert [k for k in baseline if k.endswith("::RPL019")] == []

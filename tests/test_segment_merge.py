@@ -75,14 +75,21 @@ def test_segment_meta_name_hint_wire_evolution():
 
     m = _meta(10, 19, term=3)
     raw = bytearray(m.encode())
-    # strip the trailing empty-string name_hint (4-byte length prefix)
-    # and rewrite the envelope header to the v1 layout
     ver, compat, size = struct.unpack("<BBI", raw[:6])
-    v1 = struct.pack("<BBI", 1, compat, size - 4) + bytes(raw[6:-4])
+    # strip the trailing v3 size_compressed (i64) and the empty-string
+    # name_hint (4-byte length prefix), rewriting the envelope header
+    # to the v1 layout
+    v1 = struct.pack("<BBI", 1, compat, size - 12) + bytes(raw[6:-12])
     back = SegmentMeta.decode(v1)
     assert back.name_hint == ""
     assert back.name == "10-3.seg"
     assert int(back.last_offset) == 19
+    # a v2 blob (name_hint present, no size_compressed) decodes with
+    # the verbatim-stored default
+    v2 = struct.pack("<BBI", 2, compat, size - 8) + bytes(raw[6:-8])
+    back2 = SegmentMeta.decode(v2)
+    assert int(back2.size_compressed) == 0
+    assert back2.name == "10-3.seg"
     hinted = _meta(10, 19, term=3, name_hint="x.m.seg")
     assert SegmentMeta.decode(hinted.encode()).name == "x.m.seg"
 

@@ -397,7 +397,7 @@ async def _cloud_retention(tmp_path):
         p = b.partition_manager.get(kafka_ntp("cr", 0))
         p.log.flush()
         await b.archival.run_once()
-        objects_before = {k for k in store._data if k.endswith(".seg")}
+        objects_before = {k for k in store._data if ".seg" in k.rsplit("/", 1)[-1]}
         assert objects_before, "nothing archived"
         oldest = min(objects_before)
         upto_before = p.archiver.archived_upto
@@ -410,7 +410,7 @@ async def _cloud_retention(tmp_path):
         await b.archival.run_once()
         b.storage.log_mgr.housekeeping()  # local trim by local target
         assert p.log.offsets().start_offset > 0
-        objects_after = {k for k in store._data if k.endswith(".seg")}
+        objects_after = {k for k in store._data if ".seg" in k.rsplit("/", 1)[-1]}
         assert oldest not in objects_after, sorted(objects_after)
         stm_total = sum(int(s.size_bytes) for s in p.archival.segments)
         assert stm_total <= 500 or len(p.archival.segments) == 1
@@ -546,11 +546,16 @@ async def _faulted_archival(tmp_path):
         store.clear()
 
         # invariant: whatever the manifest references exists WHOLE
+        # (stored length is size_compressed when the archiver
+        # compressed the segment, size_bytes otherwise)
         m = p.archiver.manifest
         for meta in m.segments:
             key = m.segment_key(meta)
             assert await inner.exists(key), f"dangling reference {key}"
-            assert len(inner._data[key]) == int(meta.size_bytes), (
+            want = int(getattr(meta, "size_compressed", 0)) or int(
+                meta.size_bytes
+            )
+            assert len(inner._data[key]) == want, (
                 f"truncated object referenced: {key}"
             )
         # the faults fired (otherwise this test asserts nothing)
@@ -611,7 +616,10 @@ async def _torn_manifest_recovery(tmp_path):
         for meta in healed.segments:
             k = healed.segment_key(meta)
             assert await store.exists(k)
-            assert len(store._data[k]) == int(meta.size_bytes)
+            want = int(getattr(meta, "size_compressed", 0)) or int(
+                meta.size_bytes
+            )
+            assert len(store._data[k]) == want
 
         # and archived reads still serve the full history
         b.storage.log_mgr.housekeeping()

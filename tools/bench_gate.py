@@ -50,7 +50,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # steady ratios — where growing past the trajectory means the plane
 # got LESS flat); other units are reported but not graded
 _THROUGHPUT_RE = re.compile(r"/s$|bps$", re.IGNORECASE)
-_LAT_RE = re.compile(r"^(ns|us|ms|s|skew)$|^x_wall", re.IGNORECASE)
+_LAT_RE = re.compile(r"^(ns|us|ms|s|skew)$|^x_wall|^ratio", re.IGNORECASE)
 
 
 def _direction(unit: str) -> int:
@@ -209,6 +209,7 @@ def selftest(pattern: str, tolerance: float) -> int:
         "GB/s": 1, "records/s": 1, "mbps": 1,
         "ns": -1, "us": -1, "ms": -1, "skew": -1,
         "x_wall_for_10x_groups": -1, "x_wall_for_20x_groups": -1,
+        "ratio": -1, "ratio_vs_host": -1,
         "count": 0, "": 0,
     }
     for unit, want in unit_cases.items():
@@ -225,6 +226,13 @@ def selftest(pattern: str, tolerance: float) -> int:
             {"value": 600000.0, "unit": "us"},
         "mesh_lane_balance_skew_1000000_partitions":
             {"value": 1.0, "unit": "skew"},
+        # PR 14 device-zstd units, graded before the trajectory
+        # carries them: compression ratios regress UP (a bigger
+        # stored/logical or device/host ratio means the codec got
+        # worse), throughput down
+        "zstd_compress_device_gbps": {"value": 5.0, "unit": "GB/s"},
+        "zstd_ratio_vs_host": {"value": 1.05, "unit": "ratio_vs_host"},
+        "tiered_archive_ratio": {"value": 0.55, "unit": "ratio"},
     }
     mesh_hist = [(0, "synthetic-mesh", mesh_round)]
     _, failures = gate(dict(mesh_round), mesh_hist, tolerance)
@@ -232,8 +240,15 @@ def selftest(pattern: str, tolerance: float) -> int:
         print("bench_gate selftest: identical mesh summary failed:\n"
               + "\n".join(failures), file=sys.stderr)
         return 2
-    worse = {k: {**m, "value": m["value"] * (1 + 2 * tolerance)}
-             for k, m in mesh_round.items()}
+    # degrade each metric in ITS bad direction (the synthetic block
+    # now mixes higher-better throughput with lower-better ratios)
+    worse = {
+        k: {**m, "value": m["value"] * (
+            (1 - 2 * tolerance) if _direction(m["unit"]) > 0
+            else (1 + 2 * tolerance)
+        )}
+        for k, m in mesh_round.items()
+    }
     _, failures = gate(worse, mesh_hist, tolerance)
     if len(failures) != len(mesh_round):
         print(f"bench_gate selftest: only {len(failures)}/"

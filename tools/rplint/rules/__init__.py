@@ -20,6 +20,7 @@ from .rpl015_await_atomicity import AwaitAtomicityRule
 from .rpl016_lock_consistency import LockConsistencyRule
 from .rpl017_placement_discipline import PlacementDisciplineRule
 from .rpl018_mesh_discipline import MeshDisciplineRule
+from .rpl019_codec_discipline import CodecDisciplineRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -40,6 +41,7 @@ ALL_RULES = [
     LockConsistencyRule,
     PlacementDisciplineRule,
     MeshDisciplineRule,
+    CodecDisciplineRule,
 ]
 
 __all__ = ["ALL_RULES"]

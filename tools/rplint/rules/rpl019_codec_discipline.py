@@ -1,0 +1,167 @@
+"""RPL019 — codec discipline: host-codec entry points live in
+redpanda_tpu/compression/ and nowhere else on the hot paths.
+
+PR 14 split the zstd codec into two legs behind one registry seam:
+the host `zstandard` wheel (differential oracle, default) and the
+device kernel (`ops/zstd.py` via `compression/tpu_backend.py`),
+selected by `RP_ZSTD_BACKEND`. Everything that makes that seam safe —
+backend dispatch, the decompress-bomb guard capping output at the
+declared frame content size, and the byte-for-byte punt of
+unsupported frame shapes back to the host codec — happens inside
+`compression/`. A raft/kafka/storage/cloud file that imports
+`zstandard` directly, or reaches for a `_zstd_*` private, gets bytes
+that skip all three: it pins the host wheel (silently diverging from
+the configured backend), decompresses unbounded attacker-shaped
+frames, and forks the punt policy. The failure is invisible until a
+hostile frame or a backend flip — classic second-source-of-truth
+rot.
+
+Flagged in raft/, kafka/, storage/ and cloud/ (outside
+redpanda_tpu/compression/):
+
+  * `import zstandard` / `from zstandard import ...` — hot paths
+    never see the wheel; they call `compression.compress` /
+    `compression.uncompress` with a CompressionType
+  * any CALL through a `zstandard.` attribute chain — same seam
+    bypass without the import statement (e.g. a smuggled module
+    object)
+  * any CALL of a `_zstd_*`-named function (bare or attribute) —
+    those are compression/-private; the underscore is the contract
+
+Device kernels (`ops/zstd.py`, reused by `ops/fused.py`) are out of
+scope: they are the *other* leg of the seam, not a host codec, and
+ops/ is not a hot-path package.
+
+Suppress a deliberate exception with `# rplint: disable=RPL019`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import Finding, ModuleContext, dotted_name
+
+_ZSTANDARD_CHAIN_RE = re.compile(r"^zstandard(\.\w+)*$")
+
+_EXEMPT_PREFIX = "redpanda_tpu/compression/"
+_HOT_DIRS = {"raft", "kafka", "storage", "cloud"}
+
+EXAMPLE = """\
+# in redpanda_tpu/cloud/somewhere.py
+import zstandard                                # RPL019: wheel pinned on a hot path
+blob = zstandard.ZstdCompressor().compress(d)   # RPL019: bypasses backend + bomb guard
+body = compression._zstd_uncompress(blob)       # RPL019: compression/-private
+# instead:
+from ..compression import CompressionType, compress, uncompress
+blob = compress(d, CompressionType.zstd)
+"""
+
+
+def _call_name(node: ast.Call) -> str | None:
+    """The terminal name of the called expression, for exact match."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+class CodecDisciplineRule:
+    code = "RPL019"
+    name = "codec-discipline"
+
+    def _in_scope(self, path: str) -> bool:
+        if _EXEMPT_PREFIX in path or path.startswith("compression/"):
+            return False
+        parts = path.split("/")[:-1]
+        return any(d in parts for d in _HOT_DIRS)
+
+    def check(self, ctx: ModuleContext):
+        path = ctx.path.replace("\\", "/")
+        if not self._in_scope(path):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+                hit = [
+                    n
+                    for n in names
+                    if n == "zstandard" or n.startswith("zstandard.")
+                ]
+                if not hit or ctx.suppressed(node, self.code):
+                    continue
+                yield Finding(
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.code,
+                    message=(
+                        "import zstandard on a hot path — the host wheel "
+                        "is compression/-private; route bytes through "
+                        "compression.compress/uncompress so backend "
+                        "dispatch and the decompress-bomb guard apply"
+                    ),
+                )
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod != "zstandard" and not mod.startswith("zstandard."):
+                    continue
+                if ctx.suppressed(node, self.code):
+                    continue
+                yield Finding(
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.code,
+                    message=(
+                        "from zstandard import ... on a hot path — the "
+                        "host wheel is compression/-private; route bytes "
+                        "through compression.compress/uncompress so "
+                        "backend dispatch and the decompress-bomb guard "
+                        "apply"
+                    ),
+                )
+            elif isinstance(node, ast.Call):
+                called = _call_name(node)
+                if called is None:
+                    continue
+                dotted = dotted_name(node.func)
+                if called.startswith("_zstd_"):
+                    if ctx.suppressed(node, self.code):
+                        continue
+                    yield Finding(
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.code,
+                        message=(
+                            f"{called}() on a hot path — _zstd_* is "
+                            "compression/-private (no backend dispatch, "
+                            "no bomb guard at this call site); use "
+                            "compression.compress/uncompress with "
+                            "CompressionType.zstd"
+                        ),
+                    )
+                elif _ZSTANDARD_CHAIN_RE.match(dotted):
+                    # pure attribute chain only: the inner
+                    # `zstandard.ZstdDecompressor()` of a
+                    # `zstandard.X().decompress()` expression is the
+                    # one finding; the outer call's dotted form routes
+                    # through "(...)" and is the same seam bypass
+                    if ctx.suppressed(node, self.code):
+                        continue
+                    yield Finding(
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.code,
+                        message=(
+                            f"direct {dotted}() call on a hot path — "
+                            "the host codec bypasses RP_ZSTD_BACKEND "
+                            "dispatch and the declared-content-size "
+                            "bomb guard; go through the compression "
+                            "registry"
+                        ),
+                    )

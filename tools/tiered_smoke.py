@@ -9,8 +9,16 @@ object). Then the determinism contract: the firing trace must replay
 byte-equal from (rules, seed, recorded op sequence) — a chaos failure
 here is a repro command, not an anecdote.
 
+`--zstd` runs the device-zstd archive leg instead: single broker,
+RP_ARCHIVE_COMPRESSION=zstd + RP_ZSTD_BACKEND=tpu, produce ->
+archive -> evict -> cold read, asserting the stored objects are zstd
+frames (smaller than the logical bytes) and the hydrated records are
+byte-identical — plus the stand-down contract for RP_ZSTD_BACKEND=
+host (works when the zstandard wheel is installed, refuses loudly
+when it is not).
+
 Usage:
-    python tools/tiered_smoke.py [--seed N] [--duration S]
+    python tools/tiered_smoke.py [--seed N] [--duration S] [--zstd]
 """
 
 import argparse
@@ -50,11 +58,146 @@ def default_rules():
     ]
 
 
+async def _zstd_leg() -> int:
+    from redpanda_tpu import compression
+    from redpanda_tpu.app import Broker, BrokerConfig
+    from redpanda_tpu.cloud import MemoryObjectStore
+    from redpanda_tpu.compression import CompressionType, zstd_frame as zf
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.models.fundamental import kafka_ntp
+    from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+    n_records, record_bytes, batch = 300, 512, 20
+    pat = b'{"key":"user-000001","topic":"orders","seq":12345},'
+    payload = (pat * (record_bytes // len(pat) + 1))[:record_bytes]
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    with tempfile.TemporaryDirectory(prefix="zstd_smoke_", dir=shm) as tmp:
+        store = MemoryObjectStore()
+        b = Broker(
+            BrokerConfig(
+                node_id=0,
+                data_dir=os.path.join(tmp, "n0"),
+                members=[0],
+                enable_admin=False,
+                node_status_interval_s=0,
+                housekeeping_interval_s=0,
+                archival_interval_s=0,
+            ),
+            loopback=LoopbackNetwork(),
+            object_store=store,
+        )
+        await b.start()
+        b.config.peer_kafka_addresses = {0: b.kafka_advertised}
+        client = None
+        try:
+            await b.wait_controller_leader()
+            client = KafkaClient([b.kafka_advertised])
+            await client.create_topic(
+                "zstd-smoke",
+                partitions=1,
+                replication_factor=1,
+                configs={
+                    "redpanda.remote.write": "true",
+                    "redpanda.remote.read": "true",
+                    "segment.bytes": "4096",
+                    "retention.local.target.bytes": "4096",
+                },
+            )
+            expect = []
+            for base in range(0, n_records, batch):
+                recs = [
+                    (b"k%06d" % i, payload)
+                    for i in range(base, base + batch)
+                ]
+                await client.produce("zstd-smoke", 0, recs)
+                expect.extend(recs)
+            p = b.partition_manager.get(kafka_ntp("zstd-smoke", 0))
+            p.log.flush()
+            await b.archival.run_once()
+            b.storage.log_mgr.housekeeping()
+
+            manifest = p.archiver.manifest
+            assert manifest.segments, "nothing archived"
+            logical = stored = 0
+            for m in manifest.segments:
+                comp = int(getattr(m, "size_compressed", 0))
+                assert comp > 0, "segment archived uncompressed"
+                blob = await store.get(manifest.segment_key(m))
+                assert len(blob) == comp, (len(blob), comp)
+                # stored object is a stock zstd frame declaring the
+                # segment's logical size
+                assert zf.frame_content_size(blob) == int(m.size_bytes)
+                logical += int(m.size_bytes)
+                stored += comp
+            assert stored < logical, (stored, logical)
+            assert int(p.log.offsets().start_offset) > 0, (
+                "local prefix never evicted: cold path not exercised"
+            )
+
+            # cold read re-hydrates everything through uncompress_zstd
+            for m in manifest.segments:
+                await b.remote_reader.invalidate(manifest.segment_key(m))
+            got = await client.fetch("zstd-smoke", 0, 0, max_bytes=1 << 24)
+            assert len(got) == n_records, (len(got), n_records)
+            assert [(k, v) for _o, k, v in got] == expect
+
+            # stand-down: the host leg must either work (wheel present)
+            # or refuse loudly — never silently fall back to the device
+            os.environ["RP_ZSTD_BACKEND"] = "host"
+            frame = None
+            try:
+                frame = compression.compress(payload, CompressionType.zstd)
+                standdown = "host leg active (zstandard wheel)"
+            except RuntimeError:
+                standdown = "host leg refused (wheel absent)"
+            if frame is not None:
+                assert (
+                    compression.uncompress(frame, CompressionType.zstd)
+                    == payload
+                )
+            print(
+                f"zstd smoke ok: records={n_records} "
+                f"segments={len(manifest.segments)} logical={logical} "
+                f"stored={stored} ratio={stored / logical:.3f} "
+                f"standdown='{standdown}'"
+            )
+        finally:
+            if client is not None:
+                await client.close()
+            await b.stop()
+    return 0
+
+
+def run_zstd() -> int:
+    save = {
+        k: os.environ.get(k)
+        for k in ("RP_ARCHIVE_COMPRESSION", "RP_ZSTD_BACKEND")
+    }
+    os.environ["RP_ARCHIVE_COMPRESSION"] = "zstd"
+    os.environ["RP_ZSTD_BACKEND"] = "tpu"
+    try:
+        return asyncio.run(_zstd_leg())
+    finally:
+        for k, v in save.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seed", type=int, default=515)
     ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument(
+        "--zstd",
+        action="store_true",
+        help="device-zstd archive round-trip + stand-down leg",
+    )
     args = ap.parse_args()
+    if args.zstd:
+        return run_zstd()
 
     from chaos_harness import run_chaos
     from redpanda_tpu.cloud import StoreFaultSchedule

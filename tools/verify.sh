@@ -85,6 +85,9 @@ env JAX_PLATFORMS=cpu \
 echo "== mesh stand-down smoke (RP_QUORUM_BACKEND=host) =="
 env JAX_PLATFORMS=cpu RP_QUORUM_BACKEND=host python tools/mesh_smoke.py
 
+echo "== device-zstd archive smoke (upload + cold-read parity + stand-down) =="
+env JAX_PLATFORMS=cpu python tools/tiered_smoke.py --zstd
+
 echo "== tracing-off smoke (RP_TRACE=0) =="
 env JAX_PLATFORMS=cpu RP_TRACE=0 python tools/scrape_smoke.py --fleet
 exec env JAX_PLATFORMS=cpu RP_TRACE=0 python -m pytest \
