@@ -240,6 +240,16 @@ async def _live_tick_async(n_groups: int) -> dict:
         # must absorb.
         for _ in range(3):
             await hb.tick()
+        # compile discipline: the measured window starts HERE — any
+        # jit-kernel cache growth from now until the end of the
+        # full-frame loop is a steady-state recompile (graded zero by
+        # bench_gate; with RP_COMPILEGUARD=1 the guard also names the
+        # offending signature the moment it traces)
+        from redpanda_tpu.utils import compileguard
+
+        compileguard.reset()
+        compiles_before = compileguard.compile_counts()
+        compileguard.steady()
         iters = 60
         times = []
         for _ in range(iters):
@@ -264,6 +274,12 @@ async def _live_tick_async(n_groups: int) -> dict:
             await hb.tick()
             full_times.append((time.perf_counter() - t0) * 1e3)
         interval_ms = 50.0
+        compiles_after = compileguard.compile_counts()
+        recompiled = {
+            k: v - compiles_before.get(k, 0)
+            for k, v in compiles_after.items()
+            if v - compiles_before.get(k, 0) > 0
+        }
         full_p99 = float(np.percentile(full_times, 99))
         # HEADLINE is the FULL-frame p99 — what an actively-churning
         # cluster pays every tick (VERDICT r4 #2); the quiesced SAME
@@ -285,6 +301,14 @@ async def _live_tick_async(n_groups: int) -> dict:
             "tick_frame_flushes": tf.flushes,
             "tick_frame_replies": tf.replies_folded,
             "tick_frame_max_batch": tf.max_batch,
+            "compiles": {
+                "metric": f"steady_recompiles_{n_groups}_groups",
+                "value": sum(recompiled.values()),
+                "unit": "recompiles",
+                "guard": compileguard.enabled(),
+                "per_kernel": recompiled,
+                "reports": len(compileguard.reports()),
+            },
         }
         if os.environ.get("RP_BENCH_PROBES") == "1":
             out["stages"] = _stage_quantiles(gms[0].probe)
@@ -344,7 +368,9 @@ def bench_replicated_tick() -> dict:
     (heartbeat build + RPC + service + the fused tick frame). The claim
     under test: per-partition tick CPU is ~flat because per-group math
     is off the interpreter — steady per-tick wall at N must be <= 2x
-    the wall at N/20 (20x groups, <=2x time)."""
+    the wall at N/20 (20x groups, <=2x time). The per-run `compiles`
+    blocks (steady-window recompile counts) ride along and are graded
+    absolute-zero by bench_gate."""
     n = int(os.environ.get("BENCH_REPL_PARTITIONS", "100000"))
     base = max(1000, n // 20)
     small = asyncio.run(_live_tick_async(base))
@@ -371,6 +397,7 @@ def bench_replicated_tick() -> dict:
         ),
         "tick_frame_replies": big["tick_frame_replies"],
         "health": big.get("health"),
+        "compiles": big.get("compiles"),
         "small": small,
         "big": big,
     }
@@ -412,11 +439,20 @@ def _mesh_steady_times(n: int, window: int, rounds: int, seed: int):
     """Steady-state fold walls (ms) at n rows: per round, `window`
     unique rows each get one reply — below MESH_FULL_THRESHOLD the
     mesh backend's incremental chip-local sweep, the per-tick unit the
-    flatness claim grades. Returns (times, arrays, frame)."""
+    flatness claim grades. Returns (times, arrays, frame, recompiled)
+    where `recompiled` maps kernel name -> steady-window jit cache
+    growth (graded zero by bench_gate)."""
+    from redpanda_tpu.utils import compileguard
+
     arrays, rows, frame = _mesh_lanes(n, seed)
     rng = np.random.default_rng(seed + 1)
     times = []
+    compiles_before: dict = {}
     for k in range(rounds + 3):
+        if k == 3:  # warmup over: the measured steady window starts
+            compileguard.reset()
+            compiles_before = compileguard.compile_counts()
+            compileguard.steady()
         pick = rng.choice(n, size=min(window, n), replace=False)
         rr = rows[pick]
         slots = rng.integers(1, arrays.replica_slots, len(rr)).astype(
@@ -430,7 +466,13 @@ def _mesh_steady_times(n: int, window: int, rounds: int, seed: int):
         dt = (time.perf_counter() - t0) * 1e3
         if k >= 3:  # warmup excluded
             times.append(dt)
-    return times, arrays, frame
+    compiles_after = compileguard.compile_counts()
+    recompiled = {
+        k: v - compiles_before.get(k, 0)
+        for k, v in compiles_after.items()
+        if v - compiles_before.get(k, 0) > 0
+    }
+    return times, arrays, frame, recompiled
 
 
 def bench_mesh_flat() -> dict:
@@ -470,9 +512,11 @@ def bench_mesh_flat() -> dict:
     rounds = 150  # 5 measurement windows of 30 (bench_quorum method)
     target_ms = 1.0
 
-    small, arrays, _ = _mesh_steady_times(base, window, rounds, seed=17)
+    small, arrays, _, _ = _mesh_steady_times(base, window, rounds, seed=17)
     del arrays
-    big, arrays, frame = _mesh_steady_times(n, window, rounds, seed=17)
+    big, arrays, frame, recompiled = _mesh_steady_times(
+        n, window, rounds, seed=17
+    )
     # shared-box noise: a co-tenant burst in one window says nothing
     # about the sweep — grade the BEST 30-fold window, same
     # methodology (and caveat) as bench_quorum's variance_note
@@ -489,24 +533,30 @@ def bench_mesh_flat() -> dict:
     p99 = float(np.percentile(big_best, 99))
 
     # full mesh frame: force the real sharded program (compiles once),
-    # report the steady fold wall and the one-fold totals
+    # report the steady fold wall and the one-fold totals — a declared
+    # warmup region, so the first fold's legitimate compile doesn't
+    # read as a steady-state recompile under RP_COMPILEGUARD=1
+    from redpanda_tpu.utils import compileguard
+
     os.environ["RP_MESH_FULL"] = "1"
     try:
         rng = np.random.default_rng(99)
         fold_us = []
-        for k in range(3):
-            rr = np.sort(
-                rng.choice(n, size=window, replace=False)
-            ).astype(np.int64)
-            slots = rng.integers(1, arrays.replica_slots, window).astype(
-                np.int64
-            )
-            dirty = rng.integers(-1, 2000, window).astype(np.int64)
-            flushed = np.maximum(dirty - 5, -1)
-            seq = np.full(window, rounds + 10 + k, np.int64)
-            frame.fold_now(rr, slots, dirty, flushed, seq)
-            fold_us.append(arrays._last_fold_us)
-        totals = arrays.mesh_totals()
+        with compileguard.warmup("RP_MESH_FULL first fold compiles the "
+                                 "sharded frame program"):
+            for k in range(3):
+                rr = np.sort(
+                    rng.choice(n, size=window, replace=False)
+                ).astype(np.int64)
+                slots = rng.integers(
+                    1, arrays.replica_slots, window
+                ).astype(np.int64)
+                dirty = rng.integers(-1, 2000, window).astype(np.int64)
+                flushed = np.maximum(dirty - 5, -1)
+                seq = np.full(window, rounds + 10 + k, np.int64)
+                frame.fold_now(rr, slots, dirty, flushed, seq)
+                fold_us.append(arrays._last_fold_us)
+            totals = arrays.mesh_totals()
     finally:
         os.environ.pop("RP_MESH_FULL", None)
     per_device = arrays.lane_attribution()
@@ -549,6 +599,14 @@ def bench_mesh_flat() -> dict:
             "value": round(skew, 4),
             "unit": "skew",
             "per_device": per_device,
+        },
+        "compiles": {
+            "metric": f"mesh_steady_recompiles_{n}_partitions",
+            "value": sum(recompiled.values()),
+            "unit": "recompiles",
+            "guard": compileguard.enabled(),
+            "per_kernel": recompiled,
+            "reports": len(compileguard.reports()),
         },
     }
 

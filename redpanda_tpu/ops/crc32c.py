@@ -39,7 +39,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import compileguard
 from ..utils.crc import _TABLE as _BYTE_TABLE
+from .shapes import row_bucket
 
 _MAX_LOG_PAD = 30  # supports strides up to 2^30
 
@@ -233,6 +235,9 @@ def crc32c_device(data: jax.Array, lens: jax.Array) -> jax.Array:
     return fixed ^ jnp.uint32(0xFFFFFFFF)
 
 
+crc32c_device = compileguard.instrument(crc32c_device, "crc32c.device")
+
+
 def crc32c_batch_device(bufs: np.ndarray, lens: np.ndarray) -> np.ndarray:
     """Drop-in device counterpart of utils.crc.crc32c_batch (same padded
     [n, stride] layout produced by models.record.batch_crcs)."""
@@ -242,7 +247,19 @@ def crc32c_batch_device(bufs: np.ndarray, lens: np.ndarray) -> np.ndarray:
         raise ValueError(
             f"lens.max()={int(lens.max())} exceeds stride={bufs.shape[1]}"
         )
-    if bufs.shape[1] % _CHUNK:
-        pad = _CHUNK - bufs.shape[1] % _CHUNK
-        bufs = np.pad(bufs, ((0, 0), (0, pad)))
-    return np.asarray(crc32c_device(jnp.asarray(bufs), jnp.asarray(lens)))
+    # bucket BOTH dims so the kernel signature set stays bounded: stride
+    # doubles from the fold chunk, rows take the shared pow2 bucket. The
+    # zero-pad is algebraically removed by the length fixup (Z^-k), so
+    # the extra columns/rows never change real checksums; padded rows
+    # (len 0) are sliced off below.
+    n = bufs.shape[0]
+    stride = _CHUNK
+    while stride < bufs.shape[1]:
+        stride *= 2
+    rows = row_bucket(n)
+    padded = np.zeros((rows, stride), np.uint8)
+    padded[:n, : bufs.shape[1]] = bufs
+    plens = np.zeros(rows, np.int64)
+    plens[:n] = lens
+    out = np.asarray(crc32c_device(jnp.asarray(padded), jnp.asarray(plens)))
+    return out[:n]

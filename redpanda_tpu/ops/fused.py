@@ -25,8 +25,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import compileguard
 from .crc32c import crc32c_device
 from .cellparse import CELL
+from .shapes import row_bucket
 from .lz4 import _compress_chunks, out_bound
 from .snappy import _compress_chunks as _snappy_chunks
 from .snappy import _preamble as _snappy_preamble
@@ -58,6 +60,9 @@ def _fused(data: jax.Array, body_len: jax.Array, n: int):
     return crc, out, out_len
 
 
+_fused = compileguard.instrument(_fused, "fused.crc_lz4")
+
+
 @functools.partial(jax.jit, static_argnums=(2,))
 def _fused_snappy(data: jax.Array, body_len: jax.Array, n: int):
     """Same layout as _fused, snappy emission instead of LZ4."""
@@ -70,6 +75,9 @@ def _fused_snappy(data: jax.Array, body_len: jax.Array, n: int):
     )
     out, out_len = _snappy_chunks(body, body_len, n)
     return crc, out, out_len
+
+
+_fused_snappy = compileguard.instrument(_fused_snappy, "fused.crc_snappy")
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
@@ -86,6 +94,9 @@ def _fused_zstd(data: jax.Array, body_len: jax.Array, n: int):
         lambda d, v: _zstd_encode_one(d, v, n)
     )(body, body_len)
     return crc, nbits, streams, bits
+
+
+_fused_zstd = compileguard.instrument(_fused_zstd, "fused.crc_zstd")
 
 
 def crc_zstd_fused(
@@ -112,8 +123,9 @@ def crc_zstd_fused(
     while n < longest:
         n *= 2
     width = ((PREFIX + n + 511) // 512) * 512
-    batch = np.zeros((len(arrs), width), np.uint8)
-    body_len = np.empty(len(arrs), np.int32)
+    rows = row_bucket(len(arrs))
+    batch = np.zeros((rows, width), np.uint8)
+    body_len = np.zeros(rows, np.int32)
     for i, (p, a) in enumerate(zip(prefixes, arrs)):
         assert len(p) == PREFIX, f"prefix must be {PREFIX} bytes"
         batch[i, :PREFIX] = np.frombuffer(p, np.uint8)
@@ -122,7 +134,7 @@ def crc_zstd_fused(
     crc, nbits, streams, bits = _fused_zstd(
         jnp.asarray(batch), jnp.asarray(body_len), n
     )
-    crc = np.asarray(crc)
+    crc = np.asarray(crc)[: len(arrs)]
     nbits = np.asarray(nbits)
     streams = np.asarray(streams)
     bits = np.asarray(bits)
@@ -176,8 +188,9 @@ def _fused_entry(prefixes, bodies, kernel, bound_fn, preamble_fn):
         n *= 2
     crc_w = ((PREFIX + n + 511) // 512) * 512
     width = max(PREFIX + n + CELL, crc_w)
-    batch = np.zeros((len(arrs), width), np.uint8)
-    body_len = np.empty(len(arrs), np.int32)
+    rows = row_bucket(len(arrs))
+    batch = np.zeros((rows, width), np.uint8)
+    body_len = np.zeros(rows, np.int32)
     for i, (p, a) in enumerate(zip(prefixes, arrs)):
         assert len(p) == PREFIX, f"prefix must be {PREFIX} bytes"
         batch[i, :PREFIX] = np.frombuffer(p, np.uint8)
@@ -186,7 +199,7 @@ def _fused_entry(prefixes, bodies, kernel, bound_fn, preamble_fn):
     crc, out, out_len = kernel(
         jnp.asarray(batch), jnp.asarray(body_len), n
     )
-    crc = np.asarray(crc)
+    crc = np.asarray(crc)[: len(arrs)]
     out = np.asarray(out)
     out_len = np.asarray(out_len)
     assert int(out_len.max()) <= bound_fn(n)

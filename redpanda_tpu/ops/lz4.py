@@ -44,7 +44,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import compileguard
 from .cellparse import CELL, cell_parse
+from .shapes import row_bucket
 
 
 def out_bound(n: int) -> int:
@@ -145,6 +147,11 @@ def _compress_chunks(data: jax.Array, valid: jax.Array, n: int):
     return jax.vmap(one)(data, valid)
 
 
+_compress_chunks = compileguard.instrument(
+    _compress_chunks, "lz4.compress_chunks"
+)
+
+
 def compress_chunks(chunks: list[bytes | np.ndarray]) -> list[bytes]:
     """Compress each ≤64 KiB chunk into a standard LZ4 block on device.
     Chunks are padded to a shared bucket size so one compiled program
@@ -158,8 +165,9 @@ def compress_chunks(chunks: list[bytes | np.ndarray]) -> list[bytes]:
     n = 256
     while n < longest:
         n *= 2
-    batch = np.zeros((len(arrs), n + CELL), np.uint8)
-    valid = np.empty(len(arrs), np.int32)
+    rows = row_bucket(len(arrs))
+    batch = np.zeros((rows, n + CELL), np.uint8)
+    valid = np.zeros(rows, np.int32)
     for i, a in enumerate(arrs):
         batch[i, : a.size] = a
         valid[i] = a.size

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.consensus_state import SELF_SLOT, GroupState
+from ..utils import compileguard
 
 _I64_MIN = jnp.iinfo(jnp.int64).min
 _I64_MAX = jnp.iinfo(jnp.int64).max
@@ -218,12 +219,26 @@ def local_append_update(
     )
 
 
-# jitted entry points (donate state buffers: the sweep updates in place)
-quorum_commit_step_jit = jax.jit(quorum_commit_step, donate_argnums=0)
-follower_commit_step_jit = jax.jit(follower_commit_step, donate_argnums=0)
-fold_replies_jit = jax.jit(fold_replies, donate_argnums=0)
-local_append_update_jit = jax.jit(local_append_update, donate_argnums=0)
-build_heartbeats_jit = jax.jit(build_heartbeats)
+# jitted entry points (donate state buffers: the sweep updates in
+# place); every binding registers with the compile guard so steady-
+# state recompiles are caught under RP_COMPILEGUARD=1
+quorum_commit_step_jit = compileguard.instrument(
+    jax.jit(quorum_commit_step, donate_argnums=0), "quorum.commit_step"
+)
+follower_commit_step_jit = compileguard.instrument(
+    jax.jit(follower_commit_step, donate_argnums=0),
+    "quorum.follower_commit_step",
+)
+fold_replies_jit = compileguard.instrument(
+    jax.jit(fold_replies, donate_argnums=0), "quorum.fold_replies"
+)
+local_append_update_jit = compileguard.instrument(
+    jax.jit(local_append_update, donate_argnums=0),
+    "quorum.local_append_update",
+)
+build_heartbeats_jit = compileguard.instrument(
+    jax.jit(build_heartbeats), "quorum.build_heartbeats"
+)
 
 
 def heartbeat_tick(
@@ -241,7 +256,9 @@ def heartbeat_tick(
     return quorum_commit_step(state)
 
 
-heartbeat_tick_jit = jax.jit(heartbeat_tick, donate_argnums=0)
+heartbeat_tick_jit = compileguard.instrument(
+    jax.jit(heartbeat_tick, donate_argnums=0), "quorum.heartbeat_tick"
+)
 
 
 def tick_frame(
@@ -267,4 +284,6 @@ def tick_frame(
     return state, build_heartbeats(state, hb_idx)
 
 
-tick_frame_jit = jax.jit(tick_frame, donate_argnums=0)
+tick_frame_jit = compileguard.instrument(
+    jax.jit(tick_frame, donate_argnums=0), "quorum.tick_frame"
+)

@@ -30,7 +30,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import compileguard
 from .cellparse import CELL, cell_parse
+from .shapes import row_bucket
 
 
 def out_bound(n: int) -> int:
@@ -120,6 +122,11 @@ def _compress_chunks(data: jax.Array, valid: jax.Array, n: int):
     return jax.vmap(one)(data, valid)
 
 
+_compress_chunks = compileguard.instrument(
+    _compress_chunks, "snappy.compress_chunks"
+)
+
+
 def _preamble(v: int) -> bytes:
     out = bytearray()
     while True:
@@ -148,8 +155,9 @@ def compress_chunks(chunks: list[bytes | np.ndarray]) -> list[bytes]:
     n = 256
     while n < longest:
         n *= 2
-    batch = np.zeros((len(arrs), n + CELL), np.uint8)
-    valid = np.empty(len(arrs), np.int32)
+    rows = row_bucket(len(arrs))
+    batch = np.zeros((rows, n + CELL), np.uint8)
+    valid = np.zeros(rows, np.int32)
     for i, a in enumerate(arrs):
         batch[i, : a.size] = a
         valid[i] = a.size

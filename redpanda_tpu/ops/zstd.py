@@ -42,6 +42,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import compileguard
+from .shapes import row_bucket
+
 TABLELOG = 11
 TSIZE = 1 << TABLELOG
 
@@ -190,6 +193,9 @@ def _encode_chunks(data: jax.Array, valid: jax.Array, n: int):
     return jax.vmap(lambda d, v: _encode_one(d, v, n))(data, valid)
 
 
+_encode_chunks = compileguard.instrument(_encode_chunks, "zstd.encode_chunks")
+
+
 def encode_chunks(
     chunks: "list[bytes | np.ndarray]",
 ) -> "list[tuple[np.ndarray, list[bytes]]]":
@@ -209,8 +215,9 @@ def encode_chunks(
     n = 256
     while n < longest:
         n *= 2
-    batch = np.zeros((len(arrs), n), np.uint8)
-    valid = np.empty(len(arrs), np.int32)
+    rows = row_bucket(len(arrs))
+    batch = np.zeros((rows, n), np.uint8)
+    valid = np.zeros(rows, np.int32)
     for i, a in enumerate(arrs):
         batch[i, : a.size] = a
         valid[i] = a.size
@@ -270,6 +277,11 @@ def _decode_streams(bufs, tbits, regen, tsym, tnb, sbytes: int, rmax: int):
     )(bufs, tbits, regen, tsym, tnb)
 
 
+_decode_streams = compileguard.instrument(
+    _decode_streams, "zstd.decode_streams"
+)
+
+
 def decode_streams(
     streams: "list[bytes]",
     regens: "list[int]",
@@ -289,19 +301,27 @@ def decode_streams(
     rmax = 64
     while rmax < rmax_need:
         rmax *= 2
-    bufs = np.zeros((len(streams), sbytes), np.uint8)
-    tbits = np.empty(len(streams), np.int32)
+    # padded rows (zero buf/table, tbits=regen=0) decode to end==0 and
+    # are sliced off below — inert under the vmap by construction
+    rows = row_bucket(len(streams))
+    bufs = np.zeros((rows, sbytes), np.uint8)
+    tbits = np.zeros(rows, np.int32)
     for i, s in enumerate(streams):
         if not s or s[-1] == 0:
             raise ValueError("huffman stream missing its end marker")
         bufs[i, : len(s)] = np.frombuffer(s, np.uint8)
         tbits[i] = 8 * (len(s) - 1) + s[-1].bit_length() - 1
-    tsym = np.stack([t[0] for t in tables]).astype(np.uint8)
-    tnb = np.stack([t[1] for t in tables]).astype(np.int32)
+    regen_v = np.zeros(rows, np.int32)
+    regen_v[: len(streams)] = regens
+    tsym = np.zeros((rows, TSIZE), np.uint8)
+    tnb = np.zeros((rows, TSIZE), np.int32)
+    for i, t in enumerate(tables):
+        tsym[i] = t[0]
+        tnb[i] = t[1]
     out, end = _decode_streams(
         jnp.asarray(bufs),
         jnp.asarray(tbits),
-        jnp.asarray(np.asarray(regens, np.int32)),
+        jnp.asarray(regen_v),
         jnp.asarray(tsym),
         jnp.asarray(tnb),
         sbytes,

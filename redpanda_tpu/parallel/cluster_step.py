@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.consensus_state import GroupState, make_group_state
 from ..ops.quorum import quorum_commit_step
+from ..utils import compileguard
 from .mesh import SHARD_AXIS
 
 RF = 3  # replication factor modeled by the ring placement
@@ -362,7 +363,7 @@ def election_round_sharded(mesh: Mesh, candidate_hop: int = 1):
         in_specs=(state_specs, spec),
         out_specs=(state_specs, spec, spec),
     )
-    return jax.jit(fn)
+    return compileguard.instrument(jax.jit(fn), "cluster.election_round")
 
 
 def cluster_tick_sharded(mesh: Mesh):
@@ -374,4 +375,4 @@ def cluster_tick_sharded(mesh: Mesh):
         in_specs=(state_specs, spec),
         out_specs=(state_specs, P(), P()),
     )
-    return jax.jit(fn)
+    return compileguard.instrument(jax.jit(fn), "cluster.tick")

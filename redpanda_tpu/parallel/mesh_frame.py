@@ -48,6 +48,7 @@ import numpy as np
 from ..models.consensus_state import GroupState
 from ..ops import quorum as q
 from ..ops.health import health_reduce
+from ..utils import compileguard
 from .mesh import group_sharding, make_mesh
 
 
@@ -136,8 +137,12 @@ class MeshFrame:
         self.mesh = make_mesh(n)
         self.n_devices = n
         self._sharding = group_sharding(self.mesh)
-        self._frame = jax.jit(mesh_tick_frame)
-        self._health = jax.jit(mesh_health)
+        self._frame = compileguard.instrument(
+            jax.jit(mesh_tick_frame), "mesh_frame.tick_frame"
+        )
+        self._health = compileguard.instrument(
+            jax.jit(mesh_health), "mesh_frame.health"
+        )
 
     def _place(self, a: np.ndarray) -> jax.Array:
         """Pad the row axis to a multiple of the device count with

@@ -24,6 +24,7 @@ from ..models.consensus_state import (
     GroupState,
 )
 from ..ops.health import health_reduce_np
+from ..utils import compileguard
 from . import quorum_scalar as qs
 
 I64_MIN = np.int64(np.iinfo(np.int64).min)
@@ -1305,16 +1306,20 @@ class ShardGroupArrays:
         the new [G, R] shape (the mid-traffic compile stall)."""
         empty = np.array([], np.int64)
         backend = self._backend()
-        if backend == "mesh":
-            # compile the sharded frame + health programs at the
-            # current capacity (also folds any pending dirty rows,
-            # matching the host/device prewarm semantics)
-            self._mesh_full_frame(empty, empty, empty, empty, empty)
-            self.health_refresh()
-            return
-        self.device_tick(empty, empty, empty, empty, empty)
-        if backend == "device":
-            self.frame_tick(
-                empty, empty, empty, empty, empty,
-                hb_rows=np.zeros(1, np.int64),
-            )
+        # declared-warmup region: compiles here are the point of the
+        # call (capacity doubling / backend bring-up), so the compile
+        # guard must not count them against the steady window
+        with compileguard.warmup("prewarm at capacity %d" % self._cap):
+            if backend == "mesh":
+                # compile the sharded frame + health programs at the
+                # current capacity (also folds any pending dirty rows,
+                # matching the host/device prewarm semantics)
+                self._mesh_full_frame(empty, empty, empty, empty, empty)
+                self.health_refresh()
+                return
+            self.device_tick(empty, empty, empty, empty, empty)
+            if backend == "device":
+                self.frame_tick(
+                    empty, empty, empty, empty, empty,
+                    hb_rows=np.zeros(1, np.int64),
+                )

@@ -1,0 +1,229 @@
+"""compileguard — runtime compile-discipline guard (`RP_COMPILEGUARD=1`).
+
+The dynamic twin of rplint's RPL020/RPL021: where the linter proves a
+kernel's compile-signature set is bounded from source, compileguard
+catches a steady-state recompile *happening* — a shape, dtype, or
+static-arg value the warmup never saw reaching a jit'd kernel while
+the serving loop is live. On a TPU that stall is the mid-traffic
+compile failure class: the event loop blocks on XLA for hundreds of
+milliseconds, heartbeats starve, and spurious elections follow.
+
+Model — every jit'd kernel in the tree is registered through
+`instrument(fn, name)` at its definition site. A process starts in
+the **warmup** phase (compiles are expected: prewarm, bucket probing,
+first-shape traces). The harness calls `steady()` once its measured
+window begins; from then on ANY cache growth on an instrumented
+kernel fires a report naming the kernel and the exact signature that
+forced the trace. Declared growth sites (capacity doubling, explicit
+re-warm) wrap themselves in `with warmup(reason):` — the runtime
+analog of an inline `# rplint: bucketed=...` annotation: expected
+compiles are declared at the site with a justification, never
+silently absorbed.
+
+With `RP_COMPILEGUARD` unset, `instrument` registers the kernel (so
+`compile_counts()` still works for bench deltas) and returns it
+UNTOUCHED — no wrapper, no per-call branch — so the guard's
+off-state overhead is zero **by construction**, not by measurement
+(the rpsan recipe).
+
+Per-kernel compile counts come from the jit cache itself
+(`fn._cache_size()`); the `jax.monitoring` backend-compile hook
+corroborates with the number of actual XLA compilations attributed
+to the innermost instrumented kernel on the stack. Reports carry
+kernel names, phase, and the offending call signature (shapes x
+dtypes x static values) — no ids, no clocks, no durations — so a
+seeded reproduction is byte-stable.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+ENABLED = os.environ.get("RP_COMPILEGUARD", "") == "1"
+
+#: steady-state recompile reports, in detection order (bounded: a
+#: shape-wobbling loop should not OOM the process before the harness
+#: looks)
+_MAX_REPORTS = 1000
+REPORTS: list["Report"] = []
+
+#: name -> underlying jit callable (registered even when disabled, so
+#: compile_counts() deltas work in the default configuration)
+_KERNELS: dict[str, object] = {}
+
+#: innermost instrumented kernel currently executing (attribution
+#: stack for the backend-compile monitoring hook)
+_CURRENT: list[str] = []
+
+#: name -> XLA backend compiles attributed while that kernel was the
+#: innermost instrumented frame
+_BACKEND_COMPILES: dict[str, int] = {}
+
+_PHASE = "warmup"  # "warmup" until steady(); warmup() re-enters
+_WARMUP_DEPTH = 0
+_LISTENER_ON = False
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+@dataclass(frozen=True)
+class Report:
+    kernel: str  # instrument() name of the kernel that re-traced
+    signature: str  # the offending call signature (shapes x dtypes)
+    cache_size: int  # jit cache entries after the offending call
+    grew_by: int  # new entries this single call added (>= 1)
+
+    def render(self) -> str:
+        return (
+            f"compileguard: steady-state recompile of {self.kernel}: "
+            f"signature {self.signature} forced a fresh XLA trace "
+            f"(cache now {self.cache_size} entries, +{self.grew_by}) — "
+            "bucket the shape (ops.shapes.row_bucket), pin the dtype, "
+            "or declare the site with `with compileguard.warmup(...)`"
+        )
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def reports() -> list[Report]:
+    return list(REPORTS)
+
+
+def reset() -> None:
+    """Clear reports and return to the warmup phase (test harness
+    hook; production processes call steady() exactly once)."""
+    global _PHASE
+    REPORTS.clear()
+    _BACKEND_COMPILES.clear()
+    _PHASE = "warmup"
+
+
+def steady() -> None:
+    """Declare warmup over: from here, any instrumented-kernel cache
+    growth outside a `with warmup(...)` block is a finding."""
+    global _PHASE
+    _PHASE = "steady"
+
+
+def in_steady() -> bool:
+    return _PHASE == "steady" and _WARMUP_DEPTH == 0
+
+
+@contextmanager
+def warmup(reason: str):
+    """Declare a bounded region where compiles are expected — capacity
+    doubling, explicit prewarm, backend switch. `reason` documents the
+    why at the site (never silently absorbed); re-enterable."""
+    global _WARMUP_DEPTH
+    assert reason, "warmup() requires a justification string"
+    _WARMUP_DEPTH += 1
+    try:
+        yield
+    finally:
+        _WARMUP_DEPTH -= 1
+
+
+def compile_counts() -> dict[str, int]:
+    """name -> jit cache entries for every registered kernel. Works
+    with the guard off (registration is unconditional): bench steady
+    windows grade the before/after delta of this map."""
+    out = {}
+    for name, fn in sorted(_KERNELS.items()):
+        try:
+            out[name] = int(fn._cache_size())
+        except Exception:  # factory not yet called, foreign callable
+            out[name] = 0
+    return out
+
+
+def backend_compiles() -> dict[str, int]:
+    """Corroborating XLA backend-compile counts per kernel (guard-on
+    only; empty when disabled)."""
+    return dict(_BACKEND_COMPILES)
+
+
+def _listener(name: str, _secs: float, **_kw) -> None:
+    if name == _COMPILE_EVENT and _CURRENT:
+        k = _CURRENT[-1]
+        _BACKEND_COMPILES[k] = _BACKEND_COMPILES.get(k, 0) + 1
+
+
+def _ensure_listener() -> None:
+    global _LISTENER_ON
+    if _LISTENER_ON:
+        return
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(_listener)
+    _LISTENER_ON = True
+
+
+def _describe(args) -> str:
+    parts = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append(f"{tuple(shape)}:{dtype}")
+        else:
+            parts.append(repr(a))
+    return "(" + ", ".join(parts) + ")"
+
+
+class _Guard:
+    """Call-through wrapper for one instrumented kernel: forwards to
+    the underlying jit callable, and in the steady phase converts any
+    cache growth into a byte-stable report at the offending call."""
+
+    __slots__ = ("fn", "name")
+
+    def __init__(self, fn, name: str) -> None:
+        self.fn = fn
+        self.name = name
+
+    def _cache_size(self) -> int:
+        return int(self.fn._cache_size())
+
+    def __call__(self, *args, **kwargs):
+        check = in_steady()
+        before = self._cache_size() if check else 0
+        _CURRENT.append(self.name)
+        try:
+            out = self.fn(*args, **kwargs)
+        finally:
+            _CURRENT.pop()
+        if check:
+            after = self._cache_size()
+            if after > before:
+                report = Report(
+                    kernel=self.name,
+                    signature=_describe(args),
+                    cache_size=after,
+                    grew_by=after - before,
+                )
+                if len(REPORTS) < _MAX_REPORTS:
+                    REPORTS.append(report)
+                    print(report.render(), file=sys.stderr)
+        return out
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<compileguard {self.name} of {self.fn!r}>"
+
+
+def instrument(fn, name: str):
+    """Register jit callable `fn` under `name` and return the callable
+    to bind. With the guard off this IS `fn` (structural absence:
+    `instrument(f, n) is f`); with it on, a `_Guard` forwarding
+    wrapper. Factories that rebuild kernels (per-mesh programs)
+    re-register under the same name — latest wins, matching the
+    binding the live code path actually calls."""
+    _KERNELS[name] = fn
+    if not ENABLED:
+        return fn
+    _ensure_listener()
+    return _Guard(fn, name)

@@ -1,0 +1,227 @@
+"""compileguard: the runtime twin of rplint's RPL020/021.
+
+Off-state tests run in-process (tier-1 never sets RP_COMPILEGUARD, so
+the default import IS the off state and the structural-absence claim —
+`instrument(f, n) is f` — is checked directly, not simulated). On-state
+tests run armed subprocesses (`RP_COMPILEGUARD=1` is read at import),
+including the 8-forced-host-devices mesh leg, and assert the report
+stream is byte-stable across identical runs.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from redpanda_tpu.utils import compileguard  # noqa: E402
+
+_off = pytest.mark.skipif(
+    compileguard.enabled(), reason="suite assumes the default off state"
+)
+
+
+def _run_armed(tmp_path, body: str, extra_env: dict | None = None):
+    """Run `body` in a subprocess with the guard armed."""
+    script = tmp_path / "armed.py"
+    script.write_text(
+        "import os, sys\n"
+        'os.environ.setdefault("JAX_PLATFORMS", "cpu")\n'
+        f"sys.path.insert(0, {REPO_ROOT!r})\n" + body
+    )
+    env = dict(os.environ, RP_COMPILEGUARD="1")
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, str(script)],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+    )
+
+
+# -- off state (the tier-1 default) ------------------------------------
+
+
+@_off
+def test_off_instrument_is_structural_passthrough():
+    def fn(x):
+        return x
+
+    assert not compileguard.enabled()
+    # no wrapper, no per-call branch: the bound callable IS the kernel
+    assert compileguard.instrument(fn, "t.passthrough") is fn
+
+
+@_off
+def test_off_compile_counts_still_works():
+    import jax
+    import jax.numpy as jnp
+
+    kern = compileguard.instrument(jax.jit(lambda x: x + 1), "t.counts")
+    kern(jnp.zeros(4, jnp.int32))
+    kern(jnp.zeros(8, jnp.int32))
+    counts = compileguard.compile_counts()
+    assert counts["t.counts"] == 2
+    # registration is unconditional; non-jit callables count as 0
+    compileguard.instrument(len, "t.foreign")
+    assert compileguard.compile_counts()["t.foreign"] == 0
+    assert compileguard.backend_compiles() == {}
+
+
+def test_phase_semantics():
+    compileguard.reset()
+    try:
+        assert not compileguard.in_steady()
+        compileguard.steady()
+        assert compileguard.in_steady()
+        with compileguard.warmup("declared growth site"):
+            assert not compileguard.in_steady()
+            with compileguard.warmup("re-entered"):
+                assert not compileguard.in_steady()
+            assert not compileguard.in_steady()
+        assert compileguard.in_steady()
+        compileguard.reset()
+        assert not compileguard.in_steady()
+        assert compileguard.reports() == []
+    finally:
+        compileguard.reset()
+
+
+def test_warmup_requires_justification():
+    with pytest.raises(AssertionError):
+        with compileguard.warmup(""):
+            pass
+
+
+def test_report_render_is_byte_stable():
+    r = compileguard.Report(
+        kernel="lz4.compress_chunks",
+        signature="((8, 2064):uint8, (8,):int32, 2048)",
+        cache_size=2,
+        grew_by=1,
+    )
+    assert r.render() == (
+        "compileguard: steady-state recompile of lz4.compress_chunks: "
+        "signature ((8, 2064):uint8, (8,):int32, 2048) forced a fresh "
+        "XLA trace (cache now 2 entries, +1) — bucket the shape "
+        "(ops.shapes.row_bucket), pin the dtype, or declare the site "
+        "with `with compileguard.warmup(...)`"
+    )
+    # frozen: a report cannot be edited after the fact
+    with pytest.raises(Exception):
+        r.kernel = "other"
+
+
+# -- on state (armed subprocesses) -------------------------------------
+
+_WOBBLE = """\
+import jax
+import jax.numpy as jnp
+from redpanda_tpu.utils import compileguard
+
+assert compileguard.enabled()
+kern = compileguard.instrument(jax.jit(lambda x: x * 2), "t.kern")
+assert type(kern).__name__ == "_Guard"
+kern(jnp.zeros(8, jnp.int32))           # warmup trace: expected
+compileguard.steady()
+kern(jnp.ones(8, jnp.int32))            # warm signature: no growth
+assert compileguard.reports() == []
+kern(jnp.zeros(16, jnp.int32))          # shape wobble: fresh trace
+(r,) = compileguard.reports()
+assert r.kernel == "t.kern" and r.grew_by == 1 and r.cache_size == 2
+print(r.render())
+print(sorted(compileguard.compile_counts().items()))
+print(sorted(compileguard.backend_compiles().items()))
+"""
+
+
+def test_on_shape_wobble_reported_byte_stable(tmp_path):
+    first = _run_armed(tmp_path, _WOBBLE)
+    assert first.returncode == 0, first.stderr
+    assert "steady-state recompile of t.kern" in first.stdout
+    assert "signature ((16,):int32)" in first.stdout
+    # jit cache: 2 entries; monitoring hook corroborates 2 XLA compiles
+    assert "[('t.kern', 2)]" in first.stdout
+    assert first.stdout.count("[('t.kern', 2)]") == 2
+    # the report also lands on stderr at detection time
+    assert "steady-state recompile of t.kern" in first.stderr
+    second = _run_armed(tmp_path, _WOBBLE)
+    assert second.returncode == 0, second.stderr
+    assert second.stdout == first.stdout  # byte-stable reproduction
+
+
+_WARMUP_EXEMPT = """\
+import jax
+import jax.numpy as jnp
+from redpanda_tpu.utils import compileguard
+
+kern = compileguard.instrument(jax.jit(lambda x: x + 1), "t.kern")
+kern(jnp.zeros(8, jnp.int32))
+compileguard.steady()
+with compileguard.warmup("capacity doubling prewarms the next bucket"):
+    kern(jnp.zeros(16, jnp.int32))      # declared: exempt
+with compileguard.warmup("outer"):
+    with compileguard.warmup("inner re-entry"):
+        kern(jnp.zeros(32, jnp.int32))  # still exempt at depth 2
+assert compileguard.reports() == [], compileguard.reports()
+kern(jnp.zeros(64, jnp.int32))          # undeclared: a finding
+assert len(compileguard.reports()) == 1
+compileguard.reset()                    # back to warmup, reports gone
+assert compileguard.reports() == [] and not compileguard.in_steady()
+kern(jnp.zeros(128, jnp.int32))
+assert compileguard.reports() == []
+print("ARMED-WARMUP-OK")
+"""
+
+
+def test_on_warmup_exemption_and_reset(tmp_path):
+    out = _run_armed(tmp_path, _WARMUP_EXEMPT)
+    assert out.returncode == 0, out.stderr
+    assert "ARMED-WARMUP-OK" in out.stdout
+
+
+_MESH = """\
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import numpy as np
+import jax
+from redpanda_tpu.parallel.mesh_frame import MeshFrame
+from redpanda_tpu.raft.shard_state import ShardGroupArrays
+from redpanda_tpu.utils import compileguard
+
+assert len(jax.devices()) == 8
+
+
+def arrays_of(cap):
+    arrays = ShardGroupArrays(capacity=cap)
+    row = arrays.alloc_row()
+    arrays.is_leader[row] = True
+    arrays.touch()
+    return arrays, row
+
+
+def cols(row):
+    return tuple(np.array([v], np.int64) for v in (row, 1, 5, 5, 1))
+
+
+frame = MeshFrame()
+a64, row = arrays_of(64)
+frame.run(a64, *cols(row))              # first frame compiles: warmup
+frame.run_health(a64)
+compileguard.steady()
+frame.run(a64, *cols(row))              # warm shapes across 8 chips
+frame.run_health(a64)
+assert compileguard.reports() == [], compileguard.reports()
+a128, row2 = arrays_of(128)
+frame.run(a128, *cols(row2))            # row axis doubled: fresh trace
+(r,) = compileguard.reports()
+assert r.kernel == "mesh_frame.tick_frame", r
+print("ARMED-MESH-OK", len(jax.devices()))
+"""
+
+
+def test_on_mesh_eight_forced_devices(tmp_path):
+    out = _run_armed(tmp_path, _MESH)
+    assert out.returncode == 0, out.stderr
+    assert "ARMED-MESH-OK 8" in out.stdout

@@ -15,6 +15,7 @@ import os
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
@@ -1934,3 +1935,319 @@ def test_rpl019_baseline_is_empty():
     partition hot paths only ever touch the public registry."""
     baseline = load_baseline()
     assert [k for k in baseline if k.endswith("::RPL019")] == []
+
+
+# -- RPL020: compile discipline (device-plane shape/dtype interp) ------
+
+RPL020_UNBOUNDED = """\
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _kern(batch, width):
+    return batch
+
+
+def wrapper(chunks, width):
+    batch = np.zeros((len(chunks), width), np.uint8)
+    return _kern(jnp.asarray(batch), width)
+"""
+
+
+def test_rpl020_data_dependent_rows_flagged(tmp_path):
+    (f,) = _only(_lint_source(tmp_path, RPL020_UNBOUNDED), "RPL020")
+    assert "unbounded compile-signature set" in f.message
+    assert "'_kern'" in f.message and "dim 0 is data-dependent" in f.message
+    assert "row_bucket" in f.message  # the fix is named in the finding
+    assert f.qualname == "wrapper" and f.attr == "_kern"
+
+
+def test_rpl020_while_doubling_bucket_clean(tmp_path):
+    src = RPL020_UNBOUNDED.replace(
+        "    batch = np.zeros((len(chunks), width), np.uint8)",
+        "    rows = 8\n"
+        "    while rows < len(chunks):\n"
+        "        rows *= 2\n"
+        "    batch = np.zeros((rows, width), np.uint8)",
+    )
+    assert _only(_lint_source(tmp_path, src), "RPL020") == []
+
+
+def test_rpl020_row_bucket_helper_clean(tmp_path):
+    src = RPL020_UNBOUNDED.replace(
+        "import numpy as np",
+        "import numpy as np\n\nfrom redpanda_tpu.ops.shapes import row_bucket",
+    ).replace(
+        "    batch = np.zeros((len(chunks), width), np.uint8)",
+        "    rows = row_bucket(len(chunks))\n"
+        "    batch = np.zeros((rows, width), np.uint8)",
+    )
+    assert _only(_lint_source(tmp_path, src), "RPL020") == []
+
+
+def test_rpl020_bucketed_annotation_clean(tmp_path):
+    src = RPL020_UNBOUNDED.replace(
+        "    batch = np.zeros((len(chunks), width), np.uint8)",
+        "    batch = np.zeros((len(chunks), width),"
+        " np.uint8)  # rplint: bucketed=caller pads to the frame cap",
+    )
+    assert _only(_lint_source(tmp_path, src), "RPL020") == []
+
+
+def test_rpl020_concatenate_result_flagged(tmp_path):
+    src = RPL020_UNBOUNDED.replace(
+        "    batch = np.zeros((len(chunks), width), np.uint8)",
+        "    batch = np.concatenate(chunks)",
+    )
+    (f,) = _only(_lint_source(tmp_path, src), "RPL020")
+    assert "unbounded compile-signature set" in f.message
+
+
+RPL020_WEAK_SCALAR = """\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _scale(batch, k):
+    return batch * k
+
+
+def wrapper(batch, items):
+    padded = np.zeros((8, 4), np.uint8)
+    return _scale(jnp.asarray(padded), 3)
+"""
+
+
+def test_rpl020_weak_scalar_flagged_pinned_clean(tmp_path):
+    (f,) = _only(_lint_source(tmp_path, RPL020_WEAK_SCALAR), "RPL020")
+    assert "weak-typed Python scalar '3'" in f.message
+    assert "np.int64" in f.message
+    pinned = RPL020_WEAK_SCALAR.replace(
+        "jnp.asarray(padded), 3)", "jnp.asarray(padded), np.int64(3))"
+    )
+    assert _only(_lint_source(tmp_path, pinned), "RPL020") == []
+
+
+def test_rpl020_data_dependent_traced_scalar_flagged(tmp_path):
+    src = RPL020_WEAK_SCALAR.replace(
+        "jnp.asarray(padded), 3)", "jnp.asarray(padded), len(items))"
+    )
+    (f,) = _only(_lint_source(tmp_path, src), "RPL020")
+    assert "weak-typed AND unbounded" in f.message
+
+
+def test_rpl020_data_dependent_static_flagged(tmp_path):
+    src = RPL020_UNBOUNDED.replace(
+        "    batch = np.zeros((len(chunks), width), np.uint8)",
+        "    batch = np.zeros((8, 16), np.uint8)",
+    ).replace(
+        "    return _kern(jnp.asarray(batch), width)",
+        "    return _kern(jnp.asarray(batch), len(chunks))",
+    )
+    (f,) = _only(_lint_source(tmp_path, src), "RPL020")
+    assert "static arg 1" in f.message
+    assert "one XLA compilation per distinct value" in f.message
+
+
+RPL020_DRIFT = """\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _kern(lane):
+    return lane
+
+
+def lane_a(x):
+    return _kern(jnp.asarray(x, jnp.int64))
+
+
+def lane_b(x):
+    return _kern(jnp.asarray(x, jnp.int64))
+
+
+def lane_c(x):
+    return _kern(jnp.asarray(x, jnp.int32))
+"""
+
+
+def test_rpl020_dtype_drift_minority_flagged(tmp_path):
+    (f,) = _only(_lint_source(tmp_path, RPL020_DRIFT), "RPL020")
+    assert "dtype drift" in f.message
+    assert "int32 here vs int64" in f.message
+    assert f.qualname == "lane_c"
+
+
+def test_rpl020_platform_default_dtype_flagged(tmp_path):
+    src = RPL020_DRIFT.replace(
+        "def lane_c(x):\n    return _kern(jnp.asarray(x, jnp.int32))",
+        "def lane_c(x, y):\n    return _kern(np.asarray([x, y]))",
+    )
+    (f,) = _only(_lint_source(tmp_path, src), "RPL020")
+    assert "without an explicit dtype" in f.message
+    assert "pin int64" in f.message and "pass dtype=" in f.message
+
+
+RPL020_CAP = """\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _kern(batch):
+    return batch
+
+
+class Frame:
+    def __init__(self):
+        self._cap = 64
+
+    def grow(self):
+        self._cap = self._cap * 2
+
+    def tick(self):
+        batch = np.zeros((self._cap, 8), np.uint8)
+        return _kern(jnp.asarray(batch))
+"""
+
+
+def test_rpl020_verified_cap_census_clean(tmp_path):
+    # every write to self._cap is a pow2 const or a doubling, so a
+    # cap-sized construction has a log-bounded signature set
+    assert _only(_lint_source(tmp_path, RPL020_CAP), "RPL020") == []
+
+
+def test_rpl020_suppression(tmp_path):
+    src = RPL020_UNBOUNDED.replace(
+        "    return _kern(jnp.asarray(batch), width)",
+        "    return _kern(jnp.asarray(batch), width)"
+        "  # rplint: disable=RPL020",
+    )
+    assert _only(_lint_source(tmp_path, src), "RPL020") == []
+
+
+def test_rpl020_baseline_is_empty():
+    """Compile discipline holds from day one: every device-plane call
+    site buckets its data-dependent dims; nothing grandfathered."""
+    baseline = load_baseline()
+    assert [k for k in baseline if k.endswith("::RPL020")] == []
+
+
+# -- RPL021: donation/layout discipline --------------------------------
+
+RPL021_REMAT = """\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _fold(x):
+    return x + 1
+
+
+@jax.jit
+def _commit(x):
+    return x * 2
+
+
+def frame(state):
+    folded = _fold(state)
+    acks = np.asarray(folded)
+    return _commit(jnp.asarray(acks))
+"""
+
+
+def test_rpl021_rematerialization_between_kernels_flagged(tmp_path):
+    (f,) = _only(_lint_source(tmp_path, RPL021_REMAT), "RPL021")
+    assert "re-materializes device value 'folded'" in f.message
+    assert "breaks buffer" in f.message
+    assert f.qualname == "frame" and f.attr == "folded"
+
+
+def test_rpl021_writeback_after_last_kernel_clean(tmp_path):
+    src = RPL021_REMAT.replace(
+        "    folded = _fold(state)\n"
+        "    acks = np.asarray(folded)\n"
+        "    return _commit(jnp.asarray(acks))",
+        "    folded = _fold(state)\n"
+        "    out = _commit(folded)\n"
+        "    return np.asarray(out)",
+    )
+    assert _only(_lint_source(tmp_path, src), "RPL021") == []
+
+
+RPL021_HOT_UPLOAD = """\
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _fold(x, rows):
+    return x
+
+
+class Frame:
+    def __init__(self):
+        self._prog = jax.jit(_fold)
+
+    def tick(self, rows):  # rplint: hot
+        return self._prog(jnp.asarray(self.mirror), rows)
+"""
+
+
+def test_rpl021_hot_mirror_upload_flagged(tmp_path):
+    (f,) = _only(_lint_source(tmp_path, RPL021_HOT_UPLOAD), "RPL021")
+    assert "uploads a host mirror" in f.message
+    assert "prewarm/grow" in f.message
+    assert f.qualname == "Frame.tick" and f.attr == "mirror"
+
+
+def test_rpl021_upload_outside_hot_path_clean(tmp_path):
+    src = RPL021_HOT_UPLOAD.replace("  # rplint: hot", "")
+    assert _only(_lint_source(tmp_path, src), "RPL021") == []
+
+
+def test_rpl021_suppression(tmp_path):
+    src = RPL021_REMAT.replace(
+        "    acks = np.asarray(folded)",
+        "    acks = np.asarray(folded)  # rplint: disable=RPL021",
+    )
+    assert _only(_lint_source(tmp_path, src), "RPL021") == []
+
+
+def test_rpl021_baseline_is_empty():
+    """Donation/layout discipline holds from day one: chained kernels
+    hand device arrays forward; nothing grandfathered."""
+    baseline = load_baseline()
+    assert [k for k in baseline if k.endswith("::RPL021")] == []
+
+
+def test_devplane_facts_ride_summary_cache_warm_fast(tmp_path, monkeypatch):
+    """The shape/dtype facts ride the SAME content-hash cache entry as
+    the race summaries (one entry per file, no second cache), so a
+    warm whole-tree device-plane lint is pure cache replay."""
+    from tools.rplint import cache as cache_mod
+    from tools.rplint.engine import default_rules
+
+    monkeypatch.setattr(cache_mod, "CACHE_DIR", str(tmp_path / "cache"))
+    monkeypatch.chdir(REPO_ROOT)
+    dev = [r for r in default_rules() if r.code in ("RPL020", "RPL021")]
+    cold = run_paths(["redpanda_tpu"], rules=dev, cache=True)
+    n_entries = len(os.listdir(str(tmp_path / "cache")))
+    t0 = time.perf_counter()
+    warm = run_paths(["redpanda_tpu"], rules=dev, cache=True)
+    warm_s = time.perf_counter() - t0
+    assert warm == cold == []
+    # warm run added no entries: dev facts did not spill to a 2nd cache
+    assert len(os.listdir(str(tmp_path / "cache"))) == n_entries
+    assert warm_s <= 2.0, f"warm device-plane lint took {warm_s:.2f}s"

@@ -173,6 +173,19 @@ def gate(fresh: dict, history: list, tolerance: float) -> tuple[list, list]:
     """Returns (rows, failures); a row is a human-readable verdict."""
     rows, failures = [], []
     for name, cur in sorted(fresh.items()):
+        # compile-discipline metrics are graded ABSOLUTE, not against
+        # the trajectory: the steady-state recompile count must be
+        # exactly zero (a ratio vs a zero baseline is meaningless, and
+        # "only a few recompiles" is still a mid-traffic XLA stall)
+        if cur["unit"] == "recompiles":
+            if cur["value"] > 0:
+                line = (f"FAIL  {name}: {cur['value']:g} steady-state "
+                        "recompile(s) — must be exactly 0")
+                rows.append(line)
+                failures.append(line)
+            else:
+                rows.append(f"OK    {name}: 0 recompiles (absolute gate)")
+            continue
         ref = None
         for rnd, fname, metrics in reversed(history):
             if name in metrics:
@@ -211,6 +224,8 @@ def selftest(pattern: str, tolerance: float) -> int:
         "x_wall_for_10x_groups": -1, "x_wall_for_20x_groups": -1,
         "ratio": -1, "ratio_vs_host": -1,
         "count": 0, "": 0,
+        # graded absolutely in gate(), not by direction
+        "recompiles": 0,
     }
     for unit, want in unit_cases.items():
         if _direction(unit) != want:
@@ -256,6 +271,24 @@ def selftest(pattern: str, tolerance: float) -> int:
               file=sys.stderr)
         return 2
 
+    # absolute recompile gate: zero passes with NO trajectory
+    # reference; any positive count fails even though a ratio against
+    # the zero baseline would be undefined
+    clean = {"steady_recompiles_100000_groups":
+             {"value": 0.0, "unit": "recompiles"}}
+    _, failures = gate(dict(clean), [], tolerance)
+    if failures:
+        print("bench_gate selftest: zero-recompile summary failed",
+              file=sys.stderr)
+        return 2
+    dirty = {"steady_recompiles_100000_groups":
+             {"value": 2.0, "unit": "recompiles"}}
+    _, failures = gate(dirty, [], tolerance)
+    if len(failures) != 1:
+        print("bench_gate selftest: steady-state recompiles slipped "
+              "through the absolute gate", file=sys.stderr)
+        return 2
+
     history = load_history(pattern)
     if not history:
         print(f"bench_gate selftest: no trajectory matched {pattern}",
@@ -294,7 +327,8 @@ def selftest(pattern: str, tolerance: float) -> int:
         caught.append(name)
     print(f"bench_gate selftest: ok ({len(history)} rounds, "
           f"{len(graded)} graded metrics, {len(mesh_round)} synthetic "
-          f"mesh metrics, regressions caught on {len(caught)} unit "
+          f"mesh metrics, absolute recompile gate exercised, "
+          f"regressions caught on {len(caught)} unit "
           f"probes: {', '.join(caught)})")
     return 0
 

@@ -47,10 +47,15 @@ def main() -> int:
         args.tiered = True
 
     from chaos_harness import run_chaos
-    from redpanda_tpu.utils import rpsan
+    from redpanda_tpu.utils import compileguard, rpsan
 
     if rpsan.enabled():
         print("rpsan armed: torn-write reports fail the iteration")
+    if compileguard.enabled():
+        print(
+            "compileguard armed: after the first iteration compiles "
+            "the kernels, any steady-state recompile fails its iteration"
+        )
 
     shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
 
@@ -88,6 +93,20 @@ def main() -> int:
                     + "; ".join(r.render() for r in reps)
                 )
             stats["rpsan_reports"] = 0
+        # RP_COMPILEGUARD=1: iteration 1 warms every kernel (the jit
+        # caches outlive the per-iteration clusters); from then on a
+        # fresh XLA trace mid-soak is a mid-traffic compile stall
+        if compileguard.enabled():
+            creps = compileguard.reports()
+            if creps:
+                detail = "; ".join(r.render() for r in creps)
+                compileguard.reset()
+                compileguard.steady()
+                raise AssertionError(
+                    f"compileguard: {len(creps)} steady-state "
+                    f"recompile(s): {detail}"
+                )
+            stats["compileguard_reports"] = 0
         return stats
 
     if args.seed is not None:
@@ -104,6 +123,8 @@ def main() -> int:
         t0 = time.monotonic()
         try:
             stats = one(seed)
+            if n == 1:
+                compileguard.steady()
             store = ""
             if "store_faults" in stats:
                 store = (

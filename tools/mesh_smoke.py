@@ -98,7 +98,16 @@ async def run_scenario(backend: str, mesh_full: bool) -> dict:
                         raise
                     await asyncio.sleep(0.2)
 
+            # compile discipline: the first two partitions' produce
+            # traffic is warmup (first folds compile the tick/mesh
+            # programs); from there every jit trace is a steady-state
+            # recompile finding under RP_COMPILEGUARD=1
+            from redpanda_tpu.utils import compileguard
+
+            compileguard.reset()
             for p in range(N_PARTITIONS):
+                if p == 2:
+                    compileguard.steady()
                 for i in range(0, RECORDS_PER_PARTITION, 8):
                     batch = [
                         (b"k%06d" % (i + j), b"v%d.%d" % (p, i + j))
@@ -155,6 +164,20 @@ async def run_scenario(backend: str, mesh_full: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _guard_check() -> str:
+    """Fail the smoke on any steady-state recompile; returns the OK
+    line's status fragment."""
+    from redpanda_tpu.utils import compileguard
+
+    if not compileguard.enabled():
+        return ""
+    reps = compileguard.reports()
+    assert not reps, "steady-state recompiles:\n" + "\n".join(
+        r.render() for r in reps
+    )
+    return ", compile-guard clean"
+
+
 async def main() -> None:
     backend = os.environ.get("RP_QUORUM_BACKEND", "host")
 
@@ -196,6 +219,7 @@ async def main() -> None:
             f"MESH-SMOKE-OK: mesh backend ({chips} chips), "
             f"{N_PARTITIONS}x{RECORDS_PER_PARTITION} records rf=1, "
             "fetch ledger + end offsets byte-identical vs host"
+            + _guard_check()
         )
         return
 
@@ -213,7 +237,7 @@ async def main() -> None:
     print(
         f"MESH-SMOKE-OK: {backend} stand-down, "
         f"{N_PARTITIONS}x{RECORDS_PER_PARTITION} records rf=1, "
-        "mesh machinery cold"
+        "mesh machinery cold" + _guard_check()
     )
 
 

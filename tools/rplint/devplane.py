@@ -1,0 +1,923 @@
+"""Device-plane shape/dtype facts for rplint (pass 1 of RPL020/021).
+
+The live replication plane is a handful of jit'd kernels (ops/,
+parallel/, raft/tick_frame.py callers); every DISTINCT combination of
+arg shapes x dtypes x static-arg values a kernel sees is one XLA
+compilation. A call site that feeds a kernel a data-dependent shape
+(`len(arrs)` rows, a `.shape`-derived width) compiles once per value —
+the silent-recompile failure class fixed-shape bucketed TPU kernels
+exist to prevent. This module is the abstract interpreter that makes
+that provable per call site, as plain serializable facts riding the
+same content-hash cache entry as the race summaries (program.py,
+SUMMARY_VERSION).
+
+Dimension lattice (one atom per array dimension / scalar value):
+
+  ["c", N]        literal constant
+  ["p2"]          bucketed: a power-of-two while-doubling site
+                  (`b = 8; while b < m: b *= 2`), an `ops.shapes`
+                  bucket helper, or a `# rplint: bucketed=<why>`
+                  declared-cap annotation — log-many distinct values,
+                  a BOUNDED compile-signature set
+  ["cap", attr]   sized by `self.<attr>`; pass 2 verifies the cap
+                  census (every write a pow2 const or a doubling) —
+                  verified caps are bounded, unverified stay unknown
+  ["cap2", attr]  `self.<attr> * 2` (the doubling-growth write shape)
+  ["data"]        PROVABLY data-dependent: `len(<param>)`, `.shape`
+                  of an untracked value, np.concatenate/unique/
+                  flatnonzero/stack-over-comprehension results
+  ["unk"]         unknown — deliberately NOT flagged; only proven
+                  data-dependence fires RPL020
+
+Per function the walker records: kernel-call candidates with per-arg
+facts (array dims+dtype, Python-scalar leaks, `self.<attr>` mirrors),
+cap writes (`self._cap = 64` / `self._cap = new`), host
+materializations of device-tainted values, `jnp.asarray(self.<attr>)`
+uploads, the `# rplint: hot` marker and jit-factory returns. Module
+prepass records the jit registry: decorated defs (with static
+argnums), module-level `X_jit = jax.jit(f)` bindings, `self.X =
+jax.jit(f)` instance bindings and factories returning `jax.jit(f)` —
+all unwrapped through `compileguard.instrument(...)`.
+
+Approximations, documented for triage: kwargs at kernel call sites
+are not modeled (kernels are called positionally by convention),
+taint does not flow through containers, and cross-file kernel calls
+resolve by module-name hint (`lz4._compress_chunks` -> ops/lz4.py) —
+private kernels are only matched within their own file or through an
+explicit module attribute.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import dotted_name
+
+_DEV_RULES = frozenset({"RPL020", "RPL021"})
+_HOT_MARK_RE = re.compile(r"#\s*rplint:\s*hot\b")
+_BUCKETED_RE = re.compile(r"#\s*rplint:\s*bucketed\b")
+_DEVICE_CALL_RE = re.compile(
+    r"(^|\.)(jnp|jax)(\.|$)|_jit$|(^|\.)to_device_state$"
+)
+_DTYPE_NAMES = {
+    "uint8", "int8", "int16", "uint16", "int32", "uint32",
+    "int64", "uint64", "float16", "float32", "float64",
+    "bool_", "bool", "bfloat16",
+}
+# ctor -> positional index of the dtype argument (shape is arg 0)
+_SHAPE_CTORS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2}
+_AS_ARRAY = {"asarray", "array", "ascontiguousarray"}
+# results whose length is the data itself
+_DATA_FUNCS = {
+    "concatenate", "unique", "flatnonzero", "nonzero", "fromiter",
+    "frombuffer", "packbits", "unpackbits", "where", "repeat",
+}
+_BUCKET_FUNCS = {"row_bucket", "pow2_bucket"}
+_MATERIALIZER_LASTS = {"asarray", "array", "ascontiguousarray"}
+_NP_PREFIXES = {"np", "numpy"}
+_JNP_PREFIXES = {"jnp", "jax"}
+
+UNK = ("unk",)
+
+
+def _is_pow2(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool) and v > 0 and (
+        v & (v - 1)
+    ) == 0
+
+
+def _prefix_last(name: str) -> tuple[str, str]:
+    parts = name.split(".")
+    return parts[0], parts[-1]
+
+
+def _dtype_of(expr: ast.AST | None) -> str:
+    """Dtype name of a dtype-position expression ("" when absent or
+    unresolvable). `np.uint8`, `jnp.int32`, bare `uint8`, "uint8"."""
+    if expr is None:
+        return ""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        name = expr.value
+    else:
+        name = dotted_name(expr).rsplit(".", 1)[-1]
+    if name in _DTYPE_NAMES:
+        return "bool" if name == "bool_" else name
+    return ""
+
+
+def _static_argnums(call: ast.Call) -> list:
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return [v.value]
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return [
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                ]
+    return []
+
+
+def _unwrap_instrument(expr: ast.AST) -> ast.AST:
+    """`compileguard.instrument(<jit expr>, "name")` -> `<jit expr>`."""
+    if (
+        isinstance(expr, ast.Call)
+        and dotted_name(expr.func).rsplit(".", 1)[-1] == "instrument"
+        and expr.args
+    ):
+        return expr.args[0]
+    return expr
+
+
+def _jit_call_info(expr: ast.AST):
+    """(target_expr, static_argnums) when `expr` is a `jax.jit(...)`
+    call (possibly wrapped in compileguard.instrument), else None."""
+    expr = _unwrap_instrument(expr)
+    if not isinstance(expr, ast.Call):
+        return None
+    name = dotted_name(expr.func)
+    if name not in ("jax.jit", "jit"):
+        return None
+    target = expr.args[0] if expr.args else None
+    return target, _static_argnums(expr)
+
+
+def _decorator_jit_info(dec: ast.AST):
+    """static_argnums for a `@jax.jit` / `@partial(jax.jit, ...)` /
+    `@functools.partial(jax.jit, static_argnums=...)` decorator, or
+    None when the decorator is not a jit."""
+    if dotted_name(dec) in ("jax.jit", "jit"):
+        return []
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        if fname in ("jax.jit", "jit"):
+            return _static_argnums(dec)
+        if fname in ("functools.partial", "partial") and dec.args:
+            if dotted_name(dec.args[0]) in ("jax.jit", "jit"):
+                return _static_argnums(dec)
+    return None
+
+
+class Prepass:
+    """Per-file jit registry + module constant env, built once before
+    the per-function walks."""
+
+    def __init__(self, ctx) -> None:
+        self.consts: dict[str, int] = {}
+        self.jitdefs: list[dict] = []
+        self.jitnames: set[str] = set()
+        self.selfattr: set[str] = set()
+        self.factories: set[str] = set()
+        self._scan(ctx)
+
+    def _scan(self, ctx) -> None:
+        # decorated kernel defs (and jit factories) first, so a
+        # module-level `f = compileguard.instrument(f, ...)` rebind of
+        # an already-registered kernel is recognized as a passthrough
+        for scope in ctx.functions():
+            node = scope.node
+            for dec in node.decorator_list:
+                static = _decorator_jit_info(dec)
+                if static is not None:
+                    self.jitdefs.append({
+                        "n": node.name, "t": scope.qualname, "k": "decor",
+                        "s": static, "c": "", "l": node.lineno,
+                    })
+                    self.jitnames.add(node.name)
+                    break
+            for st in ast.walk(node):
+                if isinstance(st, ast.Return) and st.value is not None:
+                    if _jit_call_info(st.value) is not None:
+                        self.factories.add(node.name)
+                        self.jitdefs.append({
+                            "n": node.name, "t": scope.qualname,
+                            "k": "factory", "s": [], "c": "",
+                            "l": node.lineno,
+                        })
+                        break
+            cls = ""
+            for parent in reversed(scope.parents):
+                if isinstance(parent, ast.ClassDef):
+                    cls = parent.name
+                    break
+            for st in ast.walk(node):
+                if not (isinstance(st, ast.Assign) and len(st.targets) == 1):
+                    continue
+                tgt = st.targets[0]
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    info = _jit_call_info(st.value)
+                    if info is not None:
+                        self.jitdefs.append({
+                            "n": tgt.attr, "t": dotted_name(info[0])
+                            if info[0] is not None else "",
+                            "k": "self", "s": info[1], "c": cls,
+                            "l": st.lineno,
+                        })
+                        self.selfattr.add(tgt.attr)
+        for st in ctx.tree.body:
+            if not (isinstance(st, ast.Assign) and len(st.targets) == 1):
+                continue
+            tgt = st.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            if isinstance(st.value, ast.Constant) and isinstance(
+                st.value.value, int
+            ) and not isinstance(st.value.value, bool):
+                self.consts[tgt.id] = st.value.value
+                continue
+            info = _jit_call_info(st.value)
+            if info is None:
+                continue
+            target, static = info
+            tname = dotted_name(target) if target is not None else ""
+            if tname == tgt.id and tgt.id in self.jitnames:
+                continue  # instrument() passthrough of a decorated kernel
+            self.jitdefs.append({
+                "n": tgt.id, "t": tname, "k": "mod", "s": static,
+                "c": "", "l": st.lineno,
+            })
+            self.jitnames.add(tgt.id)
+
+
+class _DevWalker:
+    """One source-order walk of a function body. Facts are tuples:
+    ("arr", dims, dtype) | ("sc", atom) | ("seq", facts) |
+    ("param",) | ("attr", name) | ("unk",)."""
+
+    def __init__(self, ctx, scope, pre: Prepass) -> None:
+        self.ctx = ctx
+        self.pre = pre
+        self.scope = scope
+        self.lines = ctx.source.splitlines()
+        self.env: dict[str, tuple] = {}
+        self.prov: dict[str, str] = {}
+        self.tainted: set[str] = set()
+        self.jc: list[dict] = []
+        self.mat: list[dict] = []
+        self.up: list[dict] = []
+        self.cw: list[dict] = []
+        args = scope.node.args
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        ):
+            if a.arg != "self":
+                self.env[a.arg] = ("param",)
+
+    # -- bookkeeping ---------------------------------------------------
+    def _sup(self, node: ast.AST) -> list:
+        start = getattr(node, "lineno", 0)
+        end = getattr(node, "end_lineno", start)
+        out: set[str] = set()
+        for line in range(start, end + 1):
+            out |= self.ctx.suppressions.get(line, set()) & _DEV_RULES
+        return sorted(out)
+
+    def _bucketed(self, node: ast.AST) -> bool:
+        start = getattr(node, "lineno", 1)
+        end = getattr(node, "end_lineno", start)
+        for line in range(start, min(end, len(self.lines)) + 1):
+            if _BUCKETED_RE.search(self.lines[line - 1]):
+                return True
+        return False
+
+    def _device_producing(self, name: str) -> bool:
+        last = name.rsplit(".", 1)[-1]
+        return bool(_DEVICE_CALL_RE.search(name)) or last in self.pre.jitnames
+
+    def _mentions_tainted(self, expr: ast.AST) -> str:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in self.tainted:
+                return node.id
+            if isinstance(node, ast.Call) and self._device_producing(
+                dotted_name(node.func)
+            ):
+                return dotted_name(node.func)
+        return ""
+
+    def _self_attr_in(self, expr: ast.AST) -> str:
+        for node in ast.walk(expr):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                return node.attr
+        return ""
+
+    # -- scalar atoms --------------------------------------------------
+    def _atom(self, fact: tuple) -> list:
+        if fact[0] == "sc":
+            return fact[1]
+        if fact[0] == "attr":
+            return ["cap", fact[1]]
+        return ["unk"]
+
+    def _len_atom(self, fact: tuple) -> list:
+        if fact[0] == "arr" and fact[1]:
+            return fact[1][0]
+        if fact[0] == "seq":
+            return ["c", len(fact[1])]
+        if fact[0] == "comp":
+            return fact[1]
+        if fact[0] in ("param", "attr"):
+            return ["data"]
+        return ["unk"]
+
+    # -- expression evaluation ----------------------------------------
+    def ev(self, node: ast.AST | None) -> tuple:
+        if node is None:
+            return UNK
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if isinstance(v, int) and not isinstance(v, bool):
+                return ("sc", ["c", v])
+            return UNK
+        if isinstance(node, ast.Name):
+            if node.id in self.pre.consts:
+                return ("sc", ["c", self.pre.consts[node.id]])
+            return self.env.get(node.id, UNK)
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                return ("attr", node.attr)
+            if node.attr in ("size", "nbytes"):
+                base = self.ev(node.value)
+                if base[0] in ("arr", "param", "attr"):
+                    return ("sc", ["data"])
+            return UNK
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return ("seq", [self.ev(e) for e in node.elts])
+        if isinstance(node, ast.BinOp):
+            return self._binop(node)
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.Subscript):
+            return self._subscript(node)
+        if isinstance(node, ast.IfExp):
+            self.ev(node.test)
+            a, b = self.ev(node.body), self.ev(node.orelse)
+            return a if a == b else UNK
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            # length of a comprehension = length of its (outer) iterable
+            it = self.ev(node.generators[0].iter) if node.generators else UNK
+            return ("comp", self._len_atom(it))
+        if isinstance(node, (ast.Lambda, ast.Await)):
+            if isinstance(node, ast.Await):
+                return self.ev(node.value)
+            return UNK
+        if isinstance(node, (ast.UnaryOp,)):
+            return self.ev(node.operand)
+        if isinstance(node, (ast.Compare, ast.BoolOp)):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self.ev(child)
+            return UNK
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.ev(child)
+        return UNK
+
+    def _binop(self, node: ast.BinOp) -> tuple:
+        left, right = self.ev(node.left), self.ev(node.right)
+        if left[0] == "sc" and right[0] == "sc":
+            return ("sc", self._combine(left[1], right[1], node.op))
+        # self.<cap> * 2 — the doubling-growth shape
+        for a, b in ((left, right), (right, left)):
+            if (
+                a[0] == "attr"
+                and isinstance(node.op, ast.Mult)
+                and b[0] == "sc"
+                and b[1][:2] == ["c", 2]
+            ):
+                return ("sc", ["cap2", a[1]])
+        if left[0] == "arr":
+            return self._promote(left, right)
+        if right[0] == "arr":
+            return self._promote(right, left)
+        return UNK
+
+    @staticmethod
+    def _promote(arr: tuple, other: tuple) -> tuple:
+        if other[0] == "arr" and other[2] != arr[2]:
+            return ("arr", arr[1], "")
+        return arr
+
+    @staticmethod
+    def _combine(a: list, b: list, op: ast.operator) -> list:
+        if a[0] == "c" and b[0] == "c":
+            try:
+                if isinstance(op, ast.Add):
+                    return ["c", a[1] + b[1]]
+                if isinstance(op, ast.Sub):
+                    return ["c", a[1] - b[1]]
+                if isinstance(op, ast.Mult):
+                    return ["c", a[1] * b[1]]
+                if isinstance(op, ast.FloorDiv) and b[1]:
+                    return ["c", a[1] // b[1]]
+                if isinstance(op, ast.Mod) and b[1]:
+                    return ["c", a[1] % b[1]]
+                if isinstance(op, ast.LShift):
+                    return ["c", a[1] << b[1]]
+            except (TypeError, ValueError, OverflowError):
+                return ["unk"]
+            return ["unk"]
+        if a[0] == "data" or b[0] == "data":
+            return ["data"]
+        if a[0] == "cap" and isinstance(op, ast.Mult) and b[:2] == ["c", 2]:
+            return ["cap2", a[1]]
+        if b[0] == "cap" and isinstance(op, ast.Mult) and a[:2] == ["c", 2]:
+            return ["cap2", b[1]]
+        kinds = {a[0], b[0]}
+        # bucketed +- const / * const / bucketed op bucketed: still
+        # log-many distinct values — the signature set stays bounded
+        if kinds <= {"p2", "c"} and "p2" in kinds:
+            return ["p2"]
+        return ["unk"]
+
+    def _subscript(self, node: ast.Subscript) -> tuple:
+        base = self.ev(node.value)
+        sl = node.slice
+        # x.shape[i]
+        if (
+            isinstance(node.value, ast.Attribute)
+            and node.value.attr == "shape"
+        ):
+            inner = self.ev(node.value.value)
+            if (
+                inner[0] == "arr"
+                and isinstance(sl, ast.Constant)
+                and isinstance(sl.value, int)
+                and 0 <= sl.value < len(inner[1])
+            ):
+                return ("sc", inner[1][sl.value])
+            return ("sc", ["data"])
+        if isinstance(sl, ast.Slice):
+            if base[0] == "arr":
+                dims = list(base[1])
+                if sl.lower is None and sl.upper is not None and dims:
+                    dims[0] = self._atom(self.ev(sl.upper))
+                elif dims:
+                    dims[0] = ["unk"]
+                return ("arr", dims, base[2])
+            return UNK
+        self.ev(sl)
+        return UNK
+
+    def _call(self, node: ast.Call) -> tuple:
+        name = dotted_name(node.func)
+        prefix, last = _prefix_last(name)
+        facts = [self.ev(a) for a in node.args]
+        for kw in node.keywords:
+            self.ev(kw.value)
+
+        # kernel-call candidates
+        is_self_kernel = (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "self"
+            and node.func.attr in self.pre.selfattr
+        )
+        pv = ""
+        if isinstance(node.func, ast.Name):
+            pv = self.prov.get(node.func.id, "")
+        is_kernel = (
+            is_self_kernel
+            or last.endswith("_jit")
+            or last in self.pre.jitnames
+            or bool(pv)
+        )
+        if is_kernel:
+            self.jc.append({
+                "fn": name, "pv": pv, "l": node.lineno,
+                "c": node.col_offset,
+                "a": [self._argfact(e, f) for e, f in
+                      zip(node.args, facts)][:12],
+                "sup": self._sup(node),
+            })
+            return ("arr", [["unk"]], "")
+
+        if last == "len" and facts:
+            return ("sc", self._len_atom(facts[0]))
+        if last in ("max", "min", "sum"):
+            for a in node.args:
+                for sub in ast.walk(a):
+                    if isinstance(sub, ast.Call) and dotted_name(
+                        sub.func
+                    ).rsplit(".", 1)[-1] == "len":
+                        return ("sc", ["data"])
+            atoms = [self._atom(f) for f in facts if f[0] == "sc"]
+            if len(atoms) == len(facts) and atoms and all(
+                a[0] in ("c", "p2") for a in atoms
+            ):
+                if any(a[0] == "p2" for a in atoms):
+                    return ("sc", ["p2"])
+                if last == "max":
+                    return ("sc", ["c", max(a[1] for a in atoms)])
+                if last == "min":
+                    return ("sc", ["c", min(a[1] for a in atoms)])
+            return ("sc", ["unk"])
+        if last in _BUCKET_FUNCS:
+            return ("sc", ["p2"])
+
+        np_like = prefix in _NP_PREFIXES or prefix in _JNP_PREFIXES
+        if np_like and last in _SHAPE_CTORS and node.args:
+            dims = self._ctor_dims(node.args[0], facts[0])
+            dt = ""
+            di = _SHAPE_CTORS[last]
+            if len(node.args) > di:
+                dt = _dtype_of(node.args[di])
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dt = _dtype_of(kw.value)
+            if not dt:
+                dt = "float32" if prefix in _JNP_PREFIXES else "float64"
+            if self._bucketed(node):
+                dims = [
+                    d if d[0] in ("c", "p2") else ["p2"] for d in dims
+                ]
+            return ("arr", dims, dt)
+        if np_like and last in _AS_ARRAY and node.args:
+            fact = self._asarray(node, facts[0])
+            if prefix in _NP_PREFIXES:
+                self._note_materializer(node, name)
+            else:
+                self._note_upload(node, name)
+            return fact
+        if np_like and last == "stack" and node.args:
+            lead = self._len_atom(facts[0])
+            if facts[0][0] == "comp":
+                lead = facts[0][1]
+            return ("arr", [lead, ["unk"]], "")
+        if np_like and last in _DATA_FUNCS:
+            return ("arr", [["data"]], "")
+        if np_like and last == "arange" and len(node.args) == 1:
+            return ("arr", [self._atom(facts[0])], "int64")
+        if np_like and last == "full_like" and node.args:
+            return facts[0] if facts[0][0] == "arr" else UNK
+        if name == "jax.device_put" and node.args:
+            self._note_upload(node, name)
+            return facts[0] if facts[0][0] == "arr" else UNK
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+        ):
+            base = self.ev(node.func.value)
+            dt = _dtype_of(node.args[0])
+            if base[0] == "arr":
+                return ("arr", base[1], dt)
+            return ("arr", [["unk"]], dt)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "reshape"
+        ):
+            base = self.ev(node.func.value)
+            dims = [self._atom(f) for f in facts] or [["unk"]]
+            if len(facts) == 1 and facts[0][0] == "seq":
+                dims = [self._atom(f) for f in facts[0][1]]
+            return ("arr", dims, base[2] if base[0] == "arr" else "")
+        if last in ("int", "float") and len(node.args) == 1 and name == last:
+            self._note_materializer(node, last)
+            return ("sc", ["unk"]) if last == "int" else UNK
+        return UNK
+
+    def _ctor_dims(self, shape_expr: ast.AST, shape_fact: tuple) -> list:
+        if shape_fact[0] == "seq":
+            return [self._atom(f) for f in shape_fact[1]]
+        return [self._atom(shape_fact)]
+
+    def _asarray(self, node: ast.Call, opfact: tuple) -> tuple:
+        dt = ""
+        if len(node.args) > 1:
+            dt = _dtype_of(node.args[1])
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                dt = _dtype_of(kw.value)
+        if opfact[0] == "arr":
+            return ("arr", opfact[1], dt or opfact[2])
+        if opfact[0] == "seq":
+            return ("arr", [["c", len(opfact[1])]], dt or "pydef")
+        if opfact[0] == "comp":
+            return ("arr", [opfact[1]], dt or "pydef")
+        return ("arr", [["unk"]], dt)
+
+    def _note_materializer(self, node: ast.Call, name: str) -> None:
+        tn = self._mentions_tainted(node.args[0]) if node.args else ""
+        if tn:
+            self.mat.append({
+                "l": node.lineno, "c": node.col_offset, "call": name,
+                "v": tn, "sup": self._sup(node),
+            })
+
+    def _note_upload(self, node: ast.Call, name: str) -> None:
+        attr = self._self_attr_in(node.args[0]) if node.args else ""
+        if attr:
+            self.up.append({
+                "l": node.lineno, "c": node.col_offset, "call": name,
+                "a": attr, "sup": self._sup(node),
+            })
+
+    def _argfact(self, expr: ast.AST, fact: tuple) -> dict:
+        src = dotted_name(expr)
+        if isinstance(expr, ast.Constant):
+            v = expr.value
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return {"k": "pys", "src": repr(v)}
+            return {"k": "unk", "src": repr(v)}
+        if fact[0] == "sc":
+            return {"k": "pys", "src": src, "at": fact[1]}
+        if fact[0] == "arr":
+            return {"k": "arr", "d": fact[1], "dt": fact[2], "src": src}
+        if fact[0] == "attr":
+            return {"k": "attr", "src": "self." + fact[1]}
+        return {"k": "unk", "src": src}
+
+    # -- statements ----------------------------------------------------
+    def walk(self, stmts: list) -> None:
+        for st in stmts:
+            self.stmt(st)
+
+    def _assign_name(self, name: str, fact: tuple, value: ast.AST) -> None:
+        self.env[name] = fact
+        if isinstance(value, ast.Call):
+            callee = dotted_name(value.func)
+            self.prov[name] = callee
+            if self._device_producing(callee):
+                self.tainted.add(name)
+            else:
+                self.tainted.discard(name)
+        else:
+            self.prov.pop(name, None)
+            if not (
+                isinstance(value, ast.Name) and value.id in self.tainted
+            ):
+                self.tainted.discard(name)
+
+    def _cap_kind(self, fact: tuple, attr: str) -> str:
+        if fact[0] == "param":
+            return "param"
+        if fact[0] != "sc":
+            return ""
+        atom = fact[1]
+        if atom[0] == "c":
+            return "p2" if _is_pow2(atom[1]) else "other"
+        if atom[0] == "p2":
+            return "p2"
+        if atom[0] == "cap2" and atom[1] == attr:
+            return "dbl"
+        if atom[0] == "cap" and atom[1] == attr:
+            return "p2"  # self-copy preserves the invariant
+        return "other"
+
+    def _store(self, target: ast.AST, fact: tuple, value: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self._assign_name(target.id, fact, value)
+        elif (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            kind = self._cap_kind(fact, target.attr)
+            if kind:
+                self.cw.append(
+                    {"a": target.attr, "k": kind, "l": target.lineno}
+                )
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                if isinstance(el, ast.Name):
+                    self._assign_name(el.id, UNK, value)
+
+    def stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(st, ast.Assign):
+            fact = self.ev(st.value)
+            for target in st.targets:
+                self._store(target, fact, st.value)
+            return
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._store(st.target, self.ev(st.value), st.value)
+            return
+        if isinstance(st, ast.AugAssign):
+            rhs = self.ev(st.value)
+            if isinstance(st.target, ast.Name):
+                cur = self.env.get(st.target.id, UNK)
+                if (
+                    isinstance(st.op, ast.Mult)
+                    and rhs == ("sc", ["c", 2])
+                    and cur[0] == "sc"
+                ):
+                    atom = cur[1]
+                    if atom[0] == "c":
+                        self.env[st.target.id] = ("sc", ["c", atom[1] * 2])
+                    elif atom[0] == "p2":
+                        self.env[st.target.id] = ("sc", ["p2"])
+                    elif atom[0] == "cap":
+                        self.env[st.target.id] = ("sc", ["cap2", atom[1]])
+                    else:
+                        self.env[st.target.id] = UNK
+                else:
+                    self.env[st.target.id] = UNK
+            elif (
+                isinstance(st.target, ast.Attribute)
+                and isinstance(st.target.value, ast.Name)
+                and st.target.value.id == "self"
+            ):
+                kind = (
+                    "dbl"
+                    if isinstance(st.op, ast.Mult) and rhs == ("sc", ["c", 2])
+                    else "other"
+                )
+                self.cw.append(
+                    {"a": st.target.attr, "k": kind, "l": st.lineno}
+                )
+            return
+        if isinstance(st, ast.Expr):
+            self.ev(st.value)
+            return
+        if isinstance(st, ast.While):
+            self._while(st)
+            return
+        if isinstance(st, ast.If):
+            self.ev(st.test)
+            self.walk(st.body)
+            self.walk(st.orelse)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self.ev(st.iter)
+            if isinstance(st.target, ast.Name):
+                self.env[st.target.id] = UNK
+            self.walk(st.body)
+            self.walk(st.orelse)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self.ev(item.context_expr)
+            self.walk(st.body)
+            return
+        if isinstance(st, ast.Try):
+            self.walk(st.body)
+            for h in st.handlers:
+                self.walk(h.body)
+            self.walk(st.orelse)
+            self.walk(st.finalbody)
+            return
+        if isinstance(st, (ast.Return, ast.Raise)):
+            self.ev(getattr(st, "value", None) or getattr(st, "exc", None))
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self.ev(child)
+
+    def _while(self, st: ast.While) -> None:
+        """`b = <pow2>; while b < m: b *= 2` — the bucket idiom.
+        After (and inside) the loop `b` takes log-many values: p2."""
+        name = None
+        if isinstance(st.test, ast.Compare) and isinstance(
+            st.test.left, ast.Name
+        ):
+            cand = st.test.left.id
+            for sub in st.body:
+                if (
+                    isinstance(sub, ast.AugAssign)
+                    and isinstance(sub.target, ast.Name)
+                    and sub.target.id == cand
+                    and isinstance(sub.op, ast.Mult)
+                    and isinstance(sub.value, ast.Constant)
+                    and sub.value.value == 2
+                ):
+                    name = cand
+                    break
+        self.ev(st.test)
+        if name is not None:
+            cur = self.env.get(name, UNK)
+            if cur[0] == "sc" and (
+                cur[1][0] == "p2"
+                or (cur[1][0] == "c" and _is_pow2(cur[1][1]))
+            ):
+                self.env[name] = ("sc", ["p2"])
+            elif cur[0] == "sc" and cur[1][0] == "cap":
+                self.env[name] = ("sc", ["cap2", cur[1][1]])
+        self.walk(st.body)
+        self.walk(st.orelse)
+
+    def result(self) -> dict:
+        node = self.scope.node
+        hot = False
+        header_end = (
+            node.body[0].lineno if getattr(node, "body", None) else node.lineno
+        )
+        for ln in range(node.lineno, min(header_end, len(self.lines)) + 1):
+            if _HOT_MARK_RE.search(self.lines[ln - 1]):
+                hot = True
+                break
+        out: dict = {}
+        if self.jc:
+            out["jc"] = self.jc
+        if self.mat:
+            out["mat"] = self.mat
+        if self.up:
+            out["up"] = self.up
+        if self.cw:
+            out["cw"] = self.cw
+        if node.name in self.pre.factories:
+            out["rj"] = True
+        if hot:
+            out["hot"] = True
+        return out
+
+
+def summarize_function(ctx, scope, pre: Prepass) -> dict:
+    w = _DevWalker(ctx, scope, pre)
+    w.walk(scope.node.body)
+    return w.result()
+
+
+# -- pass 2 -------------------------------------------------------------
+
+
+class KernelIndex:
+    """Whole-program kernel registry + call-site resolution for the
+    RPL020/021 rules, built from a ProgramIndex."""
+
+    def __init__(self, program) -> None:
+        self._by_name: dict[str, list] = {}
+        self._self: dict[tuple, dict] = {}
+        self._in_kernel: set[tuple] = set()
+        self._kernel_prefixes: list[tuple] = []
+        for path, jd in getattr(program, "jitdefs", []):
+            if jd["k"] == "self":
+                self._self[(path, jd["c"], jd["n"])] = (path, jd)
+            else:
+                self._by_name.setdefault(jd["n"], []).append((path, jd))
+            if jd["k"] in ("decor", "mod") and jd.get("t"):
+                self._in_kernel.add((path, jd["t"]))
+            if jd["k"] == "factory":
+                # kernels returned by a factory are the nested defs:
+                # everything scoped under the factory traces as device
+                self._kernel_prefixes.append((path, jd["t"] + "."))
+        self._cap_census: dict[tuple, set] = {}
+        for fs in program.functions:
+            for cw in (fs.dev or {}).get("cw", ()):
+                self._cap_census.setdefault(
+                    (fs.path, fs.cls, cw["a"]), set()
+                ).add(cw["k"])
+
+    def in_kernel(self, fs) -> bool:
+        """True when `fs` IS a jit'd kernel body (or is nested in a
+        jit factory): its call sites run under trace, producing no
+        separate compile signatures."""
+        if (fs.path, fs.qualname) in self._in_kernel:
+            return True
+        for path, prefix in self._kernel_prefixes:
+            if fs.path == path and (
+                fs.qualname.startswith(prefix)
+                or fs.qualname + "." == prefix
+            ):
+                return True
+        return False
+
+    def cap_verified(self, path: str, cls: str, attr: str) -> bool:
+        """A `self.<attr>` cap is a declared power-of-two bucket iff
+        every write site across the class is a pow2 constant or a
+        doubling — the grow-by-doubling contract."""
+        kinds = self._cap_census.get((path, cls, attr))
+        return bool(kinds) and kinds <= {"p2", "dbl"}
+
+    def resolve(self, path: str, cls: str, call: dict):
+        """(def_path, jitdef) for a recorded call-site candidate, or
+        None when no kernel matches (plain function calls that only
+        LOOK like candidates resolve to nothing and are skipped)."""
+        fn = call["fn"]
+        parts = fn.split(".")
+        last = parts[-1]
+        if parts[0] == "self" and len(parts) == 2:
+            return self._self.get((path, cls, last))
+        pv = call.get("pv", "")
+        if pv:
+            pl = pv.rsplit(".", 1)[-1]
+            for cand in self._by_name.get(pl, ()):
+                if cand[1]["k"] == "factory":
+                    return cand
+        cands = self._by_name.get(last, ())
+        if not cands:
+            return None
+        same = [c for c in cands if c[0] == path]
+        if same:
+            return same[0]
+        if len(parts) >= 2:
+            hint = parts[-2]
+            mod = [c for c in cands if c[0].endswith(f"/{hint}.py")]
+            if mod:
+                return mod[0]
+        if len(cands) == 1:
+            return cands[0]
+        return None

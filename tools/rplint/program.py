@@ -62,7 +62,7 @@ from dataclasses import dataclass, field
 
 from .engine import ModuleContext, dotted_name
 
-SUMMARY_VERSION = 3
+SUMMARY_VERSION = 4  # 4: device-plane facts (devplane.py) ride along
 
 _RACE_RULES = ("RPL015", "RPL016")
 _LOCKY_RE = re.compile(r"lock|mutex|semaphore", re.IGNORECASE)
@@ -151,6 +151,9 @@ class FuncSummary:
     writes: tuple  # WriteSite census
     lockdefaults: tuple
     calls: tuple  # (callee_method_name, guards_tuple) for self.<m>() calls
+    # device-plane facts (devplane.py): kernel-call candidates with
+    # per-arg shape/dtype facts, cap writes, materializations, uploads
+    dev: dict = field(default_factory=dict)
 
     @property
     def is_init(self) -> bool:
@@ -165,6 +168,7 @@ class FuncSummary:
             "w": [w.to_dict() for w in self.writes],
             "ld": [d.to_dict() for d in self.lockdefaults],
             "calls": [[c, list(g)] for c, g in self.calls],
+            "dev": self.dev,
         }
 
     @classmethod
@@ -177,6 +181,7 @@ class FuncSummary:
             writes=tuple(WriteSite.from_dict(w) for w in d["w"]),
             lockdefaults=tuple(LockDefault.from_dict(x) for x in d["ld"]),
             calls=tuple((c, tuple(g)) for c, g in d["calls"]),
+            dev=d.get("dev", {}),
         )
 
 
@@ -184,12 +189,14 @@ class FuncSummary:
 class FileSummary:
     path: str
     functions: list = field(default_factory=list)
+    jitdefs: list = field(default_factory=list)  # devplane jit registry
 
     def to_dict(self) -> dict:
         return {
             "version": SUMMARY_VERSION,
             "path": self.path,
             "functions": [f.to_dict() for f in self.functions],
+            "jitdefs": self.jitdefs,
         }
 
     @classmethod
@@ -199,6 +206,7 @@ class FileSummary:
         return cls(
             path=d["path"],
             functions=[FuncSummary.from_dict(f) for f in d["functions"]],
+            jitdefs=d.get("jitdefs", []),
         )
 
 
@@ -484,9 +492,22 @@ class _FunctionSummarizer:
 
 
 def summarize_module(ctx: ModuleContext) -> FileSummary:
-    out = FileSummary(path=ctx.path)
+    from . import devplane
+
+    pre = devplane.Prepass(ctx)
+    out = FileSummary(path=ctx.path, jitdefs=list(pre.jitdefs))
     for scope in ctx.functions():
-        out.functions.append(_FunctionSummarizer(ctx, scope).run())
+        fs = _FunctionSummarizer(ctx, scope).run()
+        dev = devplane.summarize_function(ctx, scope, pre)
+        if dev:
+            fs = FuncSummary(
+                path=fs.path, qualname=fs.qualname, cls=fs.cls,
+                name=fs.name, line=fs.line, is_async=fs.is_async,
+                may_suspend=fs.may_suspend, suspend_lines=fs.suspend_lines,
+                reads=fs.reads, writes=fs.writes,
+                lockdefaults=fs.lockdefaults, calls=fs.calls, dev=dev,
+            )
+        out.functions.append(fs)
     return out
 
 
@@ -498,6 +519,9 @@ class ProgramIndex:
     def __init__(self, files: list[FileSummary]) -> None:
         self.functions: list[FuncSummary] = [
             fn for f in files for fn in f.functions
+        ]
+        self.jitdefs: list[tuple] = [
+            (f.path, jd) for f in files for jd in f.jitdefs
         ]
         self._by_cls: dict[tuple, list[FuncSummary]] = {}
         for fn in self.functions:

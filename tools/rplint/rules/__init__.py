@@ -21,6 +21,8 @@ from .rpl016_lock_consistency import LockConsistencyRule
 from .rpl017_placement_discipline import PlacementDisciplineRule
 from .rpl018_mesh_discipline import MeshDisciplineRule
 from .rpl019_codec_discipline import CodecDisciplineRule
+from .rpl020_compile_discipline import CompileDisciplineRule
+from .rpl021_donation_layout import DonationLayoutRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -42,6 +44,8 @@ ALL_RULES = [
     PlacementDisciplineRule,
     MeshDisciplineRule,
     CodecDisciplineRule,
+    CompileDisciplineRule,
+    DonationLayoutRule,
 ]
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,202 @@
+"""RPL020 — compile discipline: every jit'd kernel must see a BOUNDED
+set of compile signatures.
+
+One XLA compilation per distinct (arg shapes x dtypes x static-arg
+values) combination is the contract of the device plane: the tick
+budget assumes kernels stay on their compiled fast path, and a single
+untracked call-site shape costs a silent recompile measured in
+hundreds of milliseconds — orders of magnitude more than the tick it
+serves. This is the same failure class fixed-shape bucketed TPU
+kernels exist to prevent (Ragged Paged Attention): data-dependent
+shapes must be routed through a power-of-two bucket so the signature
+set is log-bounded, not data-bounded.
+
+Pass 2 (tools/rplint/devplane.py) walks every call site of every
+`jax.jit`-compiled kernel — decorated defs, module-level
+`X_jit = jax.jit(f)` bindings, `self.X = jax.jit(f)` instance
+bindings, and jit factories — and checks, per traced positional arg:
+
+1. unbounded signature set: an array dimension PROVABLY data-dependent
+   (`len(<param>)` rows, `.shape` of an untracked value,
+   np.concatenate/unique/stack-over-comprehension results) that was
+   not routed through a bucket. Bounded shapes are power-of-two
+   while-doubling sites (`b = 8; while b < m: b *= 2`), the
+   `ops.shapes.row_bucket` helper, verified `self._cap` doubling caps,
+   or a `# rplint: bucketed=<why>` declared-cap annotation.
+2. weak-type leak: a Python scalar literal (or scalar-typed local)
+   in a traced position. Weak-typed scalars carry a different lattice
+   type than pinned `np.int64(...)` values, so mixing producers
+   recompiles; pin the dtype or make the argument static.
+3. dtype drift: one kernel arg slot fed distinct concrete dtypes from
+   different producer lanes (int32 here, int64 there = two compiled
+   programs), or `np.asarray(...)` without an explicit dtype (the
+   platform-default int) where other call sites pin one.
+
+Static args (static_argnums) skip the array checks but must still be
+value-bounded: a data-dependent static value compiles once per value.
+Call sites INSIDE kernel bodies trace inline and are exempt. The
+declared-cap annotation (`# rplint: bucketed=<justification>`) is a
+positive promise that a construction's dims are bucketed — distinct
+from `disable=RPL020`, which hides the site from the rule entirely.
+"""
+
+from __future__ import annotations
+
+from ..engine import Finding
+from .. import devplane
+
+EXAMPLE = '''\
+import numpy as np, jax, jax.numpy as jnp
+
+kernel_jit = jax.jit(kernel)
+
+def bad_wrapper(arrs):
+    batch = np.zeros((len(arrs), 512), np.uint8)   # rows = len(arrs)
+    return kernel_jit(jnp.asarray(batch), 3)       # RPL020: unbounded
+                                                   # rows + weak scalar
+
+def good_wrapper(arrs):
+    rows = 8
+    while rows < len(arrs):
+        rows *= 2                                  # pow2 bucket
+    batch = np.zeros((rows, 512), np.uint8)
+    return kernel_jit(jnp.asarray(batch), np.int64(3))
+'''
+
+_FIX = (
+    "route the dim through a power-of-two bucket "
+    "(ops.shapes.row_bucket / the while-doubling idiom) or declare "
+    "`# rplint: bucketed=<why>` on the construction"
+)
+
+
+class CompileDisciplineRule:
+    code = "RPL020"
+    name = "compile-discipline"
+    whole_program = True
+
+    def check(self, ctx):
+        return ()  # whole-program rule: findings come from check_program
+
+    def check_program(self, program):
+        ki = devplane.KernelIndex(program)
+        # (def_path, kernel, slot) -> [(dtype, site fs, call, argfact)]
+        slots: dict[tuple, list] = {}
+        for fs in program.functions:
+            jcs = (fs.dev or {}).get("jc", ())
+            if not jcs or ki.in_kernel(fs):
+                continue
+            for call in jcs:
+                jd = ki.resolve(fs.path, fs.cls, call)
+                if jd is None:
+                    continue
+                dpath, d = jd
+                static = set(d.get("s", ()))
+                if self.code not in call["sup"]:
+                    yield from self._check_site(ki, fs, call, d, static)
+                for i, af in enumerate(call["a"]):
+                    if i in static or af.get("k") != "arr":
+                        continue
+                    dt = af.get("dt", "")
+                    if dt and dt != "unk":
+                        slots.setdefault((dpath, d["n"], i), []).append(
+                            (dt, fs, call, af)
+                        )
+        yield from self._check_drift(slots)
+
+    def _check_site(self, ki, fs, call, d, static):
+        kernel = d["n"]
+        for i, af in enumerate(call["a"]):
+            kind = af.get("k")
+            if i in static:
+                if kind == "pys" and af.get("at", ["unk"])[0] == "data":
+                    yield self._finding(
+                        fs, call, kernel,
+                        f"static arg {i} of kernel '{kernel}' is "
+                        f"data-dependent ('{af['src']}') — one XLA "
+                        f"compilation per distinct value; {_FIX}",
+                    )
+                continue
+            if kind == "pys":
+                at = af.get("at", [""])
+                if at[0] == "data":
+                    yield self._finding(
+                        fs, call, kernel,
+                        f"data-dependent Python scalar '{af['src']}' in "
+                        f"traced arg {i} of kernel '{kernel}' — weak-typed "
+                        "AND unbounded; pin with np.int64(...) and bucket "
+                        "the value, or make the arg static",
+                    )
+                else:
+                    yield self._finding(
+                        fs, call, kernel,
+                        f"weak-typed Python scalar '{af['src']}' in traced "
+                        f"arg {i} of kernel '{kernel}' — weak scalars "
+                        "change the signature lattice vs pinned values; "
+                        "pin with np.int64(...)/np.float32(...) or make "
+                        "the arg static",
+                    )
+            elif kind == "arr":
+                for j, atom in enumerate(af.get("d", ())):
+                    if atom[0] == "data":
+                        yield self._finding(
+                            fs, call, kernel,
+                            f"unbounded compile-signature set for kernel "
+                            f"'{kernel}': arg {i} ('{af['src']}') dim {j} "
+                            f"is data-dependent — {_FIX}",
+                        )
+                        break
+                    if atom[0] in ("cap", "cap2") and not ki.cap_verified(
+                        fs.path, fs.cls, atom[1]
+                    ):
+                        # unverified caps stay unknown by design: only
+                        # proven data-dependence fires
+                        continue
+
+    def _check_drift(self, slots):
+        for (dpath, kernel, i), sites in slots.items():
+            concrete = {}
+            for dt, fs, call, af in sites:
+                if dt != "pydef":
+                    concrete.setdefault(dt, []).append((fs, call, af))
+            if len(concrete) > 1:
+                ranked = sorted(
+                    concrete.items(), key=lambda kv: (-len(kv[1]), kv[0])
+                )
+                majority = ranked[0][0]
+                lead = ranked[0][1][0]
+                for dt, insts in ranked[1:]:
+                    for fs, call, af in insts:
+                        if self.code in call["sup"]:
+                            continue
+                        yield self._finding(
+                            fs, call, kernel,
+                            f"dtype drift on arg {i} of kernel '{kernel}': "
+                            f"{dt} here vs {majority} at "
+                            f"{lead[0].path}:{lead[1]['l']} — one compiled "
+                            "program per dtype; pin the producer lanes to "
+                            "one dtype",
+                        )
+            if concrete:
+                pinned = sorted(concrete)[0]
+                for dt, fs, call, af in sites:
+                    if dt != "pydef" or self.code in call["sup"]:
+                        continue
+                    yield self._finding(
+                        fs, call, kernel,
+                        f"np.asarray/np.array without an explicit dtype "
+                        f"feeds traced arg {i} of kernel '{kernel}' "
+                        f"(platform-default int) while other call sites "
+                        f"pin {pinned} — pass dtype= explicitly",
+                    )
+
+    def _finding(self, fs, call, kernel, message):
+        return Finding(
+            path=fs.path,
+            line=call["l"],
+            col=call["c"],
+            rule=self.code,
+            qualname=fs.qualname,
+            attr=kernel,
+            message=message,
+        )

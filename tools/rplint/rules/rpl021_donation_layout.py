@@ -1,0 +1,123 @@
+"""RPL021 — donation/layout discipline: device values stay on the
+device between kernel calls; host mirrors do not ride the per-tick
+path.
+
+RPL002 stops host<->device round-trips inside declared hot functions.
+This rule extends the same contract into the DEVICE PLANE, where
+hotness is discovered structurally: any function that dispatches two
+or more jit'd kernels is a frame path, and re-materializing a lane
+tensor host-side between those dispatches (np.asarray/np.array/
+float()/int() of a device value) forces a sync + transfer + re-upload
+that also breaks XLA buffer donation — the donated input buffer
+cannot be reused when the host holds a copy. Chained kernels must
+hand device arrays (or donated buffers) directly to the next
+dispatch; the ONE writeback belongs after the last kernel of the
+frame.
+
+Second check, manifest-scoped like RPL002 (tools/rplint/hotpaths.py
+plus the `# rplint: hot` marker): `jnp.asarray(self.<attr>)` /
+`jax.device_put(self.<attr>)` inside per-tick code. Uploading a host
+mirror every tick re-transfers an O(cap) lane each call — mirrors are
+uploaded once at prewarm/grow (`to_device_state`), and per-tick code
+passes the resident device state.
+
+Intentional exceptions — the opt-in device backend's documented
+writeback, a stand-down path that runs once — carry
+`# rplint: disable=RPL021` with a one-line justification, the same
+convention every other rule uses.
+"""
+
+from __future__ import annotations
+
+from ..engine import Finding
+from .. import devplane
+
+EXAMPLE = '''\
+def frame(self, state, rows):
+    folded = fold_replies_jit(state, rows)
+    acks = np.asarray(folded.acks)           # RPL021: host round-trip
+    out = commit_step_jit(jnp.asarray(acks)) # between device calls
+    return out
+
+def frame_ok(self, state, rows):
+    folded = fold_replies_jit(state, rows)
+    out = commit_step_jit(folded.acks)       # stays on device
+    return np.asarray(out.commit)            # one writeback, after
+'''
+
+
+class DonationLayoutRule:
+    code = "RPL021"
+    name = "donation-layout-discipline"
+    whole_program = True
+
+    def __init__(self, manifest: dict | None = None) -> None:
+        if manifest is None:
+            from .. import hotpaths
+
+            manifest = hotpaths.HOT_FUNCTIONS
+        self._manifest = manifest
+
+    def check(self, ctx):
+        return ()  # whole-program rule: findings come from check_program
+
+    def _hot(self, fs) -> bool:
+        if (fs.dev or {}).get("hot"):
+            return True
+        for suffix, names in self._manifest.items():
+            if fs.path.endswith(suffix) and fs.qualname in names:
+                return True
+        return False
+
+    def check_program(self, program):
+        ki = devplane.KernelIndex(program)
+        for fs in program.functions:
+            dev = fs.dev or {}
+            if ki.in_kernel(fs):
+                continue
+            klines = sorted(
+                c["l"]
+                for c in dev.get("jc", ())
+                if ki.resolve(fs.path, fs.cls, c) is not None
+            )
+            if len(klines) >= 2:
+                first, last = klines[0], klines[-1]
+                for m in dev.get("mat", ()):
+                    if self.code in m["sup"]:
+                        continue
+                    if first < m["l"] < last:
+                        yield Finding(
+                            path=fs.path,
+                            line=m["l"],
+                            col=m["c"],
+                            rule=self.code,
+                            qualname=fs.qualname,
+                            attr=m["v"],
+                            message=(
+                                f"'{m['call']}()' re-materializes device "
+                                f"value '{m['v']}' host-side between device "
+                                f"calls (kernels at lines {first} and "
+                                f"{last}) — the sync+transfer breaks buffer "
+                                "donation; keep the value on the device "
+                                "and write back once after the last kernel"
+                            ),
+                        )
+            if not self._hot(fs):
+                continue
+            for u in dev.get("up", ()):
+                if self.code in u["sup"]:
+                    continue
+                yield Finding(
+                    path=fs.path,
+                    line=u["l"],
+                    col=u["c"],
+                    rule=self.code,
+                    qualname=fs.qualname,
+                    attr=u["a"],
+                    message=(
+                        f"'{u['call']}(self.{u['a']})' uploads a host "
+                        f"mirror inside per-tick code '{fs.qualname}' — "
+                        "an O(cap) transfer every tick; upload once at "
+                        "prewarm/grow and pass the resident device state"
+                    ),
+                )

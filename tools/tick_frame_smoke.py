@@ -124,20 +124,41 @@ def oracle_check(arrays, rows, sample: int, seed: int) -> None:
 
 def run_schedule(n: int, seed: int):
     """One full replay: fresh arrays + TickFrame, fold every round.
-    Returns (arrays, rows, advanced_sets, fold_times)."""
+    The first two folds are compile warmup; from round 2 the compile
+    guard (RP_COMPILEGUARD=1) treats any further jit trace as a
+    steady-state recompile finding. Returns (arrays, rows,
+    advanced_sets, fold_times)."""
     from redpanda_tpu.raft.tick_frame import TickFrame
+    from redpanda_tpu.utils import compileguard
 
     arrays, rows = build(n, seed)
     frame = TickFrame(arrays)
     sched = schedule(n, rows, rounds=8, per_round=max(1, n // 5), seed=seed)
     advanced_sets = []
     times = []
-    for rr, slots, dirty, flushed, seq in sched:
+    compileguard.reset()
+    for k, (rr, slots, dirty, flushed, seq) in enumerate(sched):
+        if k == 2:
+            compileguard.steady()
         t0 = time.perf_counter()
         advanced = frame.fold_now(rr, slots, dirty, flushed, seq)
         times.append(time.perf_counter() - t0)
         advanced_sets.append(np.sort(np.asarray(advanced, np.int64)))
     return arrays, rows, advanced_sets, times
+
+
+def guard_check() -> str:
+    """Fail the smoke on any steady-state recompile report; returns
+    the status fragment for the OK line."""
+    from redpanda_tpu.utils import compileguard
+
+    if not compileguard.enabled():
+        return ""
+    reps = compileguard.reports()
+    assert not reps, "steady-state recompiles:\n" + "\n".join(
+        r.render() for r in reps
+    )
+    return ", compile-guard clean"
 
 
 def main() -> int:
@@ -178,7 +199,8 @@ def main() -> int:
         )
         print(
             f"tick-frame parity OK: {n} rows, "
-            f"{len(lanes['host'][2])} folds byte-identical host vs device"
+            f"{len(lanes['host'][2])} folds byte-identical host vs "
+            f"device{guard_check()}"
         )
         return 0
 
@@ -190,7 +212,8 @@ def main() -> int:
     print(
         f"tick-frame smoke OK: {n} rows, {len(times)} folds, "
         f"{n_adv} advances, worst fold {worst_ms:.1f} ms, "
-        f"{per_part_ns:.0f} ns/partition/fold, 2000-row oracle sample clean"
+        f"{per_part_ns:.0f} ns/partition/fold, 2000-row oracle sample "
+        f"clean{guard_check()}"
     )
     # generous interpreter-regression bound: a per-group Python loop
     # at 100k rows costs seconds per fold, vectorized folds cost ~ms

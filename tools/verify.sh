@@ -15,6 +15,9 @@ python -m tools.rplint --baseline redpanda_tpu
 echo "== rplint race rules (RPL015/016 whole-program, empty by construction) =="
 python -m tools.rplint --rules RPL015,RPL016 redpanda_tpu tools tests
 
+echo "== rplint compile discipline (RPL020/021 device plane, empty by construction) =="
+python -m tools.rplint --rules RPL020,RPL021 redpanda_tpu
+
 echo "== native build =="
 if make -s -C native; then
     echo "built native/build/libredpanda_native.so"
@@ -58,6 +61,10 @@ env JAX_PLATFORMS=cpu python tools/tick_frame_smoke.py
 echo "== tick-frame backend parity (host fallback vs device) =="
 env JAX_PLATFORMS=cpu python tools/tick_frame_smoke.py --parity --groups 4096
 
+echo "== compile-guard smoke (RP_COMPILEGUARD=1 device plane, 0 recompiles) =="
+env JAX_PLATFORMS=cpu RP_COMPILEGUARD=1 RP_QUORUM_BACKEND=device \
+    python tools/tick_frame_smoke.py --groups 4096
+
 echo "== tiered chaos smoke (ObjectNemesis schedule, replay-equal) =="
 env JAX_PLATFORMS=cpu python tools/tiered_smoke.py
 
@@ -81,6 +88,11 @@ echo "== mesh backend smoke (8 forced devices, live parity vs host) =="
 env JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     RP_QUORUM_BACKEND=mesh python tools/mesh_smoke.py
+
+echo "== mesh compile-guard smoke (RP_COMPILEGUARD=1, 8 devices, 0 recompiles) =="
+env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    RP_QUORUM_BACKEND=mesh RP_COMPILEGUARD=1 python tools/mesh_smoke.py
 
 echo "== mesh stand-down smoke (RP_QUORUM_BACKEND=host) =="
 env JAX_PLATFORMS=cpu RP_QUORUM_BACKEND=host python tools/mesh_smoke.py
