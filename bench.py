@@ -1893,10 +1893,123 @@ async def _replicated_mp_async(n_cores: int) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+async def _lifecycle_bench_async() -> dict:
+    """Elastic-lifecycle latency block for the mp round: grow-adopt
+    time (fork -> mesh -> probe -> activate), per-shard in-place
+    restart time (death detected -> re-forked -> re-adopted), and the
+    produce-unavailability window a crash opens. Measured against an
+    in-process ShardedBroker — the same runtime the mp brokers embed —
+    because the counters live on the supervisor object."""
+    import signal as _signal
+
+    from redpanda_tpu.app import BrokerConfig
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.ssx.sharded_broker import ShardedBroker
+
+    os.environ.setdefault("RP_LIFECYCLE_OPS", "64")
+    n_grows = int(os.environ.get("BENCH_LIFECYCLE_GROWS", "4"))
+    n_kills = int(os.environ.get("BENCH_LIFECYCLE_KILLS", "6"))
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = tempfile.mkdtemp(prefix="rp_bench_lc_", dir=shm)
+    cfg = BrokerConfig(
+        node_id=0,
+        data_dir=os.path.join(tmp, "n0"),
+        members=[0],
+        election_timeout_s=0.3,
+        heartbeat_interval_s=0.05,
+        enable_admin=False,
+    )
+    sb = ShardedBroker(cfg, n_shards=2)
+    await sb.start()
+    try:
+        assert sb.active, f"stand-down: {sb.standdown}"
+        rt, lc = sb.runtime, sb.lifecycle
+        c = KafkaClient([("127.0.0.1", sb.kafka_port)])
+        try:
+            deadline = time.monotonic() + 30.0
+
+            async def retry(fn):
+                while True:
+                    try:
+                        return await fn()
+                    except Exception:
+                        if time.monotonic() > deadline:
+                            raise
+                        await asyncio.sleep(0.2)
+
+            await retry(lambda: c.create_topic(
+                "lc", partitions=4, replication_factor=1
+            ))
+            for p in range(4):
+                await retry(lambda p=p: c.produce(
+                    "lc", p, [(b"k", b"v")]
+                ))
+            # grow/retire cycles: each grow's fork->adopt latency lands
+            # in lc.grow_ms
+            for _ in range(n_grows):
+                sid = await lc.grow()
+                await lc.retire(sid)
+            # crash/restart cycles: rt.restart_ms (supervisor) and
+            # lc.unavailable_ms (produce-visible window)
+            for i in range(n_kills):
+                want = rt.shard_restarts.get(1, 0) + 1
+                os.kill(rt.shard_pids[1], _signal.SIGKILL)
+                deadline = time.monotonic() + 20.0
+                while (
+                    rt.shard_restarts.get(1, 0) < want
+                    or not sb.broker.shard_table.is_available(1)
+                ):
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("shard 1 never restarted")
+                    await asyncio.sleep(0.05)
+                await retry(lambda: c.produce("lc", 1, [(b"k", b"v")]))
+        finally:
+            await c.close()
+
+        def pct(xs, q):
+            return round(float(np.percentile(xs, q)), 2) if xs else -1.0
+
+        return {
+            "shard_restart_p50": {
+                "metric": "shard_restart_p50_ms",
+                "value": pct(rt.restart_ms, 50), "unit": "ms",
+            },
+            "shard_restart_p99": {
+                "metric": "shard_restart_p99_ms",
+                "value": pct(rt.restart_ms, 99), "unit": "ms",
+            },
+            "grow_adopt_p50": {
+                "metric": "grow_adopt_p50_ms",
+                "value": pct(lc.grow_ms, 50), "unit": "ms",
+            },
+            "grow_adopt_p99": {
+                "metric": "grow_adopt_p99_ms",
+                "value": pct(lc.grow_ms, 99), "unit": "ms",
+            },
+            "unavailable_window_p99": {
+                "metric": "shard_unavailable_window_p99_ms",
+                "value": pct(lc.unavailable_ms, 99), "unit": "ms",
+            },
+            "restarts": len(rt.restart_ms),
+            "grows": len(lc.grow_ms),
+        }
+    finally:
+        await sb.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_replicated_mp() -> dict:
-    return asyncio.run(
+    out = asyncio.run(
         _replicated_mp_async(int(os.environ.get("BENCH_MP_CORES", "3")))
     )
+    # the lifecycle block rides the mp round so bench_gate tracks the
+    # restart/grow latencies round over round (ms => smaller-is-better)
+    if os.environ.get("BENCH_SKIP_LIFECYCLE") != "1":
+        try:
+            out["lifecycle"] = asyncio.run(_lifecycle_bench_async())
+        except Exception as e:
+            out["lifecycle"] = {"error": str(e)}
+    return out
 
 
 # -------------------------------------------- SLO-graded sweep (bench --slo)

@@ -127,6 +127,11 @@ class AdminServer(HttpServer):
             self._placement_move,
         )
         r("POST", r"/v1/placement/rebalance", self._placement_rebalance)
+        # -- elastic shard lifecycle -----------------------------------
+        r("GET", r"/v1/shards", self._shards)
+        r("GET", r"/v1/shards/(\d+)", self._shard_detail)
+        r("POST", r"/v1/shards/grow", self._shard_grow)
+        r("POST", r"/v1/shards/(\d+)/retire", self._shard_retire)
         # -- r4 additions toward admin_server.cc route parity ----------
         r(
             "POST",
@@ -1647,6 +1652,66 @@ class AdminServer(HttpServer):
         hot = led.top(8) if led is not None else []
         await reb.sample()
         return await reb.rebalance_once(hot_ntps=hot, reason="manual")
+
+    # -- elastic shard lifecycle --------------------------------------
+    async def _shards(self, _m, _q, _b):
+        """Fleet lifecycle view: supervisor liveness (pids, restarts,
+        gray failures, retirements) plus the lifecycle coordinator's
+        budget and latency accounting."""
+        router = getattr(self.broker, "shard_router", None)
+        if router is None:
+            return {"sharded": False}
+        out = {"sharded": True, "liveness": router.liveness()}
+        lc = getattr(self.broker, "shard_lifecycle", None)
+        if lc is not None:
+            out["lifecycle"] = lc.describe()
+        return out
+
+    async def _shard_detail(self, m, _q, _b):
+        """One shard's crash/restart record: pid, core, restart and
+        gray-failure counts, availability, resident partitions."""
+        router = getattr(self.broker, "shard_router", None)
+        if router is None:
+            raise HttpError(400, "shard runtime not active")
+        sid = int(m.group(1))
+        live = router.liveness()
+        table = self.broker.shard_table
+        return {
+            "shard": sid,
+            "pid": live["alive"].get(str(sid)),
+            "core": live["cores"].get(str(sid)),
+            "alive": str(sid) in live["alive"] or sid == 0,
+            "available": table.is_available(sid),
+            "retired": sid in live["retired"],
+            "restarts": live["shard_restarts"].get(str(sid), 0),
+            "gray_failures": live["gray_failures"].get(str(sid), 0),
+            "crashed_status": live["crashed"].get(str(sid)),
+            "partitions": len(table.ntps_on(sid)),
+        }
+
+    async def _shard_grow(self, _m, _q, _b):
+        """Fork + mesh + activate one new worker shard."""
+        lc = getattr(self.broker, "shard_lifecycle", None)
+        if lc is None:
+            raise HttpError(400, "shard lifecycle not active (1 shard?)")
+        try:
+            sid = await lc.grow()
+        except Exception as e:
+            raise HttpError(400, f"grow failed: {e}") from None
+        return {"grown": True, "shard": sid}
+
+    async def _shard_retire(self, m, _q, _b):
+        """Freeze → evacuate → drain → stop one worker shard."""
+        lc = getattr(self.broker, "shard_lifecycle", None)
+        if lc is None:
+            raise HttpError(400, "shard lifecycle not active (1 shard?)")
+        try:
+            await lc.retire(int(m.group(1)))
+        except ValueError as e:
+            raise HttpError(400, str(e)) from None
+        except Exception as e:
+            raise HttpError(400, f"retire failed: {e}") from None
+        return {"retired": True, "shard": int(m.group(1))}
 
     async def _debug_profile(self, _m, q, _b):
         """Continuous-profiler window: collapsed wall stacks over the

@@ -1002,6 +1002,18 @@ class KafkaServer:
                 # await the shard's ack (ssx shard seam)
                 shard = self.broker.shard_table.shard_for(ntp)
                 if shard:
+                    if not self.broker.shard_table.is_available(shard):
+                        # crash/restart window: the group stays mapped
+                        # while the child re-forks, but invoking into
+                        # it would hang — answer RETRIABLE immediately
+                        # (graceful degradation, never a stuck client)
+                        return Msg(
+                            index=p.index,
+                            error_code=int(
+                                ErrorCode.not_leader_for_partition
+                            ),
+                            base_offset=-1,
+                        )
                     if p.records is None:
                         return Msg(
                             index=p.index,
@@ -1470,7 +1482,13 @@ class KafkaServer:
                     if self.broker.partition_manager.get(ntp) is not None:
                         continue
                     shard = self.broker.shard_table.shard_for(ntp)
-                    if not shard or budget <= 0:
+                    if (
+                        not shard
+                        or budget <= 0
+                        # crash/restart window: skip the invoke, let
+                        # read_all answer not_leader (retriable)
+                        or not self.broker.shard_table.is_available(shard)
+                    ):
                         continue
                     try:
                         rep = await shard_router.fetch(
@@ -1797,6 +1815,14 @@ class KafkaServer:
                     shard = self.broker.shard_table.shard_for(ntp)
                     if shard:
                         try:
+                            if not self.broker.shard_table.is_available(
+                                shard
+                            ):
+                                # crash/restart window: retriable, no
+                                # invoke into the dead channel
+                                raise InvokeError(
+                                    f"shard {shard} unavailable"
+                                )
                             err, off, ts = (
                                 await self.broker.shard_router.list_offsets(
                                     shard, ntp, p.timestamp

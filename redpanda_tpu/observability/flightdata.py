@@ -144,6 +144,12 @@ def _diff_child(new: tuple, old: Optional[tuple]) -> HistogramChild:
     if old is None:
         return HistogramChild.from_counts(list(nb), nov, nsum, ncnt)
     ob, oov, osum, ocnt = old
+    if ncnt < ocnt:
+        # counter reset: the shard restarted mid-window and its
+        # cumulative histogram restarted from zero — the new counts
+        # ARE the in-window observations (clamping each bucket to 0
+        # would erase every post-restart sample instead)
+        return HistogramChild.from_counts(list(nb), nov, nsum, ncnt)
     buckets = [max(0, nb[i] - ob[i]) for i in range(_NBUCKETS)]
     return HistogramChild.from_counts(
         buckets, max(0, nov - oov), max(0.0, nsum - osum), max(0, ncnt - ocnt)
@@ -286,8 +292,14 @@ class MetricsHistory:
             # absent at window start == exactly zero then: counters are
             # cumulative-from-zero, so a series born mid-window (or
             # re-entering after ring wraparound dropped its zero) still
-            # yields the exact in-window delta
-            d = max(0.0, v - old_vals.get(key, 0.0))
+            # yields the exact in-window delta. v < old is a COUNTER
+            # RESET — a shard died and its reborn child restarted from
+            # zero mid-window — and the new cumulative value IS the
+            # in-window delta (the Prometheus rate() convention); the
+            # old clamp-to-zero swallowed all post-restart traffic
+            # until the window slid past the crash
+            ov = old_vals.get(key, 0.0)
+            d = v if v < ov else v - ov
             total += d
             series.append({"labels": dict(key), "delta": d, "rate": d / dt})
         return {

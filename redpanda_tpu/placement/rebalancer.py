@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 
 from ..models.fundamental import DEFAULT_NS, NTP
@@ -57,6 +58,16 @@ class Rebalancer:
         self._last_counter: dict[int, tuple[float, float]] = {}
         self.history: list[dict] = []
         self.alerts_handled = 0
+        # elastic capacity actions: the ShardLifecycle (wired by the
+        # sharded broker) turns a sustained hot/idle signal into real
+        # grow/retire, gated by RP_ELASTIC=1 and the lifecycle budget
+        self.lifecycle = None
+        self.grow_bps = float(os.environ.get("RP_ELASTIC_GROW_BPS", "1e6"))
+        self.idle_bps = float(os.environ.get("RP_ELASTIC_IDLE_BPS", "1e3"))
+        self.scale_ticks = int(os.environ.get("RP_ELASTIC_TICKS", "5"))
+        self._hot_ticks = 0
+        self._idle_ticks: dict[int, int] = {}
+        self.scale_actions: list[dict] = []
 
     # -- load sampling ------------------------------------------------
     def _note_rate(self, shard: int, rate_bps: float) -> None:
@@ -97,10 +108,10 @@ class Rebalancer:
         """Cross-shard skew index (1.0 = balanced), same definition as
         the per-NTP ledger skew — the gauge the shard_skew alert
         judges."""
-        n = self.table.shard_count
-        if n <= 1:
+        active = self.table.active_shards()
+        if len(active) <= 1:
             return 1.0
-        return skew_of([self._rate.get(s, 0.0) for s in range(n)])
+        return skew_of([self._rate.get(s, 0.0) for s in active])
 
     def shard_rates(self) -> dict[int, float]:
         return dict(self._rate)
@@ -126,6 +137,71 @@ class Rebalancer:
                 await self.sample()
             except Exception:
                 logger.exception("placement load sample failed")
+            try:
+                await self.maybe_scale()
+            except Exception:
+                logger.exception("placement scale action failed")
+
+    # -- elastic capacity ---------------------------------------------
+    async def maybe_scale(self) -> dict | None:
+        """Grow-on-hot / retire-on-idle: when EVERY live worker's EWMA
+        rate holds above `grow_bps` for `scale_ticks` consecutive
+        samples, fork one more shard; when a worker (of several) holds
+        below `idle_bps` that long, evacuate and retire it. Inert
+        unless RP_ELASTIC=1; every action charges the lifecycle
+        budget, so an oscillating signal cannot thrash fork/retire."""
+        lc = self.lifecycle
+        if lc is None or not lc.auto:
+            return None
+        router = getattr(self.broker, "shard_router", None)
+        if router is None:
+            return None
+        workers = [s for s in router.worker_shards() if s in self._rate]
+        if not workers:
+            return None
+        rates = {s: self._rate[s] for s in workers}
+        if all(r >= self.grow_bps for r in rates.values()):
+            self._hot_ticks += 1
+        else:
+            self._hot_ticks = 0
+        for s in list(self._idle_ticks):
+            if s not in rates:
+                del self._idle_ticks[s]
+        for s, r in rates.items():
+            self._idle_ticks[s] = (
+                self._idle_ticks.get(s, 0) + 1 if r <= self.idle_bps else 0
+            )
+        act: dict | None = None
+        if self._hot_ticks >= self.scale_ticks:
+            self._hot_ticks = 0
+            try:
+                sid = await lc.grow()
+                act = {"action": "grow", "shard": sid}
+            except Exception as e:
+                act = {"action": "grow", "failed": str(e)}
+        elif len(workers) > 1:
+            idle = [
+                s
+                for s in workers
+                if self._idle_ticks.get(s, 0) >= self.scale_ticks
+            ]
+            if idle:
+                sid = min(idle, key=lambda s: rates[s])
+                self._idle_ticks[sid] = 0
+                try:
+                    await lc.retire(sid)
+                    act = {"action": "retire", "shard": sid}
+                except Exception as e:
+                    act = {"action": "retire", "shard": sid,
+                           "failed": str(e)}
+        if act is not None:
+            act["rates_bps"] = {
+                str(k): round(v, 1) for k, v in sorted(rates.items())
+            }
+            self.scale_actions.append(act)
+            del self.scale_actions[:-32]
+            logger.info("elastic scale action: %s", act)
+        return act
 
     # -- alert hook ---------------------------------------------------
     def wants(self, alert: dict) -> bool:
@@ -147,10 +223,9 @@ class Rebalancer:
     def _pick_shards(self) -> tuple[int, int]:
         """(hottest, coldest) shard by EWMA rate; partition count
         breaks ties so an idle fleet still spreads."""
-        n = self.table.shard_count
         counts = self.table.counts()
         key = lambda s: (self._rate.get(s, 0.0), counts.get(s, 0))
-        shards = list(range(n))
+        shards = self.table.active_shards()
         return max(shards, key=key), min(shards, key=key)
 
     async def rebalance_once(
@@ -234,4 +309,5 @@ class Rebalancer:
             },
             "alerts_handled": self.alerts_handled,
             "history": self.history[-8:],
+            "scale_actions": self.scale_actions[-8:],
         }

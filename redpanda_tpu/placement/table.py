@@ -80,6 +80,47 @@ class PlacementTable:
         # caches per-sender "all groups local" verdicts against it
         self.epoch = 0
         self.moves_executed = 0
+        # elastic lifecycle state: retired shards never receive NEW
+        # assignments; unavailable shards (crashed, mid-restart) keep
+        # their map entries but produce/fetch must answer retriable
+        # errors instead of invoking into a dead channel
+        self._retired: set[int] = set()
+        self._unavailable: set[int] = set()
+
+    # -- lifecycle ----------------------------------------------------
+    def active_shards(self) -> list[int]:
+        """Shards eligible for NEW placements (not retired). Shard 0
+        is always active — it is the parent process."""
+        return [s for s in range(self.shard_count) if s not in self._retired]
+
+    def activate(self, shard: int) -> None:
+        """A grown (or re-grown) shard joins the placement pool."""
+        self.shard_count = max(self.shard_count, shard + 1)
+        self._retired.discard(shard)
+        self._unavailable.discard(shard)
+        self.epoch += 1
+
+    def deactivate(self, shard: int) -> None:
+        """A retiring shard leaves the NEW-placement pool (its live
+        groups evacuate through the PartitionMover before the process
+        stops)."""
+        if shard == 0:
+            raise ValueError("shard 0 cannot retire")
+        self._retired.add(shard)
+        self.epoch += 1
+
+    def set_unavailable(self, shard: int, down: bool = True) -> None:
+        """Crash/restart window marker: the shard's groups stay mapped
+        (the new child re-adopts them in place) but routing must fail
+        fast with a retriable error while `down` holds."""
+        if down:
+            self._unavailable.add(shard)
+        else:
+            self._unavailable.discard(shard)
+        self.epoch += 1
+
+    def is_available(self, shard: int) -> bool:
+        return shard not in self._unavailable and shard not in self._retired
 
     # -- policy -------------------------------------------------------
     def assign(self, ntp: NTP, group_id: int, replicas, node_id: int) -> int:
@@ -87,14 +128,17 @@ class PlacementTable:
         policy, unified here). Internal/coordinator topics (tx,
         consumer groups) and non-default namespaces keep the shard-0
         path, where the full coordinator machinery lives; everything
-        else spreads."""
+        else spreads across the ACTIVE (non-retired) shards — with no
+        retirements the active list is [0..n) and the policy reduces
+        to the classic compute_shard modulo."""
         if self.shard_count <= 1:
             return 0
         if ntp.ns != DEFAULT_NS or ntp.topic.startswith("__"):
             return 0
         if pin_replicated() and list(replicas) != [node_id]:
             return 0
-        return compute_shard(group_id, self.shard_count)
+        active = self.active_shards()
+        return active[compute_shard(group_id, len(active))]
 
     # -- map ----------------------------------------------------------
     def insert(self, ntp: NTP, group_id: int, shard: int = 0) -> None:
@@ -164,6 +208,11 @@ class PlacementTable:
     def group_of(self, ntp: NTP) -> int | None:
         return self._gid_of.get(ntp)
 
+    def ntps_on(self, shard: int) -> list[NTP]:
+        """Every ntp currently mapped to `shard` (evacuation before a
+        retire; re-adoption after a per-shard restart)."""
+        return [ntp for ntp, s in self._ntp.items() if s == shard]
+
     def entries(self) -> list[dict]:
         """Admin surface: the full map with lane bindings."""
         out = []
@@ -188,4 +237,6 @@ class PlacementTable:
             "counts": {str(k): v for k, v in sorted(self.counts().items())},
             "moves_executed": self.moves_executed,
             "epoch": self.epoch,
+            "retired": sorted(self._retired),
+            "unavailable": sorted(self._unavailable),
         }

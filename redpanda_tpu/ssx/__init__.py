@@ -5,6 +5,7 @@ everything above it talks to shards through `invoke_on` with serde
 envelope payloads.
 """
 
+from .procnemesis import ForkFailInjected, ProcRule, ProcSchedule
 from .shards import (
     InvokeError,
     InvokeReply,
@@ -18,26 +19,18 @@ from .shards import (
     standdown_reason,
 )
 
-
-def __getattr__(name: str):
-    # deprecated v1 placement hash: resolves through the shards-module
-    # shim so the DeprecationWarning fires exactly once per use site
-    if name == "shard_of":
-        from . import shards
-
-        return shards.shard_of
-    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
-
 __all__ = [
+    "ForkFailInjected",
     "InvokeError",
     "InvokeReply",
     "InvokeRequest",
+    "ProcRule",
+    "ProcSchedule",
     "ShardChannel",
     "ShardContext",
     "ShardRuntime",
     "bind_reuse_port",
     "pin_to_core",
     "reserve_reuse_port",
-    "shard_of",
     "standdown_reason",
 ]

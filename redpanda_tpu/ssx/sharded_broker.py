@@ -802,7 +802,13 @@ class ShardRouter:
 
     def __init__(self, runtime: ShardRuntime, n_shards: int):
         self._rt = runtime
-        self.n_shards = n_shards
+
+    @property
+    def n_shards(self) -> int:
+        # elastic: the runtime's count grows with spawn_shard, so the
+        # router (and everything reading it — table sync, stats,
+        # admin) always sees the live topology
+        return self._rt.n_shards
 
     async def move_invoke(self, shard: int, method: str, payload: bytes) -> bytes:
         """One live-move protocol frame to a worker shard's MoveHost
@@ -859,6 +865,9 @@ class ShardRouter:
     async def produce(
         self, shard: int, ntp, records: bytes, acks: int
     ) -> tuple[int, int]:
+        # ProcNemesis boundary: a mid-produce process fault lands here,
+        # BEFORE the invoke, so the in-flight record is the one at risk
+        self._rt._nemesis_act("produce", shard)
         raw = await self._rt.invoke_on(
             shard,
             "partition",
@@ -964,8 +973,10 @@ class ShardRouter:
         )
         return _prof.ProfileReply.decode(raw)
 
-    def worker_shards(self) -> range:
-        return range(1, self.n_shards)
+    def worker_shards(self) -> list[int]:
+        """The LIVE worker shard ids — not a dense range once shards
+        grow/retire/restart. Shard 0 (the parent) is never a worker."""
+        return [s for s in sorted(self._rt.shard_pids)]
 
     def liveness(self) -> dict:
         """Supervisor view for /v1/debug/probes and the aggregated
@@ -984,7 +995,206 @@ class ShardRouter:
                 str(sid): st for sid, st in sorted(rt.crashed.items())
             },
             "restarts": rt.restarts,
+            "shard_restarts": {
+                str(sid): n for sid, n in sorted(rt.shard_restarts.items())
+            },
+            "gray_failures": {
+                str(sid): n for sid, n in sorted(rt.gray_failures.items())
+            },
+            "retired": sorted(rt.retired),
+            "spawns": rt.spawns,
             "failed": rt.failed.is_set(),
+        }
+
+
+# ------------------------------------------------- elastic lifecycle
+class ShardLifecycle:
+    """Coordinator for elastic shard membership and per-shard crash
+    recovery. Three flows, each complete-or-rollback under ProcNemesis:
+
+    - grow: fork (`ShardRuntime.spawn_shard`) -> readiness probe ->
+      placement activation. The new shard is provisional (supervisor
+      auto-restart suppressed) until it is placement-visible; any
+      failure reaps it with zero residue.
+    - retire: freeze NEW placements (`table.deactivate`) -> evacuate
+      every resident group through the PartitionMover (budget already
+      charged here, not per-move) -> drain check -> process stop
+      ladder. A failed evacuation rolls the shard back to active with
+      whatever groups still live on it — the map stays consistent.
+    - crash recovery seams: `on_shard_down` marks the dead shard's
+      groups UNAVAILABLE (produce/fetch answer retriable errors, never
+      hang); `on_shard_up` re-adopts every mapped group into the
+      reborn child from its on-disk StorageApi dir and lifts the
+      marker, recording the unavailability window.
+
+    All flows share one MoveBudget-style token window so an
+    oscillating capacity signal cannot thrash fork/retire cycles."""
+
+    def __init__(self, sb: "ShardedBroker"):
+        from ..placement.mover import MoveBudget
+
+        self._sb = sb
+        self.budget = MoveBudget(
+            moves_per_window=int(os.environ.get("RP_LIFECYCLE_OPS", "4")),
+            window_s=float(os.environ.get("RP_LIFECYCLE_WINDOW_S", "60")),
+        )
+        # RP_ELASTIC=1 lets the rebalancer drive grow/retire from its
+        # capacity signal; the admin POSTs work either way
+        self.auto = os.environ.get("RP_ELASTIC", "0") == "1"
+        self.grows = 0
+        self.retires = 0
+        self.rolled_back = 0
+        self.readopts = 0
+        self.grow_ms: list[float] = []
+        self.unavailable_ms: list[float] = []
+        self._down_t0: dict[int, float] = {}
+
+    @property
+    def _table(self):
+        return self._sb.broker.shard_table
+
+    async def grow(self, sid: Optional[int] = None) -> int:
+        """Fork + mesh + activate one new worker shard; returns its id.
+        Raises (ForkFailInjected, MoveBudgetExhausted, RuntimeError)
+        with no partial state on any failure."""
+        from ..placement.mover import MoveBudgetExhausted
+
+        rt = self._sb.runtime
+        if rt is None or self._sb.router is None:
+            raise RuntimeError("shard runtime not active")
+        if not self.budget.try_acquire():
+            raise MoveBudgetExhausted("lifecycle budget exhausted")
+        loop = asyncio.get_event_loop()
+        t0 = loop.time()
+        if sid is None:
+            sid = rt._next_sid
+        # provisional: a mid-grow death is GROW's to roll back, not the
+        # supervisor's to restart
+        rt.begin_retire(sid)
+        try:
+            await rt.spawn_shard(sid)
+            rt._nemesis_act("grow.ready", sid)
+            # readiness probe: the partition engine must answer before
+            # the shard becomes placement-visible
+            await self._sb.router.stats(sid)
+            rt._nemesis_act("grow.activate", sid)
+            self._table.activate(sid)
+        except BaseException:
+            self.rolled_back += 1
+            try:
+                await rt.retire_shard(sid)
+            except Exception:
+                logger.exception("grow rollback of shard %d failed", sid)
+            raise
+        finally:
+            rt.abort_retire(sid)
+        self.grows += 1
+        self.grow_ms.append((loop.time() - t0) * 1e3)
+        logger.info("shard %d grown and placement-active", sid)
+        return sid
+
+    async def retire(self, sid: int) -> None:
+        """Freeze -> evacuate -> drain -> stop. Rolls the shard back to
+        active (with its unevacuated groups) on any failure."""
+        from ..placement.mover import MoveBudgetExhausted
+
+        rt = self._sb.runtime
+        table, mover = self._table, self._sb.mover
+        if sid == 0:
+            raise ValueError("shard 0 cannot retire")
+        if rt is None or sid not in rt.shard_pids:
+            raise ValueError(f"no live shard {sid}")
+        if not self.budget.try_acquire():
+            raise MoveBudgetExhausted("lifecycle budget exhausted")
+        rt._nemesis_act("retire.freeze", sid)
+        table.deactivate(sid)
+        try:
+            rt._nemesis_act("retire.evacuate", sid)
+            for ntp in table.ntps_on(sid):
+                targets = [
+                    s
+                    for s in table.active_shards()
+                    if s != sid and (s == 0 or s in rt.shard_pids)
+                ]
+                counts = table.counts()
+                dst = min(targets, key=lambda s: counts.get(s, 0))
+                await mover.move(ntp, dst, charge_budget=False)
+            rt._nemesis_act("retire.drain", sid)
+            left = table.ntps_on(sid)
+            if left:
+                raise RuntimeError(
+                    f"retire drain: {len(left)} groups still on shard {sid}"
+                )
+        except BaseException:
+            self.rolled_back += 1
+            table.activate(sid)
+            raise
+        rt._nemesis_act("retire.stop", sid)
+        await rt.retire_shard(sid)
+        self.retires += 1
+        logger.info("shard %d evacuated and retired", sid)
+
+    # -- crash-recovery seams (ShardRuntime hooks) --------------------
+    def on_shard_down(self, sid: int, status: int) -> None:
+        broker = self._sb.broker
+        if broker is None:
+            return
+        self._down_t0[sid] = asyncio.get_event_loop().time()
+        broker.shard_table.set_unavailable(sid, True)
+        logger.warning(
+            "shard %d down (status %d): %d groups marked UNAVAILABLE",
+            sid, status, len(broker.shard_table.ntps_on(sid)),
+        )
+
+    async def on_shard_up(self, sid: int) -> None:
+        """Re-adopt the reborn shard's groups from its on-disk state:
+        the table kept every ntp -> sid binding through the crash, so
+        create_partition against the same shard dir re-opens each log
+        + kvstore snapshot in place, then the UNAVAILABLE marker lifts
+        (epoch bump rebinds the routing caches)."""
+        broker = self._sb.broker
+        rt = self._sb.runtime
+        if broker is None or rt is None:
+            return
+        rt._nemesis_act("restart.readopt", sid)
+        table = broker.shard_table
+        controller = broker.controller
+        tt = controller.topic_table
+        for ntp in table.ntps_on(sid):
+            md = tt.get(ntp.tp_ns)
+            a = md.assignments.get(ntp.partition) if md is not None else None
+            if a is None:
+                continue
+            await self._sb.router.create_partition(
+                sid, ntp, a.group, a.replicas, controller._log_config_for(ntp)
+            )
+            self.readopts += 1
+        table.set_unavailable(sid, False)
+        t0 = self._down_t0.pop(sid, None)
+        if t0 is not None:
+            self.unavailable_ms.append(
+                (asyncio.get_event_loop().time() - t0) * 1e3
+            )
+        logger.warning("shard %d re-adopted and AVAILABLE again", sid)
+
+    def describe(self) -> dict:
+        rt = self._sb.runtime
+        return {
+            "auto": self.auto,
+            "budget": self.budget.describe(),
+            "grows": self.grows,
+            "retires": self.retires,
+            "rolled_back": self.rolled_back,
+            "readopts": self.readopts,
+            "grow_ms": [round(x, 3) for x in self.grow_ms[-16:]],
+            "unavailable_ms": [
+                round(x, 3) for x in self.unavailable_ms[-16:]
+            ],
+            "restart_ms": (
+                [round(x, 3) for x in rt.restart_ms[-16:]]
+                if rt is not None
+                else []
+            ),
         }
 
 
@@ -1011,6 +1221,7 @@ class ShardedBroker:
         self.move_host = None
         self.mover = None
         self.rebalancer = None
+        self.lifecycle = None
 
     async def start(self) -> None:
         from ..app import Broker
@@ -1035,7 +1246,12 @@ class ShardedBroker:
         )
         self.config.kafka_port = port
         self.config.kafka_reuse_port = True
-        self.runtime = ShardRuntime(self.n_shards, self._shard_child_main)
+        self.runtime = ShardRuntime(
+            self.n_shards,
+            self._shard_child_main,
+            restart_limit=int(os.environ.get("RP_SHARD_RESTARTS", "8")),
+            heartbeat_deadline=float(os.environ.get("RP_SHARD_HB_S", "5")),
+        )
         self.runtime.register("rpc.out", self._rpc_out_service)
         self.runtime.register("kafka", self._kafka_service)
         self.runtime.register("placement", self._placement_service)
@@ -1064,6 +1280,13 @@ class ShardedBroker:
         self.rebalancer = Rebalancer(self.broker, self.mover, table)
         self.broker.placement_mover = self.mover
         self.broker.placement_rebalancer = self.rebalancer
+        # elastic lifecycle: grow/retire coordination + the crash
+        # recovery seams (UNAVAILABLE marking + on-disk re-adoption)
+        self.lifecycle = ShardLifecycle(self)
+        self.broker.shard_lifecycle = self.lifecycle
+        self.rebalancer.lifecycle = self.lifecycle
+        self.runtime.on_shard_down = self.lifecycle.on_shard_down
+        self.runtime.on_shard_up = self.lifecycle.on_shard_up
         svc = self.broker.group_manager.service
         svc.shard_resolver = table.shard_for_group
         svc.shard_forward = self.router.raft_invoke
@@ -1120,8 +1343,11 @@ class ShardedBroker:
 
     # -- parent services ----------------------------------------------
     def _on_shard_crash(self, shard_id: int, status: int) -> None:
+        # with per-shard restart this only fires once the restart
+        # budget is exhausted — crashes within budget recover in place
         logger.error(
-            "node %d: shard %d died (status %d) — broker must stop",
+            "node %d: shard %d died (status %d) and the restart budget "
+            "is exhausted — broker must stop",
             self.config.node_id,
             shard_id,
             status,
@@ -1209,7 +1435,7 @@ class ShardedBroker:
         if not self.active or self.router is None:
             return []
         out = []
-        for sid in range(1, self.n_shards):
+        for sid in self.router.worker_shards():
             try:
                 out.append(await self.router.stats(sid))
             except InvokeError:

@@ -17,13 +17,25 @@ frame, the same framing discipline as rpc/transport.py; payloads are
 themselves serde envelopes (rplint RPL009 — no pickled object graphs
 crossing the shard boundary).
 
-Supervision (shard 0 only): a reaper task polls `waitpid(WNOHANG)`;
-an unexpected child exit either escalates (`failed` is set, `on_crash`
-fires — the broker embedding decides to shut down) or, with
-`restart_limit > 0`, tears down and re-forks the whole shard group
-(state is rebuilt by `child_main`, exactly like a process manager
-restart — per-shard in-place restart would need SCM_RIGHTS fd
-re-plumbing into live siblings and is deliberately out of scope).
+Supervision (shard 0 only): a reaper task polls `waitpid(WNOHANG)`
+plus a heartbeat deadline (a SIGSTOP'd child is alive to waitpid but
+answers nothing — the gray failure only the deadline can see). With
+`restart_limit > 0` the default response to an unexpected child exit
+is a per-shard in-place restart: only the dead shard is re-forked
+over a fresh parent<->child socketpair, siblings keep running, and
+their direct legs to the reborn shard are replaced by relay through
+shard 0 (`ssx.relay`). The legacy whole-group restart survives as
+`restart_mode="all"`. When the limit is exhausted `failed` is set and
+`on_crash` fires (wrapped — a throwing hook never kills the reaper).
+
+Elastic lifecycle: `spawn_shard()` forks a new pinned worker at
+runtime (single parent<->child socketpair; peer legs relay via shard
+0), `retire_shard(sid)` walks the polite-invoke → SIGTERM → SIGKILL
+ladder with a per-shard deadline. The higher-level grow/retire
+protocol (placement activation, evacuation through the
+PartitionMover, on-disk re-adoption) lives in sharded_broker.py's
+ShardLifecycle; seeded process-fault injection for every boundary is
+ssx/procnemesis.py, installed as `runtime.nemesis`.
 
 Stand-down discipline mirrors the native gates (raft/service.py):
 fault-injection layers (file_sanitizer, iofaults) instrument
@@ -90,29 +102,26 @@ class ShardReady(Envelope):
     SERDE_FIELDS = [("shard", u16), ("pid", u64), ("core", u64)]
 
 
+class ShardRelay(Envelope):
+    """An invoke_on hop relayed through shard 0 when the sender has no
+    (live) direct channel to the target — dynamically spawned shards
+    and reborn crash-restart shards have a parent leg only."""
+
+    SERDE_FIELDS = [
+        ("shard", u16),
+        ("service", string),
+        ("method", string),
+        ("payload", bytes_t),
+        ("timeout", u16),  # seconds, saturating
+    ]
+
+
 # ------------------------------------------------------------------ util
 # Placement moved to its own layer (PR 12): the deterministic
 # group → shard hash lives in placement/table.py and actual routing
-# goes through the PlacementTable, which live moves can rebind.
-# The v1 `shard_of` name survives only as a deprecation shim (module
-# __getattr__, so importing it warns); rplint RPL017 forbids new uses.
-
-
-def __getattr__(name: str):
-    if name == "shard_of":
-        import warnings
-
-        warnings.warn(
-            "ssx.shards.shard_of is deprecated: placement is decided by "
-            "placement.PlacementTable (use placement.table.compute_shard "
-            "only for the new-group default)",
-            DeprecationWarning,
-            stacklevel=2,
-        )
-        from ..placement.table import compute_shard
-
-        return compute_shard
-    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+# goes through the PlacementTable, which live moves can rebind. The
+# v1 `shard_of` deprecation shim is gone (PR 17); rplint RPL017
+# forbids reintroducing placement decisions here.
 
 
 def pin_to_core(shard_id: int) -> Optional[int]:
@@ -170,6 +179,33 @@ def bind_reuse_port(host: str, port: int) -> socket.socket:
     return s
 
 
+def _close_inherited_sockets(keep: set[int]) -> None:
+    """Fork hygiene for DYNAMIC spawns: the child of a live broker
+    inherits every open fd — listeners, established connections,
+    sibling channel ends. Sockets are the dangerous ones (a connection
+    the parent closes stays half-open until the child's copy dies, so
+    peers never see FIN); pipes and files are left alone so pytest's
+    capture machinery keeps working."""
+    import stat
+
+    try:
+        fds = os.listdir("/proc/self/fd")
+    except OSError:
+        return
+    for name in fds:
+        try:
+            fd = int(name)
+        except ValueError:
+            continue
+        if fd < 3 or fd in keep:
+            continue
+        try:
+            if stat.S_ISSOCK(os.fstat(fd).st_mode):
+                os.close(fd)
+        except OSError:
+            continue
+
+
 # ------------------------------------------------------------- channel
 class ShardChannel:
     """Full-duplex correlation-multiplexed stream over one socketpair
@@ -193,6 +229,9 @@ class ShardChannel:
         self._writer: Optional[asyncio.StreamWriter] = None
         self._task: Optional[asyncio.Future] = None
         self._closed = False
+        # set once the read loop exits: the peer is gone and every
+        # future call would fail — callers may fall back to relaying
+        self.dead = False
 
     async def open(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
@@ -291,6 +330,7 @@ class ShardChannel:
         ):
             pass
         finally:
+            self.dead = True
             self._fail_pending("peer channel closed")
 
     def _fail_pending(self, why: str) -> None:
@@ -379,11 +419,25 @@ class ShardContext:
     ) -> bytes:
         """The `ss::sharded<T>::invoke_on` analog. Local shard runs the
         handler inline (no serialization round-trip, matching seastar's
-        same-shard fast path); remote goes over the socketpair."""
+        same-shard fast path); remote goes over the socketpair. A
+        missing or dead peer leg falls back to relaying through shard 0
+        (`ssx.relay`) — dynamically spawned and crash-restarted shards
+        only ever hold a parent leg, and a sibling's leg to a reborn
+        shard died with the old process."""
         if shard == self.shard_id:
             return await self.dispatch(service, method, payload)
         ch = self._channels.get(shard)
-        if ch is None:
+        if ch is None or ch.dead:
+            zero = self._channels.get(0)
+            if shard != 0 and self.shard_id != 0 and zero is not None:
+                env = ShardRelay(
+                    shard=shard,
+                    service=service,
+                    method=method,
+                    payload=payload,
+                    timeout=min(int(timeout) or 1, (1 << 16) - 1),
+                ).encode()
+                return await zero.call("ssx", "relay", env, timeout)
             raise InvokeError(
                 f"shard {self.shard_id}: no channel to shard {shard}"
             )
@@ -412,33 +466,63 @@ class ShardRuntime:
         child_main: Callable[[ShardContext], Awaitable],
         *,
         restart_limit: int = 0,
+        restart_mode: str = "shard",
         ready_timeout: float = 30.0,
         shutdown_timeout: float = 8.0,
+        heartbeat_interval: float = 0.5,
+        heartbeat_deadline: float = 0.0,
     ):
         if n_shards < 1:
             raise ValueError("n_shards must be >= 1")
+        if restart_mode not in ("shard", "all"):
+            raise ValueError(f"restart_mode {restart_mode!r}")
         self.n_shards = n_shards
         self._child_main = child_main
         self._restart_limit = restart_limit
+        self._restart_mode = restart_mode
         self._ready_timeout = ready_timeout
         self._shutdown_timeout = shutdown_timeout
+        # gray-failure detection: a child that waitpid reports alive
+        # but that misses `heartbeat_deadline` seconds of pings (e.g.
+        # SIGSTOP'd) is declared dead and SIGKILLed so the normal
+        # restart path takes over. 0 disables the heartbeat.
+        self._hb_interval = heartbeat_interval
+        self._hb_deadline = heartbeat_deadline
 
         self.ctx: Optional[ShardContext] = None
         self.failed = asyncio.Event()
-        self.crashed: dict[int, int] = {}  # shard -> wait status
+        self.crashed: dict[int, int] = {}  # shard -> last wait status
         self.restarts = 0
+        self.shard_restarts: dict[int, int] = {}  # per-shard restarts
+        self.gray_failures: dict[int, int] = {}  # heartbeat kills
+        self.restart_ms: list[float] = []  # crash -> serving again
+        self.spawns = 0
+        self.retired: set[int] = set()
         self.shard_pids: dict[int, int] = {}
         self.shard_cores: dict[int, Optional[int]] = {}
-        # on_crash(shard_id, status): escalation hook (sync or async)
+        # on_crash(shard_id, status): escalation hook (sync or async),
+        # fired when a dead shard will NOT be restarted
         self.on_crash = None
-        # on_restart(runtime): fired after a successful restart-all
+        # on_restart(runtime): fired after any successful restart
         self.on_restart = None
+        # per-shard restart seams for the broker embedding:
+        # on_shard_down(sid, status) right after the death is noticed,
+        # on_shard_up(sid) once the reborn shard answered ready
+        self.on_shard_down = None
+        self.on_shard_up = None
+        # seeded process-fault injection (ssx/procnemesis.py)
+        self.nemesis = None
 
         self._pairs: dict[tuple[int, int], tuple[socket.socket, socket.socket]] = {}
         self._ready_futs: dict[int, asyncio.Future] = {}
         self._reaper: Optional[asyncio.Future] = None
         self._stopping = False
         self._started = False
+        self._retiring: set[int] = set()
+        self._spawning: set[int] = set()
+        self._next_sid = n_shards
+        self._hb_last: dict[int, float] = {}
+        self._hb_inflight: set[int] = set()
         # services registered before start() land on the parent ctx
         self._pre_services: dict[str, Callable] = {}
 
@@ -520,6 +604,9 @@ class ShardRuntime:
                     f"shards {missing} not ready within "
                     f"{self._ready_timeout}s"
                 ) from None
+        now = loop.time()
+        for sid in range(1, n):
+            self._hb_last[sid] = now
         logger.info(
             "shard runtime up: %d shards, pids=%s cores=%s",
             n,
@@ -537,27 +624,63 @@ class ShardRuntime:
             return b""
         if method == "ping":
             return payload
+        if method == "relay":
+            # worker -> worker hop brokered through shard 0: the
+            # sender has no live direct leg to the target
+            req = ShardRelay.decode(payload)
+            return await self.ctx.invoke_on(
+                int(req.shard),
+                req.service,
+                req.method,
+                bytes(req.payload),
+                timeout=float(req.timeout),
+            )
         raise LookupError(f"ssx: no such method {method!r}")
 
-    def _fork_child(self, sid: int) -> int:
+    def _fork_child(
+        self,
+        sid: int,
+        socks: Optional[dict[int, socket.socket]] = None,
+        slow_start_s: float = 0.0,
+    ) -> int:
+        """Fork one worker. `socks=None` is the pre-fork launch path
+        (the child derives its channel ends from the full mesh in
+        `self._pairs`); a dict is the dynamic-spawn path — the child
+        keeps exactly those peer sockets and drops every other socket
+        fd it inherited from the live parent (listeners, sibling
+        channels — keeping them open would mask EOFs fleet-wide)."""
         pid = os.fork()
         if pid:
             return pid
         # ---- child: never returns ----
         status = 1
         try:
-            for (i, j), (a, b) in self._pairs.items():
-                keep = a if i == sid else (b if j == sid else None)
-                for s in (a, b):
-                    if s is not keep:
-                        s.close()
+            if socks is None:
+                socks = {}
+                for (i, j), (a, b) in self._pairs.items():
+                    keep = a if i == sid else (b if j == sid else None)
+                    for s in (a, b):
+                        if s is not keep:
+                            s.close()
+                    if keep is not None:
+                        socks[j if i == sid else i] = keep
+            else:
+                _close_inherited_sockets(
+                    {s.fileno() for s in socks.values()}
+                )
             core = pin_to_core(sid)
+            if slow_start_s > 0:
+                # procnemesis slow_start: stall before the event loop
+                # (and so the ready handshake) comes up
+                import time as _time
+
+                _time.sleep(slow_start_s)
             # the forked thread-state still marks the parent's loop as
             # running; clear it so a fresh loop can run here
             asyncio.events._set_running_loop(None)
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
-            loop.run_until_complete(self._child_body(sid, core))
+            loop.run_until_complete(self._child_body(sid, core, socks))
             status = 0
         except BaseException:
             traceback.print_exc()
@@ -565,8 +688,10 @@ class ShardRuntime:
             # NEVER unwind into the parent's stack/atexit machinery
             os._exit(status)
 
-    async def _child_body(self, sid: int, core: Optional[int]) -> None:
-        ctx = ShardContext(sid, self.n_shards)
+    async def _child_body(
+        self, sid: int, core: Optional[int], socks: dict[int, socket.socket]
+    ) -> None:
+        ctx = ShardContext(sid, max(self.n_shards, sid + 1))
         ctx.core = core
 
         async def _ssx(method: str, payload: bytes) -> bytes:
@@ -578,15 +703,9 @@ class ShardRuntime:
             raise LookupError(f"ssx: no such method {method!r}")
 
         ctx.register("ssx", _ssx)
-        for (i, j), (a, b) in self._pairs.items():
-            if i == sid:
-                peer, sock = j, a
-            elif j == sid:
-                peer, sock = i, b
-            else:
-                continue
+        for peer in sorted(socks):
             ch = ShardChannel(
-                sock,
+                socks[peer],
                 ctx.dispatch_request,
                 label=f"{sid}<->{peer}",
                 origin=f"shard{sid}",
@@ -612,12 +731,227 @@ class ShardRuntime:
                 traceback.print_exc()
         await ctx._close_channels()
 
+    # -- elastic lifecycle --------------------------------------------
+    def _nemesis_act(self, event: str, sid: int, pid: Optional[int] = None):
+        """Consult the installed ProcSchedule at one operation
+        boundary and apply the firing's process action. `fork_fail`
+        raises ForkFailInjected; `slow_start` rules are returned for
+        the caller to thread into the fork; kill/pause act on the
+        shard's pid right here. All RNG draws happen synchronously
+        (the trace is a pure function of seed + boundary sequence)."""
+        sched = self.nemesis
+        if sched is None:
+            return None
+        rule = sched.act(sid, event)
+        if rule is None:
+            return None
+        from .procnemesis import ForkFailInjected
+
+        if rule.action == "fork_fail":
+            raise ForkFailInjected(
+                f"injected fork failure at {event} (shard {sid})"
+            )
+        if rule.action == "slow_start":
+            return rule
+        if pid is None:
+            pid = self.shard_pids.get(sid)
+        if pid is None:
+            return rule
+        if rule.action == "kill":
+            logger.warning(
+                "procnemesis: SIGKILL shard %d (pid %d) at %s",
+                sid, pid, event,
+            )
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        elif rule.action == "pause":
+            dur = rule.pause_s + sched.effect_jitter(rule)
+            logger.warning(
+                "procnemesis: SIGSTOP shard %d (pid %d) at %s for %.3fs",
+                sid, pid, event, dur,
+            )
+            try:
+                os.kill(pid, signal.SIGSTOP)
+            except ProcessLookupError:
+                return rule
+
+            def _cont(p=pid):
+                try:
+                    os.kill(p, signal.SIGCONT)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+            asyncio.get_event_loop().call_later(dur, _cont)
+        return rule
+
+    async def spawn_shard(self, sid: Optional[int] = None) -> int:
+        """Fork one NEW pinned worker into the running group and mesh
+        it in: the parent brokers a fresh socketpair leg; peer-to-peer
+        invokes reach the new shard by relaying through shard 0.
+        Returns the shard id. On any failure (fork-fail injection,
+        killed mid-handshake, ready timeout) the partial spawn is
+        reaped — no orphan process, no channel, no pid entry."""
+        if not self._started:
+            raise RuntimeError("runtime not started")
+        if sid is None:
+            sid = self._next_sid
+        if sid == 0 or sid in self.shard_pids:
+            raise ValueError(f"shard {sid} already exists")
+        slow = 0.0
+        rule = self._nemesis_act("spawn.fork", sid)
+        if rule is not None and rule.action == "slow_start":
+            slow = rule.delay_s + self.nemesis.effect_jitter(rule)
+        await self._spawn(sid, slow_start_s=slow)
+        self._next_sid = max(self._next_sid, sid + 1)
+        self.n_shards = max(self.n_shards, sid + 1)
+        if self.ctx is not None:
+            self.ctx.n_shards = self.n_shards
+        self.spawns += 1
+        self.retired.discard(sid)
+        return sid
+
+    async def _spawn(self, sid: int, *, slow_start_s: float = 0.0) -> None:
+        """Fork + channel + ready handshake for one shard (grow and
+        in-place restart share this). The caller owns placement-level
+        bookkeeping; failure cleans up the partial spawn and raises."""
+        loop = asyncio.get_event_loop()
+        self._spawning.add(sid)
+        try:
+            fut = self._ready_futs[sid] = loop.create_future()
+            a, b = socket.socketpair()
+            pid = self._fork_child(sid, socks={0: b}, slow_start_s=slow_start_s)
+            b.close()
+            self.shard_pids[sid] = pid
+            old = self.ctx._channels.pop(sid, None)
+            if old is not None:
+                await old.close()
+            ch = ShardChannel(
+                a, self.ctx.dispatch_request, label=f"0<->{sid}",
+                origin="shard0",
+            )
+            await ch.open()
+            self.ctx._channels[sid] = ch
+            self._nemesis_act("spawn.forked", sid, pid=pid)
+            deadline = loop.time() + self._ready_timeout
+            while not fut.done():
+                if loop.time() >= deadline:
+                    await self._abort_spawn(sid)
+                    raise RuntimeError(
+                        f"shard {sid} not ready within "
+                        f"{self._ready_timeout}s"
+                    )
+                try:
+                    wpid, st = os.waitpid(pid, os.WNOHANG)
+                except ChildProcessError:
+                    wpid, st = pid, -1
+                if wpid:
+                    # died mid-handshake (e.g. an injected SIGKILL):
+                    # the pid is already reaped, just unwind the rest
+                    self.shard_pids.pop(sid, None)
+                    await self._abort_spawn(sid)
+                    raise RuntimeError(
+                        f"shard {sid} died during spawn (status {st})"
+                    )
+                await asyncio.sleep(0.02)
+            self._hb_last[sid] = loop.time()
+            logger.info(
+                "shard %d spawned (pid %d, core %s)",
+                sid, pid, self.shard_cores.get(sid),
+            )
+        finally:
+            self._spawning.discard(sid)
+            self._ready_futs.pop(sid, None)
+
+    async def _abort_spawn(self, sid: int) -> None:
+        """Unwind a failed spawn: close the channel, kill + reap the
+        child if it is still around. Leaves zero trace of the shard."""
+        ch = self.ctx._channels.pop(sid, None)
+        if ch is not None:
+            await ch.close()
+        pid = self.shard_pids.pop(sid, None)
+        self._hb_last.pop(sid, None)
+        if pid is None:
+            return
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        for _ in range(100):
+            try:
+                wpid, _st = os.waitpid(pid, os.WNOHANG)
+            except ChildProcessError:
+                return
+            if wpid:
+                return
+            await asyncio.sleep(0.02)
+        logger.error("aborted spawn of shard %d: pid %d unreaped", sid, pid)
+
+    def begin_retire(self, sid: int) -> None:
+        """Mark a shard's upcoming exit as expected so the reaper does
+        not treat the retire ladder's kill as a crash."""
+        self._retiring.add(sid)
+
+    def abort_retire(self, sid: int) -> None:
+        self._retiring.discard(sid)
+
+    async def retire_shard(self, sid: int) -> None:
+        """Process-level retire: polite shutdown invoke, then the
+        SIGTERM -> SIGKILL ladder with the per-shard deadline. The
+        data plane must already be drained (ShardLifecycle evacuates
+        through the PartitionMover before calling this)."""
+        if sid == 0:
+            raise ValueError("cannot retire shard 0 (the parent)")
+        self._retiring.add(sid)
+        try:
+            if sid in self.shard_pids:
+                await self._stop_one(sid)
+        finally:
+            self._retiring.discard(sid)
+        self.retired.add(sid)
+        self.shard_cores.pop(sid, None)
+        self._hb_last.pop(sid, None)
+        self.crashed.pop(sid, None)
+        if self.ctx is not None:
+            ch = self.ctx._channels.pop(sid, None)
+            if ch is not None:
+                await ch.close()
+        logger.info("shard %d retired", sid)
+
     # -- supervision --------------------------------------------------
+    async def _run_hook(self, hook, *args) -> None:
+        """Supervisor hooks are advisory: a throwing hook is logged,
+        never allowed to kill the reap loop."""
+        if hook is None:
+            return
+        try:
+            res = hook(*args)
+            if asyncio.iscoroutine(res):
+                await res
+        except Exception:
+            logger.exception(
+                "shard hook %s failed",
+                getattr(hook, "__qualname__", repr(hook)),
+            )
+
     async def _reap_loop(self) -> None:
+        loop = asyncio.get_event_loop()
+        hb_next = loop.time() + self._hb_interval
         while True:
             await asyncio.sleep(0.1)
+            now = loop.time()
+            if (
+                self._hb_deadline > 0
+                and not self._stopping
+                and now >= hb_next
+            ):
+                hb_next = now + self._hb_interval
+                self._heartbeat(now)
             dead: list[tuple[int, int]] = []
             for sid, pid in list(self.shard_pids.items()):
+                if sid in self._retiring or sid in self._spawning:
+                    continue
                 try:
                     wpid, st = os.waitpid(pid, os.WNOHANG)
                 except ChildProcessError:
@@ -633,24 +967,95 @@ class ShardRuntime:
                 logger.error(
                     "shard %d crashed (wait status %d)", sid, st
                 )
-            if self._restart_limit > self.restarts:
-                self.restarts += 1
-                try:
-                    await self._restart_all()
-                    if self.on_restart is not None:
-                        res = self.on_restart(self)
-                        if asyncio.iscoroutine(res):
-                            await res
-                    continue
-                except Exception:
-                    logger.exception("shard group restart failed")
-            self.failed.set()
-            if self.on_crash is not None:
+            if self._restart_mode == "all":
+                if self._restart_limit > self.restarts:
+                    self.restarts += 1
+                    try:
+                        await self._restart_all()
+                        await self._run_hook(self.on_restart, self)
+                        continue
+                    except Exception:
+                        logger.exception("shard group restart failed")
+                self.failed.set()
                 for sid, st in dead:
-                    res = self.on_crash(sid, st)
-                    if asyncio.iscoroutine(res):
-                        await res
+                    await self._run_hook(self.on_crash, sid, st)
+                # hardened: keep supervising the survivors
+                continue
+            for sid, st in dead:
+                await self._handle_dead_shard(sid, st)
+
+    def _heartbeat(self, now: float) -> None:
+        """Gray-failure detection: waitpid cannot see a SIGSTOP'd (or
+        wedged) child — only a missed ping deadline can. A shard past
+        the deadline is SIGKILLed; the normal waitpid path then drives
+        the per-shard restart."""
+        for sid in list(self.shard_pids):
+            if sid in self._retiring or sid in self._spawning:
+                continue
+            self._hb_last.setdefault(sid, now)
+            if sid not in self._hb_inflight:
+                self._hb_inflight.add(sid)
+                asyncio.ensure_future(self._hb_ping(sid))
+            if now - self._hb_last[sid] > self._hb_deadline:
+                pid = self.shard_pids.get(sid)
+                if pid is None:
+                    continue
+                self.gray_failures[sid] = self.gray_failures.get(sid, 0) + 1
+                logger.error(
+                    "shard %d (pid %d) missed the heartbeat deadline "
+                    "(%.1fs): gray failure, escalating to SIGKILL",
+                    sid, pid, self._hb_deadline,
+                )
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                self._hb_last[sid] = now  # one escalation per deadline
+
+    async def _hb_ping(self, sid: int) -> None:
+        try:
+            await self.ctx.invoke_on(
+                sid, "ssx", "ping", b"hb",
+                timeout=max(self._hb_deadline, 1.0),
+            )
+            self._hb_last[sid] = asyncio.get_event_loop().time()
+        except (InvokeError, RuntimeError, AttributeError):
+            pass
+        finally:
+            self._hb_inflight.discard(sid)
+
+    async def _handle_dead_shard(self, sid: int, st: int) -> None:
+        """Per-shard in-place restart (the default crash response):
+        re-fork ONLY the dead shard; siblings keep serving. The broker
+        seams run around the respawn — on_shard_down marks the shard's
+        groups unavailable, on_shard_up re-adopts from disk."""
+        loop = asyncio.get_event_loop()
+        t0 = loop.time()
+        if self.ctx is not None:
+            ch = self.ctx._channels.pop(sid, None)
+            if ch is not None:
+                await ch.close()
+        await self._run_hook(self.on_shard_down, sid, st)
+        while self._restart_limit > self.restarts:
+            self.restarts += 1
+            self.shard_restarts[sid] = self.shard_restarts.get(sid, 0) + 1
+            try:
+                self._nemesis_act("restart.fork", sid)
+                await self._spawn(sid)
+            except Exception:
+                logger.exception("shard %d in-place restart failed", sid)
+                continue
+            await self._run_hook(self.on_shard_up, sid)
+            self.restart_ms.append((loop.time() - t0) * 1e3)
+            logger.warning(
+                "shard %d restarted in place (pid %d, %d/%d restarts)",
+                sid, self.shard_pids.get(sid, -1),
+                self.restarts, self._restart_limit,
+            )
+            await self._run_hook(self.on_restart, self)
             return
+        self.failed.set()
+        await self._run_hook(self.on_crash, sid, st)
 
     async def _restart_all(self) -> None:
         """Restart policy: tear down the whole shard group and re-fork
@@ -689,8 +1094,57 @@ class ShardRuntime:
             await asyncio.sleep(0.05)
         return True
 
+    async def _wait_child(self, sid: int, timeout: float) -> bool:
+        """Poll ONE child for exit; reap and drop its pid on success."""
+        pid = self.shard_pids.get(sid)
+        if pid is None:
+            return True
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            try:
+                wpid, _ = os.waitpid(pid, os.WNOHANG)
+            except ChildProcessError:
+                wpid = pid
+            if wpid:
+                self.shard_pids.pop(sid, None)
+                return True
+            if asyncio.get_event_loop().time() >= deadline:
+                return False
+            await asyncio.sleep(0.05)
+
+    async def _stop_one(self, sid: int) -> None:
+        """Polite invoke -> SIGTERM -> SIGKILL ladder for ONE shard,
+        each rung bounded by its own deadline, so a wedged child only
+        burns its own budget — it cannot stall its siblings' shutdown
+        (the old ladder shared one global deadline across the group)."""
+        if self.ctx is not None and sid in self.ctx._channels:
+            try:
+                await self.ctx.invoke_on(sid, "ssx", "shutdown", b"", timeout=2.0)
+            except (InvokeError, RuntimeError):
+                pass
+        if await self._wait_child(sid, self._shutdown_timeout):
+            return
+        pid = self.shard_pids.get(sid)
+        if pid is not None:
+            logger.warning("shard %d ignored shutdown; SIGTERM pid %d", sid, pid)
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        if await self._wait_child(sid, 2.0):
+            return
+        pid = self.shard_pids.get(sid)
+        if pid is not None:
+            logger.warning("shard %d ignored SIGTERM; SIGKILL pid %d", sid, pid)
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        await self._wait_child(sid, 2.0)
+
     async def stop(self) -> None:
-        """Clean shutdown: polite invoke, then SIGTERM, then SIGKILL."""
+        """Clean shutdown: the polite -> SIGTERM -> SIGKILL ladder runs
+        per shard with per-shard deadlines, all shards concurrently."""
         if not self._started:
             return
         self._stopping = True
@@ -700,22 +1154,10 @@ class ShardRuntime:
                 await self._reaper
             except (asyncio.CancelledError, Exception):
                 pass
-        if self.ctx is not None:
-            for sid in list(self.ctx._channels):
-                try:
-                    await self.ctx.invoke_on(
-                        sid, "ssx", "shutdown", b"", timeout=2.0
-                    )
-                except InvokeError:
-                    pass
-        if not await self._wait_children(self._shutdown_timeout):
-            for pid in self.shard_pids.values():
-                try:
-                    os.kill(pid, signal.SIGTERM)
-                except ProcessLookupError:
-                    pass
-            if not await self._wait_children(2.0):
-                await self._kill_all()
+        await asyncio.gather(
+            *(self._stop_one(sid) for sid in list(self.shard_pids)),
+            return_exceptions=True,
+        )
         if self.ctx is not None:
             await self.ctx._close_channels()
         self._started = False

@@ -519,3 +519,51 @@ async def _r3b_routes(tmp_path):
 
 def test_r3b_routes(tmp_path):
     asyncio.run(_r3b_routes(tmp_path))
+
+
+async def _shard_lifecycle_routes(tmp_path):
+    """/v1/shards surface over a live sharded broker: fleet liveness +
+    lifecycle accounting, per-shard crash/restart detail, and the
+    grow/retire verbs driving real fork/evacuate cycles."""
+    from redpanda_tpu.ssx.sharded_broker import ShardedBroker
+
+    sb = ShardedBroker(
+        BrokerConfig(
+            node_id=0,
+            data_dir=str(tmp_path / "n0"),
+            members=[0],
+            election_timeout_s=0.3,
+            heartbeat_interval_s=0.05,
+        ),
+        n_shards=2,
+    )
+    await sb.start()
+    assert sb.active, f"unexpected stand-down: {sb.standdown}"
+    addr = sb.broker.admin.address
+    try:
+        st, body = await http(addr, "GET", "/v1/shards")
+        assert st == 200 and body["sharded"] is True
+        assert body["liveness"]["n_shards"] == 2
+        assert "budget" in body["lifecycle"]
+        st, body = await http(addr, "GET", "/v1/shards/1")
+        assert st == 200 and body["alive"] and body["available"]
+        assert body["restarts"] == 0 and not body["retired"]
+        # grow: a third shard forks, meshes in, and turns available
+        st, body = await http(addr, "POST", "/v1/shards/grow")
+        assert st == 200 and body == {"grown": True, "shard": 2}
+        st, body = await http(addr, "GET", "/v1/shards/2")
+        assert st == 200 and body["alive"] and body["available"]
+        # retire it again: evacuate + drain + reap
+        st, body = await http(addr, "POST", "/v1/shards/2/retire")
+        assert st == 200 and body == {"retired": True, "shard": 2}
+        st, body = await http(addr, "GET", "/v1/shards/2")
+        assert st == 200 and body["retired"] and not body["available"]
+        # shard 0 (the parent) is never retirable
+        st, _ = await http(addr, "POST", "/v1/shards/0/retire")
+        assert st == 400
+    finally:
+        await sb.stop()
+
+
+def test_shard_lifecycle_routes(tmp_path):
+    asyncio.run(_shard_lifecycle_routes(tmp_path))

@@ -437,3 +437,50 @@ async def _nemesis_alert_cycle(tmp_path):
 )
 def test_nemesis_alert_fire_profile_clear(tmp_path):
     asyncio.run(_nemesis_alert_cycle(tmp_path))
+
+
+def test_counter_reset_yields_post_restart_delta():
+    """A shard crash + in-place restart zeroes that child's cumulative
+    counters mid-window. Per the Prometheus rate() convention the new
+    cumulative value IS the in-window delta — clamping to zero would
+    report a dead-silent shard until the window slid past the crash."""
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    c = reg.counter("reqs_total")
+    ring = _ring(reg, clk)
+    c.inc(100.0, shard="1")
+    ring.sample()
+    clk.advance(5.0)
+    # the worker dies and is re-forked: counters restart from zero and
+    # the reborn child serves 7 requests before the next scrape
+    c._values.clear()
+    c.inc(7.0, shard="1")
+    ring.sample()
+
+    w = ring.counter_window("redpanda_tpu_reqs_total", 5.0)
+    assert w is not None and len(w["series"]) == 1
+    assert w["series"][0]["delta"] == pytest.approx(7.0)
+    assert w["total_rate"] == pytest.approx(7.0 / 5.0)
+
+
+def test_histogram_diff_counter_reset():
+    """Same reset convention for windowed histogram diffs: when the
+    new cumulative count is below the old one, the new counts are the
+    in-window observations (bucket-wise clamping would erase every
+    post-restart sample)."""
+    from redpanda_tpu.metrics import _NBUCKETS
+
+    def snap(n):
+        h = HistogramChild()
+        for _ in range(n):
+            h.observe(0.010)
+        return (tuple(h._buckets), h._overflow, h._sum, h._count)
+
+    old, new = snap(100), snap(7)  # reborn child: 7 post-restart obs
+    d = _fd._diff_child(new, old)
+    assert d._count == 7
+    assert sum(d._buckets) == 7
+    assert d._sum == pytest.approx(7 * 0.010)
+    # and the no-reset path still diffs
+    d2 = _fd._diff_child(snap(100), snap(40))
+    assert d2._count == 60

@@ -552,3 +552,94 @@ def test_skew_index():
     one = Rebalancer(broker=None, mover=None,
                      table=PlacementTable(shard_count=1))
     assert one.skew() == 1.0  # single shard can't skew
+
+
+# -- elastic lifecycle: table + scale signal --------------------------
+def test_table_lifecycle_activate_deactivate():
+    t = PlacementTable(shard_count=2)
+    assert t.active_shards() == [0, 1]
+    e0 = t.epoch
+    # shard 0 is the parent: never retirable
+    with pytest.raises(ValueError):
+        t.deactivate(0)
+    t.deactivate(1)
+    assert t.active_shards() == [0]
+    assert not t.is_available(1)
+    assert t.epoch > e0
+    # NEW placements route over the active set only
+    for i in range(8):
+        assert t.assign(kafka_ntp("t", i), 100 + i, [0], 0) == 0
+    # activating a sid past shard_count grows the universe
+    t.activate(3)
+    assert t.shard_count == 4
+    assert t.active_shards() == [0, 2, 3]
+    assert t.is_available(3)
+    d = t.describe()
+    assert d["retired"] == [1] and d["unavailable"] == []
+
+
+def test_table_unavailable_window_is_reversible():
+    t = PlacementTable(shard_count=2)
+    e0 = t.epoch
+    t.set_unavailable(1, True)
+    assert not t.is_available(1)
+    assert t.active_shards() == [0, 1]  # still active, just down
+    t.set_unavailable(1, False)
+    assert t.is_available(1)
+    assert t.epoch > e0
+    d = t.describe()
+    assert d["unavailable"] == []
+
+
+def test_rebalancer_elastic_scale_signal():
+    """Grow-on-hot / retire-on-idle: sustained all-hot EWMA forks a
+    shard, a sustained idle worker (of several) is retired — one
+    action per trigger, counters reset so a single spike can't
+    double-fire."""
+
+    class FakeLifecycle:
+        auto = True
+
+        def __init__(self):
+            self.grown = 0
+            self.retired = []
+
+        async def grow(self):
+            self.grown += 1
+            return 2
+
+        async def retire(self, sid):
+            self.retired.append(sid)
+
+    class FakeRouter:
+        def worker_shards(self):
+            return [1, 2]
+
+    class FakeBroker:
+        shard_router = FakeRouter()
+
+    async def main():
+        t = PlacementTable(shard_count=3)
+        reb = Rebalancer(broker=FakeBroker(), mover=None, table=t)
+        lc = FakeLifecycle()
+        reb.lifecycle = lc
+        reb.grow_bps, reb.idle_bps, reb.scale_ticks = 100.0, 1.0, 3
+        # both workers hot for scale_ticks consecutive samples -> grow
+        for _ in range(3):
+            reb._rate = {1: 500.0, 2: 500.0}
+            act = await reb.maybe_scale()
+        assert lc.grown == 1
+        assert act["action"] == "grow" and act["shard"] == 2
+        assert reb._hot_ticks == 0  # reset: no double-fire
+        # one worker idle that long -> retire exactly it
+        for _ in range(3):
+            reb._rate = {1: 500.0, 2: 0.5}
+            act = await reb.maybe_scale()
+        assert lc.retired == [2]
+        assert act["action"] == "retire" and act["shard"] == 2
+        # inert when auto is off
+        lc.auto = False
+        reb._rate = {1: 500.0, 2: 0.5}
+        assert await reb.maybe_scale() is None
+
+    asyncio.run(main())

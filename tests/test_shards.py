@@ -76,21 +76,18 @@ def test_shard_of_degenerate_inputs_pin_to_shard0():
     assert compute_shard(-3, 4) == 0
 
 
-def test_shard_of_legacy_name_warns_and_still_computes():
-    """The v1 `shard_of` re-export is a deprecation shim now: every
-    use warns, routes to compute_shard, and rplint RPL017 forbids new
-    call sites."""
-    import warnings
+def test_shard_of_legacy_name_is_gone():
+    """The v1 `shard_of` deprecation shim was retired (PR 17): the
+    name no longer resolves anywhere in ssx; placement.table is the
+    single authority (rplint RPL017 holds the line)."""
+    import pytest
 
     from redpanda_tpu import ssx
     from redpanda_tpu.ssx import shards as ssx_shards
 
     for mod in (ssx, ssx_shards):
-        with warnings.catch_warnings(record=True) as caught:
-            warnings.simplefilter("always")
-            fn = mod.shard_of
-        assert [w for w in caught if w.category is DeprecationWarning]
-        assert fn(7331, 4) == compute_shard(7331, 4)
+        with pytest.raises(AttributeError):
+            mod.shard_of
 
 
 # ------------------------------------------------- invoke_on round-trip
@@ -102,6 +99,10 @@ async def _echo_child(ctx):
             return b"%d" % ctx.shard_id
         if method == "boom":
             raise ValueError("boom")
+        if method == "peer":
+            # cross-worker hop: no direct channel to a SPAWNED shard,
+            # so this exercises the relay-via-shard-0 fabric leg
+            return await ctx.invoke_on(int(payload), "echo", "whoami")
         return payload
 
     ctx.register("echo", echo)
@@ -184,7 +185,110 @@ def test_shard_crash_restart_policy_refills_the_group():
     run(main())
 
 
-def test_sharded_broker_shuts_down_cleanly_after_shard_crash(tmp_path):
+# ------------------------------------------------- elastic lifecycle
+def test_spawn_shard_meshes_in_and_relays_peer_invokes():
+    async def main():
+        rt = ShardRuntime(2, _echo_child)
+        await rt.start()
+        try:
+            sid = await rt.spawn_shard()
+            assert sid == 2
+            assert rt.n_shards == 3
+            # parent reaches the spawned shard directly
+            assert await rt.invoke_on(sid, "echo", "whoami") == b"2"
+            # worker 1 has NO pre-fork channel to shard 2: the hop
+            # relays through shard 0 transparently
+            assert await rt.invoke_on(1, "echo", "peer", b"2") == b"2"
+            # and the spawned shard can answer back toward worker 1
+            assert await rt.invoke_on(sid, "echo", "peer", b"1") == b"1"
+            # retire: polite ladder, pid reaped, no orphan
+            pid = rt.shard_pids[sid]
+            await rt.retire_shard(sid)
+            assert sid not in rt.shard_pids
+            assert sid in rt.retired
+            with pytest.raises(ProcessLookupError):
+                os.kill(pid, 0)
+            # the original worker is untouched
+            assert await rt.invoke_on(1, "echo", "whoami") == b"1"
+        finally:
+            await rt.stop()
+
+    run(main())
+
+
+def test_on_crash_hook_exception_keeps_supervising():
+    """Satellite: a throwing sync on_crash hook must not kill the reap
+    loop — later crashes are still detected."""
+
+    async def main():
+        rt = ShardRuntime(3, _echo_child)  # restart_limit=0: no budget
+        seen = []
+
+        def bad_hook(sid, st):
+            seen.append(sid)
+            raise RuntimeError("hook bug")
+
+        rt.on_crash = bad_hook
+        await rt.start()
+        try:
+            os.kill(rt.shard_pids[1], signal.SIGKILL)
+            await asyncio.wait_for(rt.failed.wait(), 5.0)
+            assert seen == [1]
+            # the reap loop survived the throwing hook: a second crash
+            # is still detected and the hook fires again
+            os.kill(rt.shard_pids[2], signal.SIGKILL)
+            deadline = asyncio.get_event_loop().time() + 5.0
+            while 2 not in rt.crashed:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise TimeoutError("second crash never detected")
+                await asyncio.sleep(0.05)
+            assert seen == [1, 2]
+        finally:
+            await rt.stop()
+
+    run(main())
+
+
+def test_gray_failure_detected_via_heartbeat_deadline():
+    """A SIGSTOP'd shard is alive by waitpid but unresponsive: only
+    the heartbeat deadline can see it. The supervisor escalates to
+    SIGKILL and restarts in place."""
+
+    async def main():
+        rt = ShardRuntime(
+            2,
+            _echo_child,
+            restart_limit=2,
+            heartbeat_interval=0.1,
+            heartbeat_deadline=0.8,
+        )
+        await rt.start()
+        try:
+            pid = rt.shard_pids[1]
+            os.kill(pid, signal.SIGSTOP)
+            deadline = asyncio.get_event_loop().time() + 15.0
+            while rt.gray_failures.get(1, 0) == 0 or 1 not in rt.shard_pids \
+                    or rt.shard_pids.get(1) == pid:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise TimeoutError(
+                        f"gray failure never handled: {rt.gray_failures}"
+                    )
+                await asyncio.sleep(0.1)
+            assert rt.gray_failures[1] >= 1
+            assert rt.shard_restarts.get(1, 0) >= 1
+            assert await rt.invoke_on(1, "echo", "whoami", timeout=5.0) == b"1"
+        finally:
+            await rt.stop()
+
+    run(main())
+
+
+def test_sharded_broker_restarts_crashed_shard_in_place(tmp_path):
+    """Per-shard in-place restart is the DEFAULT crash response now:
+    kill the worker, the supervisor re-forks only that shard, the new
+    child re-adopts its groups from disk, and every record acked
+    before the crash is still fetchable after it. The broker never
+    flags failure."""
     from redpanda_tpu.app import BrokerConfig
     from redpanda_tpu.kafka.client import KafkaClient
     from redpanda_tpu.ssx.sharded_broker import ShardedBroker
@@ -216,18 +320,66 @@ def test_sharded_broker_shuts_down_cleanly_after_shard_crash(tmp_path):
                         f"{sb.broker.shard_table.counts()}"
                     )
                 await asyncio.sleep(0.1)
+            acked = {}
             for p in range(4):
-                await _retry(
+                acked[p] = await _retry(
                     lambda p=p: c.produce("t", p, [(b"k", b"v%d" % p)])
                 )
             stats = await sb.shard_stats()
             assert stats and stats[0].partitions > 0
             assert stats[0].produce_reqs > 0
+            # kill the worker shard: in-place restart, NOT broker death
+            first_pid = sb.runtime.shard_pids[1]
+            os.kill(first_pid, signal.SIGKILL)
+            deadline = asyncio.get_event_loop().time() + 15.0
+            while (
+                sb.runtime.shard_restarts.get(1, 0) == 0
+                or not sb.broker.shard_table.is_available(1)
+            ):
+                if asyncio.get_event_loop().time() > deadline:
+                    raise TimeoutError("shard 1 never restarted in place")
+                await asyncio.sleep(0.1)
+            assert not sb.failed.is_set()
+            assert sb.runtime.shard_pids[1] != first_pid
+            # zero lost acked records: everything acked pre-crash is
+            # fetchable from the re-adopted on-disk state
+            for p, off in acked.items():
+                rows = await _retry(lambda p=p, off=off: c.fetch("t", p, off))
+                assert rows, f"acked record on partition {p} lost"
+            # and the reborn shard serves NEW produce
+            for p in range(4):
+                await _retry(
+                    lambda p=p: c.produce("t", p, [(b"k", b"post%d" % p)])
+                )
         finally:
             await c.close()
-        # kill the worker shard: supervisor flags failure, and the
-        # broker still tears down cleanly (the ISSUE's "stand down
-        # cleanly" contract)
+        await sb.stop()
+
+    run(main())
+
+
+def test_sharded_broker_flags_failure_when_restart_budget_exhausted(
+    tmp_path, monkeypatch
+):
+    """RP_SHARD_RESTARTS=0: the old contract — a dead shard with no
+    restart budget flags broker failure, and teardown stays clean."""
+    from redpanda_tpu.app import BrokerConfig
+    from redpanda_tpu.ssx.sharded_broker import ShardedBroker
+
+    monkeypatch.setenv("RP_SHARD_RESTARTS", "0")
+
+    async def main():
+        cfg = BrokerConfig(
+            node_id=0,
+            data_dir=str(tmp_path / "n0"),
+            members=[0],
+            election_timeout_s=0.3,
+            heartbeat_interval_s=0.05,
+            enable_admin=False,
+        )
+        sb = ShardedBroker(cfg, n_shards=2)
+        await sb.start()
+        assert sb.active, f"unexpected stand-down: {sb.standdown}"
         os.kill(sb.runtime.shard_pids[1], signal.SIGKILL)
         await asyncio.wait_for(sb.failed.wait(), 10.0)
         await sb.stop()

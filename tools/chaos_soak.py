@@ -7,8 +7,16 @@ inside long-running availability suites). Each iteration boots a fresh
 for a few seconds, validates every acked record, and moves on. Any
 failure prints the SEED so the run reproduces exactly.
 
+`--proc-faults` switches the iteration body to the process-fault
+plane: a single sharded broker under a seeded ProcNemesis schedule —
+SIGKILLs at produce/restart/grow/retire boundaries, slow starts, and
+direct worker kills — interleaved with elastic grow/retire ops. The
+iteration fails on any lost acked record, orphaned child process, or
+inconsistent placement table.
+
 Usage:
     python tools/chaos_soak.py --minutes 30 [--tiered] [--duration 4]
+    python tools/chaos_soak.py --proc-faults --iterations 25
 """
 
 import argparse
@@ -30,9 +38,161 @@ sys.path.insert(
 )
 
 
+async def run_proc_chaos(d: Path, seed: int, duration_s: float) -> dict:
+    """One process-fault iteration: boot a 2-shard broker, arm a
+    seed-derived ProcSchedule, hammer produce while growing/retiring
+    shards and killing workers, then validate the three invariants —
+    zero lost acked records, zero orphans, consistent table."""
+    from redpanda_tpu.app import BrokerConfig
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.ssx import ProcRule, ProcSchedule
+    from redpanda_tpu.ssx.sharded_broker import ShardedBroker
+
+    rng = random.Random(seed)
+    rules = [
+        ProcRule(event="produce", action="kill",
+                 nth=rng.randrange(4, 10), count=rng.randrange(1, 3)),
+    ]
+    if rng.random() < 0.5:
+        rules.append(ProcRule(event="restart.readopt", action="kill"))
+    if rng.random() < 0.5:
+        rules.append(ProcRule(event="grow.ready", action="kill"))
+    if rng.random() < 0.5:
+        rules.append(ProcRule(event="retire.evacuate", action="kill"))
+    if rng.random() < 0.4:
+        rules.append(ProcRule(event="spawn.fork", action="slow_start",
+                              delay_s=0.1, count=2))
+    sched = ProcSchedule(rules=rules, seed=seed)
+
+    cfg = BrokerConfig(
+        node_id=0,
+        data_dir=str(d / "n0"),
+        members=[0],
+        election_timeout_s=0.3,
+        heartbeat_interval_s=0.05,
+        enable_admin=False,
+    )
+    sb = ShardedBroker(cfg, n_shards=2)
+    await sb.start()
+    stats = {"acked": 0, "grows": 0, "retires": 0}
+    acked: dict[int, list[int]] = {}
+    try:
+        assert sb.active, f"stand-down: {sb.standdown}"
+        rt, lc = sb.runtime, sb.lifecycle
+        table = sb.broker.shard_table
+        c = KafkaClient([("127.0.0.1", sb.kafka_port)])
+        try:
+            deadline = time.monotonic() + 20.0
+
+            async def retry(fn):
+                while True:
+                    try:
+                        return await fn()
+                    except Exception:
+                        if time.monotonic() > deadline:
+                            raise
+                        await asyncio.sleep(0.2)
+
+            await retry(lambda: c.create_topic(
+                "pf", partitions=4, replication_factor=1
+            ))
+            rt.nemesis = sched
+            grown: list[int] = []
+            t_end = time.monotonic() + duration_s
+            i = 0
+            while time.monotonic() < t_end:
+                i += 1
+                p = rng.randrange(4)
+                deadline = time.monotonic() + 25.0
+                off = await asyncio.wait_for(
+                    retry(lambda: c.produce(
+                        "pf", p, [(b"k", b"v%d" % i)]
+                    )),
+                    40.0,
+                )
+                acked.setdefault(p, []).append(off)
+                stats["acked"] += 1
+                roll = rng.random()
+                if roll < 0.10:
+                    try:
+                        grown.append(await lc.grow())
+                        stats["grows"] += 1
+                    except Exception:
+                        pass  # injected abort or budget: rollback owns it
+                elif roll < 0.20 and grown:
+                    sid = grown.pop()
+                    try:
+                        await lc.retire(sid)
+                        stats["retires"] += 1
+                    except Exception:
+                        if sid in rt.shard_pids:
+                            grown.append(sid)  # rolled back to active
+                elif roll < 0.26 and rt.shard_pids:
+                    victim = rng.choice(sorted(rt.shard_pids))
+                    try:
+                        os.kill(rt.shard_pids[victim], 9)
+                    except (KeyError, ProcessLookupError):
+                        pass
+            rt.nemesis = None
+            # settle: every mapped shard live + available again
+            deadline = time.monotonic() + 30.0
+            while True:
+                if rt.failed.is_set():
+                    raise AssertionError(
+                        "restart budget exhausted mid-soak "
+                        f"(crashed={rt.crashed})"
+                    )
+                mapped = set(table._ntp.values())
+                if all(
+                    (s == 0 or s in rt.shard_pids) and table.is_available(s)
+                    for s in mapped
+                ):
+                    break
+                if time.monotonic() > deadline:
+                    raise AssertionError(
+                        f"shards never settled: {table.describe()}"
+                    )
+                await asyncio.sleep(0.1)
+            # invariant 1: zero lost acked records
+            for p, offs in acked.items():
+                for off in offs:
+                    deadline = time.monotonic() + 30.0
+                    rows = await retry(lambda p=p, off=off: c.fetch(
+                        "pf", p, off
+                    ))
+                    assert rows, f"acked record lost: pf/{p}@{off}"
+            # invariant 2: zero orphans (every tracked pid alive)
+            for pid in rt.shard_pids.values():
+                os.kill(pid, 0)
+            # invariant 3: consistent table (no group on a dead or
+            # retired shard)
+            live = {0} | set(rt.shard_pids)
+            for ntp, s in table._ntp.items():
+                assert s in live, f"{ntp} on dead shard {s}"
+                assert table.is_available(s), f"{ntp} on unavailable {s}"
+        finally:
+            await c.close()
+        stats["faults"] = len(sched.trace)
+        stats["restarts"] = sum(rt.shard_restarts.values())
+        stats["gray"] = sum(rt.gray_failures.values())
+    finally:
+        pids = list(sb.runtime.shard_pids.values())
+        await sb.stop()
+        for pid in pids:  # post-stop: every child reaped
+            try:
+                os.kill(pid, 0)
+                raise AssertionError(f"orphan pid {pid} after stop")
+            except ProcessLookupError:
+                pass
+    return stats
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=30.0)
+    ap.add_argument("--iterations", type=int, default=None,
+                    help="run exactly N iterations instead of a "
+                    "wall-clock budget")
     ap.add_argument("--duration", type=float, default=4.0,
                     help="fault window per iteration (s)")
     ap.add_argument("--tiered", action="store_true")
@@ -40,11 +200,23 @@ def main() -> int:
                     help="arm the ObjectNemesis mixed fault schedule "
                     "(partial/torn/slow/error/throttle) on the tiered "
                     "object store; implies --tiered")
+    ap.add_argument("--proc-faults", action="store_true",
+                    help="soak the process-fault plane: seeded "
+                    "ProcNemesis kills/pauses over a sharded broker "
+                    "with elastic grow/retire, instead of the 3-broker "
+                    "cluster chaos")
     ap.add_argument("--seed", type=int, default=None,
                     help="reproduce one failing iteration and exit")
     args = ap.parse_args()
     if args.store_faults:
         args.tiered = True
+    if args.proc_faults:
+        # grow/retire ops per iteration exceed the production default,
+        # and the soak's kill volume would exhaust the default global
+        # restart budget (8) by design — the soak grades the recovery
+        # path, not the budget policy, so give it headroom
+        os.environ.setdefault("RP_LIFECYCLE_OPS", "64")
+        os.environ.setdefault("RP_SHARD_RESTARTS", "1000")
 
     from chaos_harness import run_chaos
     from redpanda_tpu.utils import compileguard, rpsan
@@ -60,6 +232,11 @@ def main() -> int:
     shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
 
     def one(seed: int) -> dict:
+        if args.proc_faults:
+            with tempfile.TemporaryDirectory(prefix="soak_", dir=shm) as d:
+                return asyncio.run(
+                    run_proc_chaos(Path(d), seed, args.duration)
+                )
         store_faults = None
         if args.store_faults:
             from dataclasses import replace
@@ -117,7 +294,11 @@ def main() -> int:
     deadline = time.monotonic() + args.minutes * 60.0
     rng = random.Random()
     n = fails = 0
-    while time.monotonic() < deadline:
+    while (
+        n < args.iterations
+        if args.iterations is not None
+        else time.monotonic() < deadline
+    ):
         seed = rng.randrange(1 << 31)
         n += 1
         t0 = time.monotonic()
@@ -125,16 +306,24 @@ def main() -> int:
             stats = one(seed)
             if n == 1:
                 compileguard.steady()
-            store = ""
-            if "store_faults" in stats:
-                store = (
-                    f"store={sum(stats['store_faults'].values())}"
-                    f"/{stats['store_ops']} "
+            if args.proc_faults:
+                extra = (
+                    f"faults={stats['faults']} "
+                    f"restarts={stats['restarts']} "
+                    f"grow/retire={stats['grows']}/{stats['retires']} "
                 )
+            else:
+                extra = (
+                    f"admin={sum(stats.get('admin_ops', {}).values())} "
+                )
+                if "store_faults" in stats:
+                    extra += (
+                        f"store={sum(stats['store_faults'].values())}"
+                        f"/{stats['store_ops']} "
+                    )
             print(
                 f"[{n:>4}] seed={seed:<12} ok  acked={stats['acked']:<5} "
-                f"admin={sum(stats.get('admin_ops', {}).values())} "
-                f"{store}({time.monotonic()-t0:.1f}s)",
+                f"{extra}({time.monotonic()-t0:.1f}s)",
                 flush=True,
             )
         except Exception:

@@ -1,7 +1,10 @@
 """verify.sh mp smoke: boot a 2-shard ShardedBroker (real forked
 worker, SO_REUSEPORT listener), run one produce/fetch round across a
 partition spread that crosses the invoke_on seam, check the work
-actually landed on the worker shard, and shut down cleanly.
+actually landed on the worker shard, then exercise the elastic
+lifecycle: grow a third shard, produce through it, SIGKILL a grow
+mid-handshake (rollback, zero orphans), retire the grown shard, and
+shut down cleanly.
 
 Exit 0 = the shard runtime forks, serves, and stands down on this
 machine. Kept deliberately small (~seconds) — the full matrix lives in
@@ -71,6 +74,55 @@ async def main() -> None:
             assert stats[0].produce_reqs > 0, (
                 "no produce crossed the invoke_on seam"
             )
+
+            # -- elastic lifecycle legs ------------------------------
+            from redpanda_tpu.ssx import ProcRule, ProcSchedule
+
+            lc = sb.lifecycle
+            rt = sb.runtime
+            # grow: fork shard 2, mesh + activate, then produce through
+            # the grown topology
+            sid = await lc.grow()
+            assert sid == 2 and sid in rt.shard_pids, (sid, rt.shard_pids)
+            assert sb.broker.shard_table.is_available(sid)
+            for p in range(N_PARTITIONS):
+                await c.produce("smoke", p, [(b"k", b"grown%d" % p)])
+            # SIGKILL mid-grow (injected at the grow.ready boundary):
+            # the provisional shard 3 must roll back — no orphan pid,
+            # no table residue
+            rt.nemesis = ProcSchedule(
+                rules=[ProcRule(event="grow.ready", action="kill")], seed=1
+            )
+            before = set(rt.shard_pids)
+            try:
+                await lc.grow()
+                raise AssertionError("killed grow reported success")
+            except AssertionError:
+                raise
+            except Exception:
+                pass  # rollback path
+            rt.nemesis = None
+            assert set(rt.shard_pids) == before, (
+                f"orphan after aborted grow: {rt.shard_pids} vs {before}"
+            )
+            assert 3 not in sb.broker.shard_table.active_shards()
+            # retire shard 2: freeze -> evacuate -> drain -> reap, then
+            # the evacuated groups still serve
+            pid2 = rt.shard_pids[sid]
+            await lc.retire(sid)
+            assert sid not in rt.shard_pids
+            try:
+                os.kill(pid2, 0)
+                raise AssertionError(f"retired shard pid {pid2} survives")
+            except ProcessLookupError:
+                pass
+            for p in range(N_PARTITIONS):
+                rows = await c.fetch("smoke", p, 0)
+                assert rows, f"partition {p} lost after retire"
+                await c.produce("smoke", p, [(b"k", b"post%d" % p)])
+            desc = lc.describe()
+            assert desc["grows"] >= 1 and desc["retires"] >= 1, desc
+            assert desc["rolled_back"] >= 1, desc
         finally:
             await c.close()
     finally:

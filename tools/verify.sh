@@ -40,8 +40,12 @@ env JAX_PLATFORMS=cpu RP_NATIVE=0 python -m pytest \
     -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
-echo "== shard mp smoke (2-shard broker, fork + invoke_on seam) =="
+echo "== shard mp smoke (fork + invoke_on seam, grow -> kill-mid-grow rollback -> retire) =="
 env JAX_PLATFORMS=cpu python tools/shard_smoke.py
+
+echo "== proc-fault soak smoke (seeded ProcNemesis, 3 iterations) =="
+env JAX_PLATFORMS=cpu python tools/chaos_soak.py --proc-faults \
+    --iterations 3 --duration 2
 
 echo "== placement smoke (live move mid-produce, fetch parity, merged /metrics) =="
 env JAX_PLATFORMS=cpu python tools/placement_smoke.py
