@@ -2254,6 +2254,641 @@ def bench_slo(profile: str = "default") -> dict:
     return asyncio.run(_slo_async(_load_slo_profile(profile)))
 
 
+# ------------------------- traffic simulator (bench --only traffic)
+#
+# The million-client front-end gate: does the broker HOLD 10k+ open
+# connections while serving a mixed, skewed, churning workload inside
+# the SLO? The broker runs in a CHILD process (each process has its
+# own 20k fd budget and the client side alone needs ~10k sockets);
+# the parent is the traffic generator, speaking raw kafka wire over
+# pre-encoded corr-patched frame templates so 10k clients cost no
+# per-request encode work.
+
+_TRAFFIC_CORR_SENT = 0x7EADBEEF
+_TRAFFIC_SID_SENT = 0x7EAD5E55
+_TRAFFIC_EPOCH_SENT = 0x7EAD0E0C
+
+
+async def _traffic_broker_child_async(tmp: str) -> None:
+    """Child entry (`bench.py --traffic-broker DIR`): boot ONE broker
+    with the admin server on, create + warm the `traffic` topic, print
+    `READY <kafka_port> <admin_port>`, then serve until the parent
+    closes stdin."""
+    from redpanda_tpu.app import Broker, BrokerConfig
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.models.record import RecordBatchBuilder
+    from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+    cfg = json.loads(sys.stdin.readline())
+    n_partitions = int(cfg["partitions"])
+    b = Broker(
+        BrokerConfig(
+            node_id=0,
+            data_dir=os.path.join(tmp, "n0"),
+            members=[0],
+            housekeeping_interval_s=0,
+        ),
+        loopback=LoopbackNetwork(),
+    )
+    await b.start()
+    b.config.peer_kafka_addresses = {0: b.kafka_advertised}
+    await b.wait_controller_leader()
+    boot = KafkaClient([b.kafka_advertised])
+    await boot.create_topic(
+        "traffic", partitions=n_partitions, replication_factor=1
+    )
+    builder = RecordBatchBuilder()
+    builder.add(b"warm", key=b"k")
+    wire = builder.build().to_kafka_wire()
+    deadline = time.monotonic() + 120.0
+    pid = 0
+    while pid < n_partitions:  # every partition fetchable before READY
+        try:
+            await boot.produce_wire("traffic", pid, wire, acks=1)
+            pid += 1
+        except Exception:
+            if time.monotonic() > deadline:
+                raise
+            await asyncio.sleep(0.25)
+    await boot.close()
+    print(f"READY {b.kafka_advertised[1]} {b.admin.port}", flush=True)
+    loop = asyncio.get_event_loop()
+    await loop.run_in_executor(None, sys.stdin.read)  # parent EOF
+    await b.stop()
+
+
+def _traffic_framing_ab(reps: int = 800, trials: int = 5) -> dict:
+    """Native rp_frame_scan vs the pure-Python twin on the same
+    64-frame buffer: the per-scan cost the read loop actually pays.
+    Toggled via RP_NATIVE_FRAME (checked per scan), so one process
+    measures both legs — interleaved, min-of-N, because the bench
+    shares its core with everything else."""
+    import struct
+
+    from redpanda_tpu.kafka.framing import FrameScanner
+    from redpanda_tpu.utils import native as _native
+
+    payload = struct.pack(">hhi", 0, 7, 1) + b"x" * 120
+    stream = (struct.pack(">i", len(payload)) + payload) * 64
+
+    def leg(n: int = reps) -> float:
+        sc = FrameScanner(1 << 20)
+        got = 0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            sc.feed(stream)
+            got += len(sc.scan())
+        el = time.perf_counter() - t0
+        assert got == 64 * n
+        return el / n * 1e6
+
+    out: dict = {"frames_per_scan": 64}
+    prev = os.environ.get("RP_NATIVE_FRAME")
+    try:
+        os.environ.pop("RP_NATIVE_FRAME", None)
+        native_ok = _native.frame_scan_ready()
+        nats, pys = [], []
+        for _ in range(trials):
+            if native_ok:
+                nats.append(leg())
+            os.environ["RP_NATIVE_FRAME"] = "0"
+            pys.append(leg())
+            os.environ.pop("RP_NATIVE_FRAME", None)
+        out["native_us_per_scan"] = (
+            round(min(nats), 2) if native_ok else -1.0
+        )
+        out["python_us_per_scan"] = round(min(pys), 2)
+    finally:
+        if prev is None:
+            os.environ.pop("RP_NATIVE_FRAME", None)
+        else:
+            os.environ["RP_NATIVE_FRAME"] = prev
+    if native_ok and out["native_us_per_scan"] > 0:
+        out["python_vs_native_x"] = round(
+            out["python_us_per_scan"] / out["native_us_per_scan"], 2
+        )
+    return out
+
+
+async def _traffic_async(prof: dict) -> dict:
+    """SLO-graded traffic simulation against a broker subprocess:
+    open `clients` raw connections (batched under the listen backlog),
+    pre-encode PRODUCE v7 / incremental FETCH v11 / METADATA v1 frame
+    templates, then pace the interleaved rate segments with zipf-
+    skewed client and partition picks, an abort-and-reconnect churn
+    storm between rounds, and a final admin /metrics scrape proving
+    the broker-side connection count."""
+    import struct
+    import subprocess
+    import urllib.request
+
+    from redpanda_tpu.kafka.protocol import FETCH, METADATA, PRODUCE, Msg
+    from redpanda_tpu.kafka.protocol.headers import (
+        RequestHeader,
+        encode_request_header,
+    )
+    from redpanda_tpu.kafka.protocol import produce_fast
+    from redpanda_tpu.models.record import RecordBatchBuilder
+
+    n_clients = int(prof.get("clients", 10000))
+    n_fetchers = min(int(prof.get("fetchers", 600)), n_clients // 2)
+    n_partitions = int(prof.get("partitions", 32))
+    acks = int(prof.get("acks", 1))
+    batch_records = int(prof.get("batch_records", 16))
+    record_bytes = int(prof.get("record_bytes", 256))
+    rates = [float(r) for r in prof.get("rates_per_s") or []]
+    if not rates:
+        raise SystemExit("traffic: profile declares no rates_per_s")
+    rounds = int(prof.get("rounds", 2))
+    round_s = float(prof.get("round_s", 2.0))
+    churn_n = int(prof.get("churn_per_round", 400))
+    zipf_s = float(prof.get("zipf_s", 1.2))
+    mix = prof.get("mix") or {"produce": 0.65, "fetch": 0.25, "admin": 0.1}
+    w_prod = float(mix.get("produce", 0.65))
+    w_fetch = float(mix.get("fetch", 0.25))
+    min_ratio = float(prof.get("min_rate_ratio", 0.9))
+    slo = prof.get("slo", {})
+    slo_p99 = float(slo.get("p99_ms", 100.0))
+    slo_p999 = float(slo.get("p999_ms", 4 * slo_p99))
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = tempfile.mkdtemp(prefix="rp_bench_traffic_", dir=shm)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--traffic-broker", tmp],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    loop = asyncio.get_event_loop()
+    conns: list = []
+    try:
+        proc.stdin.write(json.dumps({"partitions": n_partitions}) + "\n")
+        proc.stdin.flush()
+        while True:  # skip any startup chatter until the READY line
+            line = await loop.run_in_executor(None, proc.stdout.readline)
+            if not line:
+                raise RuntimeError("traffic broker child died before READY")
+            if line.startswith("READY "):
+                _, kafka_port, admin_port = line.split()
+                kafka_port, admin_port = int(kafka_port), int(admin_port)
+                break
+
+        # -- frame templates (corr patched in place at write time) --
+        def mk_frame(api, version: int, body: bytes) -> bytearray:
+            head = encode_request_header(
+                RequestHeader(api.key, version, _TRAFFIC_CORR_SENT, None)
+            )
+            return bytearray(
+                struct.pack(">i", len(head) + len(body)) + head + body
+            )
+
+        corr_off = bytes(
+            mk_frame(METADATA, 1, b"")
+        ).index(struct.pack(">i", _TRAFFIC_CORR_SENT))
+
+        payload = os.urandom(max(16, record_bytes - 16))
+        builder = RecordBatchBuilder()
+        for i in range(batch_records):
+            builder.add(payload, key=b"k%06d" % i)
+        wire = builder.build().to_kafka_wire()
+        produce_frames = []
+        for pid in range(n_partitions):
+            body = produce_fast.encode_request_single(
+                7, False, None, acks, 10000, "traffic", pid, wire
+            )
+            produce_frames.append(mk_frame(PRODUCE, 7, body))
+
+        meta_frame = mk_frame(
+            METADATA, 1, METADATA.encode_request(Msg(topics=None), 1)
+        )
+
+        def fetch_req(pid: int, session_id: int, epoch: int) -> Msg:
+            return Msg(
+                replica_id=-1,
+                max_wait_ms=0,
+                min_bytes=0,
+                max_bytes=1 << 20,
+                isolation_level=0,
+                session_id=session_id,
+                session_epoch=epoch,
+                topics=[]
+                if pid < 0
+                else [
+                    Msg(
+                        topic="traffic",
+                        partitions=[
+                            Msg(
+                                partition=pid,
+                                current_leader_epoch=-1,
+                                fetch_offset=0,
+                                log_start_offset=-1,
+                                partition_max_bytes=1 << 20,
+                            )
+                        ],
+                    )
+                ],
+                forgotten_topics_data=[],
+                rack_id="",
+            )
+
+        incr_base = mk_frame(
+            FETCH,
+            11,
+            FETCH.encode_request(
+                fetch_req(-1, _TRAFFIC_SID_SENT, _TRAFFIC_EPOCH_SENT), 11
+            ),
+        )
+        sid_off = bytes(incr_base).index(
+            struct.pack(">i", _TRAFFIC_SID_SENT)
+        )
+        epoch_off = bytes(incr_base).index(
+            struct.pack(">i", _TRAFFIC_EPOCH_SENT)
+        )
+
+        # -- the client fleet ---------------------------------------
+        class _Conn:
+            __slots__ = ("r", "w", "busy", "frame", "epoch")
+
+        async def _open() -> tuple:
+            last: Exception | None = None
+            for attempt in range(10):
+                try:
+                    return await asyncio.open_connection(
+                        "127.0.0.1", kafka_port
+                    )
+                except OSError as e:  # listen backlog overflow under storm
+                    last = e
+                    await asyncio.sleep(0.05 * (attempt + 1))
+            raise RuntimeError(f"traffic: connect retries exhausted: {last}")
+
+        async def _open_many(n: int) -> list:
+            out = []
+            while len(out) < n:  # stay under the ~100 listen backlog
+                k = min(100, n - len(out))
+                for r, w in await asyncio.gather(
+                    *(_open() for _ in range(k))
+                ):
+                    c = _Conn()
+                    c.r, c.w, c.busy, c.frame, c.epoch = r, w, False, None, 0
+                    out.append(c)
+            return out
+
+        t_conn0 = time.perf_counter()
+        producers = await _open_many(n_clients - n_fetchers)
+        fetchers = await _open_many(n_fetchers)
+        conns.extend(producers)
+        conns.extend(fetchers)
+        connect_s = time.perf_counter() - t_conn0
+
+        rng = np.random.default_rng(20260807)
+
+        def zipf_picks(n: int, size: int) -> np.ndarray:
+            ranks = np.arange(1, n + 1, dtype=np.float64)
+            p = ranks**-zipf_s
+            p /= p.sum()
+            return rng.choice(n, size=size, p=p)
+
+        async def rpc(c, frame: bytearray, corr: int) -> bytes:
+            struct.pack_into(">i", frame, corr_off, corr)
+            c.w.write(frame)  # transport copies synchronously
+            (size,) = struct.unpack(">i", await c.r.readexactly(4))
+            body = await c.r.readexactly(size)
+            if struct.unpack_from(">i", body, 0)[0] != corr:
+                raise RuntimeError("correlation mismatch")
+            return body
+
+        # fetch sessions: each fetcher establishes one real session on
+        # a zipf-skewed partition, then reuses it incrementally
+        fetch_parts = zipf_picks(n_partitions, n_fetchers)
+        corr_ctr = [100]
+
+        def next_corr() -> int:
+            corr_ctr[0] = (corr_ctr[0] + 1) & 0x7FFFFFFF
+            return corr_ctr[0]
+
+        async def establish(c, pid: int) -> None:
+            body = await rpc(
+                c,
+                mk_frame(FETCH, 11, FETCH.encode_request(fetch_req(pid, 0, 0), 11)),
+                next_corr(),
+            )
+            # resp body: corr i32 | throttle i32 | error i16 | session i32
+            (err,) = struct.unpack_from(">h", body, 8)
+            (sid,) = struct.unpack_from(">i", body, 10)
+            if err != 0 or sid <= 0:
+                raise RuntimeError(f"fetch session declined: {err}/{sid}")
+            c.frame = bytearray(incr_base)
+            struct.pack_into(">i", c.frame, sid_off, sid)
+            c.epoch = 1
+
+        for i in range(0, n_fetchers, 100):
+            await asyncio.gather(
+                *(
+                    establish(c, int(fetch_parts[i + j]))
+                    for j, c in enumerate(fetchers[i : i + 100])
+                )
+            )
+
+        # -- paced interleaved segments -----------------------------
+        kinds = ("produce", "fetch", "admin")
+        lat_by_rate: dict[float, list[float]] = {r: [] for r in rates}
+        reqs_by_rate = {r: 0 for r in rates}
+        overruns_by_rate = {r: 0 for r in rates}
+        starved_by_rate = {r: 0 for r in rates}
+        lat_by_kind: dict[str, list[float]] = {k: [] for k in kinds}
+        errors = {k: 0 for k in kinds}
+        sampled = {"checked": 0, "bad": 0}
+
+        picks = zipf_picks(len(producers), 1 << 18)
+        part_picks = zipf_picks(n_partitions, 1 << 18)
+        mix_draw = rng.random(1 << 18)
+        cur = [0]
+
+        async def read_one(kind, c, rate, t0, corr, check):
+            try:
+                (size,) = struct.unpack(
+                    ">i", await c.r.readexactly(4)
+                )
+                body = await c.r.readexactly(size)
+                ms = (time.perf_counter() - t0) * 1e3
+                lat_by_rate[rate].append(ms)
+                lat_by_kind[kind].append(ms)
+                if check:
+                    sampled["checked"] += 1
+                    ok = struct.unpack_from(">i", body, 0)[0] == corr
+                    if ok and kind == "produce":
+                        resp = PRODUCE.decode_response(body[4:], 7)
+                        ok = (
+                            resp.responses[0]
+                            .partition_responses[0]
+                            .error_code
+                            == 0
+                        )
+                    elif ok and kind == "fetch":
+                        (e,) = struct.unpack_from(">h", body, 8)
+                        ok = e == 0
+                    if not ok:
+                        sampled["bad"] += 1
+            except Exception:
+                errors[kind] += 1
+            finally:
+                c.busy = False
+
+        fcur = [0]
+
+        def free_conn(pool: list, start: int):
+            n = len(pool)
+            for d in range(n):
+                c = pool[(start + d) % n]
+                if not c.busy:
+                    return c
+            return None
+
+        async def segment(rate: float) -> list:
+            interval = 1.0 / rate
+            seg_t0 = time.perf_counter()
+            k = 0
+            tasks = []
+            while True:
+                target = seg_t0 + k * interval
+                if target - seg_t0 >= round_s:
+                    break
+                now = time.perf_counter()
+                if target > now:
+                    await asyncio.sleep(target - now)
+                else:
+                    overruns_by_rate[rate] += 1
+                i = cur[0] = (cur[0] + 1) & ((1 << 18) - 1)
+                u = mix_draw[i]
+                if u < w_prod:
+                    kind = "produce"
+                    c = free_conn(producers, int(picks[i]))
+                    frame = produce_frames[int(part_picks[i])]
+                elif u < w_prod + w_fetch:
+                    kind = "fetch"
+                    c = free_conn(fetchers, fcur[0])
+                    fcur[0] = (fcur[0] + 1) % len(fetchers)
+                    frame = c.frame if c is not None else None
+                else:
+                    kind = "admin"
+                    c = free_conn(producers, int(picks[i]))
+                    frame = meta_frame
+                k += 1
+                if c is None:  # every conn busy: the fleet is saturated
+                    starved_by_rate[rate] += 1
+                    continue
+                c.busy = True
+                corr = next_corr()
+                if kind == "fetch":
+                    struct.pack_into(">i", frame, epoch_off, c.epoch)
+                    c.epoch += 1
+                struct.pack_into(">i", frame, corr_off, corr)
+                t0 = time.perf_counter()
+                c.w.write(frame)
+                reqs_by_rate[rate] += 1
+                tasks.append(
+                    loop.create_task(
+                        read_one(kind, c, rate, t0, corr, corr % 64 == 0)
+                    )
+                )
+            return tasks
+
+        # -- churn storm: abort + reconnect between rounds ----------
+        churn_ms: list[float] = []
+        churn_errors = [0]
+        churned_total = [0]
+
+        async def churn_storm() -> None:
+            idle = [c for c in producers if not c.busy]
+            if not idle:
+                return
+            victims = [
+                idle[i]
+                for i in rng.choice(
+                    len(idle),
+                    size=min(churn_n, len(idle)),
+                    replace=False,
+                )
+            ]
+            for c in victims:
+                c.w.transport.abort()  # RST, not a clean close
+            churned_total[0] += len(victims)
+
+            async def reopen(c) -> None:
+                t0 = time.perf_counter()
+                try:
+                    c.r, c.w = await _open()
+                    churn_ms.append((time.perf_counter() - t0) * 1e3)
+                except Exception:
+                    churn_errors[0] += 1
+                    c.busy = True  # poisoned: park it out of the pool
+
+            for i in range(0, len(victims), 100):
+                await asyncio.gather(
+                    *(reopen(c) for c in victims[i : i + 100])
+                )
+
+        for _round in range(rounds):
+            for rate in rates:
+                tasks = await segment(rate)
+                if tasks:
+                    await asyncio.wait_for(asyncio.gather(*tasks), 60.0)
+            await churn_storm()
+
+        # -- broker-side truth: admin /metrics scrape ---------------
+        def scrape() -> str:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{admin_port}/metrics", timeout=10
+            ) as r:
+                return r.read().decode()
+
+        text = await loop.run_in_executor(None, scrape)
+
+        def mval(name: str) -> float:
+            tot, seen = 0.0, False
+            for ln in text.splitlines():
+                if ln.startswith(name):
+                    try:
+                        tot += float(ln.rsplit(None, 1)[1])
+                        seen = True
+                    except ValueError:
+                        pass
+            return tot if seen else -1.0
+
+        _P = "redpanda_tpu_"  # exposition prefix (metrics.Registry)
+        broker_stats = {
+            "connections_open": mval(_P + "kafka_connections_open"),
+            "connections_total": mval(_P + "kafka_connections_total"),
+            "inflight_stalls_total": mval(
+                _P + "kafka_inflight_stalls_total"
+            ),
+            "fetch_sessions_open": mval(_P + "kafka_fetch_sessions_open"),
+            "fetch_sessions_mem_bytes": mval(
+                _P + "kafka_fetch_sessions_mem_bytes"
+            ),
+        }
+
+        # -- verdicts ----------------------------------------------
+        verdicts = []
+        worst_p99 = 0.0
+        for rate in rates:
+            lat = lat_by_rate[rate]
+            achieved = reqs_by_rate[rate] / (rounds * round_s)
+            p50 = float(np.percentile(lat, 50)) if lat else -1.0
+            p99 = float(np.percentile(lat, 99)) if lat else -1.0
+            p999 = float(np.percentile(lat, 99.9)) if lat else -1.0
+            checks = {
+                "p99_ms": bool(lat) and p99 <= slo_p99,
+                "p999_ms": bool(lat) and p999 <= slo_p999,
+                "rate": achieved >= min_ratio * rate,
+            }
+            worst_p99 = max(worst_p99, p99)
+            verdicts.append(
+                {
+                    "rate_per_s": rate,
+                    "achieved_per_s": round(achieved, 1),
+                    "requests": reqs_by_rate[rate],
+                    "pacer_overruns": overruns_by_rate[rate],
+                    "starved": starved_by_rate[rate],
+                    "p50_ms": round(p50, 2),
+                    "p99_ms": round(p99, 2),
+                    "p999_ms": round(p999, 2),
+                    "checks": checks,
+                    "pass": all(checks.values()),
+                }
+            )
+        # the concurrency claim itself is a graded verdict: the fleet
+        # AND the broker must both report >= the profile's client count
+        total_conns = len(producers) + len(fetchers)
+        conn_checks = {
+            "clients_connected": total_conns >= n_clients,
+            "broker_connections": broker_stats["connections_open"]
+            >= n_clients,
+            "churn_errors": churn_errors[0] == 0,
+            "sampled_decodes": sampled["bad"] == 0,
+        }
+        verdicts.append(
+            {
+                "rate_per_s": "clients",
+                "connected": total_conns,
+                "broker_connections_open": broker_stats["connections_open"],
+                "checks": conn_checks,
+                "pass": all(conn_checks.values()),
+            }
+        )
+
+        out = {
+            "metric": "traffic_worst_p99_ms",
+            "value": round(worst_p99, 2),
+            "unit": "ms",
+            "vs_baseline": (
+                round(slo_p99 / worst_p99, 3) if worst_p99 > 0 else -1
+            ),
+            "slo_profile": prof["profile"],
+            "slo": {"p99_ms": slo_p99, "p999_ms": slo_p999},
+            "slo_pass": all(v["pass"] for v in verdicts),
+            "clients": total_conns,
+            "fetch_sessions": int(broker_stats["fetch_sessions_open"]),
+            "connect_s": round(connect_s, 2),
+            "interleaved_rounds": rounds,
+            "round_s": round_s,
+            "partitions": n_partitions,
+            "acks": acks,
+            "zipf_s": zipf_s,
+            "mix": mix,
+            "verdicts": verdicts,
+            "kind_p99_ms": {
+                k: round(float(np.percentile(v, 99)), 2) if v else -1.0
+                for k, v in lat_by_kind.items()
+            },
+            "errors": errors,
+            "sampled": sampled,
+            "churn": {
+                "storms": rounds,
+                "churned": churned_total[0],
+                "errors": churn_errors[0],
+                "reconnect_p50_ms": (
+                    round(float(np.percentile(churn_ms, 50)), 2)
+                    if churn_ms
+                    else -1.0
+                ),
+                "reconnect_p99_ms": (
+                    round(float(np.percentile(churn_ms, 99)), 2)
+                    if churn_ms
+                    else -1.0
+                ),
+            },
+            "broker": broker_stats,
+        }
+        for c in conns:  # close the fleet before stopping the child
+            try:
+                c.w.transport.abort()
+            except Exception:
+                pass
+        conns.clear()
+        out["framing_ab"] = _traffic_framing_ab()
+        return out
+    finally:
+        for c in conns:
+            try:
+                c.w.transport.abort()
+            except Exception:
+                pass
+        try:
+            proc.stdin.close()  # EOF => child stops its broker
+            proc.wait(timeout=30)
+        except Exception:
+            proc.kill()
+            proc.wait()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_traffic(profile: str | None = None) -> dict:
+    profile = profile or os.environ.get("BENCH_TRAFFIC_PROFILE", "traffic")
+    return asyncio.run(_traffic_async(_load_slo_profile(profile)))
+
+
 # ------------------------------------- tiered read path (warm/cold SLO)
 async def _tiered_async() -> dict:
     """Tiered-storage fetch latency across the remote/local seam:
@@ -2843,6 +3478,7 @@ BENCHES = {
     "replicated_mp": bench_replicated_mp,
     "omb": bench_omb,
     "slo": bench_slo,
+    "traffic": bench_traffic,
     "tiered": bench_tiered,
 }
 
@@ -2897,6 +3533,11 @@ def main() -> None:
         "replication plane must still tick them flat",
     )
     ap.add_argument(
+        "--traffic-broker",
+        metavar="DIR",
+        help=argparse.SUPPRESS,  # internal: traffic-bench broker child
+    )
+    ap.add_argument(
         "--slo",
         metavar="PROFILE",
         help="SLO-graded interleaved latency-vs-throughput sweep: load "
@@ -2905,6 +3546,9 @@ def main() -> None:
         "SLO and emit pass/fail verdicts in the summary line",
     )
     args = ap.parse_args()
+    if args.traffic_broker:
+        asyncio.run(_traffic_broker_child_async(args.traffic_broker))
+        return
     if args.attrib:
         os.environ["RP_BENCH_ATTRIB"] = "1"
     if args.probes:

@@ -249,4 +249,55 @@ int64_t rp_produce_frame(const uint8_t* frame, uint64_t len, int64_t* out,
     return 0;
 }
 
+// -- request framing fast path -------------------------------------
+//
+// rp_frame_scan: split a connection's raw read buffer into complete
+// Kafka request frames in ONE call, replacing the per-frame Python
+// readexactly(4) + struct.unpack + readexactly(size) loop. Each
+// complete frame yields a 5-slot descriptor:
+//
+//   [payload_off, payload_len, api_key, api_version, correlation_id]
+//
+// where payload_off points past the i32 size prefix. The scan stops
+// at the first incomplete frame (partial-frame resume: *consumed is
+// the byte offset of that frame's size prefix, so the caller keeps
+// the tail buffered and re-scans after the next read) or when the
+// descriptor table fills (the caller re-scans the remainder).
+//
+// Oversize/garbage rejection happens here, before any Python-side
+// allocation: a size prefix <= 7 cannot hold a request header
+// (api_key i16 + api_version i16 + correlation i32) and a size above
+// max_frame is either corruption or attack; both return FS_EGARBAGE
+// and the caller closes the connection — identical semantics to the
+// old Python loop's `size <= 0 or size > max_frame` check, tightened
+// to the 8-byte header floor (a 1..7-byte frame would only fail
+// header decode a few lines later with the same disconnect).
+extern "C" int64_t rp_frame_scan(const uint8_t* buf, uint64_t len,
+                                 int64_t max_frame, int64_t* out,
+                                 uint64_t out_rows, int64_t* consumed) {
+    const int64_t FS_EGARBAGE = -1;
+    uint64_t pos = 0;
+    int64_t n = 0;
+    while ((uint64_t)n < out_rows) {
+        if (len - pos < 4) break;  // partial size prefix
+        int32_t size = rd_i32be(buf + pos);
+        if (size < 8 || (int64_t)size > max_frame) {
+            *consumed = (int64_t)pos;
+            return FS_EGARBAGE;
+        }
+        if (len - pos - 4 < (uint64_t)size) break;  // partial payload
+        int64_t* row = out + n * 5;
+        const uint8_t* p = buf + pos + 4;
+        row[0] = (int64_t)(pos + 4);
+        row[1] = size;
+        row[2] = rd_i16be(p);      // api_key
+        row[3] = rd_i16be(p + 2);  // api_version
+        row[4] = rd_i32be(p + 4);  // correlation_id
+        n++;
+        pos += 4 + (uint64_t)size;
+    }
+    *consumed = (int64_t)pos;
+    return n;
+}
+
 }  // extern "C"

@@ -131,6 +131,14 @@ def default_properties() -> list[Property]:
             _positive,
         ),
         Property(
+            "kafka_max_inflight_per_connection",
+            "int",
+            64,
+            "Unwritten responses a single connection may have pending "
+            "before its reader stops decoding ahead (pipelining window)",
+            _positive,
+        ),
+        Property(
             "fetch_max_wait_cap_ms",
             "int",
             5000,

@@ -5,10 +5,14 @@ connection_context.cc:55 (process_one_request), requests.cc:285
 (handler dispatch) and handlers/{api_versions,metadata,create_topics,
 produce,fetch,list_offsets}.cc.
 
-Requests on one connection are processed strictly in order (the
-reference preserves per-connection response order with a two-stage
-dispatch; the sequential loop here gives the same external semantics —
-the staged overlap is a later optimization, produce.cc:95-111).
+Requests on one connection are ANSWERED strictly in order (the writer
+fiber emits responses in request order), but the reader decodes ahead:
+framing runs through kafka/framing.py (native rp_frame_scan splits a
+whole read buffer into frames in one C call; pure-Python twin behind
+RP_NATIVE_FRAME=0), and produce pipelining lets stage-1 dispatch of
+request N+1 overlap request N's ack wait, bounded by the
+kafka_max_inflight_per_connection window so a firehose client cannot
+queue unbounded unwritten responses.
 
 Produce CRC verification rides the model's batched CRC path
 (kafka_batch_adapter.cc:99 analog): every batch in the request is
@@ -38,6 +42,8 @@ from ..raft.consensus import NotLeaderError, ReplicateTimeout
 from ..security.acl import AclOperation, AclResourceType
 from ..ssx import InvokeError
 from ..utils.iobuf import IOBufParser
+from ..utils.tasks import cancel_and_wait
+from .framing import FrameError, FrameScanner
 from .protocol import (
     ALL_APIS,
     API_BY_KEY,
@@ -62,6 +68,11 @@ if TYPE_CHECKING:  # pragma: no cover
 logger = logging.getLogger("kafka.server")
 
 _SIZE = struct.Struct(">i")
+
+# socket read granularity for the framing loop: large enough that an
+# MB-sized produce frame arrives in a handful of wakeups, small enough
+# not to balloon per-connection buffers at 10k+ connections
+_RECV_CHUNK = 1 << 18
 
 # TopicError.code strings → kafka error codes (names match ErrorCode)
 def _topic_error_code(code: str) -> int:
@@ -139,6 +150,8 @@ class ConnectionContext:
         "authenticated",
         "session_expires_mono",
         "internal",
+        "fetch_session_ids",
+        "client_ids",
     )
 
     def __init__(self) -> None:
@@ -146,6 +159,12 @@ class ConnectionContext:
         self.mechanism: str | None = None
         self.scram = None
         self.authenticated = False
+        # per-connection protocol state released at teardown: fetch
+        # sessions created/adopted here and client_ids whose quota
+        # buckets this connection holds a reference on — an aborted
+        # connection under a churn storm must not leak either
+        self.fetch_session_ids: set[int] = set()
+        self.client_ids: set[str] = set()
         # monotonic deadline after which the SASL session is no longer
         # valid (OAUTHBEARER: derived from the token's exp at auth
         # time; None = unbounded). Monotonic, not wall: the expiry
@@ -167,6 +186,13 @@ CURRENT_PRINCIPAL: "contextvars.ContextVar[str | None]" = contextvars.ContextVar
 # for cert-pinned in-broker connections, short-circuits authorization
 CURRENT_INTERNAL: "contextvars.ContextVar[bool]" = contextvars.ContextVar(
     "kafka_internal", default=False
+)
+# the owning connection's context, set for the connection task's whole
+# lifetime: deep call-sites (fetch-session create/adopt) record
+# per-connection protocol state for teardown release without threading
+# ctx through every handler signature
+CURRENT_CONN: "contextvars.ContextVar[ConnectionContext | None]" = (
+    contextvars.ContextVar("kafka_conn", default=None)
 )
 
 
@@ -232,8 +258,46 @@ class KafkaServer:
         from .fetch_session import FetchSessionCache
         from .quotas import QuotaManager
 
-        self.quotas = QuotaManager(broker.controller.cluster_config)
+        # quota degradation couples to the load ledger's hot-NTP list:
+        # under node-wide pressure, tenants hammering the hottest
+        # partitions (and tenants above their fair rate share) throttle
+        # first — heavy tenants degrade before the fleet does
+        self.quotas = QuotaManager(
+            broker.controller.cluster_config,
+            ledger=getattr(broker, "load_ledger", None),
+        )
         self.fetch_sessions = FetchSessionCache()
+        # front-end concurrency plane: connection-count + pipelining
+        # window visibility (the traffic bench and churn smoke assert
+        # these return to baseline after a storm)
+        broker.metrics.gauge(
+            "kafka_connections_open",
+            lambda: len(self._conns),
+            "Open Kafka connections",
+        )
+        self._conn_total = broker.metrics.counter(
+            "kafka_connections_total", "Kafka connections accepted"
+        )
+        self._inflight = 0
+        broker.metrics.gauge(
+            "kafka_inflight_responses",
+            lambda: self._inflight,
+            "Responses decoded but not yet written, all connections",
+        )
+        self._inflight_stalls = broker.metrics.counter(
+            "kafka_inflight_stalls_total",
+            "Reader stalls on a full per-connection inflight window",
+        )
+        broker.metrics.gauge(
+            "kafka_fetch_sessions_open",
+            lambda: len(self.fetch_sessions),
+            "Live incremental fetch sessions",
+        )
+        broker.metrics.gauge(
+            "kafka_fetch_sessions_mem_bytes",
+            lambda: self.fetch_sessions.mem_bytes(),
+            "Accounted fetch-session memory (cost model bytes)",
+        )
 
     # -- authorization -------------------------------------------------
     @property
@@ -320,14 +384,14 @@ class KafkaServer:
             self._server.close()
         # cancel live connection handlers BEFORE wait_closed(): since
         # py3.12 wait_closed() waits for handlers, which otherwise sit
-        # in readexactly() for as long as a client keeps the socket open
+        # in the read loop for as long as a client keeps the socket open
         for t in list(self._conns):
             t.cancel()
         for t in list(self._conns):
             try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+                await cancel_and_wait(t)
+            except (ConnectionError, OSError):
+                pass  # peer-shaped teardown noise; real bugs propagate
         if self._server is not None:
             await self._server.wait_closed()
 
@@ -338,12 +402,17 @@ class KafkaServer:
         """Pipelined request loop (connection_context.cc:55 +
         produce.cc:383 two-stage dispatch): a handler may return its
         response bytes immediately OR a coroutine producing them later
-        (produce awaiting quorum). The reader keeps parsing the next
-        request while slow responses settle; a writer fiber emits
-        responses strictly in request order."""
+        (produce awaiting quorum). The reader drains COMPLETE frames
+        from the scanner seam (kafka/framing.py: native rp_frame_scan
+        splits everything buffered in one call) and keeps decoding
+        ahead while slow responses settle, bounded by the
+        per-connection inflight window; a writer fiber emits responses
+        strictly in request order."""
         task = asyncio.current_task()
         self._conns.add(task)
+        self._conn_total.inc()
         ctx = ConnectionContext()
+        CURRENT_CONN.set(ctx)
         if self._mtls_mapper is not None:
             # mTLS: the verified client certificate IS the identity
             # (mtls.cc) — mapped through the principal rules and fed to
@@ -379,6 +448,22 @@ class KafkaServer:
         conn_failed = asyncio.Event()
         proto = writer.transport.get_protocol()
         rx = proto if isinstance(proto, _RxStampProtocol) else None
+        cfg = self.broker.controller.cluster_config
+        scanner = FrameScanner(cfg.get("kafka_max_request_bytes"))
+        window = cfg.get("kafka_max_inflight_per_connection")
+        # unwritten responses this connection has queued; the reader
+        # stops decoding ahead at `window` and resumes as the writer
+        # settles them
+        inflight = 0
+        window_open = asyncio.Event()
+        window_open.set()
+
+        def settle() -> None:
+            nonlocal inflight
+            inflight -= 1
+            self._inflight -= 1
+            if inflight < window:
+                window_open.set()
 
         async def write_loop() -> None:
             while True:
@@ -389,14 +474,18 @@ class KafkaServer:
                 try:
                     resp = await fut
                 except _CloseConnection as e:
+                    settle()
                     if e.args and e.args[0]:
                         writer.write(_SIZE.pack(len(e.args[0])) + e.args[0])
                         await writer.drain()
                     conn_failed.set()
+                    window_open.set()  # a stalled reader must observe it
                     writer.close()  # unblocks the reader side
                     return
                 except Exception:
+                    settle()
                     conn_failed.set()
+                    window_open.set()
                     try:
                         writer.close()
                     except Exception:
@@ -405,71 +494,124 @@ class KafkaServer:
                 if resp is not None:
                     writer.write(_SIZE.pack(len(resp)) + resp)
                     await writer.drain()
+                settle()
                 if on_written is not None:
                     on_written()
 
         write_task = asyncio.ensure_future(write_loop())
-        try:
-            while not conn_failed.is_set():
-                try:
-                    raw_size = await reader.readexactly(4)
-                except (asyncio.IncompleteReadError, ConnectionError):
-                    return
-                (size,) = _SIZE.unpack(raw_size)
-                max_frame = self.broker.controller.cluster_config.get(
-                    "kafka_max_request_bytes"
-                )
-                if size <= 0 or size > max_frame:
-                    return
-                frame = await reader.readexactly(size)
-                # request clock starts at wire arrival when the stamp
-                # is armed; fallback (frame already buffered when the
-                # previous one was consumed) is "now" — conservative
-                if rx is not None and rx.rx_t0 >= 0.0:
-                    t_req = rx.rx_t0
-                    rx.rx_t0 = -1.0
-                else:
-                    t_req = time.monotonic()
+
+        async def enqueue(resp) -> None:
+            """Queue one response (or the future of one) for the
+            writer fiber, charging the inflight window."""
+            nonlocal inflight
+            on_written = None
+            if type(resp) is _TrackedResponse:
+                on_written = resp.on_written
+                resp = resp.resp
+            if asyncio.iscoroutine(resp):
+                fut = asyncio.ensure_future(resp)
+            else:
+                fut = asyncio.get_event_loop().create_future()
+                fut.set_result(resp)
+            inflight += 1
+            self._inflight += 1
+            await pending.put((fut, on_written))
+
+        async def process_frames(frames, t_req: float) -> bool:
+            """Run one scanned burst through _process in arrival
+            order; False ends the connection (close request from the
+            pipeline or a writer-side failure)."""
+            nonlocal inflight
+            for frame, _api_key, _api_version, _corr in frames:
+                if inflight >= window:
+                    # pipelining window full: stop decoding ahead
+                    # until the writer settles responses
+                    self._inflight_stalls.inc()
+                    window_open.clear()
+                    await window_open.wait()
+                if conn_failed.is_set():
+                    return False
                 try:
                     resp = await self._process(frame, ctx, t_req)
                 except _CloseConnection as e:
                     fut = asyncio.get_event_loop().create_future()
                     fut.set_exception(e)
+                    inflight += 1
+                    self._inflight += 1
                     await pending.put((fut, None))
-                    break
-                on_written = None
-                if type(resp) is _TrackedResponse:
-                    on_written = resp.on_written
-                    resp = resp.resp
-                if asyncio.iscoroutine(resp):
-                    await pending.put(
-                        (asyncio.ensure_future(resp), on_written)
-                    )
-                else:
-                    fut = asyncio.get_event_loop().create_future()
-                    fut.set_result(resp)
-                    await pending.put((fut, on_written))
+                    return False
+                await enqueue(resp)
+                # later frames of the burst were decode-ahead work:
+                # their request clock starts when the reader reaches
+                # them (conservative, matches the old loop's fallback)
+                t_req = time.monotonic()
+            return True
+
+        try:
+            while not conn_failed.is_set():
+                try:
+                    frames = scanner.scan()
+                except FrameError:
+                    return  # oversize/garbage size prefix
+                if frames:
+                    # the burst's request clock starts at wire arrival
+                    # when the stamp is armed; fallback (bytes were
+                    # already buffered) is "now" — conservative
+                    if rx is not None and rx.rx_t0 >= 0.0:
+                        t_burst = rx.rx_t0
+                        rx.rx_t0 = -1.0
+                    else:
+                        t_burst = time.monotonic()
+                    if not await process_frames(frames, t_burst):
+                        break
+                    continue
+                if rx is not None and scanner.buffered == 0:
+                    rx.rx_t0 = -1.0  # re-arm: next bytes stamp arrival
+                try:
+                    data = await reader.read(_RECV_CHUNK)
+                except ConnectionError:
+                    return
+                if not data:
+                    return  # EOF
+                # live config rebind once per socket read, off the
+                # per-frame path
+                scanner.max_frame = cfg.get("kafka_max_request_bytes")
+                scanner.feed(data)
             await pending.put(None)  # writer drains then exits
             await write_task
         except (asyncio.CancelledError, ConnectionError):
             pass
         finally:
-            self._conns.discard(task)
-            if not write_task.done():
-                write_task.cancel()
+            # release everything BEFORE leaving self._conns: observers
+            # (the churn smoke, admin scrapes) treat "no connections"
+            # as "nothing accounted", so the connection must not be
+            # discarded while its sessions/quota refs are still live
             try:
-                await write_task
-            except (asyncio.CancelledError, _CloseConnection, Exception):
-                pass
-            # settle any still-pending response futures
-            while not pending.empty():
-                item = pending.get_nowait()
-                if item is not None:
-                    item[0].cancel()
-            try:
-                writer.close()
-            except Exception:
-                pass
+                try:
+                    await cancel_and_wait(write_task)
+                except (ConnectionError, OSError):
+                    pass  # write-side teardown noise; real bugs propagate
+                # settle any still-pending response futures
+                while not pending.empty():
+                    item = pending.get_nowait()
+                    if item is not None:
+                        item[0].cancel()
+                # reconcile the fleet inflight gauge for responses the
+                # writer never settled
+                self._inflight -= inflight
+                # release per-connection protocol state: an aborted
+                # connection must not leak its fetch sessions or its
+                # quota-bucket references through a churn storm
+                for sid in ctx.fetch_session_ids:
+                    self.fetch_sessions.remove(sid)
+                for cid in ctx.client_ids:
+                    self.quotas.release(cid)
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            finally:
+                self._conns.discard(task)
 
     async def _process(
         self, frame: bytes, ctx: ConnectionContext, t_req: float | None = None
@@ -499,6 +641,15 @@ class KafkaServer:
         if api is None:
             logger.warning("unknown api key %d", hdr.api_key)
             raise _CloseConnection(b"")
+        # anonymous clients account under "" (record_and_throttle's
+        # fallback key) — acquire that principal too, or its rate
+        # window outlives every anonymous connection until the idle GC
+        cid = hdr.client_id or ""
+        if cid not in ctx.client_ids:
+            # first use of this client_id on the connection: pin its
+            # quota state until teardown releases the reference
+            ctx.client_ids.add(cid)
+            self.quotas.acquire(cid)
         if (
             self.broker.config.enable_sasl
             and not ctx.authenticated
@@ -1167,17 +1318,19 @@ class KafkaServer:
         # order is fixed by enqueue order
         work = []
         produced_bytes = 0
+        ntp_keys = []
         with trace.span("produce.dispatch"):
             for t in req.topics:
                 for p in t.partitions:
                     produced_bytes += len(p.records or b"")
+                    ntp_keys.append(f"{DEFAULT_NS}/{t.name}/{p.index}")
                 partition_work = [
                     await dispatch_partition(t.name, p) for p in t.partitions
                 ]
                 work.append((t.name, partition_work))
         self._produce_bytes.inc(produced_bytes)
         throttle = self.quotas.record_and_throttle(
-            "produce", hdr.client_id, produced_bytes
+            "produce", hdr.client_id, produced_bytes, ntps=ntp_keys
         )
         if throttle and acks == 0:
             # no response exists to carry throttle_time_ms for acks=0 —
@@ -1265,16 +1418,24 @@ class KafkaServer:
         ):
             sid = getattr(req, "session_id", 0) or 0
             epoch = getattr(req, "session_epoch", -1)
+            conn = CURRENT_CONN.get()
             if epoch == -1:
                 if sid:
                     self.fetch_sessions.remove(sid)
+                    if conn is not None:
+                        conn.fetch_session_ids.discard(sid)
             elif epoch == 0:
                 # KIP-227: epoch 0 creates a NEW session regardless of
                 # the id field (a client re-establishing after an error
                 # may still carry its stale id)
                 if sid:
                     self.fetch_sessions.remove(sid)
+                    if conn is not None:
+                        conn.fetch_session_ids.discard(sid)
                 session = self.fetch_sessions.create()
+                if session is not None and conn is not None:
+                    # owned by this connection: teardown releases it
+                    conn.fetch_session_ids.add(session.id)
                 if session is not None:
                     session.apply_request(req.topics, None)
                 # cache full of active sessions: answer sessionless
@@ -1287,6 +1448,11 @@ class KafkaServer:
                         session_id=0,
                         responses=[],
                     )
+                if conn is not None:
+                    # adoption: a client resuming its session over a
+                    # NEW connection moves ownership here, so the
+                    # session dies with the connection actually using it
+                    conn.fetch_session_ids.add(sid)
                 incremental = True
                 session.apply_request(
                     req.topics, getattr(req, "forgotten_topics_data", None)
@@ -1733,14 +1899,17 @@ class KafkaServer:
             responses = self._finish_session_fetch(
                 session, responses, incremental
             )
+        fetched_bytes = 0
+        fetched_ntps = []
+        for t in responses:
+            for p in t.partitions:
+                if p.records:
+                    fetched_bytes += len(p.records)
+                    fetched_ntps.append(
+                        f"{DEFAULT_NS}/{t.topic}/{p.partition_index}"
+                    )
         throttle = self.quotas.record_and_throttle(
-            "fetch",
-            hdr.client_id,
-            sum(
-                len(p.records or b"")
-                for t in responses
-                for p in t.partitions
-            ),
+            "fetch", hdr.client_id, fetched_bytes, ntps=fetched_ntps
         )
         if throttle:
             # ENFORCE, don't just advise: the connection's ordered

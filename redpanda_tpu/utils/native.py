@@ -17,6 +17,7 @@ Escape hatches (checked per call, so tests can flip them at runtime):
   RP_NATIVE=0          disable the native library entirely
   RP_NATIVE_APPEND=0   disable only the AppendEntries follower fast path
   RP_NATIVE_PRODUCE=0  disable only the Kafka produce frontend fast path
+  RP_NATIVE_FRAME=0    disable only the request-framing scanner
 """
 
 from __future__ import annotations
@@ -42,6 +43,10 @@ AF_REPLY_SIZE = 51
 
 # -- produce_frame layout (keep in sync with native/produce_frame.cc) --
 PF_OUT_N = 13
+
+# -- frame_scan layout (keep in sync with native/produce_frame.cc) --
+FS_ROW_N = 5       # [payload_off, payload_len, api_key, api_version, corr]
+FS_MAX_FRAMES = 64  # descriptor rows per call; caller re-scans when full
 
 
 def _sources_newer_than_lib() -> bool:
@@ -144,6 +149,15 @@ def load() -> ctypes.CDLL | None:
             ctypes.c_uint64,                 # len
             ctypes.POINTER(ctypes.c_int64),  # out
             ctypes.c_uint64,                 # out slots
+        ]
+        lib.rp_frame_scan.restype = ctypes.c_int64
+        lib.rp_frame_scan.argtypes = [
+            ctypes.POINTER(ctypes.c_char),   # read buffer (bytearray view)
+            ctypes.c_uint64,                 # len
+            ctypes.c_int64,                  # max_frame
+            ctypes.POINTER(ctypes.c_int64),  # out descriptor rows
+            ctypes.c_uint64,                 # out rows
+            ctypes.POINTER(ctypes.c_int64),  # consumed
         ]
         _lib = lib
         return _lib
@@ -264,3 +278,46 @@ def produce_frame(frame: bytes) -> tuple | None:
     if rc != 0:
         return None
     return tuple(out)
+
+
+# --------------------------------------- frame_scan (request framing)
+def frame_scan_ready() -> bool:
+    """Feature probe for the request-framing scanner."""
+    if os.environ.get("RP_NATIVE_FRAME") == "0":
+        return False
+    return load() is not None
+
+
+_fs_out = (ctypes.c_int64 * (FS_ROW_N * FS_MAX_FRAMES))()  # loop scratch
+_fs_consumed = (ctypes.c_int64 * 1)()
+
+
+def frame_scan(
+    buf: bytearray, max_frame: int
+) -> tuple[int, "ctypes.Array", int] | None:
+    """One-call request framing over a connection read buffer
+    (native/produce_frame.cc rp_frame_scan). Returns (n, rows,
+    consumed) where rows is the flat descriptor scratch (FS_ROW_N
+    slots per frame: payload_off, payload_len, api_key, api_version,
+    correlation_id), n is the frame count (or -1 on an oversize/
+    garbage size prefix — the caller closes the connection), and
+    consumed is the byte offset of the first incomplete frame.
+    None when the library is unavailable (caller runs its pure-Python
+    twin). Zero-copy: the bytearray is viewed in place, never copied."""
+    lib = load()
+    if lib is None:
+        return None
+    view = (ctypes.c_char * len(buf)).from_buffer(buf) if buf else None
+    try:
+        n = lib.rp_frame_scan(
+            view, len(buf), max_frame, _fs_out, FS_MAX_FRAMES, _fs_consumed
+        )
+    finally:
+        # clear the binding INSIDE this frame: the profiler's sampler
+        # thread can materialize this frame via sys._current_frames()
+        # while the C call runs (the GIL is released), and an escaped
+        # frame takes ownership of its locals at return — which would
+        # pin the buffer export past the call and make the caller's
+        # compaction (a bytearray resize) raise BufferError
+        del view
+    return int(n), _fs_out, int(_fs_consumed[0])

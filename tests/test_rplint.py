@@ -2251,3 +2251,108 @@ def test_devplane_facts_ride_summary_cache_warm_fast(tmp_path, monkeypatch):
     # warm run added no entries: dev facts did not spill to a 2nd cache
     assert len(os.listdir(str(tmp_path / "cache"))) == n_entries
     assert warm_s <= 2.0, f"warm device-plane lint took {warm_s:.2f}s"
+
+
+# -- RPL022: front-end discipline --------------------------------------
+
+RPL022_BAD = """\
+import struct
+
+
+async def _on_conn(reader, writer):
+    buf = bytearray()
+    while True:
+        raw = await reader.readexactly(4)
+        (size,) = struct.unpack(">i", raw)
+        data = await reader.read(65536)
+        buf += data
+"""
+
+
+def test_rpl022_legacy_loop_fully_flagged(tmp_path):
+    found = _only(
+        _lint_source(tmp_path, RPL022_BAD, "kafka/server.py"), "RPL022"
+    )
+    msgs = [f.message for f in found]
+    assert any(".readexactly()" in m for m in msgs)
+    assert any(".unpack()" in m for m in msgs)
+    assert any("reassembly" in m for m in msgs)
+    assert len(found) == 3
+
+
+def test_rpl022_scanner_loop_clean(tmp_path):
+    src = """
+        async def _on_conn(reader, writer):
+            scanner = FrameScanner(1 << 20)
+            inflight = 0
+            while True:
+                for frame in scanner.scan():
+                    inflight += 1  # counter math is NOT reassembly
+                data = await reader.read(1 << 18)
+                if not data:
+                    return
+                scanner.feed(data)
+    """
+    assert (
+        _only(_lint_source(tmp_path, src, "kafka/server.py"), "RPL022")
+        == []
+    )
+
+
+def test_rpl022_nested_writer_fiber_in_scope(tmp_path):
+    src = """
+        async def _on_conn(reader, writer):
+            async def write_loop():
+                hdr = await reader.readexactly(4)
+
+            await write_loop()
+    """
+    (f,) = _only(_lint_source(tmp_path, src, "kafka/server.py"), "RPL022")
+    assert ".readexactly()" in f.message
+
+
+def test_rpl022_other_functions_out_of_scope(tmp_path):
+    # handlers decode PAYLOADS (already framed) — struct math there is
+    # protocol decode, not framing; only the read loop is disciplined
+    src = """
+        import struct
+
+
+        async def handle_produce(hdr, req):
+            (acks,) = struct.unpack(">h", req[:2])
+            return acks
+    """
+    assert (
+        _only(_lint_source(tmp_path, src, "kafka/server.py"), "RPL022")
+        == []
+    )
+
+
+def test_rpl022_only_kafka_server_in_scope(tmp_path):
+    # the seam itself (kafka/framing.py) and unrelated servers stay free
+    for rel in ("kafka/framing.py", "raft/server.py", "mod.py"):
+        assert _only(_lint_source(tmp_path, RPL022_BAD, rel), "RPL022") == []
+
+
+def test_rpl022_suppression(tmp_path):
+    src = RPL022_BAD.replace(
+        "raw = await reader.readexactly(4)",
+        "raw = await reader.readexactly(4)  # rplint: disable=RPL022",
+    ).replace(
+        "(size,) = struct.unpack(\">i\", raw)",
+        "(size,) = struct.unpack(\">i\", raw)  # rplint: disable=RPL022",
+    ).replace(
+        "buf += data",
+        "buf += data  # rplint: disable=RPL022",
+    )
+    assert (
+        _only(_lint_source(tmp_path, src, "kafka/server.py"), "RPL022")
+        == []
+    )
+
+
+def test_rpl022_baseline_is_empty():
+    """Front-end discipline holds by construction: the read loop was
+    born scanner-shaped in the same PR that added the rule."""
+    baseline = load_baseline()
+    assert [k for k in baseline if k.endswith("::RPL022")] == []

@@ -23,6 +23,7 @@ from .rpl018_mesh_discipline import MeshDisciplineRule
 from .rpl019_codec_discipline import CodecDisciplineRule
 from .rpl020_compile_discipline import CompileDisciplineRule
 from .rpl021_donation_layout import DonationLayoutRule
+from .rpl022_frontend_discipline import FrontendDisciplineRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -46,6 +47,7 @@ ALL_RULES = [
     CodecDisciplineRule,
     CompileDisciplineRule,
     DonationLayoutRule,
+    FrontendDisciplineRule,
 ]
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,160 @@
+"""RPL022 — front-end discipline: the KafkaServer connection read
+loop does no per-frame Python parsing or wire-buffer reassembly.
+
+The million-client PR moved request framing out of `_on_conn` and into
+`kafka/framing.py::FrameScanner` — the single seam where the native
+`rp_frame_scan` leg and its pure-Python twin are allowed to do
+struct math and buffer splicing (and where the two are held
+byte-equal by test). The historical loop cost four coroutine
+suspensions and two Python-level parses PER REQUEST
+(readexactly(4) + struct.unpack + readexactly(size)); any of it
+creeping back into the connection loop silently re-caps connection
+scale, and — worse — forks the framing policy: a second parser in
+server.py can disagree with the scanner about the header floor or the
+oversize cut-off, and the disagreement only shows under a garbage
+storm.
+
+Flagged inside `_on_conn` (and every function nested in it) in files
+ending `kafka/server.py`:
+
+  * any `.unpack(...)` / `.unpack_from(...)` call — per-frame struct
+    math belongs to FrameScanner
+  * any `.readexactly(...)` call — the loop reads CHUNKS
+    (`reader.read(n)`) and lets the scanner carry partials; per-frame
+    exact reads are the old per-request suspension pattern
+  * `buf += data`-shaped reassembly where `data` came from an
+    `await reader.read*(...)` — wire bytes are fed to the scanner
+    (`scanner.feed(data)`), never re-accumulated loop-side (the
+    scanner's re-homing fallback is what makes compaction safe
+    against pinned buffer exports; a loop-side bytearray has no such
+    guard)
+
+`kafka/framing.py` itself is out of scope — it IS the seam.
+
+Suppress a deliberate exception with `# rplint: disable=RPL022`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext
+
+EXAMPLE = """\
+# in redpanda_tpu/kafka/server.py, inside _on_conn
+size = struct.unpack(">i", raw)[0]          # RPL022: per-frame struct math
+raw = await reader.readexactly(4)           # RPL022: per-frame exact read
+data = await reader.read(65536)
+buf += data                                 # RPL022: loop-side reassembly
+# instead:
+data = await reader.read(_RECV_CHUNK)
+scanner.feed(data)
+for payload, api_key, api_version, corr in scanner.scan():
+    ...
+"""
+
+
+def _is_reader_read_await(node: ast.AST) -> bool:
+    """`await <x>.read(...)` / `await <x>.readexactly(...)` etc."""
+    if not isinstance(node, ast.Await):
+        return False
+    call = node.value
+    return (
+        isinstance(call, ast.Call)
+        and isinstance(call.func, ast.Attribute)
+        and call.func.attr.startswith("read")
+    )
+
+
+def _names_in(node: ast.AST):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            yield n.id
+
+
+class FrontendDisciplineRule:
+    code = "RPL022"
+    name = "frontend-discipline"
+
+    def _in_scope(self, path: str) -> bool:
+        return path.replace("\\", "/").endswith("kafka/server.py")
+
+    def check(self, ctx: ModuleContext):
+        if not self._in_scope(ctx.path):
+            return
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, (ast.AsyncFunctionDef, ast.FunctionDef))
+                and node.name == "_on_conn"
+            ):
+                yield from self._check_loop(ctx, node)
+
+    def _check_loop(self, ctx: ModuleContext, fn: ast.AST):
+        # names that hold raw wire bytes: assigned from
+        # `await <reader>.read*(...)` anywhere in the loop body
+        wire_names: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_reader_read_await(
+                node.value
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        wire_names.add(tgt.id)
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                attr = node.func.attr
+                if attr in ("unpack", "unpack_from"):
+                    if ctx.suppressed(node, self.code):
+                        continue
+                    yield Finding(
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.code,
+                        message=(
+                            f".{attr}() in the connection read loop — "
+                            "per-frame struct math belongs to "
+                            "kafka/framing.FrameScanner (the native-"
+                            "wrapper seam); a second parser here forks "
+                            "the framing policy"
+                        ),
+                    )
+                elif attr == "readexactly":
+                    if ctx.suppressed(node, self.code):
+                        continue
+                    yield Finding(
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.code,
+                        message=(
+                            ".readexactly() in the connection read loop "
+                            "— per-frame exact reads are the old "
+                            "suspension-per-request pattern; read "
+                            "chunks and let FrameScanner carry the "
+                            "partial frame"
+                        ),
+                    )
+            elif (
+                isinstance(node, ast.AugAssign)
+                and isinstance(node.op, ast.Add)
+                and any(n in wire_names for n in _names_in(node.value))
+            ):
+                if ctx.suppressed(node, self.code):
+                    continue
+                yield Finding(
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.code,
+                    message=(
+                        "wire-bytes reassembly in the connection read "
+                        "loop — feed socket reads to FrameScanner "
+                        "(scanner.feed(data)); a loop-side buffer has "
+                        "no pinned-export re-homing guard and forks "
+                        "the partial-frame state"
+                    ),
+                )

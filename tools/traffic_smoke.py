@@ -1,0 +1,283 @@
+"""verify.sh front-end churn smoke: 1k raw kafka connections against
+ONE in-process broker, torn down by RST storms, with the three
+front-end planes asserted back to baseline after every storm:
+
+  1. zero lost acked produces — every produce the broker acked is
+     counted, across every churn round, with per-response decode;
+  2. zero leaked protocol state — fetch sessions (count AND accounted
+     bytes), per-client quota refs, and the pipelining inflight gauge
+     all return to zero once the aborted connections drain;
+  3. zero leaked tasks — the event-loop task count returns to the
+     pre-storm baseline, so a stuck writer fiber or an orphaned
+     read-loop can't hide behind a passing assertion.
+
+The admin /metrics scrape cross-checks (2) from the outside: the
+connection gauge the traffic bench grades must agree with the
+server's own books.
+
+Runs twice in tools/verify.sh: once with the native rp_frame_scan
+framing leg, once with RP_NATIVE_FRAME=0 pinning the pure-Python
+twin — a fallback framing regression can't hide behind a working .so.
+Exit 0 = the front end survives connection churn in this environment.
+The window/ordering/parity matrix lives in
+tests/test_kafka_frontend.py; this is the "does a thousand-client
+storm leak anything real" gate.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import struct
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from redpanda_tpu.app import Broker, BrokerConfig  # noqa: E402
+from redpanda_tpu.kafka.client import KafkaClient  # noqa: E402
+from redpanda_tpu.kafka.protocol import FETCH, PRODUCE, Msg  # noqa: E402
+from redpanda_tpu.kafka.protocol import produce_fast  # noqa: E402
+from redpanda_tpu.kafka.protocol.headers import (  # noqa: E402
+    RequestHeader,
+    encode_request_header,
+)
+from redpanda_tpu.models.record import RecordBatchBuilder  # noqa: E402
+from redpanda_tpu.rpc.loopback import LoopbackNetwork  # noqa: E402
+
+TOPIC = "smoke"
+N_PARTITIONS = 8
+
+
+def _frame(api, version: int, corr: int, body: bytes) -> bytes:
+    head = encode_request_header(
+        RequestHeader(api.key, version, corr, None)
+    )
+    return struct.pack(">i", len(head) + len(body)) + head + body
+
+
+async def _rpc(r, w, fr: bytes, corr: int) -> bytes:
+    w.write(fr)
+    (size,) = struct.unpack(">i", await r.readexactly(4))
+    body = await r.readexactly(size)
+    assert struct.unpack_from(">i", body)[0] == corr, "corr mismatch"
+    return body
+
+
+async def _settle(check, what: str, timeout: float = 10.0) -> None:
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while not check():
+        if loop.time() > deadline:
+            raise AssertionError(f"{what} did not settle in {timeout}s")
+        await asyncio.sleep(0.02)
+
+
+async def _open_many(host: str, port: int, n: int) -> list:
+    out: list = []
+    while len(out) < n:  # stay under the ~100 listen backlog
+        k = min(100, n - len(out))
+        out.extend(
+            await asyncio.gather(
+                *(asyncio.open_connection(host, port) for _ in range(k))
+            )
+        )
+    return out
+
+
+def _fetch_body(pid: int) -> bytes:
+    return FETCH.encode_request(
+        Msg(
+            replica_id=-1,
+            max_wait_ms=0,
+            min_bytes=0,
+            max_bytes=1 << 20,
+            isolation_level=0,
+            session_id=0,
+            session_epoch=0,
+            topics=[
+                Msg(
+                    topic=TOPIC,
+                    partitions=[
+                        Msg(
+                            partition=pid,
+                            current_leader_epoch=-1,
+                            fetch_offset=0,
+                            log_start_offset=-1,
+                            partition_max_bytes=1 << 20,
+                        )
+                    ],
+                )
+            ],
+            forgotten_topics_data=[],
+            rack_id="",
+        ),
+        11,
+    )
+
+
+async def main(n_clients: int, rounds: int) -> None:
+    tmp = tempfile.mkdtemp(prefix="rp_traffic_smoke_")
+    b = Broker(
+        BrokerConfig(
+            node_id=0,
+            data_dir=os.path.join(tmp, "n0"),
+            members=[0],
+            housekeeping_interval_s=0,
+        ),
+        loopback=LoopbackNetwork(),
+    )
+    await b.start()
+    b.config.peer_kafka_addresses = {0: b.kafka_advertised}
+    try:
+        await b.wait_controller_leader()
+        server = b.kafka_server
+        boot = KafkaClient([b.kafka_advertised])
+        await boot.create_topic(
+            TOPIC, partitions=N_PARTITIONS, replication_factor=1
+        )
+        builder = RecordBatchBuilder()
+        builder.add(b"v" * 64, key=b"k")
+        wire = builder.build().to_kafka_wire()
+        for pid in range(N_PARTITIONS):
+            await boot.produce_wire(TOPIC, pid, wire, acks=1)
+        await boot.close()
+        host, port = b.kafka_advertised
+        await _settle(lambda: len(server._conns) == 0, "boot teardown")
+        task_base = len(asyncio.all_tasks())
+
+        produce_bodies = [
+            produce_fast.encode_request_single(
+                7, False, None, 1, 10000, TOPIC, pid, wire
+            )
+            for pid in range(N_PARTITIONS)
+        ]
+
+        sent = acked = 0
+        sessions_made = 0
+        for _round in range(rounds):
+            conns = await _open_many(host, port, n_clients)
+
+            async def produce_one(i: int, r, w) -> None:
+                nonlocal acked
+                corr = 1_000_000 + i
+                body = await _rpc(
+                    r,
+                    w,
+                    _frame(
+                        PRODUCE, 7, corr, produce_bodies[i % N_PARTITIONS]
+                    ),
+                    corr,
+                )
+                m = PRODUCE.decode_response(body[4:], 7)
+                err = m.responses[0].partition_responses[0].error_code
+                assert err == 0, f"produce error {err}"
+                acked += 1
+
+            for i in range(0, len(conns), 100):
+                await asyncio.gather(
+                    *(
+                        produce_one(i + j, r, w)
+                        for j, (r, w) in enumerate(conns[i : i + 100])
+                    )
+                )
+            sent += len(conns)
+
+            # a quarter of the fleet parks a real fetch session, so
+            # the storm has per-connection protocol state to leak
+            n_fetch = n_clients // 4
+
+            async def establish(i: int, r, w) -> None:
+                nonlocal sessions_made
+                corr = 2_000_000 + i
+                body = await _rpc(
+                    r,
+                    w,
+                    _frame(FETCH, 11, corr, _fetch_body(i % N_PARTITIONS)),
+                    corr,
+                )
+                (err,) = struct.unpack_from(">h", body, 8)
+                (sid,) = struct.unpack_from(">i", body, 10)
+                assert err == 0 and sid > 0, f"session declined {err}/{sid}"
+                sessions_made += 1
+
+            fetch_conns = conns[:n_fetch]
+            for i in range(0, n_fetch, 100):
+                await asyncio.gather(
+                    *(
+                        establish(i + j, r, w)
+                        for j, (r, w) in enumerate(fetch_conns[i : i + 100])
+                    )
+                )
+
+            assert len(server.fetch_sessions) == n_fetch, (
+                len(server.fetch_sessions),
+                n_fetch,
+            )
+            assert len(server._conns) == n_clients
+
+            # the storm: every connection dies with an RST mid-state
+            for r, w in conns:
+                w.transport.abort()
+            await _settle(
+                lambda: len(server._conns) == 0, "storm teardown"
+            )
+            assert len(server.fetch_sessions) == 0
+            assert server.fetch_sessions.mem_bytes() == 0
+            assert server.quotas.live_state() == (0, 0, 0)
+            assert server._inflight == 0
+            # no orphaned read loops / writer fibers
+            await _settle(
+                lambda: len(asyncio.all_tasks()) <= task_base,
+                "task count",
+            )
+
+        assert acked == sent, f"lost acked produce: {acked}/{sent}"
+        assert sessions_made == rounds * (n_clients // 4)
+
+        # outside view: the admin scrape agrees nothing is open
+        if b.admin is not None:
+            text = await asyncio.to_thread(
+                lambda: urllib.request.urlopen(
+                    f"http://127.0.0.1:{b.admin.port}/metrics", timeout=10
+                )
+                .read()
+                .decode()
+            )
+            open_lines = [
+                ln
+                for ln in text.splitlines()
+                if ln.startswith("redpanda_tpu_kafka_connections_open")
+            ]
+            assert open_lines, "connection gauge missing from /metrics"
+            assert all(
+                float(ln.rsplit(None, 1)[1]) == 0.0 for ln in open_lines
+            ), open_lines
+    finally:
+        await b.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(
+        json.dumps(
+            {
+                "smoke": "traffic",
+                "clients": n_clients,
+                "rounds": rounds,
+                "acked": acked,
+                "fetch_sessions": sessions_made,
+                "framing": "python"
+                if os.environ.get("RP_NATIVE_FRAME") == "0"
+                else "native",
+            }
+        )
+    )
+    print("TRAFFIC-SMOKE-OK")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=1000)
+    ap.add_argument("--rounds", type=int, default=3)
+    args = ap.parse_args()
+    asyncio.run(main(args.clients, args.rounds))

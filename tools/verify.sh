@@ -104,6 +104,13 @@ env JAX_PLATFORMS=cpu RP_QUORUM_BACKEND=host python tools/mesh_smoke.py
 echo "== device-zstd archive smoke (upload + cold-read parity + stand-down) =="
 env JAX_PLATFORMS=cpu python tools/tiered_smoke.py --zstd
 
+echo "== front-end churn smoke (1k clients, RST storms, zero leaks) =="
+env JAX_PLATFORMS=cpu python tools/traffic_smoke.py
+
+echo "== front-end fallback smoke (RP_NATIVE_FRAME=0 pure-Python framing) =="
+env JAX_PLATFORMS=cpu RP_NATIVE_FRAME=0 python tools/traffic_smoke.py \
+    --clients 200 --rounds 2
+
 echo "== tracing-off smoke (RP_TRACE=0) =="
 env JAX_PLATFORMS=cpu RP_TRACE=0 python tools/scrape_smoke.py --fleet
 exec env JAX_PLATFORMS=cpu RP_TRACE=0 python -m pytest \
