@@ -119,6 +119,7 @@ class AdminServer(HttpServer):
         r("GET", r"/v1/metrics/history", self._metrics_history)
         r("GET", r"/v1/alerts", self._alerts)
         r("GET", r"/v1/debug/profile", self._debug_profile)
+        r("GET", r"/v1/devplane", self._devplane)
         # -- placement layer -------------------------------------------
         r("GET", r"/v1/placement", self._placement)
         r(
@@ -1602,6 +1603,34 @@ class AdminServer(HttpServer):
                 "recent": [],
             }
         return mgr.status()
+
+    async def _devplane(self, _m, q, _b):
+        """Device-plane flight data (observability/devplane.py): frame
+        dispatch->ready quantiles, cross-chip folds per frame (the
+        RPL018 runtime invariant), host<->device transfer bytes,
+        per-kernel latency, and warmup-vs-steady compile counts.
+        Sharded brokers merge every worker's devplane registry over
+        invoke_on — raw buckets on the wire, exact quantiles — unless
+        `fleet=0` asks for the local process only."""
+        from ..observability import devplane as _devplane
+
+        if not _devplane.ENABLED:
+            return {"enabled": False}
+        snaps = [_devplane.snapshot(0, self.broker.node_id)]
+        router = getattr(self.broker, "shard_router", None)
+        if router is not None and (q.get("fleet", "") or "") != "0":
+            from ..ssx.shards import InvokeError
+
+            for sid in router.worker_shards():
+                try:
+                    snaps.append(await router.obs_devplane(sid))
+                except InvokeError:
+                    self.broker.metrics.counter(
+                        "fleet_scrape_errors_total",
+                        "worker shard snapshots that failed during a "
+                        "fleet scrape",
+                    ).inc(shard=str(sid))
+        return _devplane.merged_status(snaps)
 
     # -- placement layer ----------------------------------------------
     async def _placement(self, _m, _q, _b):

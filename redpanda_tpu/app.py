@@ -265,9 +265,15 @@ class Broker:
         # and the always-on wall-stack profiler the alert auto-capture
         # snapshots from. Each piece has its own stand-down env knob.
         from .observability import alerts as _alerts
+        from .observability import devplane as _devplane
         from .observability import flightdata as _flightdata
         from .observability import profiler as _profiler
 
+        # device-plane flight data (observability/devplane.py): the
+        # process-global frame/kernel/compile families join this
+        # broker's registry BEFORE the history ring is built, so
+        # windowed devplane quantiles feed the alert rules below
+        _devplane.register(self.metrics)
         self.flightdata = _flightdata.MetricsHistory(self.metrics)
         self.profiler = _profiler.get_profiler()
         self.alerts = _alerts.AlertManager(
@@ -277,6 +283,7 @@ class Broker:
             profiler=self.profiler,
             registry=self.metrics,
         )
+        self.alerts.rules.extend(_devplane.alert_rules())
         self.shard_table = ShardTable()
         # (chip, row) → group residue resolution for the tick frame:
         # the table is the one map that survives live lane rebinds

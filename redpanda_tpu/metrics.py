@@ -310,6 +310,16 @@ class MetricsRegistry:
             self._metrics[full] = m
         return m
 
+    def adopt(self, other: "MetricsRegistry") -> None:
+        """Alias every family of `other` into this registry (shared
+        objects, not copies) so process-global planes — the devplane
+        registry is the one user: the device is process-global while
+        brokers are per-instance — ride this registry's scrape, fleet
+        snapshot, and flightdata ring. Names already present here win
+        (each registry keeps its own scrape_errors)."""
+        for name, m in other.families().items():
+            self._metrics.setdefault(name, m)
+
     def families(self) -> dict[str, object]:
         """name -> Counter | Gauge | Histogram, for the fleet snapshot."""
         return dict(self._metrics)

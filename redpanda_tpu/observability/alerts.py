@@ -86,7 +86,10 @@ def load_slo_profile(name: Optional[str] = None) -> dict:
 class AlertRule:
     """One live SLO clause. kind "quantile" judges a windowed
     histogram quantile; kind "gauge" judges the window max of a gauge
-    family."""
+    family; kind "counter" judges the in-window delta of a counter
+    family (reset-aware, so a crashed-and-reborn shard's restart does
+    not read as a burst) — threshold 0.0 means "any increment fires",
+    the shape the devplane invariants use."""
 
     __slots__ = (
         "name", "kind", "family", "labels", "q", "threshold", "unit",
@@ -256,6 +259,16 @@ class AlertManager:
             if w is None:
                 return {"value": 0.0, "count": 0}
             return {"value": w["value"], "count": w["count"]}
+        if rule.kind == "counter":
+            w = self.history.counter_window(
+                rule.family, window_s, rule.labels
+            )
+            if w is None:
+                return {"value": 0.0, "count": 0}
+            # count carries the number of matching label series so
+            # _breaches can tell "family absent/quiet" (no fire at
+            # threshold 0) from "a series moved"
+            return {"value": w["total_delta"], "count": len(w["series"])}
         w = self.history.gauge_window(rule.family, window_s, rule.labels)
         if w is None or not w["series"]:
             return {"value": 0.0, "count": 0}
@@ -267,7 +280,7 @@ class AlertManager:
     def _breaches(self, rule: AlertRule, obs: dict) -> bool:
         if rule.kind == "quantile" and obs["count"] < self.min_count:
             return False
-        if rule.kind == "gauge" and obs["count"] == 0:
+        if rule.kind in ("gauge", "counter") and obs["count"] == 0:
             return False
         return obs["value"] > rule.threshold
 

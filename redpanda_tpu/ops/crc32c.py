@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import devplane
 from ..utils import compileguard
 from ..utils.crc import _TABLE as _BYTE_TABLE
 from .shapes import row_bucket
@@ -235,7 +236,10 @@ def crc32c_device(data: jax.Array, lens: jax.Array) -> jax.Array:
     return fixed ^ jnp.uint32(0xFFFFFFFF)
 
 
-crc32c_device = compileguard.instrument(crc32c_device, "crc32c.device")
+crc32c_device = devplane.instrument(
+    compileguard.instrument(crc32c_device, "crc32c.device"),
+    "crc32c.device",
+)
 
 
 def crc32c_batch_device(bufs: np.ndarray, lens: np.ndarray) -> np.ndarray:

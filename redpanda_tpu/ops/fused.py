@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import devplane
 from ..utils import compileguard
 from .crc32c import crc32c_device
 from .cellparse import CELL
@@ -60,7 +61,9 @@ def _fused(data: jax.Array, body_len: jax.Array, n: int):
     return crc, out, out_len
 
 
-_fused = compileguard.instrument(_fused, "fused.crc_lz4")
+_fused = devplane.instrument(
+    compileguard.instrument(_fused, "fused.crc_lz4"), "fused.crc_lz4"
+)
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
@@ -77,7 +80,10 @@ def _fused_snappy(data: jax.Array, body_len: jax.Array, n: int):
     return crc, out, out_len
 
 
-_fused_snappy = compileguard.instrument(_fused_snappy, "fused.crc_snappy")
+_fused_snappy = devplane.instrument(
+    compileguard.instrument(_fused_snappy, "fused.crc_snappy"),
+    "fused.crc_snappy",
+)
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
@@ -96,7 +102,10 @@ def _fused_zstd(data: jax.Array, body_len: jax.Array, n: int):
     return crc, nbits, streams, bits
 
 
-_fused_zstd = compileguard.instrument(_fused_zstd, "fused.crc_zstd")
+_fused_zstd = devplane.instrument(
+    compileguard.instrument(_fused_zstd, "fused.crc_zstd"),
+    "fused.crc_zstd",
+)
 
 
 def crc_zstd_fused(

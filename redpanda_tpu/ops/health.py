@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.consensus_state import SELF_SLOT, GroupState
+from ..observability import devplane
 from ..utils import compileguard
 from . import quorum as q
 
@@ -115,9 +116,13 @@ def tick_frame_health(
     return state, hb, health
 
 
-health_reduce_jit = compileguard.instrument(
-    jax.jit(health_reduce), "health.reduce"
+health_reduce_jit = devplane.instrument(
+    compileguard.instrument(jax.jit(health_reduce), "health.reduce"),
+    "health.reduce",
 )
-tick_frame_health_jit = compileguard.instrument(
-    jax.jit(tick_frame_health, donate_argnums=0), "health.tick_frame"
+tick_frame_health_jit = devplane.instrument(
+    compileguard.instrument(
+        jax.jit(tick_frame_health, donate_argnums=0), "health.tick_frame"
+    ),
+    "health.tick_frame",
 )

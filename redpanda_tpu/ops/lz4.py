@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import devplane
 from ..utils import compileguard
 from .cellparse import CELL, cell_parse
 from .shapes import row_bucket
@@ -147,8 +148,9 @@ def _compress_chunks(data: jax.Array, valid: jax.Array, n: int):
     return jax.vmap(one)(data, valid)
 
 
-_compress_chunks = compileguard.instrument(
-    _compress_chunks, "lz4.compress_chunks"
+_compress_chunks = devplane.instrument(
+    compileguard.instrument(_compress_chunks, "lz4.compress_chunks"),
+    "lz4.compress_chunks",
 )
 
 

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.consensus_state import SELF_SLOT, GroupState
+from ..observability import devplane
 from ..utils import compileguard
 
 _I64_MIN = jnp.iinfo(jnp.int64).min
@@ -222,22 +223,37 @@ def local_append_update(
 # jitted entry points (donate state buffers: the sweep updates in
 # place); every binding registers with the compile guard so steady-
 # state recompiles are caught under RP_COMPILEGUARD=1
-quorum_commit_step_jit = compileguard.instrument(
-    jax.jit(quorum_commit_step, donate_argnums=0), "quorum.commit_step"
+quorum_commit_step_jit = devplane.instrument(
+    compileguard.instrument(
+        jax.jit(quorum_commit_step, donate_argnums=0), "quorum.commit_step"
+    ),
+    "quorum.commit_step",
 )
-follower_commit_step_jit = compileguard.instrument(
-    jax.jit(follower_commit_step, donate_argnums=0),
+follower_commit_step_jit = devplane.instrument(
+    compileguard.instrument(
+        jax.jit(follower_commit_step, donate_argnums=0),
+        "quorum.follower_commit_step",
+    ),
     "quorum.follower_commit_step",
 )
-fold_replies_jit = compileguard.instrument(
-    jax.jit(fold_replies, donate_argnums=0), "quorum.fold_replies"
+fold_replies_jit = devplane.instrument(
+    compileguard.instrument(
+        jax.jit(fold_replies, donate_argnums=0), "quorum.fold_replies"
+    ),
+    "quorum.fold_replies",
 )
-local_append_update_jit = compileguard.instrument(
-    jax.jit(local_append_update, donate_argnums=0),
+local_append_update_jit = devplane.instrument(
+    compileguard.instrument(
+        jax.jit(local_append_update, donate_argnums=0),
+        "quorum.local_append_update",
+    ),
     "quorum.local_append_update",
 )
-build_heartbeats_jit = compileguard.instrument(
-    jax.jit(build_heartbeats), "quorum.build_heartbeats"
+build_heartbeats_jit = devplane.instrument(
+    compileguard.instrument(
+        jax.jit(build_heartbeats), "quorum.build_heartbeats"
+    ),
+    "quorum.build_heartbeats",
 )
 
 
@@ -256,8 +272,11 @@ def heartbeat_tick(
     return quorum_commit_step(state)
 
 
-heartbeat_tick_jit = compileguard.instrument(
-    jax.jit(heartbeat_tick, donate_argnums=0), "quorum.heartbeat_tick"
+heartbeat_tick_jit = devplane.instrument(
+    compileguard.instrument(
+        jax.jit(heartbeat_tick, donate_argnums=0), "quorum.heartbeat_tick"
+    ),
+    "quorum.heartbeat_tick",
 )
 
 
@@ -284,6 +303,9 @@ def tick_frame(
     return state, build_heartbeats(state, hb_idx)
 
 
-tick_frame_jit = compileguard.instrument(
-    jax.jit(tick_frame, donate_argnums=0), "quorum.tick_frame"
+tick_frame_jit = devplane.instrument(
+    compileguard.instrument(
+        jax.jit(tick_frame, donate_argnums=0), "quorum.tick_frame"
+    ),
+    "quorum.tick_frame",
 )

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import devplane
 from ..utils import compileguard
 from .shapes import row_bucket
 
@@ -193,7 +194,10 @@ def _encode_chunks(data: jax.Array, valid: jax.Array, n: int):
     return jax.vmap(lambda d, v: _encode_one(d, v, n))(data, valid)
 
 
-_encode_chunks = compileguard.instrument(_encode_chunks, "zstd.encode_chunks")
+_encode_chunks = devplane.instrument(
+    compileguard.instrument(_encode_chunks, "zstd.encode_chunks"),
+    "zstd.encode_chunks",
+)
 
 
 def encode_chunks(
@@ -277,8 +281,9 @@ def _decode_streams(bufs, tbits, regen, tsym, tnb, sbytes: int, rmax: int):
     )(bufs, tbits, regen, tsym, tnb)
 
 
-_decode_streams = compileguard.instrument(
-    _decode_streams, "zstd.decode_streams"
+_decode_streams = devplane.instrument(
+    compileguard.instrument(_decode_streams, "zstd.decode_streams"),
+    "zstd.decode_streams",
 )
 
 

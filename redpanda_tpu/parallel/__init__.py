@@ -10,7 +10,7 @@ same axes map onto the TPU:
   `shard_map`, with DCN/host RPC as the cross-host fallback.
 """
 
-from .mesh import group_sharding, make_mesh, shard_group_state
+from .mesh import group_sharding, make_mesh, place_rows, shard_group_state
 from .cluster_step import (
     cluster_tick,
     cluster_tick_sharded,
@@ -22,6 +22,7 @@ from .cluster_step import (
 __all__ = [
     "group_sharding",
     "make_mesh",
+    "place_rows",
     "shard_group_state",
     "make_cluster_state",
     "cluster_tick",

@@ -34,9 +34,24 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.consensus_state import GroupState, make_group_state
+from ..observability import devplane
 from ..ops.quorum import quorum_commit_step
 from ..utils import compileguard
 from .mesh import SHARD_AXIS
+
+# jax.shard_map went public in newer releases; older jax ships it under
+# jax.experimental only.
+try:
+    _shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _axis_size(axis):
+    try:
+        return jax.lax.axis_size(axis)
+    except AttributeError:  # pragma: no cover - version-dependent
+        return jax.lax.psum(1, axis)
 
 RF = 3  # replication factor modeled by the ring placement
 
@@ -96,7 +111,7 @@ def cluster_tick(
     commit advanced and of stranded followers that installed the
     leader's snapshot boundary this round."""
     axis = SHARD_AXIS
-    n = jax.lax.axis_size(axis)
+    n = _axis_size(axis)
     leader = state.leader
 
     # 1. local append: self slot tracks the leader log (flush immediate
@@ -243,7 +258,7 @@ def election_round(
     if not (1 <= candidate_hop < RF):
         raise ValueError(f"candidate_hop must be in [1, {RF}): {candidate_hop}")
     axis = SHARD_AXIS
-    n = jax.lax.axis_size(axis)
+    n = _axis_size(axis)
     j = candidate_hop - 1
     leader = state.leader
     fol_term = state.fol_term
@@ -357,22 +372,28 @@ def election_round_sharded(mesh: Mesh, candidate_hop: int = 1):
     if not (1 <= candidate_hop < RF):
         raise ValueError(f"candidate_hop must be in [1, {RF}): {candidate_hop}")
     spec, state_specs = _cluster_specs(mesh)
-    fn = jax.shard_map(
+    fn = _shard_map(
         lambda s, m: election_round(s, m, candidate_hop),
         mesh=mesh,
         in_specs=(state_specs, spec),
         out_specs=(state_specs, spec, spec),
     )
-    return compileguard.instrument(jax.jit(fn), "cluster.election_round")
+    return devplane.instrument(
+        compileguard.instrument(jax.jit(fn), "cluster.election_round"),
+        "cluster.election_round",
+    )
 
 
 def cluster_tick_sharded(mesh: Mesh):
     """Build the jitted shard_map'd cluster step for `mesh`."""
     spec, state_specs = _cluster_specs(mesh)
-    fn = jax.shard_map(
+    fn = _shard_map(
         cluster_tick,
         mesh=mesh,
         in_specs=(state_specs, spec),
         out_specs=(state_specs, P(), P()),
     )
-    return compileguard.instrument(jax.jit(fn), "cluster.tick")
+    return devplane.instrument(
+        compileguard.instrument(jax.jit(fn), "cluster.tick"),
+        "cluster.tick",
+    )

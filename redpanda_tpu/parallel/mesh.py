@@ -31,3 +31,13 @@ def shard_group_state(state: GroupState, mesh: Mesh, axis: str = SHARD_AXIS) -> 
     (cluster/shard_table.h:26)."""
     sharding = group_sharding(mesh, axis)
     return jax.tree.map(lambda a: jax.device_put(a, sharding), state)
+
+
+def place_rows(a, mesh: Mesh, axis: str = SHARD_AXIS):
+    """Single-tensor form of shard_group_state: place one [G, ...]
+    lane (or a pytree of them) with the group axis split across the
+    mesh. Harness/tests placing inputs for a sharded tick use this so
+    the host→device transfer lives in the device-program layer, where
+    RPL018 expects it."""
+    sharding = group_sharding(mesh, axis)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), a)

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.consensus_state import GroupState
+from ..observability import devplane
 from ..ops import quorum as q
 from ..ops.health import health_reduce
 from ..utils import compileguard
@@ -137,11 +138,17 @@ class MeshFrame:
         self.mesh = make_mesh(n)
         self.n_devices = n
         self._sharding = group_sharding(self.mesh)
-        self._frame = compileguard.instrument(
-            jax.jit(mesh_tick_frame), "mesh_frame.tick_frame"
+        self._frame = devplane.instrument(
+            compileguard.instrument(
+                jax.jit(mesh_tick_frame), "mesh_frame.tick_frame"
+            ),
+            "mesh_frame.tick_frame",
         )
-        self._health = compileguard.instrument(
-            jax.jit(mesh_health), "mesh_frame.health"
+        self._health = devplane.instrument(
+            compileguard.instrument(
+                jax.jit(mesh_health), "mesh_frame.health"
+            ),
+            "mesh_frame.health",
         )
 
     def _place(self, a: np.ndarray) -> jax.Array:
@@ -153,6 +160,7 @@ class MeshFrame:
             a = np.concatenate(
                 [a, np.zeros((pad,) + a.shape[1:], a.dtype)]
             )
+        devplane.count_transfer(a.nbytes, "h2d")
         return jax.device_put(np.ascontiguousarray(a), self._sharding)
 
     def place_state(self, arrays) -> GroupState:
@@ -185,46 +193,73 @@ class MeshFrame:
         numpy (state lanes, health lanes) sliced back to capacity, and
         the fleet totals as python ints."""
         cap = arrays.capacity
-        state = self.place_state(arrays)
-        new, health, totals = self._frame(
-            state,
-            jnp.asarray(g_rows),
-            jnp.asarray(g_slots),
-            jnp.asarray(g_dirty),
-            jnp.asarray(g_flushed),
-            jnp.asarray(g_seqs),
-            self._place(arrays.leader_id >= 0),
-            self._place(arrays.row_active),
-        )
-        out = {
-            "commit_index": np.array(new.commit_index)[:cap],
-            "last_visible": np.array(new.last_visible)[:cap],
-            "match_index": np.array(new.match_index)[:cap],
-            "flushed_index": np.array(new.flushed_index)[:cap],
-            "last_seq": np.array(new.last_seq)[:cap],
-        }
-        health_np = {
-            "max_lag": np.array(health["max_lag"])[:cap],
-            "under_replicated": np.array(health["under_replicated"])[:cap],
-            "leaderless": np.array(health["leaderless"])[:cap],
-        }
+        with devplane.frame_scope("tick"):
+            state = self.place_state(arrays)
+            if devplane.ENABLED:
+                devplane.count_transfer(
+                    g_rows.nbytes + g_slots.nbytes + g_dirty.nbytes
+                    + g_flushed.nbytes + g_seqs.nbytes,
+                    "h2d",
+                )
+                # the totals reduction inside the compiled frame is the
+                # frame's single cross-chip fold (RPL018 invariant)
+                devplane.count_fold()
+            new, health, totals = self._frame(
+                state,
+                jnp.asarray(g_rows),
+                jnp.asarray(g_slots),
+                jnp.asarray(g_dirty),
+                jnp.asarray(g_flushed),
+                jnp.asarray(g_seqs),
+                self._place(arrays.leader_id >= 0),
+                self._place(arrays.row_active),
+            )
+            out = {
+                "commit_index": np.array(new.commit_index),
+                "last_visible": np.array(new.last_visible),
+                "match_index": np.array(new.match_index),
+                "flushed_index": np.array(new.flushed_index),
+                "last_seq": np.array(new.last_seq),
+            }
+            health_np = {
+                "max_lag": np.array(health["max_lag"]),
+                "under_replicated": np.array(health["under_replicated"]),
+                "leaderless": np.array(health["leaderless"]),
+            }
+            if devplane.ENABLED:
+                devplane.count_transfer(
+                    sum(a.nbytes for a in out.values())
+                    + sum(a.nbytes for a in health_np.values()),
+                    "d2h",
+                )
+        out = {k: a[:cap] for k, a in out.items()}
+        health_np = {k: a[:cap] for k, a in health_np.items()}
         return out, health_np, {k: int(v) for k, v in totals.items()}
 
     def run_health(self, arrays) -> tuple[dict, dict]:
         """Health-only refresh through the mesh (the read path)."""
         cap = arrays.capacity
-        health, totals = self._health(
-            self._place(arrays.match_index),
-            self._place(arrays.commit_index),
-            self._place(arrays.is_voter),
-            self._place(arrays.is_voter_old),
-            self._place(arrays.is_leader),
-            self._place(arrays.leader_id >= 0),
-            self._place(arrays.row_active),
-        )
-        health_np = {
-            "max_lag": np.array(health["max_lag"])[:cap],
-            "under_replicated": np.array(health["under_replicated"])[:cap],
-            "leaderless": np.array(health["leaderless"])[:cap],
-        }
+        with devplane.frame_scope("health"):
+            if devplane.ENABLED:
+                # same one-cross-chip-fold discipline as the tick frame
+                devplane.count_fold()
+            health, totals = self._health(
+                self._place(arrays.match_index),
+                self._place(arrays.commit_index),
+                self._place(arrays.is_voter),
+                self._place(arrays.is_voter_old),
+                self._place(arrays.is_leader),
+                self._place(arrays.leader_id >= 0),
+                self._place(arrays.row_active),
+            )
+            health_np = {
+                "max_lag": np.array(health["max_lag"]),
+                "under_replicated": np.array(health["under_replicated"]),
+                "leaderless": np.array(health["leaderless"]),
+            }
+            if devplane.ENABLED:
+                devplane.count_transfer(
+                    sum(a.nbytes for a in health_np.values()), "d2h"
+                )
+        health_np = {k: a[:cap] for k, a in health_np.items()}
         return health_np, {k: int(v) for k, v in totals.items()}

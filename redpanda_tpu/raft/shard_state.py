@@ -645,15 +645,31 @@ class ShardGroupArrays:
         sweep — chip-local BY CONSTRUCTION, since every changed row
         lives in exactly one chip block and the fold never mixes rows —
         while big/forced windows run the real sharded mesh program
-        (one device dispatch, one cross-chip totals fold)."""
+        (one device dispatch, one cross-chip totals fold). Under
+        RP_DEVPLANE=1 the whole tick runs inside devplane.tick_scope:
+        any device dispatch or transfer outside the full frame's
+        frame_scope is counted as an RPL018 runtime breach."""
         import os
+
+        from ..observability import devplane
 
         full = (
             os.environ.get("RP_MESH_FULL", "0") == "1"
             or len(group_rows) >= self.MESH_FULL_THRESHOLD
         )
-        if not full:
-            advanced = self.host_tick(
+        with devplane.tick_scope():
+            if not full:
+                advanced = self.host_tick(
+                    group_rows,
+                    replica_slots,
+                    last_dirty,
+                    last_flushed,
+                    seqs,
+                    force_rows=force_rows,
+                )
+                self._note_chip_changed(self._last_changed)
+                return advanced
+            return self._mesh_full_frame(
                 group_rows,
                 replica_slots,
                 last_dirty,
@@ -661,16 +677,6 @@ class ShardGroupArrays:
                 seqs,
                 force_rows=force_rows,
             )
-            self._note_chip_changed(self._last_changed)
-            return advanced
-        return self._mesh_full_frame(
-            group_rows,
-            replica_slots,
-            last_dirty,
-            last_flushed,
-            seqs,
-            force_rows=force_rows,
-        )
 
     def _mesh_full_frame(
         self,

@@ -428,9 +428,15 @@ class PartitionShard:
         # flight-data plane, per worker shard: this shard's own history
         # ring + profiler view, served to shard 0 over the obs service
         # ("history"/"profile") the same way metrics/traces/health are
+        from ..observability import devplane as _devplane
         from ..observability import flightdata as _flightdata
         from ..observability import profiler as _profiler
 
+        # device-plane families join this worker's registry (adopted
+        # before the ring is built so they ride its windows); the
+        # dedicated "devplane" obs method additionally serves the raw
+        # process-global registry for /v1/devplane's exact merge
+        _devplane.register(self.metrics)
         self.flightdata = _flightdata.MetricsHistory(self.metrics)
         self.profiler = _profiler.get_profiler()
 
@@ -586,6 +592,12 @@ class PartitionShard:
                 self.profiler,
                 self.ctx.shard_id,
                 _prof.ProfileQuery.decode(payload),
+            ).encode()
+        if method == "devplane":
+            from ..observability import devplane as _devplane
+
+            return _devplane.snapshot(
+                self.ctx.shard_id, self._config.node_id
             ).encode()
         raise LookupError(f"obs: no such method {method!r}")
 
@@ -972,6 +984,14 @@ class ShardRouter:
             shard, "obs", "profile", query.encode(), timeout=10.0
         )
         return _prof.ProfileReply.decode(raw)
+
+    async def obs_devplane(self, shard: int) -> fleet.RegistrySnapshot:
+        """One worker shard's devplane registry snapshot (raw buckets
+        on the wire so the /v1/devplane quantile merge stays exact)."""
+        raw = await self._rt.invoke_on(
+            shard, "obs", "devplane", b"", timeout=10.0
+        )
+        return fleet.RegistrySnapshot.decode(raw)
 
     def worker_shards(self) -> list[int]:
         """The LIVE worker shard ids — not a dense range once shards

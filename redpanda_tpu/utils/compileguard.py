@@ -62,6 +62,12 @@ _CURRENT: list[str] = []
 #: innermost instrumented frame
 _BACKEND_COMPILES: dict[str, int] = {}
 
+#: compile-event subscribers: cb(kernel, seconds, phase) invoked on
+#: every attributed XLA backend compile (devplane promotes these to
+#: first-class metrics); subscribing arms the monitoring listener even
+#: with the guard itself off
+_SUBSCRIBERS: list = []
+
 _PHASE = "warmup"  # "warmup" until steady(); warmup() re-enters
 _WARMUP_DEPTH = 0
 _LISTENER_ON = False
@@ -114,6 +120,13 @@ def in_steady() -> bool:
     return _PHASE == "steady" and _WARMUP_DEPTH == 0
 
 
+def phase() -> str:
+    """The current compile-accounting phase label: "steady" once
+    steady() was called and no warmup() region is open, else
+    "warmup" — the static label set for per-phase compile metrics."""
+    return "steady" if in_steady() else "warmup"
+
+
 @contextmanager
 def warmup(reason: str):
     """Declare a bounded region where compiles are expected — capacity
@@ -142,15 +155,46 @@ def compile_counts() -> dict[str, int]:
 
 
 def backend_compiles() -> dict[str, int]:
-    """Corroborating XLA backend-compile counts per kernel (guard-on
-    only; empty when disabled)."""
+    """Corroborating XLA backend-compile counts per kernel (empty
+    until something arms the listener: the guard itself, or a
+    subscribe_compiles() consumer like devplane)."""
     return dict(_BACKEND_COMPILES)
 
 
-def _listener(name: str, _secs: float, **_kw) -> None:
+def subscribe_compiles(cb) -> None:
+    """Register `cb(kernel, seconds, phase)` for every XLA backend
+    compile attributed to an instrumented kernel. Arms the
+    jax.monitoring listener even with the guard off, so a consumer
+    (devplane) gets compile events in the default configuration; the
+    attribution stack is then fed by that consumer's own wrappers via
+    push_kernel/pop_kernel."""
+    _SUBSCRIBERS.append(cb)
+    _ensure_listener()
+
+
+def push_kernel(name: str) -> None:
+    """Enter kernel `name` on the compile-attribution stack (the thing
+    _Guard does implicitly when the guard is on). Wrappers that exist
+    with the guard off — devplane probes — push/pop around dispatch so
+    backend compiles still attribute to the innermost kernel."""
+    _CURRENT.append(name)
+
+
+def pop_kernel() -> None:
+    _CURRENT.pop()
+
+
+def _listener(name: str, secs: float, **_kw) -> None:
     if name == _COMPILE_EVENT and _CURRENT:
         k = _CURRENT[-1]
         _BACKEND_COMPILES[k] = _BACKEND_COMPILES.get(k, 0) + 1
+        if _SUBSCRIBERS:
+            ph = phase()
+            for cb in _SUBSCRIBERS:
+                try:
+                    cb(k, secs, ph)
+                except Exception:  # a broken subscriber must not
+                    pass           # poison the XLA compile path
 
 
 def _ensure_listener() -> None:

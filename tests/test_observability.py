@@ -12,6 +12,7 @@ directly and end-to-end under an injected NemesisNet delay.
 import asyncio
 import contextlib
 import json
+import os
 import re
 
 import pytest
@@ -717,3 +718,100 @@ def test_cloud_probe_families_move_under_tiered_load(tmp_path):
             await b.stop()
 
     asyncio.run(main())
+
+
+# -- fork hygiene (PR-17 shard re-fork seam) ---------------------------
+#
+# spawn_shard (and the per-shard crash-restart respawn) forks the
+# broker process; the span-id counter and the module-default recorder
+# are copied by fork, so without _after_fork_child a child's stitched
+# spans could collide with the parent's ids and its /v1/debug/traces
+# would serve the parent's inherited trees as its own. The hook is
+# registered via os.register_at_fork, so any fork — multiprocessing
+# included — must come up clean.
+
+
+def _fork_probe(q):
+    r = trace._default_recorder
+    inherited = {
+        "trees_total": r.trees_total,
+        "frozen": len(r._frozen),
+        "ring": sum(1 for t in r._ring if t is not None),
+        "events": len(r._events),
+    }
+    ids = []
+    for _ in range(3):
+        with span("child.work") as s:
+            ids.append(s.span_id)
+    q.put(
+        {
+            "pid": os.getpid(),
+            "inherited": inherited,
+            "ids": ids,
+            "trees_after": r.trees_total,
+        }
+    )
+
+
+@needs_trace
+def test_fork_child_drops_inherited_trees_and_reseeds_ids():
+    import multiprocessing as mp
+
+    if not hasattr(os, "register_at_fork"):
+        pytest.skip("platform without register_at_fork")
+    with span("parent.seed"):
+        pass
+    with span("parent.marker") as s:
+        parent_id = s.span_id
+    assert trace._default_recorder.trees_total >= 2
+
+    ctx = mp.get_context("fork")
+    q = ctx.SimpleQueue()
+    p = ctx.Process(target=_fork_probe, args=(q,))
+    p.start()
+    out = q.get()
+    p.join(10)
+    assert p.exitcode == 0
+
+    # the child saw NONE of the parent's trees/events at startup
+    assert out["inherited"] == {
+        "trees_total": 0, "frozen": 0, "ring": 0, "events": 0,
+    }
+    # ...but its own recorder works: 3 fresh root trees recorded
+    assert out["trees_after"] == 3
+    # ids reseeded into the pid-disjoint range: (pid & 0x3FFFFF) << 40
+    base = (out["pid"] & 0x3FFFFF) << 40
+    for sid in out["ids"]:
+        assert base < sid < base + (1 << 40), (hex(sid), hex(base))
+    # and therefore cannot collide with the parent's counter
+    assert parent_id not in out["ids"]
+
+
+@needs_trace
+def test_refork_children_span_ids_pairwise_disjoint():
+    """Two successive forks (the crash-restart respawn shape): each
+    child's id space is keyed on its OWN pid, so stitched trees
+    collected from parent + both generations never collide."""
+    import multiprocessing as mp
+
+    if not hasattr(os, "register_at_fork"):
+        pytest.skip("platform without register_at_fork")
+    ctx = mp.get_context("fork")
+    outs = []
+    for _ in range(2):  # second fork = the respawned shard
+        q = ctx.SimpleQueue()
+        p = ctx.Process(target=_fork_probe, args=(q,))
+        p.start()
+        outs.append(q.get())
+        p.join(10)
+        assert p.exitcode == 0
+    with span("parent.after") as s:
+        parent_id = s.span_id
+
+    a, b = (set(o["ids"]) for o in outs)
+    assert outs[0]["pid"] != outs[1]["pid"]
+    assert not a & b, "re-forked shard reused span ids"
+    assert parent_id not in a | b
+    # the parent counter stays in the low range (seeded at 1), the
+    # children in their pid-shifted ranges — three disjoint id planes
+    assert parent_id < (1 << 40)

@@ -267,19 +267,17 @@ class TestClusterStep:
             cluster_tick_sharded,
             make_cluster_state,
             make_mesh,
+            place_rows,
             shard_group_state,
         )
-        from redpanda_tpu.parallel.mesh import group_sharding
 
         n_dev = len(jax.devices())
         assert n_dev == 8, "conftest must provide 8 virtual devices"
         mesh = make_mesh(8)
         g = 64  # 8 groups per device
-        state = make_cluster_state(g)
-        sharding = group_sharding(mesh)
-        state = jax.tree.map(lambda a: jax.device_put(a, sharding), state)
+        state = shard_group_state(make_cluster_state(g), mesh)
         tick = cluster_tick_sharded(mesh)
-        new_dirty = jax.device_put(jnp.full(g, 5, jnp.int64), sharding)
+        new_dirty = place_rows(jnp.full(g, 5, jnp.int64), mesh)
         state, total, _inst = tick(state, new_dirty)
         # after one round every leader has both follower acks at 5 and
         # its own flush at 5 → all 64 groups commit
@@ -290,7 +288,7 @@ class TestClusterStep:
         assert np.all(np.asarray(state.fol_commit) == -1)
         # second tick with no new appends: no further leader advancement,
         # but followers learn the commit index
-        zero = jax.device_put(jnp.full(g, -1, jnp.int64), sharding)
+        zero = place_rows(jnp.full(g, -1, jnp.int64), mesh)
         state, total2, _inst = tick(state, zero)
         assert int(total2) == 0
         assert np.all(np.asarray(state.fol_commit) == 5)

@@ -122,13 +122,15 @@ def _static_argnums(call: ast.Call) -> list:
 
 
 def _unwrap_instrument(expr: ast.AST) -> ast.AST:
-    """`compileguard.instrument(<jit expr>, "name")` -> `<jit expr>`."""
-    if (
+    """`compileguard.instrument(<jit expr>, "name")` -> `<jit expr>`.
+    Strips every instrument layer — devplane.instrument stacks on top
+    of compileguard.instrument at the kernel sites."""
+    while (
         isinstance(expr, ast.Call)
         and dotted_name(expr.func).rsplit(".", 1)[-1] == "instrument"
         and expr.args
     ):
-        return expr.args[0]
+        expr = expr.args[0]
     return expr
 
 

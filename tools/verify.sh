@@ -18,6 +18,9 @@ python -m tools.rplint --rules RPL015,RPL016 redpanda_tpu tools tests
 echo "== rplint compile discipline (RPL020/021 device plane, empty by construction) =="
 python -m tools.rplint --rules RPL020,RPL021 redpanda_tpu
 
+echo "== rplint transfer discipline (RPL018 whole-program incl. tests, empty by construction) =="
+python -m tools.rplint --rules RPL018 redpanda_tpu tools tests
+
 echo "== native build =="
 if make -s -C native; then
     echo "built native/build/libredpanda_native.so"
@@ -100,6 +103,14 @@ env JAX_PLATFORMS=cpu \
 
 echo "== mesh stand-down smoke (RP_QUORUM_BACKEND=host) =="
 env JAX_PLATFORMS=cpu RP_QUORUM_BACKEND=host python tools/mesh_smoke.py
+
+echo "== device-plane smoke (RP_DEVPLANE=1, folds==frames + kernel histograms) =="
+env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    RP_DEVPLANE=1 python tools/scrape_smoke.py --devplane
+
+echo "== device-plane stand-down smoke (RP_DEVPLANE unset, instrument is identity) =="
+env JAX_PLATFORMS=cpu python tools/scrape_smoke.py --devplane
 
 echo "== device-zstd archive smoke (upload + cold-read parity + stand-down) =="
 env JAX_PLATFORMS=cpu python tools/tiered_smoke.py --zstd
