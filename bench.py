@@ -3608,6 +3608,235 @@ def bench_omb() -> dict:
     return asyncio.run(_omb_async())
 
 
+# ------------------------------------------------- zero-copy fetch plane
+async def _consume_async() -> dict:
+    """Consume-side bench for the zero-copy fetch plane (three legs):
+
+      hot-tail replay  — replay the last tail window against the fetch
+                         serving seam (kafka.server.read_fetch_rows on
+                         the live leader partitions): wire plane serves
+                         cached spans with an 8-byte base-offset patch,
+                         the RP_FETCH_WIRE=0 stand-down decodes and
+                         re-frames. This is the plane the A/B isolates —
+                         over a TCP client the read path is ~15% of the
+                         per-byte cost on this 1-core box and the paths
+                         are indistinguishable inside run noise.
+      cold scan        — same seam, both cache planes + positioned
+                         readers dropped before each pass: one
+                         sequential sweep driven by Segment.read_spans
+                         disk windows
+      mixed fan-out    — whole-stack context: concurrent TCP consumers
+                         alternating tail replay with random-offset
+                         forward scans
+
+    A/B: run once natively and once under RP_FETCH_WIRE=0 — same-day
+    pairs recorded in bench_profiles/ATTRIBUTION.md."""
+    import random as _random
+
+    from redpanda_tpu.app import Broker, BrokerConfig
+    from redpanda_tpu.kafka.client import KafkaClient
+    from redpanda_tpu.kafka.server import fetch_wire_enabled, read_fetch_rows
+    from redpanda_tpu.models.fundamental import kafka_ntp
+    from redpanda_tpu.models.record import RecordBatchBuilder
+    from redpanda_tpu.rpc.loopback import LoopbackNetwork
+
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = tempfile.mkdtemp(prefix="rp_consume_", dir=shm)
+    n_partitions = 2
+    batch_records = 128
+    record_bytes = 1024
+    batches_per_partition = 96  # ~12.6 MB of wire per partition
+    hot_window_batches = 16  # tail window the hot leg replays
+    fanout_consumers = 6
+    hot_s = 2.5
+    fan_s = 2.5
+    cold_passes = 3
+
+    b = Broker(
+        BrokerConfig(
+            node_id=0,
+            data_dir=tmp,
+            members=[0],
+            enable_admin=False,
+            node_status_interval_s=0,
+            housekeeping_interval_s=0,
+        ),
+        loopback=LoopbackNetwork(),
+    )
+    await b.start()
+    b.config.peer_kafka_addresses = {0: b.kafka_advertised}
+    boot = None
+    try:
+        await b.wait_controller_leader()
+        boot = KafkaClient([b.kafka_advertised])
+        await boot.create_topic(
+            "bench", partitions=n_partitions, replication_factor=1
+        )
+        payload = os.urandom(record_bytes - 16)
+        builder = RecordBatchBuilder()
+        for i in range(batch_records):
+            builder.add(payload, key=b"k%012d" % i)
+        wire = builder.build().to_kafka_wire()
+        ends = [0] * n_partitions
+        for pid in range(n_partitions):
+            for _ in range(batches_per_partition):
+                base = await boot.produce_wire("bench", pid, wire)
+                ends[pid] = base + batch_records
+
+        def drop_read_caches() -> None:
+            # cold leg: force the next reads to disk (both batch-cache
+            # planes plus the positioned-reader hints)
+            for log in b.storage.log_mgr.logs().values():
+                if log._cache_index is not None:
+                    log._cache_index.truncate(0)
+                log.invalidate_readers()
+
+        partitions = [
+            b.partition_manager.get(kafka_ntp("bench", pid))
+            for pid in range(n_partitions)
+        ]
+        assert all(p is not None for p in partitions)
+
+        def serve_scan(pid: int, start: int, end: int, lat: list) -> int:
+            """Drive the fetch serving seam directly (what a fetch
+            request executes inside read_all, minus the shared protocol
+            encode + socket copies both paths pay identically)."""
+            nbytes = 0
+            pos = start
+            while pos < end:
+                t0 = time.perf_counter()
+                wire, fetch_end = read_fetch_rows(
+                    partitions[pid], pos, 4 << 20, None
+                )
+                lat.append((time.perf_counter() - t0) * 1e3)
+                if fetch_end is None:
+                    break
+                nbytes += len(wire)
+                pos = fetch_end
+            return nbytes
+
+        # leg 1: hot-tail replay (serve plane, cache-hot)
+        hot_starts = [
+            max(0, ends[pid] - hot_window_batches * batch_records)
+            for pid in range(n_partitions)
+        ]
+        hot_lat: list[float] = []
+        hot_bytes = 0
+        # warm the window into cache before the clock starts
+        for pid in range(n_partitions):
+            serve_scan(pid, hot_starts[pid], ends[pid], [])
+        t0 = time.perf_counter()
+        t_end = t0 + hot_s
+        while time.perf_counter() < t_end:
+            for pid in range(n_partitions):
+                hot_bytes += serve_scan(
+                    pid, hot_starts[pid], ends[pid], hot_lat
+                )
+            await asyncio.sleep(0)  # keep broker background tasks live
+        hot_mbps = hot_bytes / (time.perf_counter() - t0) / 1e6
+
+        # leg 2: cold sequential scan (serve plane, disk windows)
+        cold_bytes = 0
+        cold_lat: list[float] = []
+        t0 = time.perf_counter()
+        for _ in range(cold_passes):
+            drop_read_caches()
+            for pid in range(n_partitions):
+                cold_bytes += serve_scan(pid, 0, ends[pid], cold_lat)
+            await asyncio.sleep(0)
+        cold_mbps = cold_bytes / (time.perf_counter() - t0) / 1e6
+
+        # leg 3: mixed fan-out
+        rnd = _random.Random(20)
+        fan_lat: list[float] = []
+        fan_bytes = 0
+
+        async def consumer(idx: int) -> None:
+            nonlocal fan_bytes
+            client = KafkaClient([b.kafka_advertised])
+            try:
+                while time.perf_counter() < fan_end:
+                    pid = rnd.randrange(n_partitions)
+                    if idx % 2 == 0:  # tail replayer
+                        start = hot_starts[pid]
+                        stop = ends[pid]
+                    else:  # random-offset scanner, bounded window
+                        start = rnd.randrange(max(1, ends[pid]))
+                        stop = min(
+                            ends[pid], start + 8 * batch_records
+                        )
+                    pos = start
+                    while pos < stop:
+                        t0 = time.perf_counter()
+                        chunk, nxt = await client.fetch_raw(
+                            "bench", pid, pos, max_bytes=1 << 20
+                        )
+                        fan_lat.append((time.perf_counter() - t0) * 1e3)
+                        if nxt == pos:
+                            break
+                        fan_bytes += len(chunk)
+                        pos = nxt
+            finally:
+                await client.close()
+
+        t0 = time.perf_counter()
+        fan_end = t0 + fan_s
+        await asyncio.gather(
+            *(consumer(i) for i in range(fanout_consumers))
+        )
+        fan_mbps = fan_bytes / (time.perf_counter() - t0) / 1e6
+
+        cache = b.storage.cache
+        return {
+            "metric": "fetch_hot_tail_mbps",
+            "value": round(hot_mbps, 1),
+            "unit": "mbps",
+            "wire_plane": fetch_wire_enabled(),
+            "fetch_hot_tail_p99": {
+                "metric": "fetch_hot_tail_p99_ms",
+                "value": round(float(np.percentile(hot_lat, 99)), 3),
+                "unit": "ms",
+            },
+            "fetch_cold_scan": {
+                "metric": "fetch_cold_scan_mbps",
+                "value": round(cold_mbps, 1),
+                "unit": "mbps",
+            },
+            "fetch_fanout": {
+                "metric": "fetch_fanout_mbps",
+                "value": round(fan_mbps, 1),
+                "unit": "mbps",
+            },
+            "fetch_fanout_p99": {
+                "metric": "fetch_fanout_p99_ms",
+                "value": round(float(np.percentile(fan_lat, 99)), 3),
+                "unit": "ms",
+            },
+            "hot_fetches": len(hot_lat),
+            "fan_fetches": len(fan_lat),
+            "wire_cache_hits": cache.wire_hits,
+            "wire_cache_misses": cache.wire_misses,
+            "decoded_cache_hits": cache.hits,
+            "decoded_cache_misses": cache.misses,
+            "cores": os.cpu_count(),
+        }
+    finally:
+        if boot is not None:
+            try:
+                await boot.close()
+            except Exception:
+                pass
+        try:
+            await b.stop()
+        except Exception:
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_consume() -> dict:
+    return asyncio.run(_consume_async())
+
+
 BENCHES = {
     "quorum": bench_quorum,
     "live_tick": bench_live_tick,
@@ -3624,6 +3853,7 @@ BENCHES = {
     "devplane": bench_devplane,
     "replicated_mp": bench_replicated_mp,
     "omb": bench_omb,
+    "consume": bench_consume,
     "slo": bench_slo,
     "traffic": bench_traffic,
     "tiered": bench_tiered,

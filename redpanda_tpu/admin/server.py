@@ -271,7 +271,10 @@ class AdminServer(HttpServer):
         from ..observability import health as _health
 
         local = _health.build_report(
-            self.broker.group_manager, self.broker.load_ledger, top_k=top_k
+            self.broker.group_manager,
+            self.broker.load_ledger,
+            top_k=top_k,
+            storage=getattr(self.broker, "storage", None),
         )
         for row in local["top_laggy"]:
             row["shard"] = 0

@@ -14,7 +14,12 @@ import logging
 import time
 
 from ..models.fundamental import NTP
-from ..models.record import RecordBatch, RecordBatchBuilder, RecordBatchType
+from ..models.record import (
+    RecordBatch,
+    RecordBatchBuilder,
+    RecordBatchType,
+    WireSpan,
+)
 from ..raft.consensus import Consensus, NotLeaderError  # noqa: F401 (re-export)
 from ..raft.offset_translator import OffsetTranslator
 from ..raft.replicate_batcher import ReplicateStages, consume_exc
@@ -683,6 +688,48 @@ class Partition:
                     return out
                 out.append((kbase, b))
                 consumed += b.size_bytes()
+                if consumed >= max_bytes:
+                    break
+        return out
+
+    def read_kafka_wire(
+        self,
+        kafka_offset: int,
+        max_bytes: int = 1 << 20,
+        upto_kafka: int | None = None,
+    ) -> list[tuple[int, WireSpan]]:
+        """Zero-copy twin of read_kafka: committed data batches from
+        kafka_offset as (kafka_base_offset, WireSpan) pairs. Rows come
+        out of the wire plane already in Kafka wire form; framing a
+        fetch response is an 8-byte base-offset patch per span
+        (WireSpan.patch_base — CRC-safe per the read_kafka contract),
+        never a decode or re-encode. Batch-type filtering is done on
+        the header peek the span walk recorded; bounds/budget semantics
+        are identical to read_kafka so both paths return the same batch
+        set for any (offset, max_bytes, upto_kafka)."""
+        hw = self.high_watermark()
+        bound = hw if upto_kafka is None else min(hw, upto_kafka)
+        if kafka_offset >= bound:
+            return []
+        raft_pos = self.translator.from_kafka(kafka_offset)
+        commit = self.consensus.commit_index
+        out: list[tuple[int, WireSpan]] = []
+        consumed = 0
+        while raft_pos <= commit and consumed < max_bytes:
+            rows = self.log.read_wire(
+                raft_pos, max_bytes=max_bytes - consumed, upto=commit
+            )
+            if not rows:
+                break
+            for row in rows:
+                raft_pos = row.last_offset + 1
+                if row.batch_type != int(RecordBatchType.raft_data):
+                    continue
+                kbase = self.translator.to_kafka(row.base_offset)
+                if kbase >= bound:
+                    return out
+                out.append((kbase, row))
+                consumed += row.size_bytes()
                 if consumed >= max_bytes:
                     break
         return out

@@ -284,19 +284,25 @@ class Writer:
         else:
             self.write_compact_bytes(v)
 
-    def write_records(self, v: bytes | memoryview | None, flexible: bool) -> None:
+    def write_records(
+        self, v: bytes | bytearray | memoryview | None, flexible: bool
+    ) -> None:
+        # appended WITHOUT normalizing to bytes: records is the one
+        # MB-scale field, the fetch plane hands a freshly-built buffer
+        # it never mutates, and the final join accepts any bytes-like —
+        # normalizing here would re-copy every fetched byte
         if flexible:
             if v is None:
                 self.write_uvarint(0)
             else:
                 self.write_uvarint(len(v) + 1)
-                self._parts.append(bytes(v))
+                self._parts.append(v)
         else:
             if v is None:
                 self.write_int32(-1)
             else:
                 self.write_int32(len(v))
-                self._parts.append(bytes(v))
+                self._parts.append(v)
 
     def write_array_len(self, n: int, flexible: bool) -> None:
         if flexible:

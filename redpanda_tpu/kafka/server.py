@@ -25,6 +25,7 @@ import asyncio
 import contextvars
 import dataclasses
 import logging
+import os
 import struct
 import time
 from typing import TYPE_CHECKING
@@ -36,7 +37,12 @@ from ..cluster.producer_state import (
 )
 from ..models.fundamental import NTP, DEFAULT_NS, TopicNamespace, kafka_ntp
 from ..compression import CompressionType
-from ..models.record import CrcMismatch, RecordBatch
+from ..models.record import (
+    CrcMismatch,
+    RecordBatch,
+    pack_wire_base,
+    wire_crc_payloads,
+)
 from ..observability import trace
 from ..raft.consensus import NotLeaderError, ReplicateTimeout
 from ..security.acl import AclOperation, AclResourceType
@@ -492,7 +498,11 @@ class KafkaServer:
                         pass
                     raise
                 if resp is not None:
-                    writer.write(_SIZE.pack(len(resp)) + resp)
+                    # two writes, not a size+body concat: a MB-scale
+                    # fetch response would pay a full extra copy just
+                    # to prepend 4 bytes
+                    writer.write(_SIZE.pack(len(resp)))
+                    writer.write(resp)
                     await writer.drain()
                 settle()
                 if on_written is not None:
@@ -1837,15 +1847,13 @@ class KafkaServer:
                             )
                         )
                         continue
-                    pairs = partition.read_kafka(
+                    wire, fetch_end = read_fetch_rows(
+                        partition,
                         p.fetch_offset,
                         max_bytes=min(p.partition_max_bytes, budget - total)
                         if budget - total > 0
                         else 0,
                         upto_kafka=lso if read_committed else None,
-                    )
-                    wire = b"".join(
-                        _frame_kafka(batch, kbase) for kbase, batch in pairs
                     )
                     total += len(wire)
                     if wire:
@@ -1854,12 +1862,7 @@ class KafkaServer:
                             len(wire),
                         )
                     aborted = None
-                    if read_committed and pairs:
-                        fetch_end = (
-                            pairs[-1][0]
-                            + pairs[-1][1].header.last_offset_delta
-                            + 1
-                        )
+                    if read_committed and fetch_end is not None:
                         aborted = [
                             Msg(producer_id=pid, first_offset=first)
                             for pid, first in partition.aborted_in(
@@ -1895,6 +1898,8 @@ class KafkaServer:
                 break
             await asyncio.sleep(min(0.005, deadline - now))
 
+        if fetch_verify_enabled():
+            self._verify_fetch_response(responses)
         if session is not None:
             responses = self._finish_session_fetch(
                 session, responses, incremental
@@ -1923,6 +1928,63 @@ class KafkaServer:
             session_id=session.id if session is not None else 0,
             responses=responses,
         )
+
+    def _verify_fetch_response(self, responses) -> None:
+        """Device-batched CRC verify-on-read (RP_FETCH_VERIFY=1).
+
+        Stages every span of every partition row in this fetch response
+        into ONE row_bucket-padded ops/crc32c dispatch (the Kafka body
+        CRC covers attributes onward, so the base-offset patch never
+        invalidates it). A mismatching row — a span corrupted on disk
+        below append-time verification — is replaced with a retriable
+        KAFKA_STORAGE_ERROR and the owning log's wire plane is dropped
+        so the client's retry re-reads from disk instead of re-serving
+        the cached corrupt copy."""
+        import numpy as np
+
+        payloads: list[bytes] = []
+        expected: list[int] = []
+        rows: list[tuple] = []  # (row Msg, topic, start index, count)
+        for t in responses:
+            for p in t.partitions:
+                if not p.records:
+                    continue
+                bufs, crcs = wire_crc_payloads(p.records)
+                if not bufs:
+                    continue
+                rows.append((p, t.topic, len(payloads), len(bufs)))
+                payloads.extend(bufs)
+                expected.extend(crcs)
+        if not payloads:
+            return
+        from ..ops.crc32c import crc32c_batch_device
+
+        stride = max(len(b) for b in payloads)
+        mat = np.zeros((len(payloads), stride), dtype=np.uint8)
+        lens = np.zeros(len(payloads), dtype=np.int64)
+        for i, b in enumerate(payloads):
+            mat[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+            lens[i] = len(b)
+        got = crc32c_batch_device(mat, lens)
+        for p, topic, start, n in rows:
+            ok = all(
+                int(got[start + i]) == expected[start + i] for i in range(n)
+            )
+            if ok:
+                continue
+            logger.warning(
+                "fetch verify: CRC mismatch in %s/%s — answering "
+                "retriable storage error",
+                topic,
+                p.partition_index,
+            )
+            p.error_code = int(ErrorCode.kafka_storage_error)
+            p.records = None
+            part = self.broker.partition_manager.get(
+                kafka_ntp(topic, p.partition_index)
+            )
+            if part is not None:
+                part.log.drop_wire_cache()
 
     @staticmethod
     def _finish_session_fetch(session, responses, incremental):
@@ -2063,3 +2125,61 @@ def _frame_kafka(batch: RecordBatch, kafka_base: int) -> bytes:
         return batch.to_kafka_wire()
     hdr = dataclasses.replace(batch.header, base_offset=kafka_base)
     return RecordBatch(hdr, batch.body).to_kafka_wire()
+
+
+def fetch_wire_enabled() -> bool:
+    """Zero-copy fetch plane gate. RP_FETCH_WIRE=0 stands down to the
+    decoded read_kafka + _frame_kafka path, byte-for-byte the pre-wire
+    behavior (checked per call, same idiom as file_sanitizer.enabled)."""
+    return os.environ.get("RP_FETCH_WIRE", "1") != "0"
+
+
+def fetch_verify_enabled() -> bool:
+    """RP_FETCH_VERIFY=1 opt-in: device-batched CRC verify-on-read,
+    one ops/crc32c dispatch per fetch response. Stand-down (default)
+    is the trust-append-time behavior."""
+    return os.environ.get("RP_FETCH_VERIFY", "0") == "1"
+
+
+def read_fetch_rows(
+    partition, fetch_offset: int, max_bytes: int, upto_kafka: int | None
+) -> tuple[bytes, int | None]:
+    """One partition's fetch records as (concatenated wire, fetch_end).
+
+    The shared serving seam for the local-leader read_all path and the
+    shard-router fetch relay. Wire plane (default): WireSpan rows out
+    of Partition.read_kafka_wire, framed by patching the translated
+    base offset into the first 8 bytes of each span — no RecordBatch
+    is constructed. RP_FETCH_WIRE=0: the decoded path, unchanged.
+    fetch_end is the exclusive kafka end offset of the last row (None
+    when empty) — the aborted-transaction window bound."""
+    if fetch_wire_enabled():
+        rows = partition.read_kafka_wire(
+            fetch_offset, max_bytes=max_bytes, upto_kafka=upto_kafka
+        )
+        if not rows:
+            return b"", None
+        # single-allocation concat: copy each cached span once into the
+        # response buffer and stamp the translated base in place — the
+        # whole fetch body is ONE copy of the cached bytes (the protocol
+        # writer appends buffers without normalizing, so no re-copy)
+        total = 0
+        for _kbase, row in rows:
+            total += len(row.wire)
+        out = bytearray(total)
+        at = 0
+        for kbase, row in rows:
+            w = row.wire
+            out[at : at + len(w)] = w
+            if kbase != row.base_offset:
+                pack_wire_base(out, at, kbase)
+            at += len(w)
+        last_kbase, last = rows[-1]
+        return out, last_kbase + (last.last_offset - last.base_offset) + 1
+    pairs = partition.read_kafka(
+        fetch_offset, max_bytes=max_bytes, upto_kafka=upto_kafka
+    )
+    if not pairs:
+        return b"", None
+    wire = b"".join(_frame_kafka(batch, kbase) for kbase, batch in pairs)
+    return wire, pairs[-1][0] + pairs[-1][1].header.last_offset_delta + 1

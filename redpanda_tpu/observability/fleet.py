@@ -47,6 +47,7 @@ from ..utils.serde import (
     u64,
     vector,
 )
+from . import health
 
 # SampleFamily.kind
 KIND_COUNTER = 0
@@ -149,6 +150,8 @@ class HealthSnapshot(Envelope):
         ("top_laggy", vector(envelope(LaggyRow))),
         ("top_hot", vector(envelope(HotRow))),
         ("lag_hist", vector(u64)),
+        # read-path cache counters in health.READ_PATH_KEYS order
+        ("read_path", vector(u64)),
     ]
 
 
@@ -187,6 +190,10 @@ def health_to_envelope(rep: dict, shard: int, node: int = -1) -> HealthSnapshot:
             for r in rep.get("top_hot", [])
         ],
         lag_hist=[int(c) for c in rep.get("lag_histogram", [])],
+        read_path=[
+            int((rep.get("read_path") or {}).get(k, 0))
+            for k in health.READ_PATH_KEYS
+        ],
     )
 
 
@@ -230,6 +237,7 @@ def envelope_to_health(snap: HealthSnapshot) -> dict:
             for r in snap.top_hot
         ],
         "lag_histogram": list(snap.lag_hist),
+        "read_path": dict(zip(health.READ_PATH_KEYS, snap.read_path)),
     }
 
 

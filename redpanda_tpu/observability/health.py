@@ -51,6 +51,16 @@ def lag_histogram(lags: np.ndarray) -> list[int]:
     return counts
 
 
+READ_PATH_KEYS = (
+    "cache_hits",
+    "cache_misses",
+    "wire_hits",
+    "wire_misses",
+    "reader_hits",
+    "reader_misses",
+)
+
+
 def empty_report() -> dict:
     return {
         "active": 0,
@@ -67,15 +77,39 @@ def empty_report() -> dict:
         "top_laggy": [],
         "top_hot": [],
         "lag_histogram": [0] * LAG_BUCKETS,
+        "read_path": dict.fromkeys(READ_PATH_KEYS, 0),
     }
 
 
-def build_report(group_manager, ledger, top_k: int = 10) -> dict:
-    """One shard's full health report: raft lanes + load ledger."""
+def read_path_stats(storage) -> dict:
+    """Fetch/read-plane counters off a StorageApi: batch-cache planes
+    (decoded + wire) and the positioned-reader hint hits summed over
+    the shard's logs. Mirrors the probe's storage_read gauge family in
+    report form so the fleet merge can sum them."""
+    cache = storage.cache
+    reader_hits = reader_misses = 0
+    for log in storage.log_mgr.logs().values():
+        reader_hits += log.reader_hits
+        reader_misses += log.reader_misses
+    return {
+        "cache_hits": cache.hits,
+        "cache_misses": cache.misses,
+        "wire_hits": cache.wire_hits,
+        "wire_misses": cache.wire_misses,
+        "reader_hits": reader_hits,
+        "reader_misses": reader_misses,
+    }
+
+
+def build_report(group_manager, ledger, top_k: int = 10, storage=None) -> dict:
+    """One shard's full health report: raft lanes + load ledger, plus
+    the read-path cache counters when the caller hands its StorageApi."""
     rep = group_manager.health_report(top_k=top_k)
     rep["top_hot"] = ledger.top(top_k)
     rep["skew"] = ledger.skew()
     rep["rates"] = ledger.totals()
+    if storage is not None:
+        rep["read_path"] = read_path_stats(storage)
     return rep
 
 
@@ -107,6 +141,9 @@ def merge_reports(reports: list[dict], top_k: int = 10) -> dict:
             out["lag_histogram"] = [
                 a + b for a, b in zip(out["lag_histogram"], hist)
             ]
+        rp = rep.get("read_path") or {}
+        for k in READ_PATH_KEYS:
+            out["read_path"][k] += rp.get(k, 0)
     laggy.sort(key=lambda r: r.get("lag", 0), reverse=True)
     hot.sort(key=lambda r: r.get("total_bps", 0.0), reverse=True)
     out["top_laggy"] = laggy[:top_k]

@@ -572,7 +572,9 @@ class PartitionShard:
             from ..observability import health as _health
 
             rep = _health.build_report(
-                self.group_manager, self.group_manager.probe.ledger
+                self.group_manager,
+                self.group_manager.probe.ledger,
+                storage=self.storage,
             )
             return fleet.health_to_envelope(
                 rep, self.ctx.shard_id, self._config.node_id
@@ -710,7 +712,7 @@ class PartitionShard:
 
     def _fetch(self, req: ShardFetchRequest) -> bytes:
         from ..kafka.protocol.headers import ErrorCode
-        from ..kafka.server import _frame_kafka
+        from ..kafka.server import read_fetch_rows
 
         self.fetch_reqs += 1
         partition = self.partition_manager.get(
@@ -735,12 +737,14 @@ class PartitionShard:
                 log_start=start,
                 records=b"",
             ).encode()
-        pairs = partition.read_kafka(
+        # wire-plane serving seam shared with read_all (RP_FETCH_WIRE
+        # gated inside): the relay ships patched spans, never decodes
+        wire, _fetch_end = read_fetch_rows(
+            partition,
             req.offset,
             max_bytes=req.max_bytes,
             upto_kafka=lso if req.read_committed else None,
         )
-        wire = b"".join(_frame_kafka(b, kb) for kb, b in pairs)
         self.fetch_bytes += len(wire)
         if wire:
             self.group_manager.probe.ledger.note_fetch(

@@ -13,7 +13,7 @@ from __future__ import annotations
 import os
 import time
 
-from ..models.record import RecordBatch
+from ..models.record import RecordBatch, WireSpan, span_to_wire
 from . import dirsync, file_sanitizer
 from .batch_cache import BatchCache, BatchCacheIndex
 from .segment import Segment
@@ -446,6 +446,94 @@ class Log:
                     self._reader_hints.popitem(last=False)
                 return batches[0]
         return None
+
+    # -- zero-copy wire read (kafka fetch plane) ---------------------
+    def read_wire(
+        self, start_offset: int, max_bytes: int = 1 << 20, upto: int | None = None
+    ) -> list[WireSpan]:
+        """WireSpan rows intersecting [start_offset, upto] — the
+        fetch-path twin of read(): served from the wire plane of the
+        batch cache when possible, else one raw span scan per segment
+        window (Segment.read_spans) converted to Kafka wire form ONCE
+        and cached. No RecordBatch objects anywhere on this path; the
+        byte budget is accounted in internal span sizes so the row set
+        matches read()'s batch set exactly."""
+        offs = self.offsets()
+        end = offs.dirty_offset if upto is None else min(upto, offs.dirty_offset)
+        if start_offset > end:
+            return []
+        out: list[WireSpan] = []
+        consumed = 0
+        pos = start_offset
+        while pos <= end and consumed < max_bytes:
+            row = None
+            if self._cache_index is not None:
+                row = self._cache_index.get_wire(pos)
+            if row is None:
+                row = self._wire_from_decoded_cache(pos)
+            if row is None:
+                row = self._wire_from_disk(pos)
+            if row is None:
+                break
+            out.append(row)
+            consumed += row.size_bytes()
+            pos = row.last_offset + 1
+        return out
+
+    def _wire_from_decoded_cache(self, offset: int) -> WireSpan | None:
+        """Convert a decoded-plane hit (hot tail: the append path puts
+        RecordBatch objects) into a wire row without touching disk; the
+        conversion is paid once and lands in the wire plane."""
+        if self._cache_index is None:
+            return None
+        batch = self._cache_index.get(offset)
+        if batch is None:
+            return None
+        h = batch.header
+        row = WireSpan(
+            h.base_offset, h.last_offset, int(h.type), batch.to_kafka_wire()
+        )
+        self._cache_index.put_wire(row)
+        return row
+
+    def _wire_from_disk(self, offset: int) -> WireSpan | None:
+        for seg in reversed(self._segments):
+            if offset >= seg.base_offset:
+                if offset > seg.dirty_offset:
+                    return None
+                pos = None
+                hint = self._reader_hints.pop(offset, None)
+                if hint is not None and hint[0] is seg:
+                    pos = hint[1]
+                    self.reader_hits += 1
+                else:
+                    self.reader_misses += 1
+                spans = seg.read_spans(offset, max_bytes=1 << 20, pos=pos)
+                if not spans:
+                    return None
+                first: WireSpan | None = None
+                for _hdr_view, span, end in spans:
+                    row = span_to_wire(span)
+                    if first is None:
+                        first = row
+                    if self._cache_index is not None:
+                        # whole read-ahead window, same rationale as
+                        # _read_from_disk: the next poll asks for the
+                        # following offset and must hit memory
+                        self._cache_index.put_wire(row)
+                    self._reader_hints[row.last_offset + 1] = (seg, end)
+                while len(self._reader_hints) > 1024:
+                    self._reader_hints.popitem(last=False)
+                return first
+        return None
+
+    def drop_wire_cache(self) -> None:
+        """Evict this log's wire plane + positioned readers (verify-on-
+        read CRC mismatch: don't keep serving a possibly-corrupt cached
+        span; the retrying fetch re-reads and re-converts from disk)."""
+        if self._cache_index is not None:
+            self._cache_index.drop_wire()
+        self.invalidate_readers()
 
     def timequery(self, ts: int) -> int | None:
         log_start = self.offsets().start_offset

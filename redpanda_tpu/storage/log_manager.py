@@ -111,6 +111,7 @@ class StorageApi:
         self.log_mgr = LogManager(
             os.path.join(data_dir, "data"), self.cache, probe=self.probe
         )
+        self.probe.register_read_metrics(self.cache, self.log_mgr)
 
     def close(self) -> None:
         self.log_mgr.close()

@@ -39,6 +39,36 @@ class StorageProbe:
         self.observe_append = self.segment_append_hist.observe
         self.observe_flush_wait = self.flush_wait_hist.observe
 
+    def register_read_metrics(self, cache, log_mgr) -> None:
+        """Export the read-path counters as the `storage_read` family.
+
+        Registered as one labelled gauge over live counters (no hot-path
+        instrumentation cost) so they ride everything the registry rides:
+        `/metrics`, the fleet snapshot merge, and the flightdata history
+        ring. `cache` is the shard's BatchCache, `log_mgr` the LogManager
+        whose logs carry the positioned-reader counters."""
+
+        def _read_stats():
+            reader_hits = reader_misses = 0
+            for log in log_mgr.logs().values():
+                reader_hits += log.reader_hits
+                reader_misses += log.reader_misses
+            return [
+                ({"counter": "cache_hits"}, cache.hits),
+                ({"counter": "cache_misses"}, cache.misses),
+                ({"counter": "wire_cache_hits"}, cache.wire_hits),
+                ({"counter": "wire_cache_misses"}, cache.wire_misses),
+                ({"counter": "reader_hits"}, reader_hits),
+                ({"counter": "reader_misses"}, reader_misses),
+                ({"counter": "cache_bytes"}, cache.size_bytes),
+            ]
+
+        self.registry.gauge(
+            "storage_read",
+            _read_stats,
+            "Read-path cache and positioned-reader counters",
+        )
+
 
 _fixture_probe: Optional[StorageProbe] = None
 

@@ -22,11 +22,23 @@ import bisect
 import os
 import struct
 
-from ..models.record import HEADER_SIZE, RecordBatch, RecordBatchHeader
+from ..models.record import (
+    HEADER_SIZE,
+    RecordBatch,
+    RecordBatchHeader,
+    peek_base_offset,
+    peek_last_offset,
+    peek_size_bytes,
+)
 from ..utils.crc import crc32c
 from . import dirsync, file_sanitizer, iofaults
 
 INDEX_INTERVAL_BYTES = 32 * 1024
+
+# read_spans window slack beyond the caller's max_bytes: covers the
+# partial batch straddling the budget boundary in the common case, so
+# a 1 MiB fetch window stays ONE os.pread
+_SPAN_SLACK = 128 * 1024
 
 _IDX_MAGIC = 0x58444E49  # "INDX"
 _IDX_HDR = struct.Struct("<II")
@@ -341,13 +353,76 @@ class Segment:
             consumed += header.size_bytes
         return out, ends
 
+    def read_spans(
+        self,
+        start_offset: int,
+        max_bytes: int = 1 << 20,
+        pos: int | None = None,
+    ) -> list[tuple]:
+        """Raw batch spans intersecting [start_offset, dirty] as
+        (header_view, span, end_pos) rows — the zero-copy twin of
+        read_batches_pos: ONE os.pread covers the whole window and the
+        header walk is memoryview slices + fixed-offset peeks; no
+        RecordBatch objects, no per-batch syscall pair. An oversized
+        batch (or a window that outgrows the slack) re-preads from the
+        current batch boundary, so the syscall count stays O(window /
+        (max_bytes + slack)), not O(batches)."""
+        if self._file is not None:
+            self._file.flush()
+        fd = self._read_fd()
+        if pos is None:
+            pos = self.lower_bound_pos(start_offset)
+        rows: list[tuple] = []
+        consumed = 0
+
+        def window(at: int, want: int) -> bytes:
+            # cap the allocation at the tracked file size: a corrupt
+            # size_bytes must not translate into a GB-sized buffer
+            return os.pread(fd, min(want, max(self._size - at, 0)), at)
+
+        win_pos = pos
+        win = window(win_pos, max_bytes + _SPAN_SLACK)
+        mv = memoryview(win)
+        while consumed < max_bytes:
+            rel = pos - win_pos
+            size = (
+                peek_size_bytes(win, rel)
+                if rel + HEADER_SIZE <= len(win)
+                else None
+            )
+            if size is not None and size < HEADER_SIZE:
+                break  # corrupt length: stop like read_batches_pos
+            if size is None or rel + size > len(win):
+                # batch straddles the window end: slide to its boundary
+                # (one follow-up pread; EOF shows up as a short read)
+                win_pos = pos
+                win = window(
+                    win_pos,
+                    max(max_bytes - consumed + _SPAN_SLACK, size or 0),
+                )
+                mv = memoryview(win)
+                rel = 0
+                if rel + HEADER_SIZE > len(win):
+                    break
+                size = peek_size_bytes(win, rel)
+                if size < HEADER_SIZE or rel + size > len(win):
+                    break
+            pos += size
+            if peek_last_offset(win, rel) < start_offset:
+                continue
+            rows.append(
+                (mv[rel : rel + HEADER_SIZE], mv[rel : rel + size], pos)
+            )
+            consumed += size
+        return rows
+
     def timequery(self, ts: int) -> int | None:
         """First indexed offset with timestamp >= ts (sparse — callers
-        scan forward from it)."""
-        for off, t in zip(self._idx_offsets, self._idx_timestamps):
-            if t >= ts:
-                return off
-        return None
+        scan forward from it). Timestamps are non-decreasing in append
+        order, so this is a bisect over the parallel timestamp array,
+        not a linear scan."""
+        i = bisect.bisect_left(self._idx_timestamps, ts)
+        return self._idx_offsets[i] if i < len(self._idx_offsets) else None
 
     # -- truncation --------------------------------------------------
     def truncate(self, offset: int) -> None:
@@ -355,18 +430,32 @@ class Segment:
         by raft on log-matching conflicts)."""
         if self._file is not None:
             self._file.flush()
-        keep_end = 0
-        new_dirty = self.base_offset - 1
-        with open(self._path, "rb") as f:
-            data = f.read()
-        pos = 0
-        while pos + HEADER_SIZE <= len(data):
-            header = RecordBatchHeader.unpack(data[pos : pos + HEADER_SIZE])
-            if header.base_offset >= offset:
-                break
-            pos += header.size_bytes
-            keep_end = pos
-            new_dirty = header.last_offset
+        # seek to the last indexed batch strictly below the cut and
+        # scan forward from there — a 128 MB segment truncated near its
+        # tail touches ~32 KiB of header peeks, not the whole file
+        i = bisect.bisect_left(self._idx_offsets, offset) - 1
+        pos = self._idx_positions[i] if i >= 0 else 0
+        keep_end = pos
+        new_dirty = (
+            self._idx_offsets[i] - 1 if i >= 0 else self.base_offset - 1
+        )
+        fd = os.open(self._path, os.O_RDONLY)
+        try:
+            size = os.path.getsize(self._path)
+            while pos + HEADER_SIZE <= size:
+                hdr = os.pread(fd, HEADER_SIZE, pos)
+                if len(hdr) < HEADER_SIZE:
+                    break
+                if peek_base_offset(hdr) >= offset:
+                    break
+                bsize = peek_size_bytes(hdr)
+                if bsize < HEADER_SIZE:
+                    break  # corrupt length: keep what scanned clean
+                pos += bsize
+                keep_end = pos
+                new_dirty = peek_last_offset(hdr)
+        finally:
+            os.close(fd)
         if self._file is not None:
             self._file.close()
             self._file = None  # lazily reopened via _wfile()

@@ -2356,3 +2356,115 @@ def test_rpl022_baseline_is_empty():
     born scanner-shaped in the same PR that added the rule."""
     baseline = load_baseline()
     assert [k for k in baseline if k.endswith("::RPL022")] == []
+
+
+# -- RPL023: fetch discipline -------------------------------------------
+
+RPL023_BAD = """\
+import struct
+
+
+def read_fetch_rows(partition, fetch_offset, max_bytes, upto_kafka):
+    spans = partition.log.read_wire(fetch_offset)
+    out = []
+    for span in spans:
+        batch = RecordBatch.deserialize(bytes(span.wire))
+        hdr = RecordBatchHeader(base_offset=batch.header.base_offset)
+        (size,) = struct.unpack("<I", span.wire[:4])
+        out.append(batch)
+    return out
+"""
+
+
+def test_rpl023_decode_on_span_walk_fully_flagged(tmp_path):
+    found = _only(
+        _lint_source(tmp_path, RPL023_BAD, "kafka/server.py"), "RPL023"
+    )
+    msgs = [f.message for f in found]
+    assert any(".deserialize()" in m for m in msgs)
+    assert any("RecordBatchHeader(...)" in m for m in msgs)
+    assert any(".unpack()" in m for m in msgs)
+    assert len(found) == 3
+
+
+def test_rpl023_peek_walk_clean(tmp_path):
+    src = """
+        def read_fetch_rows(partition, fetch_offset, max_bytes, upto_kafka):
+            rows = partition.read_kafka_wire(fetch_offset, max_bytes=max_bytes)
+            total = 0
+            for _kbase, row in rows:
+                total += len(row.wire)
+            out = bytearray(total)
+            at = 0
+            for kbase, row in rows:
+                out[at : at + len(row.wire)] = row.wire
+                if kbase != row.base_offset:
+                    pack_wire_base(out, at, kbase)  # blessed seam
+                at += len(row.wire)
+            return out
+    """
+    assert (
+        _only(_lint_source(tmp_path, src, "kafka/server.py"), "RPL023")
+        == []
+    )
+
+
+def test_rpl023_standdown_branch_out_of_scope(tmp_path):
+    # the RP_FETCH_WIRE=0 stand-down decodes via partition.read_kafka —
+    # a plain call, deliberately unflagged (stand-down is ALLOWED to
+    # decode); only direct decode machinery inside the span walk trips
+    src = """
+        def read_fetch_rows(partition, fetch_offset, max_bytes, upto_kafka):
+            pairs = partition.read_kafka(fetch_offset, max_bytes=max_bytes)
+            return b"".join(_frame_kafka(b, k) for k, b in pairs)
+    """
+    assert (
+        _only(_lint_source(tmp_path, src, "kafka/server.py"), "RPL023")
+        == []
+    )
+
+
+def test_rpl023_other_functions_out_of_scope(tmp_path):
+    # handle_produce decodes batches — that is the WRITE path, where
+    # decode is the contract; only the span-walk functions are scoped
+    src = RPL023_BAD.replace("def read_fetch_rows", "def handle_produce")
+    assert (
+        _only(_lint_source(tmp_path, src, "kafka/server.py"), "RPL023")
+        == []
+    )
+
+
+def test_rpl023_scope_follows_file(tmp_path):
+    # read_kafka_wire is scoped in cluster/partition.py but the record
+    # seam itself (models/record.py) and unrelated files stay free
+    bad = RPL023_BAD.replace("def read_fetch_rows", "def read_kafka_wire")
+    found = _only(
+        _lint_source(tmp_path, bad, "cluster/partition.py"), "RPL023"
+    )
+    assert len(found) == 3
+    for rel in ("models/record.py", "raft/consensus.py"):
+        assert _only(_lint_source(tmp_path, bad, rel), "RPL023") == []
+
+
+def test_rpl023_suppression(tmp_path):
+    src = RPL023_BAD.replace(
+        "batch = RecordBatch.deserialize(bytes(span.wire))",
+        "batch = RecordBatch.deserialize(bytes(span.wire))  # rplint: disable=RPL023",
+    ).replace(
+        "hdr = RecordBatchHeader(base_offset=batch.header.base_offset)",
+        "hdr = RecordBatchHeader(base_offset=batch.header.base_offset)  # rplint: disable=RPL023",
+    ).replace(
+        '(size,) = struct.unpack("<I", span.wire[:4])',
+        '(size,) = struct.unpack("<I", span.wire[:4])  # rplint: disable=RPL023',
+    )
+    assert (
+        _only(_lint_source(tmp_path, src, "kafka/server.py"), "RPL023")
+        == []
+    )
+
+
+def test_rpl023_baseline_is_empty():
+    """Fetch discipline holds by construction: the span walk was born
+    decode-free in the same PR that added the rule."""
+    baseline = load_baseline()
+    assert [k for k in baseline if k.endswith("::RPL023")] == []

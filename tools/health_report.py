@@ -90,6 +90,21 @@ def render_report(rep: dict, out=None) -> None:
     p(f"  ntp skew            {_skew_bar(rep.get('skew', 1.0))}")
     if "shard_skew" in rep:
         p(f"  shard skew          {_skew_bar(rep.get('shard_skew', 1.0))}")
+    rp = rep.get("read_path") or {}
+    if rp:
+        # fetch-plane cache effectiveness: wire-plane hits serve with
+        # zero decode/re-encode; decoded hits pay one conversion; a
+        # reader hit resumes a positioned segment scan mid-file
+        def _ratio(hits, misses):
+            total = hits + misses
+            return f"{hits / total * 100:5.1f}%" if total else "    -"
+
+        p(
+            "  fetch cache         "
+            f"wire {_ratio(rp.get('wire_hits', 0), rp.get('wire_misses', 0))}"
+            f"  decoded {_ratio(rp.get('cache_hits', 0), rp.get('cache_misses', 0))}"
+            f"  readers {_ratio(rp.get('reader_hits', 0), rp.get('reader_misses', 0))}"
+        )
 
     laggy = rep.get("top_laggy") or []
     if laggy:

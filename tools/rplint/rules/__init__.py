@@ -24,6 +24,7 @@ from .rpl019_codec_discipline import CodecDisciplineRule
 from .rpl020_compile_discipline import CompileDisciplineRule
 from .rpl021_donation_layout import DonationLayoutRule
 from .rpl022_frontend_discipline import FrontendDisciplineRule
+from .rpl023_fetch_discipline import FetchDisciplineRule
 
 ALL_RULES = [
     SameLaneTouchRule,
@@ -48,6 +49,7 @@ ALL_RULES = [
     CompileDisciplineRule,
     DonationLayoutRule,
     FrontendDisciplineRule,
+    FetchDisciplineRule,
 ]
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,148 @@
+"""RPL023 — fetch discipline: the kafka fetch hot path stays on the
+wire plane; no batch decode or re-encode between segment bytes and
+the response buffer.
+
+The zero-copy fetch PR made the read side a span pipeline: segment
+pread windows (`Segment.read_spans`) → wire-form cache rows
+(`Log.read_wire` / `_wire_from_disk`) → kafka translation by
+patching the 8-byte base offset in place
+(`Partition.read_kafka_wire`) → one concatenated records buffer per
+partition (`read_fetch_rows`). The whole win is that NO RecordBatch
+object exists on this path — header fields needed for translation
+(size, base offset, batch type, last offset) are peeked with the
+blessed `peek_*` helpers in `models/record.py`, and integrity is
+checked batch-wise on the encoded bytes (`_verify_fetch_response` →
+one crc32c device dispatch per response). A single
+`RecordBatch.deserialize` or `RecordBatchHeader.unpack` creeping
+back into these functions silently reverts the plane to
+decode+re-encode: three full byte copies per fetched megabyte plus
+per-batch Python attribute traffic, and the A/B regression only
+shows under hot-tail replay load.
+
+Flagged inside the span-walk functions — `read_fetch_rows` and
+`_verify_fetch_response` in files ending `kafka/server.py`,
+`read_kafka_wire` in `cluster/partition.py`, `read_wire` and
+`_wire_from_disk` in `storage/log.py`, `read_spans` in
+`storage/segment.py`:
+
+  * constructing `RecordBatch(...)` or `RecordBatchHeader(...)` —
+    decoded objects have no business on the wire plane
+  * any `.deserialize(...)` call — full batch decode
+  * any `.unpack(...)` / `.unpack_from(...)` call — ad-hoc header
+    struct math; field peeks go through the `peek_*` /
+    `pack_wire_base` seam in `models/record.py` (which is out of
+    scope — it IS the seam)
+
+The decoded stand-down branch (`RP_FETCH_WIRE=0`) calls
+`partition.read_kafka` + `_frame_kafka`, which are plain calls and
+deliberately unflagged: stand-down is allowed to decode, that is
+its job.
+
+Suppress a deliberate exception with `# rplint: disable=RPL023`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ModuleContext
+
+EXAMPLE = """\
+# in redpanda_tpu/kafka/server.py, inside read_fetch_rows
+batch = RecordBatch.deserialize(bytes(span))    # RPL023: decode on wire plane
+hdr = RecordBatchHeader.unpack(span[:69])       # RPL023: ad-hoc header math
+# instead:
+size = peek_size_bytes(span)                    # blessed peek seam
+pack_wire_base(out, at, kbase)                  # in-place base patch
+"""
+
+# file suffix -> span-walk function names held to the wire plane
+_SCOPE: dict[str, frozenset[str]] = {
+    "kafka/server.py": frozenset(
+        {"read_fetch_rows", "_verify_fetch_response"}
+    ),
+    "cluster/partition.py": frozenset({"read_kafka_wire"}),
+    "storage/log.py": frozenset({"read_wire", "_wire_from_disk"}),
+    "storage/segment.py": frozenset({"read_spans"}),
+}
+
+_DECODED_CTORS = ("RecordBatch", "RecordBatchHeader")
+
+
+class FetchDisciplineRule:
+    code = "RPL023"
+    name = "fetch-discipline"
+
+    def _scoped_funcs(self, path: str) -> frozenset[str] | None:
+        norm = path.replace("\\", "/")
+        for suffix, names in _SCOPE.items():
+            if norm.endswith(suffix):
+                return names
+        return None
+
+    def check(self, ctx: ModuleContext):
+        names = self._scoped_funcs(ctx.path)
+        if names is None:
+            return
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, (ast.AsyncFunctionDef, ast.FunctionDef))
+                and node.name in names
+            ):
+                yield from self._check_fn(ctx, node)
+
+    def _check_fn(self, ctx: ModuleContext, fn: ast.AST):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Name)
+                and func.id in _DECODED_CTORS
+            ):
+                if ctx.suppressed(node, self.code):
+                    continue
+                yield Finding(
+                    path=ctx.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    rule=self.code,
+                    message=(
+                        f"{func.id}(...) on the fetch span walk — the "
+                        "wire plane never materializes decoded batch "
+                        "objects; peek header fields via the peek_* "
+                        "seam in models/record.py"
+                    ),
+                )
+            elif isinstance(func, ast.Attribute):
+                attr = func.attr
+                if attr == "deserialize":
+                    if ctx.suppressed(node, self.code):
+                        continue
+                    yield Finding(
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.code,
+                        message=(
+                            ".deserialize() on the fetch span walk — "
+                            "full batch decode reverts the zero-copy "
+                            "plane to decode+re-encode (three copies "
+                            "per fetched MB); stay on encoded spans"
+                        ),
+                    )
+                elif attr in ("unpack", "unpack_from"):
+                    if ctx.suppressed(node, self.code):
+                        continue
+                    yield Finding(
+                        path=ctx.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        rule=self.code,
+                        message=(
+                            f".{attr}() on the fetch span walk — ad-hoc "
+                            "header struct math forks the on-disk "
+                            "layout; field peeks go through peek_* / "
+                            "pack_wire_base in models/record.py"
+                        ),
+                    )

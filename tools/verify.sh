@@ -21,6 +21,9 @@ python -m tools.rplint --rules RPL020,RPL021 redpanda_tpu
 echo "== rplint transfer discipline (RPL018 whole-program incl. tests, empty by construction) =="
 python -m tools.rplint --rules RPL018 redpanda_tpu tools tests
 
+echo "== rplint fetch discipline (RPL023 span walk, empty by construction) =="
+python -m tools.rplint --rules RPL023 redpanda_tpu tools
+
 echo "== native build =="
 if make -s -C native; then
     echo "built native/build/libredpanda_native.so"
@@ -121,6 +124,12 @@ env JAX_PLATFORMS=cpu python tools/traffic_smoke.py
 echo "== front-end fallback smoke (RP_NATIVE_FRAME=0 pure-Python framing) =="
 env JAX_PLATFORMS=cpu RP_NATIVE_FRAME=0 python tools/traffic_smoke.py \
     --clients 200 --rounds 2
+
+echo "== consume smoke (2-broker wire plane: parity + verify-on-read + counters) =="
+env JAX_PLATFORMS=cpu python tools/consume_smoke.py
+
+echo "== consume stand-down smoke (RP_FETCH_WIRE=0 decoded framing) =="
+env JAX_PLATFORMS=cpu RP_FETCH_WIRE=0 python tools/consume_smoke.py
 
 echo "== tracing-off smoke (RP_TRACE=0) =="
 env JAX_PLATFORMS=cpu RP_TRACE=0 python tools/scrape_smoke.py --fleet
